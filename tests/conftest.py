@@ -1,0 +1,26 @@
+"""Test env: force CPU JAX with 8 virtual devices (SURVEY.md §4).
+
+Must run before any `import jax` — pytest imports conftest first. The 8
+virtual devices stand in for a TPU slice so every sharding / collective path
+(the DDP + mapper/reducer replacements) is exercised in CI without hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The axon sitecustomize registers the TPU backend at interpreter startup and
+# force-sets jax_platforms="axon,cpu"; backends initialize lazily, so pinning
+# the config here (before any device access) reliably lands tests on CPU.
+jax.config.update("jax_platforms", "cpu")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,199 @@
+"""Numpy oracle implementations mirroring the reference's native-library ops.
+
+torchvision is not installed in this image, so these are direct ports of the
+torchvision CUDA/C++ kernel semantics the reference relies on
+(roi_align, nms) plus reference-faithful ports of its Python numerics.
+Used only by tests.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def bilinear_interpolate_np(feat: np.ndarray, y: float, x: float) -> np.ndarray:
+    """torchvision bilinear_interpolate: feat (C, H, W) -> (C,)."""
+    C, H, W = feat.shape
+    if y < -1.0 or y > H or x < -1.0 or x > W:
+        return np.zeros(C, feat.dtype)
+    y = max(y, 0.0)
+    x = max(x, 0.0)
+    y_low = int(y)
+    x_low = int(x)
+    if y_low >= H - 1:
+        y_high = y_low = H - 1
+        y = float(y_low)
+    else:
+        y_high = y_low + 1
+    if x_low >= W - 1:
+        x_high = x_low = W - 1
+        x = float(x_low)
+    else:
+        x_high = x_low + 1
+    ly = y - y_low
+    lx = x - x_low
+    hy = 1.0 - ly
+    hx = 1.0 - lx
+    return (
+        hy * hx * feat[:, y_low, x_low]
+        + hy * lx * feat[:, y_low, x_high]
+        + ly * hx * feat[:, y_high, x_low]
+        + ly * lx * feat[:, y_high, x_high]
+    )
+
+
+def roi_align_np(
+    feat: np.ndarray,
+    boxes: np.ndarray,
+    output_size,
+    spatial_scale: float = 1.0,
+    sampling_ratio: int = -1,
+    aligned: bool = True,
+) -> np.ndarray:
+    """torchvision.ops.roi_align port: feat (C,H,W), boxes (N,4) -> (N,C,oh,ow)."""
+    oh, ow = output_size
+    C, H, W = feat.shape
+    out = np.zeros((len(boxes), C, oh, ow), np.float64)
+    off = 0.5 if aligned else 0.0
+    for n, (x1, y1, x2, y2) in enumerate(boxes):
+        start_w = x1 * spatial_scale - off
+        start_h = y1 * spatial_scale - off
+        end_w = x2 * spatial_scale - off
+        end_h = y2 * spatial_scale - off
+        roi_w = end_w - start_w
+        roi_h = end_h - start_h
+        if not aligned:
+            roi_w = max(roi_w, 1.0)
+            roi_h = max(roi_h, 1.0)
+        bin_h = roi_h / oh
+        bin_w = roi_w / ow
+        grid_h = sampling_ratio if sampling_ratio > 0 else int(math.ceil(roi_h / oh))
+        grid_w = sampling_ratio if sampling_ratio > 0 else int(math.ceil(roi_w / ow))
+        grid_h = max(grid_h, 1)
+        grid_w = max(grid_w, 1)
+        for ph in range(oh):
+            for pw in range(ow):
+                acc = np.zeros(C, np.float64)
+                for iy in range(grid_h):
+                    yy = start_h + ph * bin_h + (iy + 0.5) * bin_h / grid_h
+                    for ix in range(grid_w):
+                        xx = start_w + pw * bin_w + (ix + 0.5) * bin_w / grid_w
+                        acc += bilinear_interpolate_np(feat.astype(np.float64), yy, xx)
+                out[n, :, ph, pw] = acc / (grid_h * grid_w)
+    return out
+
+
+def nms_np(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float) -> list:
+    """torchvision.ops.nms port — greedy by descending score, returns kept idx."""
+
+    def iou(a, b):
+        ix1 = max(a[0], b[0])
+        iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2])
+        iy2 = min(a[3], b[3])
+        inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_b = (b[2] - b[0]) * (b[3] - b[1])
+        union = area_a + area_b - inter
+        return inter / union if union > 0 else 0.0
+
+    order = np.argsort(-scores, kind="stable")
+    suppressed = np.zeros(len(boxes), bool)
+    keep = []
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        for j in order:
+            if not suppressed[j] and iou(boxes[i], boxes[j]) > iou_threshold:
+                suppressed[j] = True
+        suppressed[i] = True
+    return keep
+
+
+def template_geometry_np(exemplar, feat_h: int, feat_w: int):
+    """Reference template sizing (template_matching.py:55-73)."""
+    x1 = min(1.0, max(0.0, exemplar[0])) * feat_w
+    y1 = min(1.0, max(0.0, exemplar[1])) * feat_h
+    x2 = min(1.0, max(0.0, exemplar[2])) * feat_w
+    y2 = min(1.0, max(0.0, exemplar[3])) * feat_h
+    wt = math.ceil(x2) - math.floor(x1)
+    ht = math.ceil(y2) - math.floor(y1)
+    if wt % 2 == 0:
+        wt -= 1
+    if ht % 2 == 0:
+        ht -= 1
+    return (x1, y1, x2, y2), max(ht, 1), max(wt, 1)
+
+
+def xcorr_np(feature: np.ndarray, template: np.ndarray, squeeze: bool = False):
+    """Reference cross_correlation (template_matching.py:23-41) for one image.
+
+    feature (C, H, W), template (C, ht, wt) -> (C or 1, H, W).
+    """
+    C, H, W = feature.shape
+    _, ht, wt = template.shape
+    oh, ow = H - ht + 1, W - wt + 1
+    out = np.zeros((C, oh, ow), np.float64)
+    f = feature.astype(np.float64)
+    t = template.astype(np.float64)
+    for y in range(oh):
+        for x in range(ow):
+            out[:, y, x] = (f[:, y : y + ht, x : x + wt] * t).sum(axis=(1, 2))
+    out = out / (ht * wt + 1e-14)
+    if squeeze:
+        out = out.sum(axis=0, keepdims=True)
+    ph, pw = ht // 2, wt // 2
+    full = np.zeros((out.shape[0], H, W), np.float64)
+    full[:, ph : ph + oh, pw : pw + ow] = out
+    return full
+
+
+def giou_loss_np(pred: np.ndarray, target: np.ndarray, eps: float = 1e-13):
+    """torchvision.ops.generalized_box_iou_loss port (elementwise, xyxy)."""
+    x1, y1, x2, y2 = pred.T
+    x1g, y1g, x2g, y2g = target.T
+    xk1 = np.maximum(x1, x1g)
+    yk1 = np.maximum(y1, y1g)
+    xk2 = np.minimum(x2, x2g)
+    yk2 = np.minimum(y2, y2g)
+    inter = np.where((yk2 > yk1) & (xk2 > xk1), (xk2 - xk1) * (yk2 - yk1), 0.0)
+    union = (x2 - x1) * (y2 - y1) + (x2g - x1g) * (y2g - y1g) - inter
+    iou = inter / (union + eps)
+    xc1 = np.minimum(x1, x1g)
+    yc1 = np.minimum(y1, y1g)
+    xc2 = np.maximum(x2, x2g)
+    yc2 = np.maximum(y2, y2g)
+    area_c = (xc2 - xc1) * (yc2 - yc1)
+    return 1.0 - (iou - (area_c - union) / (area_c + eps))
+
+
+def masked_maxpool3x3_np(x: np.ndarray, kernel) -> np.ndarray:
+    """Reference custom_shape_3x3_maxpool2d (TM_utils.py:337-361): x (H, W)."""
+    H, W = x.shape
+    mask = np.asarray(kernel, bool)
+    padded = np.zeros((H + 2, W + 2), x.dtype)
+    padded[1:-1, 1:-1] = x
+    out = np.full((H, W), -np.inf, x.dtype)
+    for dy in range(3):
+        for dx in range(3):
+            if mask[dy, dx]:
+                out = np.maximum(out, padded[dy : dy + H, dx : dx + W])
+    return out
+
+
+def adaptive_kernel_np(ex_size, pred_size):
+    """Reference adaptive_kernel_generater (TM_utils.py:363-377)."""
+    needy_h, needy_w = 1.0 / pred_size[0], 1.0 / pred_size[1]
+    ex_h, ex_w = ex_size
+    if ex_h >= needy_h * 3 and ex_w >= needy_w * 3:
+        return [[1, 1, 1], [1, 1, 1], [1, 1, 1]]
+    if ex_h < needy_h * 2 and ex_w < needy_w * 2:
+        return [[0, 0, 0], [0, 1, 0], [0, 0, 0]]
+    if ex_h < needy_h * 2 and ex_w >= needy_w * 2:
+        return [[0, 1, 0], [0, 1, 0], [0, 1, 0]]
+    if ex_h >= needy_h * 2 and ex_w < needy_w * 2:
+        return [[0, 0, 0], [1, 1, 1], [0, 0, 0]]
+    return [[0, 1, 0], [1, 1, 1], [0, 1, 0]]

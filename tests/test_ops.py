@@ -1,0 +1,239 @@
+"""Unit tests for tmr_tpu.ops against reference-semantics oracles."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu import ops
+from tmr_tpu.ops.peaks import local_peaks
+from tmr_tpu.ops.xcorr import match_templates
+
+from oracles import (
+    adaptive_kernel_np,
+    giou_loss_np,
+    masked_maxpool3x3_np,
+    nms_np,
+    roi_align_np,
+    template_geometry_np,
+    xcorr_np,
+)
+
+RNG = np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------- boxes/giou
+def test_giou_loss_matches_torchvision_semantics():
+    pred = RNG.uniform(0, 1, (64, 4)).astype(np.float32)
+    pred[:, 2:] = pred[:, :2] + np.abs(pred[:, 2:]) + 1e-3
+    target = RNG.uniform(0, 1, (64, 4)).astype(np.float32)
+    target[:, 2:] = target[:, :2] + np.abs(target[:, 2:]) + 1e-3
+
+    got = np.asarray(ops.generalized_box_iou_loss(jnp.array(pred), jnp.array(target)))
+    want = giou_loss_np(pred.astype(np.float64), target.astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_box_codecs_roundtrip():
+    b = RNG.uniform(0, 1, (32, 4)).astype(np.float32)
+    b[:, 2:] += b[:, :2]  # valid xyxy
+    back = ops.cxcywh_to_xyxy(ops.xyxy_to_cxcywh(jnp.array(b)))
+    np.testing.assert_allclose(np.asarray(back), b, atol=1e-6)
+
+
+# ----------------------------------------------------------------- roi_align
+@pytest.mark.parametrize("sampling_ratio", [-1, 1, 2])
+@pytest.mark.parametrize("aligned", [True, False])
+def test_roi_align_matches_torchvision_port(sampling_ratio, aligned):
+    feat = RNG.standard_normal((3, 24, 20)).astype(np.float32)
+    boxes = np.array(
+        [
+            [2.3, 4.1, 9.7, 15.2],
+            [0.0, 0.0, 19.9, 23.9],
+            [5.5, 5.5, 6.5, 7.5],
+            [-1.0, -2.0, 4.0, 3.0],  # partially out of bounds
+        ],
+        np.float32,
+    )
+    out = ops.roi_align(
+        jnp.array(feat),
+        jnp.array(boxes),
+        (5, 5),
+        sampling_ratio=sampling_ratio,
+        aligned=aligned,
+        max_ratio=8,
+    )
+    want = roi_align_np(feat, boxes, (5, 5), sampling_ratio=sampling_ratio, aligned=aligned)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_odd_template_sizes():
+    """The template-extraction configuration: aligned=True, adaptive ratio."""
+    feat = RNG.standard_normal((2, 32, 32)).astype(np.float32)
+    for _ in range(10):
+        x1, y1 = RNG.uniform(0, 20, 2)
+        w, h = RNG.uniform(1.2, 10, 2)
+        box = np.array([[x1, y1, x1 + w, y1 + h]], np.float32)
+        (ht, wt) = (
+            max(int(np.ceil(y1 + h)) - int(np.floor(y1)) - ((int(np.ceil(y1 + h)) - int(np.floor(y1))) % 2 == 0), 1),
+            max(int(np.ceil(x1 + w)) - int(np.floor(x1)) - ((int(np.ceil(x1 + w)) - int(np.floor(x1))) % 2 == 0), 1),
+        )
+        out = ops.roi_align(jnp.array(feat), jnp.array(box), (ht, wt), aligned=True)
+        want = roi_align_np(feat, box, (ht, wt), aligned=True)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------- xcorr
+def test_extract_template_centered_in_capacity():
+    feat = RNG.standard_normal((4, 16, 16)).astype(np.float32)
+    exemplar = np.array([0.2, 0.3, 0.55, 0.62], np.float32)
+    cap = 9
+
+    tmpl, thw = ops.extract_template(jnp.array(feat), jnp.array(exemplar), cap)
+    (x1, y1, x2, y2), ht, wt = template_geometry_np(exemplar, 16, 16)
+    want_core = roi_align_np(feat, np.array([[x1, y1, x2, y2]]), (ht, wt))[0]
+
+    assert tuple(np.asarray(thw)) == (ht, wt)
+    oy, ox = (cap - ht) // 2, (cap - wt) // 2
+    got = np.asarray(tmpl)
+    np.testing.assert_allclose(got[:, oy : oy + ht, ox : ox + wt], want_core, rtol=1e-4, atol=1e-5)
+    # everything outside the centered window must be exactly zero
+    mask = np.ones((cap, cap), bool)
+    mask[oy : oy + ht, ox : ox + wt] = False
+    assert np.all(got[:, mask] == 0)
+
+
+@pytest.mark.parametrize("squeeze", [False, True])
+def test_cross_correlation_matches_reference(squeeze):
+    B, C, H, W = 2, 3, 20, 18
+    cap = 7
+    feat = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    sizes = [(3, 5), (7, 1)]
+    templates = np.zeros((B, C, cap, cap), np.float32)
+    want = []
+    for b, (ht, wt) in enumerate(sizes):
+        core = RNG.standard_normal((C, ht, wt)).astype(np.float32)
+        oy, ox = (cap - ht) // 2, (cap - wt) // 2
+        templates[b, :, oy : oy + ht, ox : ox + wt] = core
+        want.append(xcorr_np(feat[b], core, squeeze=squeeze))
+    thw = jnp.array(sizes, jnp.int32)
+
+    got = ops.cross_correlation(jnp.array(feat), jnp.array(templates), thw, squeeze=squeeze)
+    np.testing.assert_allclose(np.asarray(got), np.stack(want), rtol=1e-4, atol=1e-5)
+
+
+def test_match_templates_end_to_end():
+    """Full matcher vs. reference pipeline (roi_align oracle -> xcorr oracle)."""
+    B, C, H, W = 2, 3, 16, 16
+    feat = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    exemplars = np.array(
+        [[0.1, 0.2, 0.4, 0.45], [0.5, 0.5, 0.9, 0.8]], np.float32
+    )
+    got = np.asarray(
+        jax.jit(lambda f, e: match_templates(f, e, capacity=9))(
+            jnp.array(feat), jnp.array(exemplars)
+        )
+    )
+    for b in range(B):
+        (x1, y1, x2, y2), ht, wt = template_geometry_np(exemplars[b], H, W)
+        core = roi_align_np(feat[b], np.array([[x1, y1, x2, y2]]), (ht, wt))[0]
+        want = xcorr_np(feat[b], core.astype(np.float32))
+        np.testing.assert_allclose(got[b], want, rtol=1e-3, atol=1e-4)
+
+
+def test_extract_template_capacity_overflow_clamps():
+    """Exemplar larger than the bucket -> coarse full-coverage template,
+    not a misaligned truncation (code-review finding, round 1)."""
+    feat = RNG.standard_normal((2, 32, 32)).astype(np.float32)
+    exemplar = np.array([0.0, 0.0, 1.0, 1.0], np.float32)
+    tmpl, thw = ops.extract_template(jnp.array(feat), jnp.array(exemplar), 9)
+    assert tuple(np.asarray(thw)) == (9, 9)  # clamped to capacity
+    got = np.asarray(tmpl)
+    assert np.isfinite(got).all()
+    # every bin is populated (full coverage of the exemplar region)
+    assert (np.abs(got).sum(axis=0) > 0).all()
+    # and the resulting correlation map is not border-masked to near-zero
+    out = ops.cross_correlation(
+        jnp.array(feat)[None], tmpl[None], thw[None]
+    )
+    assert float((np.asarray(out) != 0).mean()) > 0.5
+
+
+def test_prototype_matches_reference_avgpool():
+    import math as m
+
+    feat = RNG.standard_normal((3, 12, 12)).astype(np.float32)
+    exemplar = np.array([0.21, 0.05, 0.63, 0.4], np.float32)
+    tmpl, thw = ops.extract_prototype(jnp.array(feat), jnp.array(exemplar), 1)
+    x1, x2 = m.floor(exemplar[0] * 12), m.ceil(exemplar[2] * 12)
+    y1, y2 = m.floor(exemplar[1] * 12), m.ceil(exemplar[3] * 12)
+    want = feat[:, y1:y2, x1:x2].mean(axis=(1, 2))
+    np.testing.assert_allclose(np.asarray(tmpl)[:, 0, 0], want, rtol=1e-5, atol=1e-6)
+    assert tuple(np.asarray(thw)) == (1, 1)
+
+
+# ----------------------------------------------------------------------- nms
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("iou_thr", [0.15, 0.5, 0.65])
+def test_nms_matches_greedy_oracle(seed, iou_thr):
+    rng = np.random.default_rng(seed)
+    n = 120
+    centers = rng.uniform(0.1, 0.9, (n, 2))
+    wh = rng.uniform(0.02, 0.25, (n, 2))
+    boxes = np.concatenate([centers - wh / 2, centers + wh / 2], axis=1).astype(np.float32)
+    scores = rng.uniform(0.01, 1.0, n).astype(np.float32)
+
+    keep = np.asarray(
+        jax.jit(lambda b, s: ops.nms_keep_mask(b, s, iou_thr))(
+            jnp.array(boxes), jnp.array(scores)
+        )
+    )
+    want = set(nms_np(boxes, scores, iou_thr))
+    assert set(np.flatnonzero(keep)) == want
+
+
+def test_nms_respects_valid_mask():
+    boxes = np.array(
+        [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]], np.float32
+    )
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    valid = np.array([False, True, True])
+    keep = np.asarray(
+        ops.nms_keep_mask(jnp.array(boxes), jnp.array(scores), 0.5, jnp.array(valid))
+    )
+    # box 0 is padding: must not be kept and must not suppress box 1
+    assert keep.tolist() == [False, True, True]
+
+
+# --------------------------------------------------------------------- peaks
+@pytest.mark.parametrize(
+    "ex_size",
+    [(0.5, 0.5), (0.001, 0.001), (0.001, 0.5), (0.5, 0.001), (0.12, 0.12)],
+)
+def test_adaptive_kernel_matches_reference(ex_size):
+    H, W = 16, 20
+    got = np.asarray(ops.adaptive_kernel(ex_size[0], ex_size[1], H, W))
+    want = np.array(adaptive_kernel_np(list(ex_size), [H, W]), np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_masked_maxpool_and_peaks():
+    H, W = 16, 20
+    x = RNG.uniform(0.01, 1.0, (H, W)).astype(np.float32)
+    for kernel in (
+        [[1, 1, 1], [1, 1, 1], [1, 1, 1]],
+        [[0, 1, 0], [1, 1, 1], [0, 1, 0]],
+        [[0, 0, 0], [0, 1, 0], [0, 0, 0]],
+    ):
+        got = np.asarray(ops.masked_maxpool3x3(jnp.array(x), jnp.array(kernel, jnp.float32)))
+        want = masked_maxpool3x3_np(x, kernel)
+        np.testing.assert_allclose(got, want, atol=0)
+
+    # end to end peak mask equals reference formula
+    ex_h, ex_w = 0.3, 0.3
+    peaks = np.asarray(local_peaks(jnp.array(x), ex_h, ex_w, cls_threshold=0.25))
+    k = adaptive_kernel_np([ex_h, ex_w], [H, W])
+    pooled = masked_maxpool3x3_np(x, k)
+    want = (pooled == x) & (x >= 0.25)
+    np.testing.assert_array_equal(peaks, want)

@@ -1,0 +1,22 @@
+"""tmr_tpu — TPU-native few-shot pattern detection framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the
+Template-Matching-and-Regression-MapReduce reference (TMR, ICCV 2025 +
+Hadoop-Streaming feature-extraction layer):
+
+- ``tmr_tpu.ops``      — pure-XLA numeric kernels (cross-correlation template
+  matching, RoIAlign, fixed-capacity NMS, adaptive peak pooling, box codecs).
+- ``tmr_tpu.models``   — Flax model zoo (SAM ViT-B/H encoders, matching_net).
+- ``tmr_tpu.train``    — target assignment, losses, optax train state.
+- ``tmr_tpu.parallel`` — device mesh / sharding rules / collective stat
+  aggregation (the TPU replacement for both Lightning DDP and the
+  Hadoop mapper/reducer shuffle).
+- ``tmr_tpu.data``     — dataset readers + static-shape preprocessing.
+- ``tmr_tpu.utils``    — metrics (COCO-style AP, MAE/RMSE), checkpointing.
+
+Everything in the compute path is designed for XLA: static shapes (bucketed),
+fixed-capacity detection postprocessing, batched/masked target assignment,
+and `jax.sharding`-based parallelism over a device Mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Pure-XLA numeric kernels.
+
+Each op re-implements, TPU-first, a native library kernel the reference
+leans on (SURVEY.md §2.4):
+
+- ``xcorr``      — grouped cross-correlation template matching
+                   (reference models/template_matching.py:23-41).
+- ``roi_align``  — RoIAlign as separable sampling-matrix matmuls
+                   (reference models/template_matching.py:55-76 /
+                   torchvision.ops.roi_align).
+- ``nms``        — fixed-capacity greedy NMS (reference utils/TM_utils.py:307-323 /
+                   torchvision.ops.nms).
+- ``peaks``      — adaptive masked 3x3 max-pool peak detection
+                   (reference utils/TM_utils.py:337-377).
+- ``boxes``      — box codecs + IoU/gIoU (reference criterion/criterions_TM.py:7-13 /
+                   torchvision generalized_box_iou_loss).
+"""
+
+from tmr_tpu.ops.boxes import (  # noqa: F401
+    cxcywh_to_xyxy,
+    xyxy_to_cxcywh,
+    box_area,
+    pairwise_iou,
+    generalized_box_iou_loss,
+)
+from tmr_tpu.ops.roi_align import roi_align, sampling_matrix  # noqa: F401
+from tmr_tpu.ops.xcorr import (  # noqa: F401
+    cross_correlation,
+    extract_template,
+    extract_prototype,
+    template_geometry,
+)
+from tmr_tpu.ops.nms import nms_keep_mask  # noqa: F401
+from tmr_tpu.ops.peaks import adaptive_kernel, masked_maxpool3x3  # noqa: F401

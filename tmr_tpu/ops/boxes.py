@@ -1,0 +1,73 @@
+"""Box codecs, IoU and generalized-IoU loss.
+
+Replaces ``torchvision.ops.generalized_box_iou_loss`` as used by the
+reference loss (criterion/criterions_TM.py:7-13) and the IoU machinery
+needed by NMS (utils/TM_utils.py:317-323). Pure jnp, shape-polymorphic,
+safe under vmap/jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cxcywh_to_xyxy(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4) [cx, cy, w, h] -> [x1, y1, x2, y2]."""
+    cxy, wh = boxes[..., :2], boxes[..., 2:]
+    return jnp.concatenate([cxy - wh / 2.0, cxy + wh / 2.0], axis=-1)
+
+
+def xyxy_to_cxcywh(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4) [x1, y1, x2, y2] -> [cx, cy, w, h]."""
+    xy1, xy2 = boxes[..., :2], boxes[..., 2:]
+    return jnp.concatenate([(xy1 + xy2) / 2.0, xy2 - xy1], axis=-1)
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4) xyxy -> (...,) area."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def pairwise_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """IoU matrix between (N, 4) and (M, 4) xyxy boxes -> (N, M)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-30), 0.0)
+
+
+def generalized_box_iou_loss(
+    pred: jnp.ndarray, target: jnp.ndarray, eps: float = 1e-13
+) -> jnp.ndarray:
+    """Elementwise gIoU loss between aligned (..., 4) xyxy boxes.
+
+    Mirrors torchvision.ops.generalized_box_iou_loss semantics (the op the
+    reference calls at criterion/criterions_TM.py:12 with eps=1e-13):
+    loss = 1 - iou + (area_c - union) / (area_c + eps), iou = inter/(union+eps).
+    """
+    x1, y1, x2, y2 = (pred[..., i] for i in range(4))
+    x1g, y1g, x2g, y2g = (target[..., i] for i in range(4))
+
+    xkis1 = jnp.maximum(x1, x1g)
+    ykis1 = jnp.maximum(y1, y1g)
+    xkis2 = jnp.minimum(x2, x2g)
+    ykis2 = jnp.minimum(y2, y2g)
+
+    intsct = jnp.where(
+        (ykis2 > ykis1) & (xkis2 > xkis1),
+        (xkis2 - xkis1) * (ykis2 - ykis1),
+        0.0,
+    )
+    union = (x2 - x1) * (y2 - y1) + (x2g - x1g) * (y2g - y1g) - intsct
+    iou = intsct / (union + eps)
+
+    xc1 = jnp.minimum(x1, x1g)
+    yc1 = jnp.minimum(y1, y1g)
+    xc2 = jnp.maximum(x2, x2g)
+    yc2 = jnp.maximum(y2, y2g)
+    area_c = (xc2 - xc1) * (yc2 - yc1)
+
+    giou = iou - ((area_c - union) / (area_c + eps))
+    return 1.0 - giou

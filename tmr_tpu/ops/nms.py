@@ -1,0 +1,66 @@
+"""Fixed-capacity greedy NMS inside XLA.
+
+Replaces ``torchvision.ops.nms`` (reference utils/TM_utils.py:6,322). Exact
+greedy semantics — keep a box iff no higher-scored kept box overlaps it above
+the IoU threshold — computed without a data-dependent Python loop:
+
+1. sort boxes by descending score (invalid entries sink with -inf),
+2. build the (N, N) IoU matrix once,
+3. iterate ``keep = valid & ~(M^T @ keep)`` to fixpoint with a
+   ``lax.while_loop``, where M[j, i] = (j < i) & (iou > thr).
+
+Any fixpoint of that map satisfies the greedy recurrence, whose solution is
+unique (row i depends only on rows < i), so convergence == correctness; row i
+stabilizes once rows < i have, giving <= N iterations and, in practice, a
+handful (the suppression-chain depth). Each iteration is one masked
+bool-matmul — VPU/MXU work, no host sync, O(N^2) memory with N = the static
+detection capacity (cfg.max_detections, default 1100 >= maxDets upper bound
+of log_utils.py:193).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from tmr_tpu.ops.boxes import pairwise_iou
+
+
+def nms_keep_mask(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Greedy NMS keep mask in the *original* box order.
+
+    boxes: (N, 4) xyxy; scores: (N,); valid: optional (N,) bool mask of real
+    entries (padding excluded). Returns (N,) bool keep mask.
+    """
+    n = boxes.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+    sort_scores = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-sort_scores)
+    b = boxes[order]
+    v = valid[order]
+
+    iou = pairwise_iou(b, b)
+    idx = jnp.arange(n)
+    # M[j, i]: j is earlier (higher score) and overlaps i beyond threshold.
+    suppressor = (idx[:, None] < idx[None, :]) & (iou > iou_threshold)
+
+    def cond(state):
+        keep, prev, it = state
+        return (it < n) & jnp.any(keep != prev)
+
+    def body(state):
+        keep, _, it = state
+        suppressed = (suppressor & keep[:, None]).any(axis=0)
+        return v & ~suppressed, keep, it + 1
+
+    init = (v, jnp.zeros_like(v), jnp.asarray(0))
+    keep_sorted, _, _ = lax.while_loop(cond, body, init)
+
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep

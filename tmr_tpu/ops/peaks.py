@@ -1,0 +1,77 @@
+"""Adaptive-kernel local-max peak detection.
+
+Replaces the reference's ``custom_shape_3x3_maxpool2d`` (an F.unfold gather,
+utils/TM_utils.py:337-361) and ``adaptive_kernel_generater`` (:363-377) with
+shifted-window maxima under a traced (3, 3) mask — nine static slices and a
+select, fully fused by XLA, no unfold materialization. The kernel choice
+(full / point / column / row / cross, picked from exemplar size vs. one-cell
+size) happens *inside* jit from traced exemplar extents, so one compiled
+program serves every image.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Kernel shapes of TM_utils.py:363-377, stacked [full, point, column, row, cross].
+_KERNELS = jnp.array(
+    [
+        [[1, 1, 1], [1, 1, 1], [1, 1, 1]],
+        [[0, 0, 0], [0, 1, 0], [0, 0, 0]],
+        [[0, 1, 0], [0, 1, 0], [0, 1, 0]],
+        [[0, 0, 0], [1, 1, 1], [0, 0, 0]],
+        [[0, 1, 0], [1, 1, 1], [0, 1, 0]],
+    ],
+    dtype=jnp.float32,
+)
+
+
+def adaptive_kernel(ex_h, ex_w, pred_h: int, pred_w: int) -> jnp.ndarray:
+    """Pick the suppression-kernel mask from normalized exemplar extents.
+
+    ex_h/ex_w may be traced scalars; pred_h/pred_w are static map sizes.
+    Mirrors adaptive_kernel_generater (TM_utils.py:363-377) with
+    needy = 1/pred size.
+    """
+    nh = 1.0 / pred_h
+    nw = 1.0 / pred_w
+    c_full = (ex_h >= 3 * nh) & (ex_w >= 3 * nw)
+    c_point = (ex_h < 2 * nh) & (ex_w < 2 * nw)
+    c_col = (ex_h < 2 * nh) & (ex_w >= 2 * nw)
+    c_row = (ex_h >= 2 * nh) & (ex_w < 2 * nw)
+    idx = jnp.select([c_full, c_point, c_col, c_row], [0, 1, 2, 3], 4)
+    return _KERNELS[idx]
+
+
+def masked_maxpool3x3(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """3x3 max-pool over the positions where mask == 1.
+
+    x: (..., H, W); mask: (3, 3), possibly traced. Matches
+    custom_shape_3x3_maxpool2d (TM_utils.py:337-361): stride 1, zero padding
+    (the masked max always includes the center, and objectness maps are
+    post-sigmoid > 0, so the pad value is never selected — same as unfold's
+    zero padding in the reference).
+    """
+    h, w = x.shape[-2], x.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 2) + [(1, 1), (1, 1)]
+    p = jnp.pad(x, pad, constant_values=0.0)
+    out = jnp.full_like(x, -jnp.inf)
+    for dy in range(3):
+        for dx in range(3):
+            shifted = p[..., dy : dy + h, dx : dx + w]
+            use = mask[dy, dx] > 0
+            out = jnp.maximum(out, jnp.where(use, shifted, -jnp.inf))
+    return out
+
+
+def local_peaks(
+    objectness: jnp.ndarray, ex_h, ex_w, cls_threshold: float
+) -> jnp.ndarray:
+    """Peak mask: adaptive local maxima above threshold (TM_utils.py:252-254).
+
+    objectness: (H, W) post-sigmoid scores for one image. Returns (H, W) bool.
+    """
+    h, w = objectness.shape
+    kernel = adaptive_kernel(ex_h, ex_w, h, w)
+    pooled = masked_maxpool3x3(objectness, kernel)
+    return (pooled == objectness) & (objectness >= cls_threshold)

@@ -1,0 +1,143 @@
+"""RoIAlign as separable sampling-matrix matmuls.
+
+The reference extracts exemplar templates with ``torchvision.ops.roi_align``
+(a CUDA gather kernel; reference models/template_matching.py:6,75,
+aligned=True, adaptive sampling ratio). On TPU a gather over bilinear sample
+points is VPU/scatter-hostile; instead we exploit that RoIAlign's sample grid
+is separable: every pooled bin value is an average of bilinear interpolations
+on a cartesian grid of sample points, so
+
+    out[n, c, i, j] = (Ay[n] @ f[c] @ Ax[n].T)[i, j]
+
+where ``Ay (oh, H)`` / ``Ax (ow, W)`` are per-ROI averaging matrices of 1-D
+bilinear weights. Two small matmuls per ROI -> MXU work, fully jittable with
+*dynamic* ROI geometry (the matrices are built from traced scalars; only the
+output capacity is static).
+
+Semantics mirror torchvision's roi_align (bilinear_interpolate clamping,
+``aligned`` offset, ``sampling_ratio=-1`` => ceil(roi/out) samples per bin),
+validated against a numpy port of the CUDA kernel in tests/test_roi_align.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _bilinear_weight_rows(pos: jnp.ndarray, size: int) -> jnp.ndarray:
+    """1-D bilinear interpolation weights.
+
+    pos: (...,) continuous sample coordinates (pixel-center space).
+    Returns (..., size) rows w such that w @ f == bilinear sample of f at pos,
+    with torchvision's bilinear_interpolate boundary rules: out-of-bounds
+    (pos < -1 or pos > size) -> all-zero row; pos clamped below at 0; the last
+    pixel handles pos >= size-1.
+    """
+    oob = (pos < -1.0) | (pos > size)
+    p = jnp.maximum(pos, 0.0)
+    low = jnp.floor(p).astype(jnp.int32)
+    at_edge = low >= size - 1
+    low = jnp.where(at_edge, size - 1, low)
+    high = jnp.where(at_edge, size - 1, low + 1)
+    frac = jnp.where(at_edge, 0.0, p - low.astype(p.dtype))
+    iota = jnp.arange(size)
+    w = (1.0 - frac)[..., None] * (iota == low[..., None]) + frac[..., None] * (
+        iota == high[..., None]
+    )
+    return jnp.where(oob[..., None], 0.0, w)
+
+
+def sampling_matrix(
+    start,
+    length,
+    n_active,
+    n_static: int,
+    feat_size: int,
+    offset=0,
+    sampling_ratio: int = -1,
+    max_ratio: int = 2,
+) -> jnp.ndarray:
+    """Per-axis RoIAlign averaging matrix, shape (n_static, feat_size).
+
+    start/length: traced ROI start (already offset by -0.5 when aligned) and
+    extent, in feature pixels. ``n_active`` (traced int) is the true number of
+    output bins; rows are laid out centered at ``offset`` (traced) inside the
+    static ``n_static`` capacity, rows outside [offset, offset+n_active) are
+    zero — this centered placement is what lets the template land directly in
+    a fixed-size cross-correlation kernel (see ops/xcorr.py).
+
+    sampling_ratio: static positive count, or -1 for torchvision's adaptive
+    ceil(length / n_active) clamped to ``max_ratio`` (2 suffices for template
+    extraction, where the output size is the odd-ified ceil-span of the ROI).
+    """
+    n_active = jnp.asarray(n_active)
+    bin_size = length / n_active
+    if sampling_ratio > 0:
+        ratio = jnp.full((), sampling_ratio, jnp.int32)
+        max_ratio = sampling_ratio
+    else:
+        ratio = jnp.ceil(length / n_active).astype(jnp.int32)
+        ratio = jnp.clip(ratio, 1, max_ratio)
+    i = jnp.arange(n_static) - jnp.asarray(offset)  # active-bin index per row
+    k = jnp.arange(max_ratio)
+    # sample position of the k-th sub-sample in bin i:
+    #   start + bin_size * (i + (k + 0.5) / ratio)
+    pos = start + bin_size * (
+        i[:, None].astype(jnp.float32)
+        + (k[None, :].astype(jnp.float32) + 0.5) / ratio.astype(jnp.float32)
+    )
+    w = _bilinear_weight_rows(pos, feat_size)  # (n_static, max_ratio, F)
+    kmask = (k < ratio).astype(w.dtype)
+    w = (w * kmask[None, :, None]).sum(axis=1) / ratio.astype(w.dtype)
+    row_valid = (i >= 0) & (i < n_active)
+    return w * row_valid[:, None].astype(w.dtype)
+
+
+def roi_align(
+    features: jnp.ndarray,
+    boxes: jnp.ndarray,
+    output_size,
+    spatial_scale: float = 1.0,
+    sampling_ratio: int = -1,
+    aligned: bool = True,
+    max_ratio: int = 8,
+) -> jnp.ndarray:
+    """RoIAlign over a single image's feature map.
+
+    features: (C, H, W); boxes: (N, 4) xyxy in input coordinates
+    (multiplied by spatial_scale like torchvision). Returns (N, C, oh, ow).
+    ``output_size`` is static; box geometry may be traced.
+
+    ``max_ratio`` statically bounds the adaptive sampling grid; ROIs larger
+    than ``max_ratio * output_size`` are sampled coarser than torchvision
+    would. The default of 8 covers ROIs up to 8x the pooled size; template
+    extraction passes 2, which is provably sufficient there (see
+    ops/xcorr.py).
+    """
+    oh, ow = output_size
+    C, H, W = features.shape
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+
+    def one_axis(start, length, n_static, feat_size):
+        return sampling_matrix(
+            start, length, n_static, n_static, feat_size,
+            offset=0, sampling_ratio=sampling_ratio, max_ratio=max_ratio,
+        )
+
+    ay = jax.vmap(lambda s, l: one_axis(s, l, oh, H))(y1, roi_h)  # (N, oh, H)
+    ax = jax.vmap(lambda s, l: one_axis(s, l, ow, W))(x1, roi_w)  # (N, ow, W)
+    # full f32 precision: these matmuls place bilinear sample weights, and the
+    # TPU default (bf16) would shift box geometry.
+    return jnp.einsum(
+        "nyh,chw,nxw->ncyx", ay, features, ax, precision=jax.lax.Precision.HIGHEST
+    )

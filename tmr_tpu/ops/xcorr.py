@@ -1,0 +1,177 @@
+"""Cross-correlation template matching — the north-star kernel.
+
+Reference semantics (models/template_matching.py):
+- ``extract_template`` (:55-76): RoIAlign the exemplar region of the feature
+  map into an odd-sized (Ht, Wt) template.
+- ``extract_prototype`` (:43-53): adaptive-avg-pool the integer exemplar crop
+  to a (1, 1) prototype.
+- ``cross_correlation`` (:23-41): depthwise VALID conv of the feature map with
+  the template as kernel, / (Ht*Wt + 1e-14), optional channel-sum squeeze,
+  then zero-pad the output back to (H, W).
+
+TPU-first design: templates have *dynamic* odd sizes per image, which is
+jit-hostile. We give the template a static odd capacity T (bucketed by the
+caller), place the true (ht, wt) template centered inside the (T, T) kernel
+(zero elsewhere — zeros contribute nothing to the correlation), and run ONE
+``lax.conv_general_dilated`` with ``feature_group_count = B*C`` (depthwise,
+per-image kernels) at SAME padding. Interior pixels then equal the reference's
+VALID conv exactly; the (ht//2, wt//2) border band — zero in the reference by
+construction — is zeroed with an iota mask. Template extraction itself is two
+MXU matmuls (see ops/roi_align.py sampling matrices), so the whole matcher
+fuses into the surrounding jitted model with no host sync.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tmr_tpu.ops.roi_align import sampling_matrix
+
+
+def template_geometry(exemplar: jnp.ndarray, feat_h: int, feat_w: int):
+    """Exemplar box -> template geometry, mirroring template_matching.py:55-73.
+
+    exemplar: (4,) normalized [x1, y1, x2, y2]. Returns a dict of traced
+    scalars: clipped feature-space coords x1,y1,x2,y2 (float) and odd template
+    size ht, wt (int32, >= 1).
+    """
+    x1 = jnp.clip(exemplar[0], 0.0, 1.0) * feat_w
+    y1 = jnp.clip(exemplar[1], 0.0, 1.0) * feat_h
+    x2 = jnp.clip(exemplar[2], 0.0, 1.0) * feat_w
+    y2 = jnp.clip(exemplar[3], 0.0, 1.0) * feat_h
+
+    wt = jnp.ceil(x2).astype(jnp.int32) - jnp.floor(x1).astype(jnp.int32)
+    ht = jnp.ceil(y2).astype(jnp.int32) - jnp.floor(y1).astype(jnp.int32)
+    wt = wt - (wt % 2 == 0)  # odd-ify (template_matching.py:72-73)
+    ht = ht - (ht % 2 == 0)
+    wt = jnp.maximum(wt, 1)
+    ht = jnp.maximum(ht, 1)
+    return {"x1": x1, "y1": y1, "x2": x2, "y2": y2, "ht": ht, "wt": wt}
+
+
+def extract_template(
+    feature: jnp.ndarray, exemplar: jnp.ndarray, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """RoIAlign the exemplar into a centered (C, T, T) padded template.
+
+    feature: (C, H, W) single image. Returns (template (C, T, T), thw (2,)
+    int32 actual (ht, wt)). Equivalent to roi_align(..., (ht, wt),
+    aligned=True, sampling_ratio=-1) placed centered in the T x T kernel.
+
+    When the odd-ified exemplar span exceeds ``capacity`` (the caller picked
+    too small a bucket), ht/wt are clamped to ``capacity``: the template is
+    then a coarser ``capacity``-bin RoIAlign of the full exemplar — a
+    well-defined approximation rather than a silent misaligned truncation.
+    The adaptive sampling ratio is exact (<= 2 per axis) whenever the bucket
+    fits, since the output size is the odd-ified ceil-span of the ROI.
+    """
+    C, H, W = feature.shape
+    g = template_geometry(exemplar, H, W)
+    ht = jnp.minimum(g["ht"], capacity)
+    wt = jnp.minimum(g["wt"], capacity)
+    ay = sampling_matrix(
+        g["y1"] - 0.5, g["y2"] - g["y1"], ht, capacity, H,
+        offset=(capacity - ht) // 2, sampling_ratio=-1, max_ratio=2,
+    )
+    ax = sampling_matrix(
+        g["x1"] - 0.5, g["x2"] - g["x1"], wt, capacity, W,
+        offset=(capacity - wt) // 2, sampling_ratio=-1, max_ratio=2,
+    )
+    template = jnp.einsum(
+        "yh,chw,xw->cyx", ay, feature, ax, precision=jax.lax.Precision.HIGHEST
+    )
+    return template, jnp.stack([ht, wt])
+
+
+def extract_prototype(
+    feature: jnp.ndarray, exemplar: jnp.ndarray, capacity: int = 1
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Adaptive-avg-pool prototype (template_matching.py:43-53).
+
+    Means the feature over the integer crop [floor(x1*W):ceil(x2*W)] x
+    [floor(y1*H):ceil(y2*H)], returned centered in a (C, T, T) kernel with
+    actual size (1, 1).
+    """
+    C, H, W = feature.shape
+    g = template_geometry(exemplar, H, W)
+    xs = jnp.arange(W)
+    ys = jnp.arange(H)
+    mx = (xs >= jnp.floor(g["x1"]).astype(jnp.int32)) & (
+        xs < jnp.ceil(g["x2"]).astype(jnp.int32)
+    )
+    my = (ys >= jnp.floor(g["y1"]).astype(jnp.int32)) & (
+        ys < jnp.ceil(g["y2"]).astype(jnp.int32)
+    )
+    mask = (my[:, None] & mx[None, :]).astype(feature.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    proto = (feature * mask).sum(axis=(1, 2)) / denom  # (C,)
+    template = jnp.zeros((C, capacity, capacity), feature.dtype)
+    template = template.at[:, capacity // 2, capacity // 2].set(proto)
+    ones = jnp.ones((), jnp.int32)
+    return template, jnp.stack([ones, ones])
+
+
+def cross_correlation(
+    feature: jnp.ndarray,
+    template: jnp.ndarray,
+    template_hw: jnp.ndarray,
+    squeeze: bool = False,
+) -> jnp.ndarray:
+    """Depthwise cross-correlation with per-image kernels.
+
+    feature: (B, C, H, W); template: (B, C, T, T) centered-padded (T odd
+    static); template_hw: (B, 2) int32 true (ht, wt). Returns (B, C, H, W),
+    or (B, 1, H, W) when squeeze (channel sum, template_matching.py:34-35).
+    Matches template_matching.py:23-41: interior = VALID conv / (ht*wt+1e-14),
+    border band of (ht//2, wt//2) zeroed.
+    """
+    B, C, H, W = feature.shape
+    T = template.shape[-1]
+    lhs = feature.reshape(1, B * C, H, W)
+    rhs = template.reshape(B * C, 1, T, T)
+    out = lax.conv_general_dilated(
+        lhs,
+        rhs,
+        window_strides=(1, 1),
+        padding=[(T // 2, T // 2), (T // 2, T // 2)],
+        feature_group_count=B * C,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST,
+    ).reshape(B, C, H, W)
+
+    ht = template_hw[:, 0]
+    wt = template_hw[:, 1]
+    out = out / (ht * wt + 1e-14).astype(out.dtype)[:, None, None, None]
+
+    ph = (ht // 2)[:, None]  # (B, 1)
+    pw = (wt // 2)[:, None]
+    ys = jnp.arange(H)[None, :]
+    xs = jnp.arange(W)[None, :]
+    row_ok = (ys >= ph) & (ys < H - ph)  # (B, H)
+    col_ok = (xs >= pw) & (xs < W - pw)  # (B, W)
+    mask = row_ok[:, None, :, None] & col_ok[:, None, None, :]
+    out = jnp.where(mask, out, 0.0)
+    if squeeze:
+        out = out.sum(axis=1, keepdims=True)
+    return out
+
+
+def match_templates(
+    feature: jnp.ndarray,
+    exemplars: jnp.ndarray,
+    capacity: int,
+    template_type: str = "roi_align",
+    squeeze: bool = False,
+) -> jnp.ndarray:
+    """Full matcher (template_matching.py:79-93) without the learnable scale.
+
+    feature: (B, C, H, W); exemplars: (B, 4) normalized first-exemplar boxes.
+    The reference's per-image Python loop becomes a vmap'd template extraction
+    feeding one grouped conv.
+    """
+    extract = extract_template if template_type == "roi_align" else extract_prototype
+    cap = capacity if template_type == "roi_align" else 1
+    templates, thw = jax.vmap(lambda f, e: extract(f, e, cap))(feature, exemplars)
+    return cross_correlation(feature, templates, thw, squeeze=squeeze)
