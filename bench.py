@@ -1,0 +1,85 @@
+"""Benchmark: FSCD-147-configuration eval throughput on one TPU chip.
+
+Runs the flagship fused inference program — SAM ViT-B encoder @ 1024, 2x
+feature upsample, 512-d template matching, decoders, peak decode, NMS — and
+reports steady-state images/sec/chip.
+
+Baseline note (BASELINE.md): the reference publishes NO numbers; its only
+in-repo perf evidence is ~25 s/img for the ONNX-CPU mapper. The north-star
+comparison is single-A100 PyTorch eval of the same model, which cannot be
+measured in this image (no GPU, no torchvision); we use an engineering
+estimate of 30 img/s for an A100 running the reference eval loop (ViT-B @
+1024^2, batch 1, detection postprocessing on device) as the ``vs_baseline``
+denominator until a measured number exists.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+A100_BASELINE_IMG_PER_SEC = 30.0  # documented estimate, see module docstring
+
+BATCH = 4
+IMAGE_SIZE = 1024
+WARMUP = 3
+ITERS = 10
+
+
+def main() -> None:
+    import jax
+
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset(
+        "TMR_FSCD147",
+        backbone="sam_vit_b",
+        image_size=IMAGE_SIZE,
+        compute_dtype="bfloat16",
+        batch_size=BATCH,
+    )
+    predictor = Predictor(cfg)
+    predictor.init_params(seed=0, image_size=IMAGE_SIZE)
+
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(
+        np.float32
+    )
+    # typical FSCD-147 exemplar: small object, lands in the 17-cell bucket
+    exemplars = np.tile(
+        np.array([[[0.45, 0.45, 0.53, 0.55]]], np.float32), (BATCH, 1, 1)
+    )
+
+    for _ in range(WARMUP):
+        dets = predictor(image, exemplars)
+    jax.block_until_ready(dets["scores"])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        dets = predictor(image, exemplars)
+    jax.block_until_ready(dets["scores"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "FSCD-147 eval images/sec/chip (ViT-B 1024, fused "
+                "match+decode+NMS, random weights)",
+                "value": round(img_per_sec, 3),
+                "unit": "img/s",
+                "vs_baseline": round(img_per_sec / A100_BASELINE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""CLI entry point — flag-for-flag surface of the reference main.py:14-83,
+plus the TPU-native knobs (--device, mesh shape, dtype).
+
+Train:  python main.py --dataset FSCD147 --datapath ... --backbone sam \
+            --emb_dim 512 --fusion --feature_upsample --lr_drop ...
+Eval:   add --eval (loads the best checkpoint like reference main.py:122-130).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import random
+
+import numpy as np
+
+
+def config_parser(argv=None):
+    p = argparse.ArgumentParser(description="Matching Network (TPU-native)")
+
+    p.add_argument("--seed", default=42, type=int)
+
+    # logging
+    p.add_argument("--project_name", type=str, default="Few-Shot Pattern Detection")
+    p.add_argument("--logpath", type=str, default="./outputs/default")
+    p.add_argument("--nowandb", action="store_true",
+                   help="kept for parity; logging is CSV either way")
+    p.add_argument("--AP_term", default=5, type=int)
+    p.add_argument("--best_model_count", action="store_true")
+
+    # dataset
+    p.add_argument("--datapath", type=str, default="/home/")
+    p.add_argument("--dataset", type=str, default="RPINE")
+    p.add_argument("--batch_size", default=1, type=int)
+    p.add_argument("--num_workers", default=8, type=int)
+    p.add_argument("--num_exemplars", default=1, type=int)
+    p.add_argument("--image_size", default=1024, type=int)
+
+    # training
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--max_epochs", default=30, type=int)
+    p.add_argument("--multi_gpu", action="store_true",
+                   help="parity alias for data parallelism over all devices")
+
+    # optimizer
+    p.add_argument("--weight_decay", default=1e-4, type=float)
+    p.add_argument("--clip_max_norm", default=0.1, type=float)
+    p.add_argument("--lr_drop", action="store_true")
+    p.add_argument("--lr", default=1e-4, type=float)
+    p.add_argument("--lr_backbone", default=1e-5, type=float)
+
+    # eval / vis
+    p.add_argument("--eval", action="store_true")
+    p.add_argument("--visualize", action="store_true")
+
+    # model
+    p.add_argument("--modeltype", type=str, default="matching_net")
+    p.add_argument("--emb_dim", default=512, type=int)
+    p.add_argument("--no_matcher", action="store_true")
+    p.add_argument("--squeeze", action="store_true")
+    p.add_argument("--fusion", action="store_true")
+    p.add_argument("--positive_threshold", default=0.7, type=float)
+    p.add_argument("--negative_threshold", default=0.7, type=float)
+    p.add_argument("--NMS_cls_threshold", default=0.1, type=float)
+    p.add_argument("--NMS_iou_threshold", default=0.15, type=float)
+    p.add_argument("--refine_box", action="store_true")
+    p.add_argument("--ablation_no_box_regression", action="store_true")
+    p.add_argument("--template_type", type=str, default="roi_align")
+    p.add_argument("--feature_upsample", action="store_true")
+    p.add_argument("--eval_multi_scale", action="store_true")  # parity (dead)
+    p.add_argument("--regression_scaling_imgsize", action="store_true")
+    p.add_argument("--regression_scaling_WH_only", action="store_true")
+    p.add_argument("--focal_loss", action="store_true")
+
+    # backbone / heads
+    p.add_argument("--backbone", default="resnet50", type=str)
+    p.add_argument("--encoder", default="original", type=str)
+    p.add_argument("--dilation", default=True)
+    p.add_argument("--decoder_num_layer", default=1, type=int)
+    p.add_argument("--decoder_kernel_size", default=3, type=int)
+
+    # TPU-native additions
+    p.add_argument("--device", default="tpu", type=str,
+                   help="'tpu' (default) or 'cpu'")
+    p.add_argument("--mesh_data", default=-1, type=int,
+                   help="data-parallel mesh size (-1: all devices)")
+    p.add_argument("--mesh_model", default=1, type=int,
+                   help="tensor-parallel mesh size for the ViT")
+    p.add_argument("--compute_dtype", default="bfloat16", type=str)
+
+    args = p.parse_args(argv)
+    return args
+
+
+def to_config(args):
+    from tmr_tpu.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(args).items() if k in fields}
+    kw["dilation"] = bool(args.dilation)
+    return Config(**kw)
+
+
+def main(argv=None):
+    args = config_parser(argv)
+
+    if args.device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    # seed_everything (reference main.py:86)
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    cfg = to_config(args)
+
+    from tmr_tpu.parallel import make_mesh
+    from tmr_tpu.train.loop import Trainer
+
+    mesh = None
+    if args.multi_gpu or args.mesh_model > 1:
+        mesh = make_mesh((args.mesh_data, args.mesh_model))
+
+    trainer = Trainer(cfg, mesh=mesh)
+    if cfg.eval:
+        trainer.test()
+    else:
+        trainer.fit()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""COCOEvalLite correctness on analytically-known cases + the metrics
+filesystem pipeline (pycocotools is unavailable, so cases are hand-derived
+from the COCOeval algorithm definition)."""
+
+import numpy as np
+
+from tmr_tpu.utils.coco_eval import COCOEvalLite, iou_xywh
+from tmr_tpu.utils.metrics import (
+    coco_style_annotation_generator,
+    get_ap_scores,
+    get_mae_rmse,
+    image_info_collector,
+)
+
+
+def _gt(x, y, w, h):
+    return {"bbox": [x, y, w, h], "area": w * h}
+
+
+def _pred(x, y, w, h, s):
+    return {"bbox": [x, y, w, h], "score": s}
+
+
+def test_iou_xywh():
+    a = np.array([[0, 0, 10, 10]], float)
+    b = np.array([[0, 0, 10, 10], [5, 5, 10, 10], [20, 20, 5, 5]], float)
+    got = iou_xywh(a, b)[0]
+    np.testing.assert_allclose(got, [1.0, 25 / 175, 0.0], rtol=1e-6)
+
+
+def test_perfect_predictions_ap_1():
+    gts = {1: [_gt(0, 0, 10, 10), _gt(50, 50, 20, 20)]}
+    preds = {1: [_pred(0, 0, 10, 10, 0.9), _pred(50, 50, 20, 20, 0.8)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(1, 2, 3)).run()
+    assert np.isclose(ev.stats[0], 1.0)  # AP
+    assert np.isclose(ev.stats[1], 1.0)  # AP50
+
+
+def test_no_predictions_ap_0():
+    gts = {1: [_gt(0, 0, 10, 10)]}
+    ev = COCOEvalLite(gts, {1: []}, max_dets=(10, 20, 30)).run()
+    assert ev.stats[0] == 0.0
+
+
+def test_half_recall_ap():
+    """2 GTs, 1 perfect pred -> P=1 up to recall 0.5, 0 beyond.
+    101-pt AP = mean over thresholds: 51/101 points get precision 1."""
+    gts = {1: [_gt(0, 0, 10, 10), _gt(100, 100, 10, 10)]}
+    preds = {1: [_pred(0, 0, 10, 10, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    want = 51 / 101
+    assert np.isclose(ev.stats[1], want, atol=1e-6)  # AP50
+    assert np.isclose(ev.stats[0], want, atol=1e-6)  # all thresholds identical
+
+
+def test_false_positive_then_true_positive():
+    """Higher-scored FP before a TP: precision at the TP is 1/2.
+    AP50 = 0.5 over the covered recall (one GT -> all 101 pts at 0.5 from
+    recall 0)."""
+    gts = {1: [_gt(0, 0, 10, 10)]}
+    preds = {1: [_pred(500, 500, 10, 10, 0.95), _pred(0, 0, 10, 10, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    assert np.isclose(ev.stats[1], 0.5, atol=1e-6)
+
+
+def test_iou_threshold_cutoff():
+    """Pred at IoU ~0.6 with the GT counts at t=0.5 but not at t=0.75."""
+    gts = {1: [_gt(0, 0, 10, 10)]}
+    preds = {1: [_pred(0, 0, 10, 6.1, 0.9)]}  # IoU = 6.1*10/100 = 0.61
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    assert np.isclose(ev.stats[1], 1.0)  # AP50
+    assert np.isclose(ev.stats[2], 0.0)  # AP75
+
+
+def test_max_dets_truncation():
+    """With maxDet=1, only the top-scored det per image is considered."""
+    gts = {1: [_gt(0, 0, 10, 10), _gt(100, 100, 10, 10)]}
+    preds = {
+        1: [_pred(100, 100, 10, 10, 0.9), _pred(0, 0, 10, 10, 0.8)]
+    }
+    ev = COCOEvalLite(gts, preds, max_dets=(1, 2, 2)).run()
+    # stats[6] = AR @ maxDets[0]=1 -> only one det kept -> recall 0.5
+    assert np.isclose(ev.stats[6], 0.5, atol=1e-6)
+    assert np.isclose(ev.stats[8], 1.0, atol=1e-6)  # AR @ 2
+
+
+def test_greedy_matching_prefers_best_iou():
+    """One det overlapping two GTs must match the higher-IoU one."""
+    gts = {1: [_gt(0, 0, 10, 10), _gt(2, 0, 10, 10)]}
+    preds = {1: [_pred(2.2, 0, 10, 10, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(5, 5, 5)).run()
+    # matched to the second GT (IoU ~0.98); 1 of 2 GTs found
+    assert np.isclose(ev.stats[1], 51 / 101, atol=1e-6)
+
+
+def test_area_ranges():
+    """Small GT (16 area) ignored in 'large' range; AP small == 1."""
+    gts = {1: [_gt(0, 0, 4, 4)]}
+    preds = {1: [_pred(0, 0, 4, 4, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(5, 5, 5)).run()
+    assert np.isclose(ev.stats[3], 1.0)  # APs
+    assert ev.stats[5] == -1.0  # APl: no GT in range -> undefined (-1)
+
+
+def test_multi_image_accumulation():
+    gts = {
+        1: [_gt(0, 0, 10, 10)],
+        2: [_gt(0, 0, 10, 10)],
+    }
+    preds = {
+        1: [_pred(0, 0, 10, 10, 0.9)],
+        2: [_pred(300, 300, 10, 10, 0.95)],  # FP with the highest score
+    }
+    ev = COCOEvalLite(gts, preds, max_dets=(5, 5, 5)).run()
+    # order by score: FP, TP -> precision at recall .5 is 1/2; 51 points
+    assert np.isclose(ev.stats[1], 0.5 * 51 / 101, atol=1e-6)
+
+
+def test_zero_detection_image_contributes_dummy(tmp_path):
+    """An image with no detections must count as ONE prediction in MAE
+    (reference Get_pred_boxes dummy, TM_utils.py:288-291)."""
+    log_path = str(tmp_path)
+    meta = [{
+        "img_name": "z.jpg", "img_url": "", "img_id": 5, "img_size": (64, 64),
+        "orig_boxes": np.array([[10, 10, 20, 20], [30, 30, 40, 40]]),
+        "orig_exemplars": np.array([[10, 10, 20, 20]]),
+    }]
+    dets = [{"boxes": np.zeros((0, 4)), "scores": np.zeros(0),
+             "refs": np.zeros((0, 2))}]
+    image_info_collector(log_path, "test", meta, dets)
+    coco_style_annotation_generator(log_path, "test")
+    mae, rmse = get_mae_rmse(log_path, "test")
+    assert mae == 1.0  # |2 gts - 1 dummy pred|, not |2 - 0|
+
+
+# ------------------------------------------------------- pipeline on disk
+def test_metrics_pipeline_end_to_end(tmp_path):
+    log_path = str(tmp_path)
+    meta = [
+        {
+            "img_name": "a.jpg", "img_url": "", "img_id": 1,
+            "img_size": (100, 80),
+            "orig_boxes": np.array([[10, 10, 30, 30], [50, 50, 70, 70]]),
+            "orig_exemplars": np.array([[10, 10, 30, 30]]),
+        },
+        {
+            "img_name": "b.jpg", "img_url": "", "img_id": 2,
+            "img_size": (100, 80),
+            "orig_boxes": np.array([[20, 20, 40, 40]]),
+            "orig_exemplars": np.array([[20, 20, 40, 40]]),
+        },
+    ]
+    dets = [
+        {  # image 1: both found
+            "boxes": np.array([[0.1, 0.125, 0.3, 0.375], [0.5, 0.625, 0.7, 0.875]]),
+            "scores": np.array([0.9, 0.85]),
+            "refs": np.array([[0.2, 0.25], [0.6, 0.75]]),
+        },
+        {  # image 2: one found + one FP -> count error 1
+            "boxes": np.array([[0.2, 0.25, 0.4, 0.5], [0.8, 0.8, 0.9, 0.9]]),
+            "scores": np.array([0.8, 0.7]),
+            "refs": np.array([[0.3, 0.375], [0.85, 0.85]]),
+        },
+    ]
+    image_info_collector(log_path, "test", meta, dets)
+    coco_style_annotation_generator(log_path, "test")
+
+    mae, rmse = get_mae_rmse(log_path, "test")
+    assert np.isclose(mae, 0.5)
+    assert np.isclose(rmse, np.sqrt(0.5))
+
+    ap, ap50, ap75 = get_ap_scores(log_path, "test")
+    assert 0 < ap50 <= 100
+    assert ap50 >= ap  # AP50 is the loosest threshold

@@ -1,0 +1,27 @@
+"""CSVLogger keeps full history across varying key sets and resume."""
+
+import csv
+
+from tmr_tpu.train.loop import CSVLogger
+
+
+def test_varying_keys_never_truncate(tmp_path):
+    log = CSVLogger(str(tmp_path))
+    log.log({"epoch": 0, "train/loss": 1.0, "val/AP": 5.0})
+    log.log({"epoch": 1, "train/loss": 0.9})  # no val keys this epoch
+    log.log({"epoch": 2, "train/loss": 0.8, "val/AP": 7.0})
+
+    rows = list(csv.DictReader(open(log.path)))
+    assert len(rows) == 3
+    assert rows[0]["val/AP"] == "5.0"
+    assert rows[1]["val/AP"] == ""  # missing keys blank, row preserved
+    assert rows[2]["train/loss"] == "0.8"
+
+
+def test_resume_appends_to_existing(tmp_path):
+    log = CSVLogger(str(tmp_path))
+    log.log({"epoch": 0, "train/loss": 1.0})
+    log2 = CSVLogger(str(tmp_path))  # new process, same logpath
+    log2.log({"epoch": 1, "train/loss": 0.5})
+    rows = list(csv.DictReader(open(log2.path)))
+    assert [r["epoch"] for r in rows] == ["0", "1"]

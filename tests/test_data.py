@@ -1,0 +1,208 @@
+"""Dataset readers + loader on synthetic fixtures mirroring each dataset's
+on-disk layout (FSCD-147 / FSCD-LVIS / RPINE)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tmr_tpu.config import Config
+from tmr_tpu.data import DataLoader, build_dataset, collate
+from tmr_tpu.data.transforms import normalize_image, pick_image_size
+
+
+def _img(path, w=64, h=48):
+    from PIL import Image
+
+    arr = np.random.default_rng(0).integers(0, 255, (h, w, 3), np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def _write_fscd147(root):
+    os.makedirs(f"{root}/annotations", exist_ok=True)
+    os.makedirs(f"{root}/images_384_VarV2", exist_ok=True)
+    names = ["im0.jpg", "im1.jpg"]
+    for n in names:
+        _img(f"{root}/images_384_VarV2/{n}")
+    json.dump(
+        {
+            n: {
+                "box_examples_coordinates": [
+                    [[4, 4], [4, 14], [14, 14], [14, 4]],
+                    [[20, 8], [20, 18], [30, 18], [30, 8]],
+                ]
+            }
+            for n in names
+        },
+        open(f"{root}/annotations/annotation_FSC147_384.json", "w"),
+    )
+    json.dump(
+        {"train": names, "val": names, "test": [names[0]]},
+        open(f"{root}/annotations/Train_Test_Val_FSC_147.json", "w"),
+    )
+    for split in ("train", "val", "test"):
+        json.dump(
+            {
+                "images": [{"id": i, "file_name": n} for i, n in enumerate(names)],
+                "annotations": [
+                    {"id": 1, "image_id": 0, "bbox": [4, 4, 10, 10]},
+                    {"id": 2, "image_id": 0, "bbox": [30, 20, 8, 12]},
+                    {"id": 3, "image_id": 1, "bbox": [10, 10, 20, 20]},
+                ],
+            },
+            open(f"{root}/annotations/instances_{split}.json", "w"),
+        )
+
+
+def test_fscd147_reader(tmp_path):
+    root = str(tmp_path)
+    _write_fscd147(root)
+    cfg = Config(dataset="FSCD147", datapath=root, image_size=64,
+                 num_exemplars=2)
+    ds = build_dataset(cfg, "val")
+    assert len(ds) == 2
+    item = ds[0]
+    assert item["image"].shape == (64, 64, 3)
+    # boxes normalized by the ORIGINAL image size (64 x 48)
+    np.testing.assert_allclose(
+        item["boxes"][0], [4 / 64, 4 / 48, 14 / 64, 14 / 48], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        item["exemplars"][0], [4 / 64, 4 / 48, 14 / 64, 14 / 48], atol=1e-6
+    )
+    assert item["exemplars"].shape == (2, 4)
+
+
+def test_small_object_escape_hatch(tmp_path):
+    root = str(tmp_path)
+    _write_fscd147(root)
+    cfg = Config(dataset="FSCD147", datapath=root, image_size=64,
+                 num_exemplars=1, eval=True)
+    ds = build_dataset(cfg, "test")
+    item = ds[0]  # smallest box is 10x10 (< 25 in both dims)
+    assert item["image"].shape == (1536, 1536, 3)
+    # train split never escalates
+    ds_train = build_dataset(cfg, "train", eval_mode=False)
+    assert ds_train[0]["image"].shape == (64, 64, 3)
+
+
+def test_pick_image_size_rules():
+    small = np.array([[0, 0, 10, 10]], np.float32)
+    big = np.array([[0, 0, 100, 100]], np.float32)
+    mixed = np.array([[0, 0, 10, 100]], np.float32)  # only one dim small
+    assert pick_image_size(small, 1024, eval_mode=True, split="test") == 1536
+    assert pick_image_size(big, 1024, eval_mode=True, split="test") == 1024
+    assert pick_image_size(mixed, 1024, eval_mode=True, split="test") == 1024
+    assert pick_image_size(small, 1024, eval_mode=False, split="test") == 1024
+    assert pick_image_size(small, 1024, eval_mode=True, split="train") == 1024
+
+
+def test_rpine_reader(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(f"{root}/labels")
+    os.makedirs(f"{root}/images")
+    _img(f"{root}/images/a.png", 40, 40)
+    with open(f"{root}/labels/a.txt", "w") as f:
+        f.write("1 2 11 12\n20 20 30 30\n")
+    json.dump({"a": [[1, 2, 11, 12]]}, open(f"{root}/exemplars.json", "w"))
+
+    from tmr_tpu.data.datasets import RPINEDataset
+
+    ds = RPINEDataset(root, split="test", image_size=32, max_exemplars=1)
+    item = ds[0]
+    assert item["image"].shape == (32, 32, 3)
+    assert len(item["boxes"]) == 2
+    np.testing.assert_allclose(item["orig_exemplars"][0], [1, 2, 11, 12])
+
+
+def test_lvis_reader(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(f"{root}/annotations")
+    os.makedirs(f"{root}/images")
+    _img(f"{root}/images/x.jpg", 50, 50)
+    json.dump(
+        {
+            "images": [{"id": 7, "file_name": "x.jpg"}],
+            "annotations": [
+                {"id": 1, "image_id": 7, "bbox": [5, 5, 10, 10]},
+            ],
+        },
+        open(f"{root}/annotations/unseen_instances_test.json", "w"),
+    )
+    json.dump(
+        {
+            "images": [{"id": 1, "file_name": "x.jpg"}],
+            "annotations": [
+                {"id": 1, "image_id": 7, "boxes": [[5, 5, 10, 10]],
+                 "points": [[10, 10]]},
+            ],
+        },
+        open(f"{root}/annotations/unseen_count_test.json", "w"),
+    )
+    from tmr_tpu.data.datasets import FSCDLVISDataset
+
+    ds = FSCDLVISDataset(root, split="test", unseen=True, image_size=32,
+                         max_exemplars=1)
+    item = ds[0]
+    np.testing.assert_allclose(item["orig_boxes"][0], [5, 5, 15, 15])
+    np.testing.assert_allclose(item["orig_exemplars"][0], [5, 5, 15, 15])
+
+
+def test_collate_and_loader(tmp_path):
+    root = str(tmp_path)
+    _write_fscd147(root)
+    cfg = Config(dataset="FSCD147", datapath=root, image_size=64,
+                 num_exemplars=1)
+    ds = build_dataset(cfg, "val")
+    loader = DataLoader(ds, batch_size=2, shuffle=True, seed=1, max_gt=5,
+                        max_exemplars=1)
+    batches = list(loader)
+    assert len(batches) == 1
+    b = batches[0]
+    assert b["image"].shape == (2, 64, 64, 3)
+    assert b["gt_boxes"].shape == (2, 5, 4)
+    assert b["gt_valid"].sum() == 3  # 2 + 1 real boxes
+    assert b["exemplars"].shape == (2, 1, 4)
+    assert len(b["meta"]) == 2
+
+    # determinism: same seed+epoch -> same order
+    l2 = DataLoader(ds, batch_size=2, shuffle=True, seed=1, max_gt=5,
+                    max_exemplars=1)
+    assert [m["img_id"] for m in next(iter(l2))["meta"]] == [
+        m["img_id"] for m in b["meta"]
+    ]
+
+
+def test_collate_grows_instead_of_truncating():
+    """GT boxes are never dropped: the pad bucket grows in powers of two
+    (code-review finding — truncation would train real objects as negatives)."""
+    items = []
+    for n in (3, 37):
+        items.append({
+            "image": np.zeros((8, 8, 3), np.float32),
+            "boxes": np.tile([[0.1, 0.1, 0.2, 0.2]], (n, 1)).astype(np.float32),
+            "exemplars": np.array([[0.1, 0.1, 0.2, 0.2]], np.float32),
+            "img_name": f"x{n}", "img_url": "", "img_id": n,
+            "img_size": np.array([8, 8]),
+            "orig_boxes": np.zeros((n, 4)), "orig_exemplars": np.zeros((1, 4)),
+        })
+    out = collate(items, max_gt=16, max_exemplars=1)
+    assert out["gt_boxes"].shape[1] == 64  # next pow2 >= 37 from floor 16
+    assert out["gt_valid"][1].sum() == 37  # nothing dropped
+
+
+def test_dark_uint8_image_still_scaled_by_255():
+    img = np.ones((4, 4, 3), np.uint8)  # all pixels == 1
+    out = normalize_image(img)
+    want = (1 / 255.0 - 0.485) / 0.229
+    np.testing.assert_allclose(out[0, 0, 0], want, rtol=1e-5)
+
+
+def test_normalize_image_matches_formula():
+    img = np.full((4, 4, 3), 128, np.uint8)
+    out = normalize_image(img)
+    want = (128 / 255.0 - np.array([0.485, 0.456, 0.406])) / np.array(
+        [0.229, 0.224, 0.225]
+    )
+    np.testing.assert_allclose(out[0, 0], want, rtol=1e-5)

@@ -1,0 +1,107 @@
+"""MatchingNet forward: shapes, jit, matcher semantics, bucket selection."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.config import Config
+from tmr_tpu.models import build_model
+from tmr_tpu.models.matching_net import MatchingNet, select_capacity_bucket
+from tmr_tpu.models.vit import SamViT
+
+TINY_VIT = dict(
+    embed_dim=32,
+    depth=2,
+    num_heads=2,
+    global_attn_indexes=(1,),
+    patch_size=8,
+    window_size=3,
+    out_chans=16,
+    pretrain_img_size=64,
+)
+
+
+def _tiny_model(**over):
+    kwargs = dict(
+        backbone=SamViT(**TINY_VIT),
+        emb_dim=24,
+        fusion=True,
+        feature_upsample=True,
+        template_capacity=9,
+    )
+    kwargs.update(over)
+    return MatchingNet(**kwargs)
+
+
+def _data(b=2, s=64):
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((b, s, s, 3)).astype(np.float32)
+    exemplars = np.tile(np.array([[0.2, 0.2, 0.4, 0.45]], np.float32), (b, 1))[:, None, :]
+    return jnp.array(image), jnp.array(exemplars)
+
+
+def test_forward_shapes_and_finiteness():
+    model = _tiny_model()
+    image, exemplars = _data()
+    params = model.init(jax.random.key(0), image, exemplars)["params"]
+    out = jax.jit(lambda p, i, e: model.apply({"params": p}, i, e))(
+        params, image, exemplars
+    )
+    # 64/8 patches = 8 -> upsampled 16
+    assert out["objectness"][0].shape == (2, 16, 16)
+    assert out["regressions"][0].shape == (2, 16, 16, 4)
+    assert out["f_tm"][0].shape == (2, 16, 16, 24)
+    assert np.isfinite(np.asarray(out["objectness"][0])).all()
+    assert np.isfinite(np.asarray(out["regressions"][0])).all()
+    # f_tm passed through relu
+    assert (np.asarray(out["f_tm"][0]) >= 0).all()
+
+
+def test_no_matcher_and_no_boxreg_variants():
+    image, exemplars = _data()
+    m1 = _tiny_model(no_matcher=True, fusion=False)
+    p1 = m1.init(jax.random.key(0), image, exemplars)["params"]
+    out = m1.apply({"params": p1}, image, exemplars)
+    assert "matcher" not in p1
+    assert out["objectness"][0].shape == (2, 16, 16)
+
+    m2 = _tiny_model(box_reg=False)
+    p2 = m2.init(jax.random.key(0), image, exemplars)["params"]
+    out = m2.apply({"params": p2}, image, exemplars)
+    assert out["regressions"][0] is None
+    assert "decoder_b_0" not in p2
+
+
+def test_gradients_flow_to_heads_not_nan():
+    model = _tiny_model()
+    image, exemplars = _data()
+    params = model.init(jax.random.key(0), image, exemplars)["params"]
+
+    def loss_fn(p):
+        out = model.apply({"params": p}, image, exemplars)
+        return (out["objectness"][0] ** 2).mean() + (out["regressions"][0] ** 2).mean()
+
+    grads = jax.grad(loss_fn)(params)
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    # matcher scale receives gradient
+    assert float(np.abs(np.asarray(grads["matcher"]["scale"]))[0]) >= 0
+
+
+def test_build_model_registry_smoke():
+    cfg = Config(backbone="sam_vit_b", modeltype="matching_net", fusion=True,
+                 feature_upsample=True, compute_dtype="float32")
+    model = build_model(cfg)
+    assert isinstance(model, MatchingNet)
+    assert model.template_capacity == max(cfg.template_buckets)
+
+
+def test_select_capacity_bucket():
+    buckets = (9, 17, 33)
+    # tiny exemplar -> smallest bucket
+    assert select_capacity_bucket([0.1, 0.1, 0.12, 0.12], 64, 64, buckets) == 9
+    # mid exemplar spanning ~20 cells -> 33
+    assert select_capacity_bucket([0.1, 0.1, 0.4, 0.4], 64, 64, buckets) == 33
+    # oversized exemplar -> clamped to largest
+    assert select_capacity_bucket([0.0, 0.0, 1.0, 1.0], 64, 64, buckets) == 33

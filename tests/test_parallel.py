@@ -1,0 +1,235 @@
+"""Parallelism tests on the 8-device virtual CPU mesh: dp/tp sharded train
+step equivalence, and the MapReduce-replacement streaming stats pipeline."""
+
+import io
+import os
+import subprocess
+import sys
+import tarfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.config import Config
+from tmr_tpu.models.matching_net import MatchingNet
+from tmr_tpu.models.vit import SamViT
+from tmr_tpu.parallel import make_mesh, shard_params
+from tmr_tpu.parallel.mapreduce import (
+    StatAccumulator,
+    category_of,
+    feature_stats,
+    iter_tar_images,
+    reducer_table,
+    run_stream,
+)
+from tmr_tpu.parallel.sharding import shard_batch, state_sharding
+from tmr_tpu.train.state import create_train_state, make_train_step
+
+TINY_VIT = dict(
+    embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+    patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64,
+)
+
+
+def _model_cfg():
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        positive_threshold=0.5, negative_threshold=0.5,
+        lr=1e-3, lr_backbone=1e-4, compute_dtype="float32",
+    )
+    model = MatchingNet(backbone=SamViT(**TINY_VIT), emb_dim=16, fusion=True,
+                        template_capacity=9)
+    return cfg, model
+
+
+def _batch(b=8, s=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jnp.array(rng.standard_normal((b, s, s, 3)).astype(np.float32)),
+        "exemplars": jnp.array(
+            np.tile([[[0.3, 0.3, 0.45, 0.5]]], (b, 1, 1)).astype(np.float32)
+        ),
+        "gt_boxes": jnp.array(
+            np.tile([[[0.3, 0.3, 0.45, 0.5], [0.6, 0.6, 0.8, 0.75]]], (b, 1, 1)
+                    ).astype(np.float32)
+        ),
+        "gt_valid": jnp.ones((b, 2), bool),
+    }
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_train_step_matches_single_device(mesh_shape):
+    """dp/tp-sharded training must produce the same loss and params as the
+    unsharded program — sharding is an execution detail, not semantics."""
+    cfg, model = _model_cfg()
+    batch = _batch()
+    state = create_train_state(
+        model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
+        steps_per_epoch=10,
+    )
+    step = make_train_step(model, cfg)
+
+    ref_state, ref_losses = jax.jit(step)(state, batch)
+    ref_loss = float(ref_losses["loss"])
+
+    mesh = make_mesh(mesh_shape)
+    with mesh:
+        sh_state = state.replace(params=shard_params(state.params, mesh))
+        sh_batch = shard_batch(batch, mesh)
+        sharded = jax.jit(
+            step, out_shardings=(state_sharding(sh_state, mesh), None)
+        )
+        new_state, losses = sharded(sh_state, sh_batch)
+        jax.block_until_ready(new_state.params)
+
+    assert np.isclose(float(losses["loss"]), ref_loss, rtol=1e-4)
+    # spot-check a sharded param leaf matches the reference update
+    a = np.asarray(ref_state.params["backbone"]["blocks_0"]["attn"]["qkv"]["kernel"])
+    b = np.asarray(new_state.params["backbone"]["blocks_0"]["attn"]["qkv"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------- mapreduce
+def _make_tar(tmpdir, name, n_images, seed):
+    rng = np.random.default_rng(seed)
+    from PIL import Image
+
+    path = os.path.join(tmpdir, name)
+    with tarfile.open(path, "w") as tar:
+        for i in range(n_images):
+            arr = rng.integers(0, 255, (32, 40, 3), np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=f"img_{i}.png")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+def test_category_rules():
+    assert category_of("Easy_001.tar") == 0
+    assert category_of("Normal_x.tar") == 1
+    assert category_of("Hard_9.tar") == 2
+    assert category_of("whatever.tar") == 3
+
+
+def test_feature_stats_match_numpy():
+    x = np.random.default_rng(0).standard_normal((3, 4, 5, 6)).astype(np.float32)
+    got = np.asarray(feature_stats(jnp.array(x)))
+    for i in range(3):
+        f = x[i]
+        np.testing.assert_allclose(got[i, 0], f.mean(), rtol=1e-5)
+        np.testing.assert_allclose(got[i, 1], f.std(), rtol=1e-5)
+        np.testing.assert_allclose(got[i, 2], f.max(), rtol=1e-6)
+        np.testing.assert_allclose(got[i, 3], (f <= 0).mean(), rtol=1e-6)
+
+
+def test_stream_pipeline_and_reducer_parity(tmp_path):
+    """End-to-end: tar shards -> batched encode -> stats -> table, and the
+    table must equal what the REFERENCE reducer.py prints when fed our
+    emitted shuffle lines."""
+    tars = [
+        _make_tar(str(tmp_path), "Easy_0.tar", 5, 1),
+        _make_tar(str(tmp_path), "Easy_1.tar", 3, 2),
+        _make_tar(str(tmp_path), "Hard_0.tar", 4, 3),
+    ]
+
+    # stand-in encoder: identity-ish conv features via a tiny module
+    import flax.linen as nn
+
+    class TinyEnc(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(4, (3, 3), name="c")(x)
+
+    enc = TinyEnc()
+    params = enc.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+
+    @jax.jit
+    def encode_stats(images):
+        f = enc.apply({"params": params}, images)
+        return f, feature_stats(f)
+
+    saved = {}
+
+    def save_features(shard, name, feat):
+        saved[(shard, name)] = feat.shape
+
+    import tmr_tpu.parallel.mapreduce as mr
+
+    # shrink image size for the test
+    orig = mr.preprocess_image
+    mr.preprocess_image = lambda data, size=32: orig(data, 32)
+    try:
+        acc = run_stream(tars, encode_stats, batch_size=4, save_features=save_features)
+    finally:
+        mr.preprocess_image = orig
+
+    assert acc.table[0, 4] == 8  # Easy images
+    assert acc.table[2, 4] == 4  # Hard images
+    assert len(saved) == 12  # every image's features dumped
+
+    table = reducer_table(acc.table)
+
+    # cross-check against the reference reducer on our shuffle lines
+    lines = sorted(acc.emit_lines())  # Hadoop sorts by key
+    proc = subprocess.run(
+        [sys.executable, "/root/reference/reducer.py"],
+        input="\n".join(lines) + "\n",
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+    # identical table body (reference prints the same header + rows)
+    want_rows = [l for l in proc.stdout.splitlines() if "|" in l]
+    got_rows = [l for l in table.splitlines() if "|" in l]
+    assert got_rows == want_rows
+
+
+def test_psum_shuffle_replacement():
+    """Per-device stat partials psum'd over the mesh == host-side merge
+    (the collective that replaces the Hadoop sort/shuffle)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh((8, 1))
+    rng = np.random.default_rng(0)
+    partials = rng.uniform(0, 10, (8, 4, 5)).astype(np.float32)
+
+    def reduce_fn(t):
+        return jax.lax.psum(t[0], "data")[None]
+
+    out = shard_map(
+        reduce_fn, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+    )(jnp.array(partials))
+    total = np.asarray(out)[0]
+    np.testing.assert_allclose(total, partials.sum(axis=0), rtol=1e-5)
+
+
+def test_iter_tar_skips_corrupt_members(tmp_path):
+    path = os.path.join(str(tmp_path), "Easy_bad.tar")
+    from PIL import Image
+
+    with tarfile.open(path, "w") as tar:
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(buf, format="PNG")
+        good = buf.getvalue()
+        info = tarfile.TarInfo("good.png")
+        info.size = len(good)
+        tar.addfile(info, io.BytesIO(good))
+        bad = b"not an image"
+        info = tarfile.TarInfo("bad.jpg")
+        info.size = len(bad)
+        tar.addfile(info, io.BytesIO(bad))
+        info = tarfile.TarInfo("notes.txt")
+        info.size = 1
+        tar.addfile(info, io.BytesIO(b"x"))
+    images = list(iter_tar_images(path))
+    assert [n for n, _ in images] == ["good.png"]

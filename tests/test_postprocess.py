@@ -1,0 +1,120 @@
+"""Fixed-capacity detection decode + NMS vs. a numpy port of
+Get_pred_boxes/NMS (reference utils/TM_utils.py:224-323)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+
+from oracles import adaptive_kernel_np, masked_maxpool3x3_np, nms_np
+
+
+def get_pred_boxes_np(obj_logits, regs, exemplar, cls_thr, box_reg=True):
+    """Single-image, single-level port of Get_pred_boxes (TM_utils.py:224-305)."""
+    H, W = obj_logits.shape
+    pred = 1.0 / (1.0 + np.exp(-obj_logits))
+
+    ex = [min(1.0, max(0.0, float(v))) for v in exemplar]
+    bw, bh = ex[2] - ex[0], ex[3] - ex[1]
+
+    kernel = adaptive_kernel_np([bh, bw], [H, W])
+    pooled = masked_maxpool3x3_np(pred, kernel)
+    peak = pooled == pred
+    ys, xs = np.nonzero((pred >= cls_thr) & peak)
+
+    refs = np.stack([xs / W, ys / H], 1)
+    scores = pred[ys, xs]
+    if box_reg:
+        r = regs[ys, xs]
+        xy = refs + r[:, :2] * np.array([bw, bh])
+        wh = np.exp(r[:, 2:]) * np.array([bw, bh])
+    else:
+        xy = refs
+        wh = np.tile([[bw, bh]], (len(refs), 1))
+    boxes = np.concatenate([xy - wh / 2, xy + wh / 2], 1)
+    return boxes, scores, refs
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("cls_thr", [0.25, 0.5])
+def test_decode_matches_reference(seed, cls_thr):
+    rng = np.random.default_rng(seed)
+    H = W = 24
+    obj = rng.standard_normal((1, H, W)).astype(np.float32)
+    regs = (rng.standard_normal((1, H, W, 4)) * 0.2).astype(np.float32)
+    exemplar = np.array([[0.3, 0.35, 0.5, 0.55]], np.float32)
+
+    dets = jax.jit(
+        lambda o, r, e: decode_detections([o], [r], e, cls_thr, max_detections=128)
+    )(jnp.array(obj), jnp.array(regs), jnp.array(exemplar))
+
+    want_boxes, want_scores, want_refs = get_pred_boxes_np(
+        obj[0].astype(np.float64), regs[0].astype(np.float64), exemplar[0], cls_thr
+    )
+
+    valid = np.asarray(dets["valid"][0])
+    got_scores = np.asarray(dets["scores"][0])[valid]
+    got_boxes = np.asarray(dets["boxes"][0])[valid]
+    got_refs = np.asarray(dets["refs"][0])[valid]
+
+    assert len(got_scores) == len(want_scores)
+    # compare as score-sorted sets
+    wo = np.argsort(-want_scores)
+    go = np.argsort(-got_scores)
+    np.testing.assert_allclose(got_scores[go], want_scores[wo], rtol=1e-5)
+    np.testing.assert_allclose(got_boxes[go], want_boxes[wo], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_refs[go], want_refs[wo], rtol=1e-5, atol=1e-6)
+
+
+def test_decode_no_box_reg_uses_exemplar_size():
+    rng = np.random.default_rng(2)
+    H = W = 16
+    obj = rng.standard_normal((1, H, W)).astype(np.float32)
+    exemplar = np.array([[0.2, 0.2, 0.4, 0.5]], np.float32)
+    dets = decode_detections(
+        [jnp.array(obj)], [None], jnp.array(exemplar), 0.3,
+        max_detections=32, box_reg=False,
+    )
+    valid = np.asarray(dets["valid"][0])
+    boxes = np.asarray(dets["boxes"][0])[valid]
+    wh = boxes[:, 2:] - boxes[:, :2]
+    np.testing.assert_allclose(wh, np.tile([[0.2, 0.3]], (len(wh), 1)), atol=1e-6)
+
+
+def test_full_pipeline_with_nms_matches_reference():
+    rng = np.random.default_rng(3)
+    H = W = 24
+    obj = (rng.standard_normal((1, H, W)) * 2).astype(np.float32)
+    regs = (rng.standard_normal((1, H, W, 4)) * 0.2).astype(np.float32)
+    exemplar = np.array([[0.3, 0.3, 0.45, 0.5]], np.float32)
+    iou_thr = 0.5
+
+    dets = decode_detections(
+        [jnp.array(obj)], [jnp.array(regs)], jnp.array(exemplar), 0.25,
+        max_detections=128,
+    )
+    dets = batched_nms(dets, iou_thr)
+
+    boxes, scores, _ = get_pred_boxes_np(
+        obj[0].astype(np.float64), regs[0].astype(np.float64), exemplar[0], 0.25
+    )
+    keep = nms_np(boxes, scores, iou_thr)
+    want = scores[sorted(keep)]
+
+    valid = np.asarray(dets["valid"][0])
+    got = np.sort(np.asarray(dets["scores"][0])[valid])
+    np.testing.assert_allclose(got, np.sort(want), rtol=1e-5)
+
+
+def test_empty_detections_are_clean():
+    obj = jnp.full((1, 16, 16), -10.0)  # sigmoid ~ 0
+    regs = jnp.zeros((1, 16, 16, 4))
+    ex = jnp.array([[0.4, 0.4, 0.6, 0.6]])
+    dets = batched_nms(
+        decode_detections([obj], [regs], ex, 0.25, max_detections=32), 0.5
+    )
+    assert not bool(np.asarray(dets["valid"]).any())
+    assert np.isfinite(np.asarray(dets["boxes"])).all()

@@ -1,0 +1,234 @@
+"""Target assignment + criterion vs. a numpy port of GT_map semantics
+(reference utils/TM_utils.py:20-222, criterion/criterions_TM.py:31-58)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.ops.boxes import decode_regression
+from tmr_tpu.train.criterion import criterion
+from tmr_tpu.train.targets import assign_targets
+
+
+# ------------------------------------------------------------------- oracle
+def gt_map_np(boxes, exemplar, H, W, pos_thr, neg_thr, is_last=True):
+    """Single-image, single-level port of GT_map.Get_pred_gts's map logic."""
+    L = H * W
+    xs = np.arange(W) / W
+    ys = np.arange(H) / H
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    cxs, cys = gx.reshape(-1), gy.reshape(-1)
+
+    N = len(boxes)
+    x1, y1, x2, y2 = boxes.T
+    cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+    bw, bh = x2 - x1, y2 - y1
+
+    rel_x = np.abs(cxs[:, None] - cx[None])
+    rel_y = np.abs(cys[:, None] - cy[None])
+
+    is_center = np.zeros((L, N), bool)
+    idx = np.argmin(rel_x + rel_y, axis=0)
+    is_center[idx, range(N)] = True
+
+    ratio = -bh / bw
+    bias_p = ((1 - pos_thr) / (1 + pos_thr)) * bh
+    bias_n = ((1 - neg_thr) / (1 + neg_thr)) * bh
+    is_in_pos = ratio * rel_x + bias_p >= rel_y
+    is_in_neg = ratio * rel_x + bias_n < rel_y
+    if pos_thr == 1.0:
+        is_in_pos = is_center
+    if neg_thr == 1.0:
+        is_in_neg = ~is_center
+
+    ex = [min(1.0, max(0.0, float(v))) for v in exemplar]
+    xi1, xi2 = math.floor(ex[0] * W), math.ceil(ex[2] * W)
+    yi1, yi2 = math.floor(ex[1] * H), math.ceil(ex[3] * H)
+    if (xi2 - xi1) % 2 == 0:
+        xi2 -= 1
+    if (yi2 - yi1) % 2 == 0:
+        yi2 -= 1
+    px, py = (xi2 - xi1) // 2, (yi2 - yi1) // 2
+    nb2 = np.zeros((H, W), bool)
+    nb2[py : H - py, px : W - px] = True
+    nb = nb2.reshape(-1)[:, None].repeat(N, 1)
+
+    pos = (is_center | is_in_pos) if is_last else is_in_pos
+    is_in_neg = is_in_neg | (pos & ~nb)
+    pos = pos & nb
+
+    area = bw * bh
+    grid = np.where(pos, area[None], 1e8)
+    bid = np.argmin(grid, axis=1)
+    box_targets = np.stack([cx, cy, bw, bh], 1)[bid]
+
+    positive = pos.max(1).reshape(H, W)
+    ignore = ((~pos).max(1) & (~is_in_neg).max(1) & nb.max(1)).reshape(H, W)
+    negative = ~(positive | ignore)
+    return positive, negative, box_targets.reshape(H, W, 4)
+
+
+def _random_boxes(rng, n):
+    c = rng.uniform(0.1, 0.9, (n, 2))
+    wh = rng.uniform(0.03, 0.3, (n, 2))
+    b = np.concatenate([c - wh / 2, c + wh / 2], 1)
+    return np.clip(b, 0.0, 1.0).astype(np.float32)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("thr", [(0.5, 0.5), (0.7, 0.7), (1.0, 1.0)])
+@pytest.mark.parametrize("is_last", [True, False])
+def test_assignment_matches_reference(seed, thr, is_last):
+    rng = np.random.default_rng(seed)
+    H = W = 16
+    n = 5
+    boxes = _random_boxes(rng, n)
+    exemplar = boxes[0]
+
+    M = 8  # padded capacity
+    padded = np.zeros((1, M, 4), np.float32)
+    padded[0, :n] = boxes
+    valid = np.zeros((1, M), bool)
+    valid[0, :n] = True
+
+    got = assign_targets(
+        jnp.array(padded), jnp.array(valid), jnp.array(exemplar[None]),
+        H, W, thr[0], thr[1], is_last_level=is_last,
+    )
+    want_pos, want_neg, want_boxes = gt_map_np(
+        boxes.astype(np.float64), exemplar, H, W, thr[0], thr[1], is_last
+    )
+    np.testing.assert_array_equal(np.asarray(got["positive"][0]), want_pos)
+    np.testing.assert_array_equal(np.asarray(got["negative"][0]), want_neg)
+    # box targets only matter at positive locations
+    np.testing.assert_allclose(
+        np.asarray(got["box_target"][0])[want_pos],
+        want_boxes[want_pos],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_padding_boxes_do_not_leak():
+    """A padded (invalid) giant box must not claim any location."""
+    H = W = 16
+    real = np.array([[0.4, 0.4, 0.6, 0.6]], np.float32)
+    padded = np.zeros((1, 2, 4), np.float32)
+    padded[0, 0] = real[0]
+    padded[0, 1] = [0.0, 0.0, 1.0, 1.0]  # invalid giant box
+    valid = np.array([[True, False]])
+    got = assign_targets(
+        jnp.array(padded), jnp.array(valid),
+        jnp.array(real), H, W, 0.5, 0.5,
+    )
+    want_pos, want_neg, _ = gt_map_np(real.astype(np.float64), real[0], H, W, 0.5, 0.5)
+    np.testing.assert_array_equal(np.asarray(got["positive"][0]), want_pos)
+    np.testing.assert_array_equal(np.asarray(got["negative"][0]), want_neg)
+    # chosen box target at positives is the real box, not the padding
+    bt = np.asarray(got["box_target"][0])[want_pos]
+    np.testing.assert_allclose(bt[:, 2:], [[0.2, 0.2]] * len(bt), atol=1e-6)
+
+
+# ---------------------------------------------------------------- criterion
+def _torch_reference_loss(obj_logits, reg, pos, neg, box_t, exemplar):
+    """Reference SetCriterion_TM on gathered values, via torch ops."""
+    import torch
+    import torch.nn.functional as F
+
+    o = torch.from_numpy(obj_logits)
+    pred_pos = o[torch.from_numpy(pos)]
+    pred_neg = o[torch.from_numpy(neg)]
+    preds = torch.cat([pred_pos, pred_neg])
+    gts = torch.cat([torch.ones_like(pred_pos), torch.zeros_like(pred_neg)])
+    ce = F.binary_cross_entropy_with_logits(preds, gts, reduction="none")
+
+    H, W = obj_logits.shape[1:]
+    ex_w = exemplar[2] - exemplar[0]
+    ex_h = exemplar[3] - exemplar[1]
+    xs = np.arange(W) / W
+    ys = np.arange(H) / H
+    gy, gx = np.meshgrid(ys, gx_ := xs, indexing="ij")
+    centers = np.stack([gx, gy], -1)[None]
+    pred_xy = centers + reg[..., :2] * np.array([ex_w, ex_h])
+    pred_wh = np.exp(reg[..., 2:]) * np.array([ex_w, ex_h])
+    pred_xywh = np.concatenate([pred_xy, pred_wh], -1)
+
+    p = pred_xywh[pos]
+    t = box_t[pos]
+    num_pos = len(p)
+    if num_pos == 0:
+        p = np.array([[0.0, 0.0, 1e-14, 1e-14]])
+        t = np.array([[0.0, 0.0, 1e-14, 1e-14]])
+        num_pos = 1
+
+    from oracles import giou_loss_np
+
+    def to_xyxy(b):
+        return np.concatenate([b[:, :2] - b[:, 2:] / 2, b[:, :2] + b[:, 2:] / 2], 1)
+
+    giou = giou_loss_np(to_xyxy(p), to_xyxy(t))
+    return ce.sum().item() / num_pos, giou.sum() / num_pos
+
+
+def test_criterion_matches_reference():
+    rng = np.random.default_rng(3)
+    H = W = 16
+    boxes = _random_boxes(rng, 4)
+    exemplar = boxes[0]
+    pos, neg, box_t = gt_map_np(boxes.astype(np.float64), exemplar, H, W, 0.5, 0.5)
+
+    obj = rng.standard_normal((1, H, W)).astype(np.float32)
+    reg = (rng.standard_normal((1, H, W, 4)) * 0.1).astype(np.float32)
+
+    padded = np.zeros((1, 8, 4), np.float32)
+    padded[0, :4] = boxes
+    valid = np.zeros((1, 8), bool)
+    valid[0, :4] = True
+    tgt = assign_targets(
+        jnp.array(padded), jnp.array(valid), jnp.array(exemplar[None]), H, W, 0.5, 0.5
+    )
+    got = criterion(
+        [jnp.array(obj)], [jnp.array(reg)], [tgt], jnp.array(exemplar[None])
+    )
+    want_ce, want_giou = _torch_reference_loss(
+        obj, reg.astype(np.float64), pos[None], neg[None], box_t[None], exemplar
+    )
+    np.testing.assert_allclose(float(got["loss_ce"]), want_ce, rtol=1e-4)
+    np.testing.assert_allclose(float(got["loss_giou"]), want_giou, rtol=1e-4)
+
+
+def test_criterion_zero_positive_dummy():
+    """Image with no positives contributes giou 1.0 and counts 1 (the
+    reference's degenerate-box fallback, TM_utils.py:201-203)."""
+    H = W = 8
+    obj = np.full((1, H, W), -5.0, np.float32)
+    reg = np.zeros((1, H, W, 4), np.float32)
+    tgt = {
+        "positive": jnp.zeros((1, H, W), bool),
+        "negative": jnp.ones((1, H, W), bool),
+        "box_target": jnp.zeros((1, H, W, 4)),
+    }
+    ex = jnp.array([[0.4, 0.4, 0.6, 0.6]])
+    got = criterion([jnp.array(obj)], [jnp.array(reg)], [tgt], ex)
+    # giou: dummy only -> 1.0 / 1
+    np.testing.assert_allclose(float(got["loss_giou"]), 1.0, atol=1e-6)
+    # ce: sum of BCE(-5, 0) over all 64 negatives / 1
+    want_ce = float(np.log1p(np.exp(-5.0)) * H * W)
+    np.testing.assert_allclose(float(got["loss_ce"]), want_ce, rtol=1e-5)
+
+
+def test_decode_regression_ablations():
+    rng = np.random.default_rng(0)
+    reg = jnp.array(rng.standard_normal((1, 4, 4, 4)).astype(np.float32) * 0.1)
+    ex = jnp.array([[0.2, 0.2, 0.5, 0.6]])
+    base = np.asarray(decode_regression(reg, ex))
+    img = np.asarray(decode_regression(reg, ex, scale_imgsize=True))
+    who = np.asarray(decode_regression(reg, ex, scale_wh_only=True))
+    # imgsize ablation scales by 1 instead of exemplar size
+    assert not np.allclose(base, img)
+    # wh_only: xy offsets unscaled, wh still exemplar-scaled
+    np.testing.assert_allclose(who[..., 2:], base[..., 2:], atol=1e-7)
+    assert not np.allclose(who[..., :2], base[..., :2])

@@ -1,0 +1,123 @@
+"""End-to-end slice: fit a tiny model on a synthetic FSCD-147 fixture,
+validate (AP/MAE pipeline), checkpoint best/last, resume, and test-eval."""
+
+import json
+import os
+
+import numpy as np
+
+from tmr_tpu.config import Config
+from tmr_tpu.inference import Predictor
+from tmr_tpu.models.matching_net import MatchingNet
+from tmr_tpu.models.vit import SamViT
+
+TINY_VIT = dict(
+    embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+    patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64,
+)
+
+
+def _write_fixture(root, n_train=4, n_val=2):
+    """Images with 2 bright square 'objects' on dark background."""
+    from PIL import Image
+
+    os.makedirs(f"{root}/annotations", exist_ok=True)
+    os.makedirs(f"{root}/images_384_VarV2", exist_ok=True)
+    rng = np.random.default_rng(0)
+    names = [f"im{i}.jpg" for i in range(n_train + n_val)]
+    annos, instances = {}, []
+    aid = 1
+    for i, n in enumerate(names):
+        arr = (rng.uniform(0, 40, (64, 64, 3))).astype(np.uint8)
+        boxes = []
+        for (cx, cy) in [(16, 16), (44, 40)]:
+            arr[cy - 5 : cy + 5, cx - 5 : cx + 5] = 220
+            boxes.append([cx - 5, cy - 5, 10, 10])
+        Image.fromarray(arr).save(f"{root}/images_384_VarV2/{n}")
+        x, y, w, h = boxes[0]
+        annos[n] = {
+            "box_examples_coordinates": [
+                [[x, y], [x, y + h], [x + w, y + h], [x + w, y]]
+            ]
+        }
+        for b in boxes:
+            instances.append(
+                {"id": aid, "image_id": i, "bbox": b}
+            )
+            aid += 1
+    json.dump(annos, open(f"{root}/annotations/annotation_FSC147_384.json", "w"))
+    json.dump(
+        {
+            "train": names[:n_train],
+            "val": names[n_train:],
+            "test": names[n_train:],
+        },
+        open(f"{root}/annotations/Train_Test_Val_FSC_147.json", "w"),
+    )
+    inst = {
+        "images": [{"id": i, "file_name": n} for i, n in enumerate(names)],
+        "annotations": instances,
+    }
+    for split in ("train", "val", "test"):
+        json.dump(inst, open(f"{root}/annotations/instances_{split}.json", "w"))
+
+
+def _make_trainer(root, logdir, resume=False):
+    from tmr_tpu.train.loop import Trainer
+
+    cfg = Config(
+        dataset="FSCD147", datapath=root, logpath=logdir,
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        feature_upsample=False, image_size=64,
+        positive_threshold=0.5, negative_threshold=0.5,
+        NMS_cls_threshold=0.3, NMS_iou_threshold=0.5,
+        lr=2e-3, lr_backbone=0.0, max_epochs=2, AP_term=1,
+        batch_size=2, num_workers=2, max_gt_boxes=8,
+        compute_dtype="float32", max_detections=64,
+        template_buckets=(9,), resume=resume,
+    )
+    trainer = Trainer(cfg)
+    tiny = MatchingNet(
+        backbone=SamViT(**TINY_VIT), emb_dim=cfg.emb_dim, fusion=True,
+        template_capacity=9,
+    )
+    trainer.model = tiny
+    trainer.predictor = Predictor(cfg, model=tiny)
+    return trainer
+
+
+def test_fit_eval_checkpoint_resume(tmp_path):
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    _write_fixture(root)
+
+    trainer = _make_trainer(root, logdir)
+    trainer.fit()
+
+    # metrics CSV written with train + val columns
+    csv_path = os.path.join(logdir, "metrics.csv")
+    assert os.path.exists(csv_path)
+    content = open(csv_path).read()
+    assert "val/AP" in content and "train/loss_ce" in content
+
+    # checkpoints: last + at least one best version
+    assert trainer.ckpt.last_path() is not None
+    assert trainer.ckpt.best_path() is not None
+    assert trainer.ckpt.meta["last_epoch"] == 1
+
+    # test eval runs end to end and returns the full metric suite
+    metrics = trainer.test()
+    for key in ("test/AP", "test/AP50", "test/MAE", "test/RMSE",
+                "test/loss_ce"):
+        assert key in metrics
+    assert np.isfinite(metrics["test/MAE"])
+
+    # eval logged_datas cleaned up after epoch end (log_utils del path)
+    assert not os.path.exists(os.path.join(logdir, "logged_datas", "test"))
+
+    # resume continues from the saved epoch without error
+    trainer2 = _make_trainer(root, logdir, resume=True)
+    trainer2.cfg = trainer2.cfg  # same config, max_epochs already reached
+    trainer2.fit()  # restores epoch 2 == max_epochs -> no further steps
+    assert trainer2.ckpt.meta["last_epoch"] == 1

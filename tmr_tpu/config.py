@@ -81,8 +81,12 @@ class Config:
     # static capacity of the template kernel (odd). Templates larger than the
     # active bucket re-trace at the next bucket; see ops/xcorr.py.
     template_buckets: Tuple[int, ...] = (9, 17, 33, 65)
-    # fixed detection capacity: >= maxDets upper bound (log_utils.py:193).
-    max_detections: int = 1100
+    # fixed detection capacity. AP's maxDets tops out at 1100
+    # (log_utils.py:193), so 2000 leaves headroom for MAE/RMSE counting on
+    # extremely dense images (the reference's post-NMS count is unbounded;
+    # ours caps here — only images with > max_detections surviving peaks
+    # can diverge).
+    max_detections: int = 2000
     # compute dtype for the encoder ("bfloat16" or "float32").
     compute_dtype: str = "bfloat16"
     # mesh axes: (data, model). Products must equal device count.

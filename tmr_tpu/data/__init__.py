@@ -1,0 +1,11 @@
+"""Datasets, preprocessing and host-side loading (reference datamodules/)."""
+
+from tmr_tpu.data.coco_index import COCOIndex  # noqa: F401
+from tmr_tpu.data.datasets import (  # noqa: F401
+    FSCD147Dataset,
+    FSCDLVISDataset,
+    RPINEDataset,
+    build_dataset,
+)
+from tmr_tpu.data.loader import DataLoader, collate  # noqa: F401
+from tmr_tpu.data.transforms import normalize_image, resize_normalize  # noqa: F401

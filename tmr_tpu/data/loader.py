@@ -1,0 +1,134 @@
+"""Host-side data loading: seeded shuffling, thread prefetch, padded collate.
+
+Replaces torch DataLoader + custom_collate (reference
+datamodules/collate.py:3-21, abstract_datamodule.py:11-59). The reference
+keeps boxes/exemplars as ragged python lists; jit wants fixed shapes, so the
+collate pads GT boxes to ``max_gt`` with a validity mask and exemplars to
+``max_exemplars``. Metadata stays a python list (host-only). Determinism
+mirrors seed_everything + seeded workers: one np.random.Generator seeded
+from (seed, epoch) drives the permutation.
+
+Eval batches must be shape-uniform: items are grouped by their resolved
+image size (1024 vs the 1536 escape hatch), which also preserves the
+reference's val/test batch_size=1 behavior when batch_size=1.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def _gt_capacity(n: int, floor: int) -> int:
+    """Smallest power-of-two bucket >= n (min ``floor``). GT boxes are NEVER
+    truncated — dropping boxes would turn real objects into negative
+    supervision in the target assignment (the reference keeps ragged lists
+    of every box). Power-of-two growth bounds jit recompiles to a handful of
+    bucket shapes even on FSC-147's few-thousand-object images."""
+    cap = max(1, floor)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def collate(items: list, max_gt: int, max_exemplars: int) -> dict:
+    b = len(items)
+    s = items[0]["image"].shape[0]
+    image = np.stack([it["image"] for it in items])
+    counts = [len(np.asarray(it["boxes"]).reshape(-1, 4)) for it in items]
+    cap = _gt_capacity(max(counts, default=0), max_gt)
+    gt_boxes = np.zeros((b, cap, 4), np.float32)
+    gt_valid = np.zeros((b, cap), bool)
+    exemplars = np.zeros((b, max_exemplars, 4), np.float32)
+    for i, it in enumerate(items):
+        boxes = np.asarray(it["boxes"], np.float32).reshape(-1, 4)
+        gt_boxes[i, : len(boxes)] = boxes
+        gt_valid[i, : len(boxes)] = True
+        ex = np.asarray(it["exemplars"], np.float32).reshape(-1, 4)
+        k = min(len(ex), max_exemplars)
+        exemplars[i, :k] = ex[:k]
+        if k == 0:
+            raise ValueError(f"item {it['img_name']} has no exemplars")
+        if k < max_exemplars:  # repeat last exemplar into padding slots
+            exemplars[i, k:] = ex[k - 1]
+    meta = [
+        {k: it[k] for k in ("img_name", "img_url", "img_id", "img_size",
+                            "orig_boxes", "orig_exemplars")}
+        for it in items
+    ]
+    return {
+        "image": image,
+        "exemplars": exemplars,
+        "gt_boxes": gt_boxes,
+        "gt_valid": gt_valid,
+        "meta": meta,
+    }
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        seed: int = 42,
+        max_gt: int = 800,
+        max_exemplars: int = 1,
+        num_workers: int = 4,
+        drop_last: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.max_gt = max_gt
+        self.max_exemplars = max_exemplars
+        self.num_workers = max(1, num_workers)
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            order = rng.permutation(n)
+
+        window = self.num_workers * 2  # bounded submit-ahead: decoded images
+        # are ~MBs each; scheduling the whole epoch up front would buffer
+        # without limit when decoding outpaces the training step.
+        from collections import deque
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            queue: deque = deque()
+            idx_iter = iter(order.tolist())
+            for idx in idx_iter:
+                queue.append(pool.submit(self.dataset.__getitem__, idx))
+                if len(queue) >= window:
+                    break
+            pending: dict = {}
+            while queue:
+                it = queue.popleft().result()
+                nxt = next(idx_iter, None)
+                if nxt is not None:
+                    queue.append(pool.submit(self.dataset.__getitem__, nxt))
+                size = it["image"].shape[0]
+                pending.setdefault(size, []).append(it)
+                if len(pending[size]) == self.batch_size:
+                    yield collate(pending.pop(size), self.max_gt,
+                                  self.max_exemplars)
+            if not self.drop_last:
+                for group in pending.values():
+                    if group:
+                        yield collate(group, self.max_gt, self.max_exemplars)

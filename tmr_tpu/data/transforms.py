@@ -1,0 +1,62 @@
+"""Image preprocessing (reference datamodules/transforms.py, sans
+albumentations).
+
+The reference's train/eval transform is deterministic: Resize(S, S)
+(cv2 INTER_LINEAR under albumentations) + ImageNet Normalize + CHW tensor
+(transforms.py:42-50). The "large" variant is the same at 1536
+(:61-69). We keep cv2 INTER_LINEAR for pixel parity and emit NHWC float32
+(TPU layout). Resize semantics define the two static shape buckets
+(1024 / 1536) that replace the reference's dynamic <25px escape hatch
+branch at the model level.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize_image(image: np.ndarray) -> np.ndarray:
+    """uint8/float HWC RGB -> ImageNet-normalized float32 HWC
+    (albumentations A.Normalize: x/255 then (x - mean) / std)."""
+    img = np.asarray(image)
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    if img.shape[-1] == 4:
+        img = img[..., :3]
+    if np.issubdtype(img.dtype, np.integer):
+        img = img.astype(np.float32) / 255.0
+    else:
+        # float input is taken as already [0, 1]; dtype (not content) decides
+        img = img.astype(np.float32)
+    return (img - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def resize_normalize(image: np.ndarray, size: int) -> np.ndarray:
+    """Resize to (size, size) with cv2 INTER_LINEAR then normalize."""
+    import cv2
+
+    img = np.asarray(image)
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    if img.shape[-1] == 4:
+        img = img[..., :3]
+    img = cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
+    return normalize_image(img)
+
+
+def pick_image_size(orig_boxes: np.ndarray, base: int = 1024,
+                    large: int = 1536, eval_mode: bool = False,
+                    split: str = "train") -> int:
+    """The small-object escape hatch (FSCD147.py:148-150, RPINE.py:123-125):
+    eval/test images whose smallest GT box is < 25 px in BOTH dimensions run
+    at 1536, else the base size."""
+    if split != "test" or not eval_mode or len(orig_boxes) == 0:
+        return base
+    w = orig_boxes[:, 2] - orig_boxes[:, 0]
+    h = orig_boxes[:, 3] - orig_boxes[:, 1]
+    if w.min() < 25 and h.min() < 25:
+        return large
+    return base

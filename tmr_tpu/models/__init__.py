@@ -1,0 +1,48 @@
+"""Model zoo + registries (reference models/__init__.py and
+models/backbone/__init__.py, re-expressed for Flax modules)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tmr_tpu.models.matching_net import MatchingNet, select_capacity_bucket  # noqa: F401
+from tmr_tpu.models.resnet import RESNET_VARIANTS, build_resnet
+from tmr_tpu.models.vit import SamViT, build_sam_vit  # noqa: F401
+
+
+def build_backbone(cfg):
+    """Backbone registry (models/backbone/__init__.py:4-24).
+
+    'sam' maps to vit_h like the reference; 'sam_vit_b'/'sam_vit_h' select
+    explicitly (the reference reaches vit_b only via export_onnx.py).
+    """
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    name = cfg.backbone
+    if name == "sam" or name == "sam_vit_h":
+        return build_sam_vit("vit_h", dtype=dtype)
+    if name == "sam_vit_b":
+        return build_sam_vit("vit_b", dtype=dtype)
+    if name in RESNET_VARIANTS:
+        return build_resnet(name, dilation=cfg.dilation)
+    raise KeyError(f"unknown backbone {name!r}")
+
+
+def build_model(cfg) -> MatchingNet:
+    """Model registry (models/__init__.py:4-9; only 'matching_net')."""
+    if cfg.modeltype != "matching_net":
+        raise KeyError(f"unknown modeltype {cfg.modeltype!r}")
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    return MatchingNet(
+        backbone=build_backbone(cfg),
+        emb_dim=cfg.emb_dim,
+        fusion=cfg.fusion,
+        squeeze=cfg.squeeze,
+        box_reg=cfg.box_reg,
+        no_matcher=cfg.no_matcher,
+        feature_upsample=cfg.feature_upsample,
+        template_type=cfg.template_type,
+        template_capacity=max(cfg.template_buckets),
+        decoder_num_layer=cfg.decoder_num_layer,
+        decoder_kernel_size=cfg.decoder_kernel_size,
+        dtype=dtype,
+    )

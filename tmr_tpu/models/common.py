@@ -1,0 +1,47 @@
+"""Shared model layers (reference models/backbone/sam/common.py:12-56)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LayerNorm2d(nn.Module):
+    """Channels-last layer norm over the channel axis only.
+
+    Port of SAM's LayerNorm2d (common.py:44-56) — normalizes across C with a
+    *biased* variance and per-channel affine. The reference operates NCHW and
+    normalizes dim 1; we operate NHWC and normalize the trailing axis, which
+    is the identical computation in the TPU-preferred layout.
+    """
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        u = x.mean(axis=-1, keepdims=True)
+        s = ((x - u) ** 2).mean(axis=-1, keepdims=True)
+        x = (x - u) / jnp.sqrt(s + self.eps)
+        weight = self.param("weight", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return x * weight + bias
+
+
+class MLPBlock(nn.Module):
+    """Transformer MLP: Linear -> act -> Linear (common.py:26-39)."""
+
+    mlp_dim: int
+    act: Callable = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d = x.shape[-1]
+        act = self.act or (lambda y: nn.gelu(y, approximate=False))
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, name="lin1")(x)
+        x = act(x)
+        x = nn.Dense(d, dtype=self.dtype, name="lin2")(x)
+        return x

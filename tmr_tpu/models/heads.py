@@ -1,0 +1,60 @@
+"""Decoder conv stacks and prediction heads (reference models/regression_head.py).
+
+All convs initialize weight ~ N(0, 0.01), bias = 0, matching
+regression_head.py:19-24 — the objectness head's near-zero init sets the
+initial sigmoid to ~0.5, which the BCE normalization scheme expects.
+NHWC layout; LeakyReLU uses torch's default negative slope 0.01.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_INIT = nn.initializers.normal(stddev=0.01)
+
+
+class Decoder(nn.Module):
+    """N x (conv k x k same -> LeakyReLU), channel-preserving
+    (regression_head.py:3-24)."""
+
+    num_layers: int = 1
+    kernel_size: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        for i in range(self.num_layers):
+            x = nn.Conv(
+                c,
+                (self.kernel_size, self.kernel_size),
+                padding=(self.kernel_size - 1) // 2,
+                kernel_init=_INIT,
+                dtype=self.dtype,
+                name=f"conv_{i}",
+            )(x)
+            x = nn.leaky_relu(x, negative_slope=0.01)
+        return x
+
+
+class ObjectnessHead(nn.Module):
+    """1x1 conv -> 1 logit channel (regression_head.py:26-43)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Conv(1, (1, 1), kernel_init=_INIT, dtype=self.dtype,
+                       name="conv")(x)
+
+
+class BboxesHead(nn.Module):
+    """1x1 conv -> 4 ltrb regression channels (regression_head.py:45-62)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Conv(4, (1, 1), kernel_init=_INIT, dtype=self.dtype,
+                       name="conv")(x)

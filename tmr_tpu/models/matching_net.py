@@ -1,0 +1,155 @@
+"""The TMR detector (reference models/matching_net.py + template_matching.py).
+
+Pipeline per level (one level in practice):
+    encoder -> [2x bilinear upsample] -> 1x1 input_proj to emb_dim ->
+    template matcher (learnable scalar scale) -> [fusion concat] ->
+    decoder conv stacks -> objectness (1ch) + ltrb (4ch) heads.
+
+TPU-first differences from the reference:
+- NHWC activations; the matcher's per-image Python loop
+  (template_matching.py:79-93) is a vmap'd template extraction feeding ONE
+  grouped conv (ops/xcorr.py), so the whole forward is a single XLA program.
+- Template kernels have a static odd capacity (``template_capacity``); the
+  caller picks a bucket per batch from exemplar geometry (host-side, see
+  ``select_capacity_bucket``), and each bucket compiles once.
+- Outputs are dicts of per-level lists with channels-last maps:
+  objectness (B, H, W), regressions (B, H, W, 4), f_tm (B, H, W, C'),
+  feature (B, H, W, C) — the information content of matching_net.py:44-81's
+  returns in TPU layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
+from tmr_tpu.ops.xcorr import cross_correlation, extract_prototype, extract_template
+
+
+class TemplateMatcher(nn.Module):
+    """Matcher with learnable scalar scale (template_matching.py:8-21,95-98)."""
+
+    template_type: str = "roi_align"
+    squeeze: bool = False
+    capacity: int = 33
+
+    @nn.compact
+    def __call__(self, feature: jnp.ndarray, exemplars: jnp.ndarray) -> jnp.ndarray:
+        # feature: (B, H, W, C) NHWC; exemplars: (B, 4) normalized xyxy.
+        scale = self.param(
+            "scale", lambda key: jnp.array([1.0], jnp.float32)
+        )
+        f_nchw = feature.transpose(0, 3, 1, 2)
+        if self.template_type == "roi_align":
+            extract = lambda f, e: extract_template(f, e, self.capacity)
+        elif self.template_type == "prototype":
+            extract = lambda f, e: extract_prototype(f, e, 1)
+        else:
+            raise ValueError(f"unknown template_type {self.template_type!r}")
+        templates, thw = jax.vmap(extract)(f_nchw, exemplars)
+        out = cross_correlation(f_nchw, templates, thw, squeeze=self.squeeze)
+        return out.transpose(0, 2, 3, 1) * scale
+
+
+class MatchingNet(nn.Module):
+    """Few-shot pattern detector (matching_net.py:9-81)."""
+
+    backbone: nn.Module
+    emb_dim: int = 512
+    fusion: bool = False
+    squeeze: bool = False
+    box_reg: bool = True
+    no_matcher: bool = False
+    feature_upsample: bool = False
+    template_type: str = "roi_align"
+    template_capacity: int = 33
+    decoder_num_layer: int = 1
+    decoder_kernel_size: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, image: jnp.ndarray, exemplars: jnp.ndarray) -> dict:
+        """image: (B, S, S, 3) NHWC; exemplars: (B, K, 4) normalized xyxy
+        (the matcher uses exemplar 0, like template_matching.py:85)."""
+        f = self.backbone(image)
+        feats: Sequence[jnp.ndarray] = f if isinstance(f, (list, tuple)) else [f]
+
+        if self.feature_upsample:
+            feats = [
+                jax.image.resize(
+                    x,
+                    (x.shape[0], x.shape[1] * 2, x.shape[2] * 2, x.shape[3]),
+                    method="bilinear",
+                    antialias=False,
+                )
+                for x in feats
+            ]  # F.interpolate(scale 2, bilinear, align_corners=False)
+
+        out = {"objectness": [], "regressions": [], "f_tm": [], "feature": feats[0]}
+        for i, fi in enumerate(feats):
+            fp = nn.Conv(
+                self.emb_dim, (1, 1), dtype=self.dtype, name=f"input_proj_{i}"
+            )(fi)
+
+            if self.no_matcher:
+                f_tm = fp
+            else:
+                f_tm = TemplateMatcher(
+                    template_type=self.template_type,
+                    squeeze=self.squeeze,
+                    capacity=self.template_capacity,
+                    name=f"matcher_{i}" if i else "matcher",
+                )(fp.astype(jnp.float32), exemplars[:, 0, :])
+                f_tm = f_tm.astype(fp.dtype)
+
+            f_cat = jnp.concatenate([fp, f_tm], axis=-1) if self.fusion else f_tm
+
+            if self.box_reg:
+                f_box = Decoder(
+                    num_layers=self.decoder_num_layer,
+                    kernel_size=self.decoder_kernel_size,
+                    dtype=self.dtype,
+                    name=f"decoder_b_{i}",
+                )(f_cat)
+                b = BboxesHead(dtype=self.dtype, name=f"ltrbs_head_{i}")(f_box)
+                out["regressions"].append(b.astype(jnp.float32))
+            else:
+                out["regressions"].append(None)
+
+            f_obj = Decoder(
+                num_layers=self.decoder_num_layer,
+                kernel_size=self.decoder_kernel_size,
+                dtype=self.dtype,
+                name=f"decoder_o_{i}",
+            )(f_cat)
+            o = ObjectnessHead(dtype=self.dtype, name=f"objectness_head_{i}")(f_obj)
+            out["objectness"].append(o[..., 0].astype(jnp.float32))
+            out["f_tm"].append(nn.relu(f_tm).astype(jnp.float32))
+        return out
+
+
+def select_capacity_bucket(exemplar, feat_h: int, feat_w: int, buckets) -> int:
+    """Host-side bucket choice: smallest bucket holding the odd-ified
+    exemplar span (so the in-jit clamp in extract_template never bites).
+
+    exemplar: numpy (4,) normalized xyxy; buckets: ascending odd ints.
+    """
+    import math
+
+    x1 = min(1.0, max(0.0, float(exemplar[0]))) * feat_w
+    y1 = min(1.0, max(0.0, float(exemplar[1]))) * feat_h
+    x2 = min(1.0, max(0.0, float(exemplar[2]))) * feat_w
+    y2 = min(1.0, max(0.0, float(exemplar[3]))) * feat_h
+    wt = math.ceil(x2) - math.floor(x1)
+    ht = math.ceil(y2) - math.floor(y1)
+    wt -= wt % 2 == 0
+    ht -= ht % 2 == 0
+    need = max(1, ht, wt)
+    for b in buckets:
+        if b >= need:
+            return b
+    return buckets[-1]

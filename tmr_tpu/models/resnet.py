@@ -1,0 +1,157 @@
+"""ResNet-50 backbone family in Flax (reference models/backbone/resnet.py).
+
+Seven variants: full resnet50 (2048 ch), truncations at layer1/2/3
+(256/512/1024 ch) whose upper stages the reference grad-freezes, and fully
+frozen ``_FRZ`` versions (resnet.py:11-140). In this framework "frozen" is an
+optimizer concern, not a module concern — see ``trainable_param_filter``:
+the train state masks those subtrees out of the AdamW update, the functional
+equivalent of ``requires_grad_(False)``.
+
+BatchNorm is the reference's FrozenBatchNorm2d: affine + running stats used
+as constants, never updated — here simply parameters excluded from training,
+applied as (x - mean) / sqrt(var + eps) * w + b. NHWC layout throughout.
+ImageNet initialization requires a torchvision checkpoint file; the weight
+converter (utils/convert.py) maps ``resnet50`` state_dicts onto this tree.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FrozenBatchNorm(nn.Module):
+    """BatchNorm with fixed statistics (torchvision FrozenBatchNorm2d)."""
+
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        weight = self.param("weight", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        mean = self.param("running_mean", nn.initializers.zeros, (c,))
+        var = self.param("running_var", nn.initializers.ones, (c,))
+        scale = weight / jnp.sqrt(var + self.eps)
+        return x * scale + (bias - mean * scale)
+
+
+class Bottleneck(nn.Module):
+    """dilation applies to conv2; torchvision gives a stage's FIRST block the
+    previous stage's dilation and only later blocks the increased one
+    (resnet._make_layer's previous_dilation), which matters for DC5 weight
+    conversion parity."""
+
+    planes: int
+    stride: int = 1
+    dilation: int = 1
+    downsample: bool = False
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        out = FrozenBatchNorm(name="bn1")(out)
+        out = nn.relu(out)
+        out = nn.Conv(
+            self.planes,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=self.dilation,
+            kernel_dilation=(self.dilation, self.dilation),
+            use_bias=False,
+            name="conv2",
+        )(out)
+        out = FrozenBatchNorm(name="bn2")(out)
+        out = nn.relu(out)
+        out = nn.Conv(
+            self.planes * self.expansion, (1, 1), use_bias=False, name="conv3"
+        )(out)
+        out = FrozenBatchNorm(name="bn3")(out)
+        if self.downsample:
+            identity = nn.Conv(
+                self.planes * self.expansion,
+                (1, 1),
+                strides=(self.stride, self.stride),
+                use_bias=False,
+                name="downsample_0",
+            )(x)
+            identity = FrozenBatchNorm(name="downsample_1")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet50(nn.Module):
+    """Truncatable ResNet-50. ``out_layer`` in {1, 2, 3, 4}; ``dilation``
+    replaces layer4's stride with dilation (the reference's DC5 flag)."""
+
+    out_layer: int = 4
+    dilation: bool = True
+    layers: Sequence[int] = (3, 4, 6, 3)
+
+    @property
+    def num_channels(self) -> int:
+        return {1: 256, 2: 512, 3: 1024, 4: 2048}[self.out_layer]
+
+    @property
+    def feature_stride(self) -> int:
+        """Input-to-feature downsampling (stem 4x, x2 per later stage; with
+        DC5, layer4 keeps stride so 4->16 like layer3)."""
+        stride = {1: 4, 2: 8, 3: 16, 4: 32}[self.out_layer]
+        if self.out_layer == 4 and self.dilation:
+            stride = 16
+        return stride
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    name="conv1")(x)
+        x = FrozenBatchNorm(name="bn1")(x)
+        x = nn.relu(x)
+        # torch MaxPool2d(3, stride 2, padding 1)
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        # (planes, stride, first_block_dilation, later_block_dilation):
+        # with DC5, layer4 trades its stride for dilation, but its first
+        # block keeps previous_dilation=1 (torchvision _make_layer).
+        dilate4 = 2 if self.dilation else 1
+        stage_cfg = [
+            (64, 1, 1, 1),
+            (128, 2, 1, 1),
+            (256, 2, 1, 1),
+            (512, 1 if self.dilation else 2, 1, dilate4),
+        ]
+        for stage, (planes, stride, dil0, dil) in enumerate(stage_cfg, start=1):
+            if stage > self.out_layer:
+                break
+            for block in range(self.layers[stage - 1]):
+                x = Bottleneck(
+                    planes=planes,
+                    stride=stride if block == 0 else 1,
+                    dilation=dil0 if block == 0 else dil,
+                    downsample=(block == 0),
+                    name=f"layer{stage}_{block}",
+                )(x)
+        return x
+
+
+# name -> (constructor kwargs, frozen_prefixes) where frozen_prefixes lists
+# param subtrees the optimizer must mask out (reference requires_grad_(False)
+# calls at resnet.py:52-55,80-82,108-109,123-140).
+RESNET_VARIANTS = {
+    "resnet50": (dict(out_layer=4), ()),
+    "resnet50_layer1": (dict(out_layer=1), ()),
+    "resnet50_layer2": (dict(out_layer=2), ()),
+    "resnet50_layer3": (dict(out_layer=3), ()),
+    "resnet50_layer1_FRZ": (dict(out_layer=1), ("",)),  # all frozen
+    "resnet50_layer2_FRZ": (dict(out_layer=2), ("",)),
+    "resnet50_layer3_FRZ": (dict(out_layer=3), ("",)),
+}
+
+
+def build_resnet(name: str, dilation: bool = True) -> ResNet50:
+    kwargs, _ = RESNET_VARIANTS[name]
+    return ResNet50(dilation=dilation, **kwargs)

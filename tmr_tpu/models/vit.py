@@ -1,0 +1,253 @@
+"""SAM image-encoder ViT in Flax (ViTDet-style windowed attention).
+
+A TPU-first re-implementation of the reference encoder
+(models/backbone/sam/sam_ViT.py + sam.py):
+
+- NHWC end to end (TPU-native layout); tokens keep their (H, W) grid.
+- Windowed attention (window 14) with 4 global-attention blocks; window
+  padding shapes are static under jit.
+- Decomposed relative position bias (sam_ViT.py:292-361) with the index
+  tables precomputed at trace time (static shapes), and linear interpolation
+  of the tables for non-native grids (the 1536-input bucket).
+- Absolute position embeddings bilinearly resized for non-64 grids
+  (sam.py:72-76).
+- Configurable compute dtype: params stay f32, activations/matmuls can run
+  bf16 (MXU-native); softmax runs f32.
+
+Weight layout intentionally mirrors the reference module tree so the
+``.pth -> params`` converter (utils/convert.py) is a mechanical transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmr_tpu.models.common import LayerNorm2d, MLPBlock
+
+
+def window_partition(x: jnp.ndarray, window: int):
+    """(B, H, W, C) -> (B*nW, window, window, C), padding to multiples.
+
+    Mirrors sam_ViT.py:243-264; all shapes static under jit.
+    """
+    b, h, w, c = x.shape
+    pad_h = (window - h % window) % window
+    pad_w = (window - w % window) % window
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+    hp, wp = h + pad_h, w + pad_w
+    x = x.reshape(b, hp // window, window, wp // window, window, c)
+    windows = x.transpose(0, 1, 3, 2, 4, 5).reshape(-1, window, window, c)
+    return windows, (hp, wp)
+
+
+def window_unpartition(
+    windows: jnp.ndarray, window: int, pad_hw: Tuple[int, int], hw: Tuple[int, int]
+) -> jnp.ndarray:
+    """Inverse of window_partition (sam_ViT.py:267-289)."""
+    hp, wp = pad_hw
+    h, w = hw
+    b = windows.shape[0] // (hp * wp // window // window)
+    x = windows.reshape(b, hp // window, wp // window, window, window, -1)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, hp, wp, -1)
+    return x[:, :h, :w, :]
+
+
+def _interp_rel_pos(rel_pos: jnp.ndarray, target_len: int) -> jnp.ndarray:
+    """Linear resize of a (L, C) rel-pos table to (target_len, C).
+
+    Matches F.interpolate(mode='linear', align_corners=False)
+    (sam_ViT.py:306-313); identity when lengths agree.
+    """
+    if rel_pos.shape[0] == target_len:
+        return rel_pos
+    return jax.image.resize(
+        rel_pos, (target_len, rel_pos.shape[1]), method="linear", antialias=False
+    )
+
+
+def get_rel_pos(q_size: int, k_size: int, rel_pos: jnp.ndarray) -> jnp.ndarray:
+    """(Lq= q_size, Lk= k_size) table lookup of sam_ViT.py:292-322."""
+    max_rel_dist = int(2 * max(q_size, k_size) - 1)
+    rel = _interp_rel_pos(rel_pos, max_rel_dist)
+    # static integer index matrix (shapes are static under jit)
+    q_coords = np.arange(q_size)[:, None] * max(k_size / q_size, 1.0)
+    k_coords = np.arange(k_size)[None, :] * max(q_size / k_size, 1.0)
+    rel_coords = (q_coords - k_coords) + (k_size - 1) * max(q_size / k_size, 1.0)
+    return rel[rel_coords.astype(np.int64)]
+
+
+class Attention(nn.Module):
+    """Multi-head attention with decomposed rel-pos (sam_ViT.py:185-240).
+
+    ``rel_pos_size`` fixes the rel-pos *parameter* shapes at the pretrain
+    grid (window size for windowed blocks, native image grid for global
+    blocks); get_rel_pos interpolates the tables whenever the runtime grid
+    differs (the 1536 bucket).
+    """
+
+    num_heads: int
+    use_rel_pos: bool = True
+    rel_pos_size: Optional[Tuple[int, int]] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, dim = x.shape
+        head_dim = dim // self.num_heads
+        scale = head_dim**-0.5
+
+        qkv = nn.Dense(dim * 3, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, h * w, 3, self.num_heads, head_dim)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each (b, hw, heads, hd)
+        q = q.transpose(0, 2, 1, 3)  # (b, heads, hw, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+
+        attn = jnp.einsum("bnqc,bnkc->bnqk", q * scale, k)
+
+        if self.use_rel_pos:
+            rel_pos_h = self.param(
+                "rel_pos_h",
+                nn.initializers.zeros,
+                (2 * self.rel_pos_size[0] - 1, head_dim),
+            )
+            rel_pos_w = self.param(
+                "rel_pos_w",
+                nn.initializers.zeros,
+                (2 * self.rel_pos_size[1] - 1, head_dim),
+            )
+            rh = get_rel_pos(h, h, rel_pos_h).astype(self.dtype)  # (h, h, hd)
+            rw = get_rel_pos(w, w, rel_pos_w).astype(self.dtype)  # (w, w, hd)
+            r_q = q.reshape(b, self.num_heads, h, w, head_dim)
+            rel_h = jnp.einsum("bnhwc,hkc->bnhwk", r_q, rh)
+            rel_w = jnp.einsum("bnhwc,wkc->bnhwk", r_q, rw)
+            attn = attn.reshape(b, self.num_heads, h, w, h, w)
+            attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
+            attn = attn.reshape(b, self.num_heads, h * w, h * w)
+
+        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
+        x = jnp.einsum("bnqk,bnkc->bnqc", attn, v)
+        x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
+        return nn.Dense(dim, dtype=self.dtype, name="proj")(x)
+
+
+class Block(nn.Module):
+    """Transformer block with optional window attention (sam_ViT.py:119-182)."""
+
+    num_heads: int
+    mlp_ratio: float = 4.0
+    window_size: int = 0
+    rel_pos_size: Optional[Tuple[int, int]] = None  # native grid for global attn
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        dim = x.shape[-1]
+        shortcut = x
+        x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="norm1")(x)
+        if self.window_size > 0:
+            h, w = x.shape[1], x.shape[2]
+            x, pad_hw = window_partition(x, self.window_size)
+        attn_size = (
+            (self.window_size, self.window_size)
+            if self.window_size > 0
+            else self.rel_pos_size
+        )
+        x = Attention(
+            num_heads=self.num_heads,
+            rel_pos_size=attn_size,
+            dtype=self.dtype,
+            name="attn",
+        )(x)
+        if self.window_size > 0:
+            x = window_unpartition(x, self.window_size, pad_hw, (h, w))
+        x = shortcut + x
+        y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="norm2")(x)
+        y = MLPBlock(mlp_dim=int(dim * self.mlp_ratio), dtype=self.dtype, name="mlp")(y)
+        return x + y
+
+
+class SamViT(nn.Module):
+    """SAM image encoder (sam_ViT.py:17-116 + the pos-embed interpolation of
+    sam.py:70-95). Input (B, S, S, 3) NHWC -> (B, S/16, S/16, 256)."""
+
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    global_attn_indexes: Sequence[int] = (2, 5, 8, 11)
+    patch_size: int = 16
+    window_size: int = 14
+    out_chans: int = 256
+    mlp_ratio: float = 4.0
+    pretrain_img_size: int = 1024  # pos_embed native grid = 1024/16 = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        grid = self.pretrain_img_size // self.patch_size
+        x = nn.Conv(
+            self.embed_dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        h, w = x.shape[1], x.shape[2]
+
+        pos_embed = self.param(
+            "pos_embed", nn.initializers.zeros, (1, grid, grid, self.embed_dim)
+        )
+        if (h, w) != (grid, grid):
+            # bilinear re-interpolation for the 1536 bucket (sam.py:72-76)
+            pos_embed = jax.image.resize(
+                pos_embed, (1, h, w, self.embed_dim), method="bilinear",
+                antialias=False,
+            )
+        x = x + pos_embed.astype(x.dtype)
+
+        for i in range(self.depth):
+            win = 0 if i in self.global_attn_indexes else self.window_size
+            x = Block(
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                window_size=win,
+                rel_pos_size=(grid, grid),
+                dtype=self.dtype,
+                name=f"blocks_{i}",
+            )(x)
+
+        # neck: 1x1 conv -> LN2d -> 3x3 conv -> LN2d (sam_ViT.py:88-104)
+        x = nn.Conv(
+            self.out_chans, (1, 1), use_bias=False, dtype=self.dtype, name="neck_0"
+        )(x)
+        x = LayerNorm2d(name="neck_1")(x.astype(jnp.float32))
+        x = nn.Conv(
+            self.out_chans, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+            name="neck_2",
+        )(x.astype(self.dtype))
+        x = LayerNorm2d(name="neck_3")(x.astype(jnp.float32))
+        return x
+
+
+# Configurations of sam.py:20-30. `backbone='sam'` in the reference always
+# builds vit_h for train/eval (models/backbone/__init__.py:22); vit_b is the
+# ONNX/mapper path (export_onnx.py:27).
+VIT_CONFIGS = {
+    "vit_b": dict(
+        embed_dim=768, depth=12, num_heads=12, global_attn_indexes=(2, 5, 8, 11)
+    ),
+    "vit_h": dict(
+        embed_dim=1280, depth=32, num_heads=16, global_attn_indexes=(7, 15, 23, 31)
+    ),
+}
+
+
+def build_sam_vit(model_type: str = "vit_h", dtype=jnp.float32) -> SamViT:
+    return SamViT(dtype=dtype, **VIT_CONFIGS[model_type])

@@ -20,6 +20,7 @@ from tmr_tpu.ops.boxes import (  # noqa: F401
     cxcywh_to_xyxy,
     xyxy_to_cxcywh,
     box_area,
+    decode_regression,
     pairwise_iou,
     generalized_box_iou_loss,
 )
@@ -32,3 +33,4 @@ from tmr_tpu.ops.xcorr import (  # noqa: F401
 )
 from tmr_tpu.ops.nms import nms_keep_mask  # noqa: F401
 from tmr_tpu.ops.peaks import adaptive_kernel, masked_maxpool3x3  # noqa: F401
+from tmr_tpu.ops.postprocess import batched_nms, decode_detections  # noqa: F401

@@ -38,6 +38,40 @@ def pairwise_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(union > 0, inter / jnp.maximum(union, 1e-30), 0.0)
 
 
+def decode_regression(
+    regressions: jnp.ndarray,  # (B, H, W, 4)
+    exemplars: jnp.ndarray,  # (B, 4) normalized xyxy
+    scale_imgsize: bool = False,  # reference flag regression_scaling_imgsize
+    scale_wh_only: bool = False,  # reference flag regression_scaling_WH_only
+) -> jnp.ndarray:
+    """Exemplar-relative box decode (TM_utils.py:183-189 == :264-278).
+
+    pred_xy = center + dxy * (ex_w, ex_h); pred_wh = exp(dwh) * (ex_w, ex_h);
+    the ablation flags swap the (ex_w, ex_h) scaling for (1, 1) on both or on
+    xy only. Returns (B, H, W, 4) cxcywh in normalized coordinates.
+    """
+    b, h, w, _ = regressions.shape
+    ex1 = jnp.clip(exemplars[:, 0], 0.0, 1.0)
+    ey1 = jnp.clip(exemplars[:, 1], 0.0, 1.0)
+    ex2 = jnp.clip(exemplars[:, 2], 0.0, 1.0)
+    ey2 = jnp.clip(exemplars[:, 3], 0.0, 1.0)
+    ew = ex2 - ex1
+    eh = ey2 - ey1
+    if scale_imgsize:
+        ew = jnp.ones_like(ew)
+        eh = jnp.ones_like(eh)
+    exy = jnp.stack([ew, eh], axis=-1)[:, None, None, :]  # (B,1,1,2)
+
+    xs = jnp.arange(w, dtype=jnp.float32) / w
+    ys = jnp.arange(h, dtype=jnp.float32) / h
+    centers = jnp.stack(jnp.meshgrid(xs, ys), axis=-1)[None]  # (1,h,w,2) [x,y]
+
+    xy_scale = jnp.ones_like(exy) if scale_wh_only else exy
+    pred_xy = centers + regressions[..., :2] * xy_scale
+    pred_wh = jnp.exp(regressions[..., 2:]) * exy
+    return jnp.concatenate([pred_xy, pred_wh], axis=-1)
+
+
 def generalized_box_iou_loss(
     pred: jnp.ndarray, target: jnp.ndarray, eps: float = 1e-13
 ) -> jnp.ndarray:

@@ -1,0 +1,25 @@
+"""Device-mesh parallelism.
+
+The TPU replacement for BOTH of the reference's distribution mechanisms
+(SURVEY.md §2.2-2.3):
+
+- Lightning DDP/NCCL training (reference main.py:111-112) ->
+  ``jax.sharding`` data parallelism over the mesh 'data' axis; gradient
+  psum is inserted by XLA from the sharding annotations.
+- Hadoop Streaming mapper/reducer inference (mapper.py/reducer.py) ->
+  sharded streaming in parallel/mapreduce.py: each device owns a shard
+  stream, the sort/shuffle collapses into an on-device reduction of
+  fixed-size stat tuples.
+
+Mesh axes: ('data', 'model'). 'model' tensor-parallelism shards the ViT
+attention/MLP feature dims — not required for reference parity (the
+reference has no TP) but first-class here for scaling ViT-H beyond one chip.
+"""
+
+from tmr_tpu.parallel.mesh import make_mesh  # noqa: F401
+from tmr_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_spec,
+    shard_params,
+    state_sharding,
+)

@@ -1,0 +1,206 @@
+"""Sharded streaming feature extraction — the Hadoop MapReduce replacement.
+
+Reference pipeline (mapper.py + reducer.py under Hadoop Streaming):
+  shard list on stdin -> mapper per tar: HDFS get, untar, per image
+  ONNX ViT-B encode (batch 1) -> 4 stats (mean/std/max/sparsity,
+  mapper.py:103-114) summed per category + .npy feature dumps ->
+  "category\\tsum_mean,sum_std,sum_max,sum_spar,count" (:138) ->
+  Hadoop sort/shuffle -> reducer group-by-category averages table
+  (reducer.py:25-27).
+
+TPU-native redesign:
+- the per-image ONNX session becomes the jitted Flax encoder, batched;
+- a shard is a work item on a host feeder thread (tarfile + PIL);
+- the sort/shuffle collapses into a 3x5 per-category stat matrix summed on
+  device — when running over a mesh, each device accumulates partials for
+  its shard subset and one ``jax.lax.psum`` over the 'data' axis replaces
+  the entire Hadoop shuffle;
+- the reducer is a pure formatting function over the final (3, 5) matrix,
+  emitting the identical table.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CATEGORIES = ("Easy", "Normal", "Hard", "Unknown")  # mapper.py:15-20
+STAT_NAMES = ("sum_mean", "sum_std", "sum_max", "sum_spar", "count")
+
+
+def category_of(shard_name: str) -> int:
+    folder = os.path.basename(shard_name).replace(".tar", "")
+    for i, c in enumerate(CATEGORIES[:3]):
+        if folder.startswith(c + "_"):
+            return i
+    return 3
+
+
+def preprocess_image(data: bytes, size: int = 1024) -> Optional[np.ndarray]:
+    """PIL decode -> resize -> /255 (mapper.py:22-30), NHWC float32."""
+    from PIL import Image
+
+    try:
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((size, size))
+        return np.asarray(img, np.float32) / 255.0
+    except Exception:
+        return None  # bad image -> skip, like mapper.py:31-32
+
+
+def iter_tar_images(path: str) -> Iterator[tuple[str, np.ndarray]]:
+    """Stream (name, image) from a tar shard; corrupt members skipped."""
+    with tarfile.open(path, "r") as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            if not member.name.lower().endswith((".png", ".jpg", ".jpeg")):
+                continue
+            data = tar.extractfile(member)
+            if data is None:
+                continue
+            img = preprocess_image(data.read())
+            if img is not None:
+                yield member.name, img
+
+
+def feature_stats(features: jnp.ndarray) -> jnp.ndarray:
+    """(B, ...) -> (B, 4) [mean, std, max, sparsity] per image.
+
+    Sparsity = fraction of elements <= 0 (mapper.py:107); std is the
+    population std like np.std.
+    """
+    b = features.shape[0]
+    flat = features.reshape(b, -1).astype(jnp.float32)
+    mean = flat.mean(axis=1)
+    std = jnp.sqrt(((flat - mean[:, None]) ** 2).mean(axis=1))
+    mx = flat.max(axis=1)
+    spar = (flat <= 0).mean(axis=1)
+    return jnp.stack([mean, std, mx, spar], axis=1)
+
+
+def make_encode_stats_fn(encoder, params) -> Callable:
+    """Jitted (images (B,S,S,3)) -> ((B,...) features, (B,4) stats)."""
+
+    @jax.jit
+    def run(images):
+        feats = encoder.apply({"params": params}, images)
+        return feats, feature_stats(feats)
+
+    return run
+
+
+class StatAccumulator:
+    """Per-category running sums — the mapper emit + reducer aggregation
+    state, as a dense (4 categories x 5 values) matrix."""
+
+    def __init__(self):
+        self.table = np.zeros((len(CATEGORIES), len(STAT_NAMES)), np.float64)
+
+    def add(self, category: int, stats: np.ndarray) -> None:
+        """stats: (B, 4) per-image values for one shard batch."""
+        self.table[category, :4] += stats.sum(axis=0)
+        self.table[category, 4] += len(stats)
+
+    def merge(self, other: "StatAccumulator") -> None:
+        self.table += other.table
+
+    def emit_lines(self) -> list[str]:
+        """The mapper's shuffle records (mapper.py:138), for parity/debug."""
+        lines = []
+        for i, cat in enumerate(CATEGORIES):
+            m, s, x, sp, n = self.table[i]
+            if n > 0:
+                lines.append(f"{cat}\t{m},{s},{x},{sp},{int(n)}")
+        return lines
+
+
+def reducer_table(table: np.ndarray) -> str:
+    """Format the final averages exactly like reducer.py:25-27,39-42."""
+    out = [
+        f"{'CATEGORY':<12} | {'IMAGES':>6} | "
+        f"{'AVG_MEAN':>8} | {'AVG_STD':>8} | "
+        f"{'AVG_MAX':>8} | {'SPARSITY':>9}",
+        "-" * 70,
+    ]
+    for i, cat in enumerate(CATEGORIES):
+        n = table[i, 4]
+        if n <= 0:
+            continue
+        avg = table[i, :4] / n
+        out.append(
+            f"{cat:<12} | {int(n):>6} | "
+            f"{avg[0]:>8.4f} | {avg[1]:>8.4f} | "
+            f"{avg[2]:>8.4f} | {avg[3]:>7.2%}"
+        )
+    return "\n".join(out)
+
+
+def run_stream(
+    shard_paths: Sequence[str],
+    encode_stats_fn: Callable,
+    batch_size: int = 8,
+    image_size: int = 1024,
+    save_features: Optional[Callable[[str, str, np.ndarray], None]] = None,
+    feeder_threads: int = 4,
+) -> StatAccumulator:
+    """Single-host streaming map phase over tar shards.
+
+    Host feeder threads decode shards ahead of the device; the device runs
+    the jitted encoder on fixed-size batches (short tails padded and
+    masked out of the stats). ``save_features(shard, image_name, features)``
+    is the .npy side-effect hook (mapper.py:117-118).
+    """
+    acc = StatAccumulator()
+
+    def load_shard(path):
+        return list(iter_tar_images(path))
+
+    from collections import deque
+
+    with ThreadPoolExecutor(max_workers=feeder_threads) as pool:
+        # bounded shard prefetch — whole decoded shards are large
+        queue: deque = deque()
+        path_iter = iter(shard_paths)
+        for path in path_iter:
+            queue.append((path, pool.submit(load_shard, path)))
+            if len(queue) >= feeder_threads + 1:
+                break
+        while queue:
+            path, fut = queue.popleft()
+            images = fut.result()
+            nxt = next(path_iter, None)
+            if nxt is not None:
+                queue.append((nxt, pool.submit(load_shard, nxt)))
+            cat = category_of(path)
+            for i in range(0, len(images), batch_size):
+                chunk = images[i : i + batch_size]
+                names = [n for n, _ in chunk]
+                arr = np.stack([im for _, im in chunk])
+                real = len(arr)
+                if real < batch_size:  # pad to the jitted batch shape
+                    pad = np.zeros(
+                        (batch_size - real,) + arr.shape[1:], arr.dtype
+                    )
+                    arr = np.concatenate([arr, pad])
+                feats, stats = encode_stats_fn(jnp.asarray(arr))
+                stats = np.asarray(stats)[:real]
+                acc.add(cat, stats)
+                if save_features is not None:
+                    f_np = np.asarray(feats)[:real]
+                    for name, feat in zip(names, f_np):
+                        save_features(os.path.basename(path), name, feat)
+    return acc
+
+
+def allreduce_stats(table: jnp.ndarray, axis_name: str = "data") -> jnp.ndarray:
+    """The shuffle replacement: psum per-device (4, 5) partials over the
+    mesh axis. Use inside shard_map/pmap; see tests/test_parallel.py."""
+    return jax.lax.psum(table, axis_name)

@@ -1,0 +1,55 @@
+"""Mesh construction + multi-host init helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: Tuple[int, int] = (-1, 1),
+    axis_names: Tuple[str, str] = ("data", "model"),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ('data', 'model') mesh. shape=(-1, tp) fills 'data' with all
+    remaining devices. Works identically on a real slice and on the
+    virtual CPU mesh used in tests/dry runs.
+
+    Device order: jax.experimental.mesh_utils picks an ICI-friendly layout on
+    real TPU topologies; on hosts it's the flat device list.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = shape
+    if dp == -1:
+        if len(devices) % tp:
+            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
+        dp = len(devices) // tp
+    n = dp * tp
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
+    except Exception:
+        arr = np.array(devices[:n]).reshape(dp, tp)
+    return Mesh(arr, axis_names)
+
+
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Multi-host (DCN) initialization — the reference's multi-node story is
+    Hadoop job submission; ours is jax.distributed over the pod.
+
+    No-op when single-process (the common case in this image)."""
+    if num_processes in (None, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

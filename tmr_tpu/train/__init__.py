@@ -1,0 +1,11 @@
+"""Training layer: target assignment, losses, optimizer/train state."""
+
+from tmr_tpu.train.targets import assign_targets  # noqa: F401
+from tmr_tpu.train.criterion import criterion, focal_loss_elementwise  # noqa: F401
+from tmr_tpu.ops.boxes import decode_regression  # noqa: F401  (re-export)
+from tmr_tpu.train.state import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_optimizer,
+    train_step,
+)

@@ -1,0 +1,89 @@
+"""Loss computation (reference criterion/criterions_TM.py:7-58).
+
+The reference gathers positive/negative locations into ragged 1-D tensors
+then sums; here the identical sums are computed as masked reductions over
+the full maps, so the loss is shape-static and jit-fused with the forward.
+
+Normalization semantics preserved exactly (SetCriterion_TM.forward :40-52):
+- BCE (or focal) summed over positive+negative locations, / num_positive;
+- gIoU summed over positive locations, / num_positive;
+- num_positive counts ALL positive locations in the batch, PLUS one per
+  image with zero positives — those images contribute a degenerate-box dummy
+  whose gIoU loss is exactly 1.0 (TM_utils.py:201-203 with eps 1e-13);
+- losses averaged over levels.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.ops.boxes import (
+    cxcywh_to_xyxy,
+    decode_regression,
+    generalized_box_iou_loss,
+)
+
+
+def bce_with_logits(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise binary cross-entropy on logits (stable form)."""
+    return jnp.maximum(logits, 0.0) - logits * targets + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def focal_loss_elementwise(
+    logits: jnp.ndarray, targets: jnp.ndarray, alpha: float = 0.25, gamma: float = 2.0
+) -> jnp.ndarray:
+    """WeightedFocalLoss (criterions_TM.py:15-29): at*(1-pt)^g * BCE with
+    at = alpha for target 1, (1-alpha) for target 0."""
+    bce = bce_with_logits(logits, targets)
+    at = jnp.where(targets > 0.5, alpha, 1.0 - alpha)
+    pt = jnp.exp(-bce)
+    return at * (1.0 - pt) ** gamma * bce
+
+
+def criterion(
+    objectness: Sequence[jnp.ndarray],  # per level (B, H, W) logits
+    regressions: Sequence[jnp.ndarray],  # per level (B, H, W, 4) or None
+    targets: Sequence[dict],  # per level assign_targets output
+    exemplars: jnp.ndarray,  # (B, 4)
+    use_focal_loss: bool = False,
+    scale_imgsize: bool = False,
+    scale_wh_only: bool = False,
+) -> dict:
+    ce_losses, giou_losses = [], []
+    for level, (obj, reg, tgt) in enumerate(zip(objectness, regressions, targets)):
+        pos = tgt["positive"].astype(jnp.float32)  # (B, H, W)
+        neg = tgt["negative"].astype(jnp.float32)
+
+        elem = focal_loss_elementwise if use_focal_loss else bce_with_logits
+        ce_map = elem(obj, jnp.ones_like(obj)) * pos + elem(
+            obj, jnp.zeros_like(obj)
+        ) * neg
+        ce_sum = ce_map.sum()
+
+        if reg is None:
+            # ablation_no_box_regression: zero deltas -> exemplar-size boxes
+            reg = jnp.zeros(obj.shape + (4,), jnp.float32)
+        pred_xywh = decode_regression(reg, exemplars, scale_imgsize, scale_wh_only)
+        giou_map = generalized_box_iou_loss(
+            cxcywh_to_xyxy(pred_xywh), cxcywh_to_xyxy(tgt["box_target"])
+        )  # (B, H, W)
+        giou_sum = (giou_map * pos).sum()
+
+        pos_per_img = pos.sum(axis=(1, 2))  # (B,)
+        empty = (pos_per_img == 0).astype(jnp.float32)
+        num_positive = pos_per_img.sum() + empty.sum()
+        # zero-positive images contribute the degenerate-dummy loss of 1.0
+        giou_sum = giou_sum + empty.sum()
+
+        ce_losses.append(ce_sum / num_positive)
+        giou_losses.append(giou_sum / num_positive)
+
+    loss_ce = jnp.stack(ce_losses).mean()
+    loss_giou = jnp.stack(giou_losses).mean()
+    return {"loss_ce": loss_ce, "loss_giou": loss_giou,
+            "loss": loss_ce + loss_giou}

@@ -1,0 +1,287 @@
+"""Training/eval driver (reference trainer.py Matching_Trainer + main.py run
+orchestration, re-expressed as an explicit loop over jitted steps).
+
+Covers: per-epoch training, validation every ``AP_term`` epochs
+(trainer.py:68-73), the eval step chain forward -> loss -> decode -> NMS ->
+per-image JSON logging (:123-153), the epoch-end metrics rendezvous
+(:172-206 — process 0 merges, all processes compute, barriers around it),
+multi-exemplar eval (:75-121), checkpoint best/last/resume (callbacks.py),
+and CSV metric logging (the --nowandb path of main.py:113).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmr_tpu.data import DataLoader, build_dataset
+from tmr_tpu.inference import Predictor, detections_to_numpy
+from tmr_tpu.models import build_model
+from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+from tmr_tpu.train.state import (
+    compute_losses,
+    create_train_state,
+    make_train_step,
+)
+from tmr_tpu.utils.checkpoint import CheckpointManager
+from tmr_tpu.utils.metrics import (
+    coco_style_annotation_generator,
+    del_img_log_path,
+    get_ap_scores,
+    get_mae_rmse,
+    image_info_collector,
+)
+
+
+class CSVLogger:
+    """Epoch metrics CSV. Rows have varying key sets (val metrics only on
+    AP_term epochs), so the file is rewritten with the union of keys —
+    never truncating earlier epochs."""
+
+    def __init__(self, logpath: str):
+        os.makedirs(logpath, exist_ok=True)
+        self.path = os.path.join(logpath, "metrics.csv")
+        self._rows: list = []
+        if os.path.exists(self.path):  # resume: keep existing history
+            with open(self.path, newline="") as f:
+                self._rows = list(csv.DictReader(f))
+
+    def log(self, row: Dict[str, float]) -> None:
+        self._rows.append({k: str(v) for k, v in row.items()})
+        keys = sorted({k for r in self._rows for k in r})
+        with open(self.path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for r in self._rows:
+                w.writerow(r)
+
+
+class Trainer:
+    """Explicit train/eval driver. Single-process by default; on a mesh the
+    jitted steps run sharded (see tmr_tpu.parallel) and the metrics
+    rendezvous is gated on jax.process_index() == 0 like the reference's
+    rank-0 gating."""
+
+    def __init__(self, cfg, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = build_model(cfg)
+        self.predictor = Predictor(cfg, model=self.model)
+        self.logger = CSVLogger(cfg.logpath)
+        self.ckpt = CheckpointManager(
+            os.path.join(cfg.logpath, "checkpoints"),
+            monitor="val/MAE" if cfg.best_model_count else "val/AP",
+            mode="min" if cfg.best_model_count else "max",
+            every_n_epochs=cfg.AP_term,
+        )
+        self.state = None
+        self._train_step = None
+        self._eval_loss_fn = None
+
+    # ------------------------------------------------------------ plumbing
+    def _loaders(self):
+        cfg = self.cfg
+        train = DataLoader(
+            build_dataset(cfg, "train", eval_mode=False),
+            batch_size=cfg.batch_size, shuffle=True, seed=cfg.seed,
+            max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
+            num_workers=cfg.num_workers, drop_last=True,
+        )
+        # reference forces batch_size=1 for val/test (datamodules.py:27,47,50)
+        val_split = "val" if cfg.dataset == "FSCD147" else "test"
+        val = DataLoader(
+            build_dataset(cfg, val_split),
+            batch_size=1, shuffle=False, seed=cfg.seed,
+            max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
+            num_workers=cfg.num_workers,
+        )
+        test = DataLoader(
+            build_dataset(cfg, "test"),
+            batch_size=1, shuffle=False, seed=cfg.seed,
+            max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
+            num_workers=cfg.num_workers,
+        )
+        return train, val, test
+
+    def _init_state(self, sample_batch, steps_per_epoch: int):
+        self.state = create_train_state(
+            self.model, self.cfg, jax.random.key(self.cfg.seed),
+            jnp.asarray(sample_batch["image"]),
+            jnp.asarray(sample_batch["exemplars"]),
+            steps_per_epoch=steps_per_epoch,
+        )
+        step = make_train_step(self.model, self.cfg)
+        if self.mesh is not None:
+            # DDP replacement: params sharded per the TP rules (replicated on
+            # a pure-data mesh), batches split over 'data'; XLA derives the
+            # gradient psum from these annotations.
+            from tmr_tpu.parallel import shard_params
+            from tmr_tpu.parallel.sharding import state_sharding
+
+            self.state = self.state.replace(
+                params=shard_params(self.state.params, self.mesh)
+            )
+            self._train_step = jax.jit(
+                step,
+                out_shardings=(state_sharding(self.state, self.mesh), None),
+                donate_argnums=0,
+            )
+        else:
+            self._train_step = jax.jit(step, donate_argnums=0)
+
+    def _to_device(self, batch: dict) -> dict:
+        arrays = {k: v for k, v in batch.items() if k != "meta"}
+        if self.mesh is not None:
+            from tmr_tpu.parallel.sharding import shard_batch
+
+            return shard_batch(arrays, self.mesh)
+        return {k: jnp.asarray(v) for k, v in arrays.items()}
+
+    def _eval_losses(self, params, batch):
+        if self._eval_loss_fn is None:
+            cfg = self.cfg
+
+            @jax.jit
+            def fn(params, image, exemplars, gt_boxes, gt_valid):
+                out = self.model.apply({"params": params}, image, exemplars)
+                return compute_losses(
+                    out,
+                    {"exemplars": exemplars, "gt_boxes": gt_boxes,
+                     "gt_valid": gt_valid},
+                    cfg.positive_threshold, cfg.negative_threshold,
+                    use_focal_loss=cfg.focal_loss,
+                    scale_imgsize=cfg.regression_scaling_imgsize,
+                    scale_wh_only=cfg.regression_scaling_WH_only,
+                )
+
+            self._eval_loss_fn = fn
+        return self._eval_loss_fn(
+            params, jnp.asarray(batch["image"]),
+            jnp.asarray(batch["exemplars"]), jnp.asarray(batch["gt_boxes"]),
+            jnp.asarray(batch["gt_valid"]),
+        )
+
+    # ---------------------------------------------------------------- train
+    def fit(self, max_steps_per_epoch: Optional[int] = None) -> None:
+        cfg = self.cfg
+        train, val, _ = self._loaders()
+        steps = len(train) if max_steps_per_epoch is None else min(
+            len(train), max_steps_per_epoch
+        )
+
+        start_epoch = 0
+        first = next(iter(train))
+        self._init_state(first, steps)
+        if cfg.resume and self.ckpt.last_path():
+            self.state = self.ckpt.restore(self.ckpt.last_path(), self.state)
+            start_epoch = self.ckpt.meta["last_epoch"] + 1
+            print(f"resumed from epoch {start_epoch}")
+
+        for epoch in range(start_epoch, cfg.max_epochs):
+            train.set_epoch(epoch)
+            t0 = time.time()
+            sums: Dict[str, float] = {}
+            n = 0
+            for i, batch in enumerate(train):
+                if i >= steps:
+                    break
+                self.state, losses = self._train_step(
+                    self.state, self._to_device(batch)
+                )
+                for k, v in losses.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                n += 1
+            row = {f"train/{k}": v / max(n, 1) for k, v in sums.items()}
+            row["epoch"] = epoch
+            row["train/sec"] = time.time() - t0
+
+            ap_epoch = epoch == 0 or (epoch % cfg.AP_term == cfg.AP_term - 1)
+            if ap_epoch:
+                row.update(self.eval_epoch(val, "val", self.state.params))
+            self.logger.log(row)
+            line = f"Epoch {epoch}: | " + " | ".join(
+                f"{k}: {v:.4f}" for k, v in sorted(row.items()) if k != "epoch"
+            )
+            print(line)
+            self.ckpt.save_epoch(self.state, epoch, row)
+        self.ckpt.wait()
+
+    # ----------------------------------------------------------------- eval
+    def eval_epoch(self, loader, stage: str, params) -> Dict[str, float]:
+        cfg = self.cfg
+        self.predictor.params = params
+        sums: Dict[str, float] = {}
+        n = 0
+        for batch in loader:
+            losses = self._eval_losses(params, batch)
+            for k, v in losses.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+
+            if cfg.num_exemplars > 1:
+                dets = self.predictor.predict_multi_exemplar(
+                    batch["image"], batch["meta"][0]["orig_exemplars"]
+                    / np.array(batch["meta"][0]["img_size"].tolist() * 2,
+                               np.float32),
+                )
+            else:
+                dets = self.predictor(batch["image"], batch["exemplars"])
+            image_info_collector(
+                cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
+            )
+
+        metrics = {f"{stage}/{k}": v / max(n, 1) for k, v in sums.items()}
+
+        # epoch-end rendezvous (trainer.py:181-199): process 0 merges the
+        # per-image JSONs; every process computes the metrics from the files.
+        if jax.process_count() > 1:  # pragma: no cover - multihost only
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tmr_eval_pre_merge")
+        if jax.process_index() == 0:
+            coco_style_annotation_generator(cfg.logpath, stage)
+        if jax.process_count() > 1:  # pragma: no cover
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tmr_eval_post_merge")
+
+        mae, rmse = get_mae_rmse(cfg.logpath, stage)
+        ap, ap50, ap75 = get_ap_scores(cfg.logpath, stage)
+        metrics.update(
+            {f"{stage}/AP": ap, f"{stage}/AP50": ap50, f"{stage}/AP75": ap75,
+             f"{stage}/MAE": mae, f"{stage}/RMSE": rmse}
+        )
+        if jax.process_index() == 0:
+            print(
+                f"{stage}/AP: {ap:.2f} | {stage}/AP50: {ap50:.2f} | "
+                f"{stage}/AP75: {ap75:.2f} | {stage}/MAE: {mae:.2f} | "
+                f"{stage}/RMSE: {rmse:.2f}"
+            )
+            del_img_log_path(cfg.logpath, stage)
+        return metrics
+
+    def test(self, params=None) -> Dict[str, float]:
+        """Eval-mode entry (reference main.py:122-130): load the best
+        checkpoint unless params are given, run the test loop."""
+        _, _, test = self._loaders()
+        if params is None:
+            if self.state is None:
+                first = next(iter(test))
+                self._init_state(first, steps_per_epoch=1)
+            best = self.ckpt.best_path()
+            if best is None:
+                # mirror the reference, which fails when no checkpoint
+                # resolves for --eval (callbacks.py:40-45 / main.py:124-129)
+                raise FileNotFoundError(
+                    f"--eval: no best_model checkpoint under "
+                    f"{self.ckpt.directory}; train first or pass params"
+                )
+            self.state = self.ckpt.restore(best, self.state)
+            params = self.state.params
+        return self.eval_epoch(test, "test", params)

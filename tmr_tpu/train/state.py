@@ -1,0 +1,165 @@
+"""Optimizer + train step (reference trainer.py:208-236 + Lightning wiring).
+
+Reference recipe: AdamW with two LR groups — backbone params at
+``lr_backbone`` (0 in every published script => frozen), everything else at
+``lr`` — weight decay 1e-4, global-norm grad clip 0.1 (main.py:116), and
+MultiStepLR x0.1 at 60% of training when ``lr_drop`` (trainer.py:227-234).
+
+TPU-native expression: one optax chain — clip_by_global_norm ->
+multi_transform{head: adamw(sched), backbone: adamw(sched)|set_to_zero}.
+``set_to_zero`` for frozen groups means frozen params carry no optimizer
+state (no m/v buffers), saving HBM for the 632M-param ViT-H. FrozenBatchNorm
+statistics are always in the frozen group regardless of backbone LR.
+
+The train step is a pure jittable function; data parallelism comes from
+sharding its inputs over a mesh (see tmr_tpu/parallel), not from a wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import traverse_util
+from flax.training import train_state
+
+from tmr_tpu.train.criterion import criterion
+from tmr_tpu.train.targets import assign_targets
+
+
+class TrainState(train_state.TrainState):
+    pass
+
+
+def param_labels(params: Any, frozen_backbone: bool) -> Any:
+    """Label tree for multi_transform: 'head' | 'backbone' | 'frozen'.
+
+    - everything under the top-level 'backbone' module is the backbone group
+      (the reference matches parameter names on the substring 'backbone',
+      trainer.py:210-225);
+    - FrozenBatchNorm running statistics are always 'frozen';
+    - frozen_backbone switches the whole backbone group to 'frozen'.
+    """
+    flat = traverse_util.flatten_dict(params)
+    labels = {}
+    for path in flat:
+        if any(k in ("running_mean", "running_var") for k in path):
+            labels[path] = "frozen"
+        elif path[0] == "backbone":
+            labels[path] = "frozen" if frozen_backbone else "backbone"
+        else:
+            labels[path] = "head"
+    return traverse_util.unflatten_dict(labels)
+
+
+def make_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
+    if cfg.lr_drop:
+        milestone = int(cfg.max_epochs * 0.6) * steps_per_epoch
+    else:
+        milestone = (cfg.max_epochs + 1) * steps_per_epoch
+
+    def sched(base):
+        return optax.piecewise_constant_schedule(base, {milestone: 0.1})
+
+    frozen_backbone = cfg.lr_backbone == 0 or cfg.backbone.endswith("_FRZ")
+    transforms = {
+        "head": optax.adamw(sched(cfg.lr), weight_decay=cfg.weight_decay),
+        "backbone": optax.adamw(sched(cfg.lr_backbone),
+                                weight_decay=cfg.weight_decay),
+        "frozen": optax.set_to_zero(),
+    }
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.clip_max_norm),
+        optax.multi_transform(
+            transforms, lambda p: param_labels(p, frozen_backbone)
+        ),
+    )
+
+
+def create_train_state(
+    model, cfg, rng, sample_image, sample_exemplars, steps_per_epoch: int = 1000
+) -> TrainState:
+    params = model.init(rng, sample_image, sample_exemplars)["params"]
+    tx = make_optimizer(cfg, steps_per_epoch)
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx
+    )
+
+
+def compute_losses(
+    model_out: dict,
+    batch: dict,
+    positive_threshold: float,
+    negative_threshold: float,
+    use_focal_loss: bool = False,
+    scale_imgsize: bool = False,
+    scale_wh_only: bool = False,
+) -> dict:
+    """Forward outputs + batch -> loss dict (the body of trainer.py:132-137).
+
+    batch: image (B,S,S,3), exemplars (B,K,4), gt_boxes (B,M,4) normalized
+    xyxy padded, gt_valid (B,M) bool.
+    """
+    ex0 = batch["exemplars"][:, 0, :]
+    num_levels = len(model_out["objectness"])
+    targets = []
+    for lvl, obj in enumerate(model_out["objectness"]):
+        h, w = obj.shape[1], obj.shape[2]
+        targets.append(
+            assign_targets(
+                batch["gt_boxes"],
+                batch["gt_valid"],
+                ex0,
+                h,
+                w,
+                positive_threshold,
+                negative_threshold,
+                is_last_level=(lvl == num_levels - 1),
+            )
+        )
+    return criterion(
+        model_out["objectness"],
+        model_out["regressions"],
+        targets,
+        ex0,
+        use_focal_loss=use_focal_loss,
+        scale_imgsize=scale_imgsize,
+        scale_wh_only=scale_wh_only,
+    )
+
+
+def make_train_step(model, cfg) -> Callable:
+    """Build the jittable train step. Static config is closed over; the
+    returned fn is (state, batch) -> (state, metrics) and is safe to wrap in
+    jax.jit with sharded inputs."""
+
+    def train_step(state: TrainState, batch: dict):
+        def loss_fn(params):
+            out = model.apply(
+                {"params": params}, batch["image"], batch["exemplars"]
+            )
+            losses = compute_losses(
+                out,
+                batch,
+                cfg.positive_threshold,
+                cfg.negative_threshold,
+                use_focal_loss=cfg.focal_loss,
+                scale_imgsize=cfg.regression_scaling_imgsize,
+                scale_wh_only=cfg.regression_scaling_WH_only,
+            )
+            return losses["loss"], losses
+
+        (_, losses), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        state = state.apply_gradients(grads=grads)
+        return state, losses
+
+    return train_step
+
+
+def train_step(model, cfg):  # pragma: no cover - thin alias
+    return make_train_step(model, cfg)

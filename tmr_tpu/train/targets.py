@@ -1,0 +1,151 @@
+"""Vectorized target assignment (reference utils/TM_utils.py GT_map, :20-222).
+
+The reference loops Python-side over levels x batch x GT boxes, building
+per-location positive/negative/ignore maps. Here the whole assignment is one
+batched masked computation over a (locations, boxes) grid — vmap over batch,
+broadcast over boxes — so it lives inside the jitted train step. Variable GT
+counts become a padded (B, M, 4) array + validity mask.
+
+Semantics preserved exactly:
+- location grid at *corner* coordinates (get_template is_center=False,
+  TM_utils.py:124);
+- nearest-center one-hot per box by L1 distance, first-min tie-break
+  (Get_is_center :56-67);
+- diamond in/out tests with ratio -h/w and threshold-derived biases
+  (Get_is_in_out_positive :77-92), with the threshold==1.0 overrides (:146-147);
+- exemplar-sized boundary exclusion with odd-ified span (Get_not_in_boundary
+  :36-54);
+- is_center folded into positives only on the last level (:152-155);
+- boundary-excluded positives demoted to negatives (:157-158);
+- smallest-area box wins contested locations (:161-165);
+- ignore = (some box doesn't claim positive) & (some box doesn't claim
+  negative) & in-boundary, negatives are the complement (:168-170).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def location_centers(h: int, w: int) -> jnp.ndarray:
+    """(L, 2) [x, y] normalized corner coordinates, row-major like
+    get_template(..., is_center=False) (TM_utils.py:26-34,124)."""
+    xs = jnp.arange(w, dtype=jnp.float32) / w
+    ys = jnp.arange(h, dtype=jnp.float32) / h
+    gx, gy = jnp.meshgrid(xs, ys)  # default 'xy': gx/gy are (h, w)
+    return jnp.stack([gx.reshape(-1), gy.reshape(-1)], axis=1)
+
+
+def boundary_mask(exemplar: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """(L,) bool interior mask (Get_not_in_boundary, TM_utils.py:36-54)."""
+    x1 = jnp.clip(exemplar[0], 0.0, 1.0) * w
+    y1 = jnp.clip(exemplar[1], 0.0, 1.0) * h
+    x2 = jnp.clip(exemplar[2], 0.0, 1.0) * w
+    y2 = jnp.clip(exemplar[3], 0.0, 1.0) * h
+    xi1 = jnp.floor(x1).astype(jnp.int32)
+    xi2 = jnp.ceil(x2).astype(jnp.int32)
+    yi1 = jnp.floor(y1).astype(jnp.int32)
+    yi2 = jnp.ceil(y2).astype(jnp.int32)
+    wspan = xi2 - xi1
+    hspan = yi2 - yi1
+    xi2 = xi2 - (wspan % 2 == 0)
+    yi2 = yi2 - (hspan % 2 == 0)
+    pad_x = (xi2 - xi1) // 2
+    pad_y = (yi2 - yi1) // 2
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+    row = (ys >= pad_y) & (ys < h - pad_y)
+    col = (xs >= pad_x) & (xs < w - pad_x)
+    return (row[:, None] & col[None, :]).reshape(-1)
+
+
+def _assign_one(
+    gt_boxes: jnp.ndarray,  # (M, 4) xyxy normalized, padded
+    gt_valid: jnp.ndarray,  # (M,) bool
+    exemplar: jnp.ndarray,  # (4,)
+    h: int,
+    w: int,
+    positive_threshold: float,
+    negative_threshold: float,
+    is_last_level: bool,
+):
+    L = h * w
+    centers = location_centers(h, w)  # (L, 2)
+    cxs, cys = centers[:, 0], centers[:, 1]
+
+    x1, y1, x2, y2 = gt_boxes[:, 0], gt_boxes[:, 1], gt_boxes[:, 2], gt_boxes[:, 3]
+    cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+    bw, bh = x2 - x1, y2 - y1
+
+    rel_x = jnp.abs(cxs[:, None] - cx[None, :])  # (L, M)
+    rel_y = jnp.abs(cys[:, None] - cy[None, :])
+
+    # nearest-center one-hot per box (first-min tie-break like torch.argmin)
+    center_idx = jnp.argmin(rel_x + rel_y, axis=0)  # (M,)
+    is_center = jax.nn.one_hot(center_idx, L, dtype=jnp.bool_).T  # (L, M)
+
+    ratio = -bh / bw
+    bias_p = ((1 - positive_threshold) / (1 + positive_threshold)) * bh
+    bias_n = ((1 - negative_threshold) / (1 + negative_threshold)) * bh
+    is_in_positive = ratio[None, :] * rel_x + bias_p[None, :] >= rel_y
+    is_in_negative = ratio[None, :] * rel_x + bias_n[None, :] < rel_y
+
+    if positive_threshold == 1.0:
+        is_in_positive = is_center
+    if negative_threshold == 1.0:
+        is_in_negative = ~is_center
+
+    in_bounds = boundary_mask(exemplar, h, w)[:, None]  # (L, 1)
+
+    if is_last_level:
+        pos_cand = is_center | is_in_positive
+    else:
+        pos_cand = is_in_positive
+    is_in_negative = is_in_negative | (pos_cand & ~in_bounds)
+    pos_cand = pos_cand & in_bounds
+
+    valid = gt_valid[None, :]
+    # smallest-area box claims each contested location
+    area = bw * bh
+    area_grid = jnp.where(pos_cand & valid, area[None, :], 1e8)
+    box_id = jnp.argmin(area_grid, axis=1)  # (L,)
+    cxcywh = jnp.stack([cx, cy, bw, bh], axis=1)  # (M, 4)
+    box_target = cxcywh[box_id]  # (L, 4)
+
+    positive = (pos_cand & valid).any(axis=1)
+    ignore = (
+        (~pos_cand & valid).any(axis=1)
+        & (~is_in_negative & valid).any(axis=1)
+        & in_bounds[:, 0]
+    )
+    negative = ~(positive | ignore)
+
+    return {
+        "positive": positive.reshape(h, w),
+        "negative": negative.reshape(h, w),
+        "box_target": box_target.reshape(h, w, 4),
+    }
+
+
+def assign_targets(
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    exemplars: jnp.ndarray,
+    h: int,
+    w: int,
+    positive_threshold: float,
+    negative_threshold: float,
+    is_last_level: bool = True,
+):
+    """Batched GT assignment.
+
+    gt_boxes (B, M, 4) normalized xyxy (padded), gt_valid (B, M) bool,
+    exemplars (B, 4). Returns dict of positive/negative (B, h, w) bool and
+    box_target (B, h, w, 4) cxcywh.
+    """
+    return jax.vmap(
+        lambda b, v, e: _assign_one(
+            b, v, e, h, w, positive_threshold, negative_threshold, is_last_level
+        )
+    )(gt_boxes, gt_valid, exemplars)

@@ -1,0 +1,1 @@
+"""Utilities: weight conversion, metrics, checkpointing, profiling."""

@@ -1,0 +1,97 @@
+"""Checkpointing (reference callbacks.py CustomCheckpoint + Lightning resume).
+
+Orbax-backed manager with the reference's retention semantics
+(callbacks.py:9-45):
+- track a monitored metric — val/AP maximized, or val/MAE minimized when
+  ``best_model_count`` (:16-29);
+- keep the best checkpoint (new best saved as best_model-v{k} like
+  Lightning's versioning), always keep ``last`` (save_last=True);
+- save cadence every ``AP_term`` epochs (:28, matching when val metrics
+  exist);
+- ``latest``/``best`` path resolution for eval (:40-45) and full train-state
+  restore for --resume (reference main.py:133-136).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str,
+        monitor: str = "val/AP",
+        mode: str = "max",
+        every_n_epochs: int = 1,
+        fresh_guard: bool = False,
+    ):
+        """fresh_guard: refuse to start a fresh run into an existing logpath
+        (callbacks.py:12-13 applies this to single-process fresh training)."""
+        self.directory = os.path.abspath(directory)
+        if fresh_guard and os.path.isdir(os.path.join(self.directory, "best")):
+            raise FileExistsError(
+                f"logpath {self.directory} already contains checkpoints; "
+                "pass resume=True or choose a fresh logpath"
+            )
+        os.makedirs(self.directory, exist_ok=True)
+        self.monitor = monitor
+        self.mode = mode
+        self.every_n_epochs = max(1, every_n_epochs)
+        self._ckpt = ocp.StandardCheckpointer()
+        self._meta_path = os.path.join(self.directory, "ckpt_meta.json")
+        self.meta = {"best_value": None, "best_version": -1, "last_epoch": -1}
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                self.meta = json.load(f)
+
+    def _save_meta(self):
+        with open(self._meta_path, "w") as f:
+            json.dump(self.meta, f)
+
+    def _is_better(self, value: float) -> bool:
+        best = self.meta["best_value"]
+        if best is None:
+            return True
+        return value > best if self.mode == "max" else value < best
+
+    def save_epoch(self, state: Any, epoch: int, metrics: dict) -> None:
+        """Save ``last`` every call; promote to a new best version when the
+        monitored metric improves on the cadence epochs."""
+        last_dir = os.path.join(self.directory, "last")
+        self._ckpt.save(last_dir, state, force=True)
+        self.meta["last_epoch"] = epoch
+
+        value = metrics.get(self.monitor)
+        on_cadence = (epoch + 1) % self.every_n_epochs == 0 or epoch == 0
+        if value is not None and on_cadence and self._is_better(float(value)):
+            self.meta["best_value"] = float(value)
+            self.meta["best_version"] += 1
+            best_dir = os.path.join(
+                self.directory, f"best_model-v{self.meta['best_version']}"
+            )
+            self._ckpt.save(best_dir, state, force=True)
+        self._save_meta()
+
+    def best_path(self) -> Optional[str]:
+        """Highest-version best checkpoint (callbacks.py:40-45)."""
+        v = self.meta["best_version"]
+        if v < 0:
+            return None
+        return os.path.join(self.directory, f"best_model-v{v}")
+
+    def last_path(self) -> Optional[str]:
+        p = os.path.join(self.directory, "last")
+        return p if os.path.isdir(p) else None
+
+    def restore(self, path: str, target: Any) -> Any:
+        """Restore a full train state (optimizer/step included) for resume,
+        or params-only when ``target`` is a params tree."""
+        return self._ckpt.restore(path, target=target)
+
+    def wait(self):
+        self._ckpt.wait_until_finished()

@@ -1,0 +1,187 @@
+"""PyTorch checkpoint -> Flax params conversion.
+
+Handles the three checkpoint layouts of the reference stack:
+
+- SAM-HQ encoder checkpoints (``sam_hq_vit_{b,h}.pth``): keys
+  ``image_encoder.*`` (reference models/backbone/sam/sam.py:63-65; the ONNX
+  exporter re-maps the same keys at export_onnx.py:45-52).
+- Lightning training checkpoints (``best_model*.ckpt``): ``state_dict`` with
+  ``model.*`` keys over matching_net (demo.py:154-155 layout).
+- torchvision ``resnet50`` state_dicts for the ResNet backbone family.
+
+Transposition rules: torch Conv2d (O, I, kh, kw) -> flax (kh, kw, I, O);
+torch Linear (O, I) -> flax (I, O); everything else is a direct copy.
+Arrays are converted via numpy; no torch tensors escape this module.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.detach().cpu().numpy()  # torch tensor
+
+
+def _conv(t) -> np.ndarray:
+    return _np(t).transpose(2, 3, 1, 0)
+
+
+def _dense(t) -> np.ndarray:
+    return _np(t).transpose(1, 0)
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a .pth/.ckpt into a flat {key: np.ndarray} dict."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return {k: _np(v) for k, v in obj.items()}
+
+
+def convert_sam_vit(
+    sd: Dict[str, np.ndarray], prefix: str = "image_encoder."
+) -> dict:
+    """ImageEncoderViT state_dict subtree -> SamViT (models/vit.py) params."""
+    sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+    p: dict = {}
+    p["patch_embed"] = {
+        "kernel": _conv(sd["patch_embed.proj.weight"]),
+        "bias": _np(sd["patch_embed.proj.bias"]),
+    }
+    p["pos_embed"] = _np(sd["pos_embed"])
+
+    depth = 1 + max(
+        int(m.group(1))
+        for k in sd
+        if (m := re.match(r"blocks\.(\d+)\.", k))
+    )
+    for i in range(depth):
+        b = f"blocks.{i}."
+        blk = {
+            "norm1": {"scale": _np(sd[b + "norm1.weight"]),
+                      "bias": _np(sd[b + "norm1.bias"])},
+            "norm2": {"scale": _np(sd[b + "norm2.weight"]),
+                      "bias": _np(sd[b + "norm2.bias"])},
+            "attn": {
+                "qkv": {"kernel": _dense(sd[b + "attn.qkv.weight"]),
+                        "bias": _np(sd[b + "attn.qkv.bias"])},
+                "proj": {"kernel": _dense(sd[b + "attn.proj.weight"]),
+                         "bias": _np(sd[b + "attn.proj.bias"])},
+                "rel_pos_h": _np(sd[b + "attn.rel_pos_h"]),
+                "rel_pos_w": _np(sd[b + "attn.rel_pos_w"]),
+            },
+            "mlp": {
+                "lin1": {"kernel": _dense(sd[b + "mlp.lin1.weight"]),
+                         "bias": _np(sd[b + "mlp.lin1.bias"])},
+                "lin2": {"kernel": _dense(sd[b + "mlp.lin2.weight"]),
+                         "bias": _np(sd[b + "mlp.lin2.bias"])},
+            },
+        }
+        p[f"blocks_{i}"] = blk
+
+    p["neck_0"] = {"kernel": _conv(sd["neck.0.weight"])}
+    p["neck_1"] = {"weight": _np(sd["neck.1.weight"]),
+                   "bias": _np(sd["neck.1.bias"])}
+    p["neck_2"] = {"kernel": _conv(sd["neck.2.weight"])}
+    p["neck_3"] = {"weight": _np(sd["neck.3.weight"]),
+                   "bias": _np(sd["neck.3.bias"])}
+    return p
+
+
+def convert_resnet50(sd: Dict[str, np.ndarray], prefix: str = "") -> dict:
+    """torchvision resnet50 state_dict -> ResNet50 (models/resnet.py) params."""
+    sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+
+    def bn(key: str) -> dict:
+        return {
+            "weight": _np(sd[key + ".weight"]),
+            "bias": _np(sd[key + ".bias"]),
+            "running_mean": _np(sd[key + ".running_mean"]),
+            "running_var": _np(sd[key + ".running_var"]),
+        }
+
+    p: dict = {
+        "conv1": {"kernel": _conv(sd["conv1.weight"])},
+        "bn1": bn("bn1"),
+    }
+    layers = (3, 4, 6, 3)
+    for stage in range(1, 5):
+        for block in range(layers[stage - 1]):
+            t = f"layer{stage}.{block}."
+            if t + "conv1.weight" not in sd:
+                continue  # truncated checkpoint
+            entry = {
+                "conv1": {"kernel": _conv(sd[t + "conv1.weight"])},
+                "bn1": bn(t + "bn1"),
+                "conv2": {"kernel": _conv(sd[t + "conv2.weight"])},
+                "bn2": bn(t + "bn2"),
+                "conv3": {"kernel": _conv(sd[t + "conv3.weight"])},
+                "bn3": bn(t + "bn3"),
+            }
+            if t + "downsample.0.weight" in sd:
+                entry["downsample_0"] = {
+                    "kernel": _conv(sd[t + "downsample.0.weight"])
+                }
+                entry["downsample_1"] = bn(t + "downsample.1")
+            p[f"layer{stage}_{block}"] = entry
+    return p
+
+
+def convert_matching_net(sd: Dict[str, np.ndarray], backbone: str = "sam") -> dict:
+    """Lightning ``model.*`` state_dict -> MatchingNet params.
+
+    Reference module paths (trainer.py:21 / matching_net.py):
+      model.encoder.backbone.backbone.*  -> params['backbone']   (SAM ViT)
+      model.input_proj.{i}.*             -> params['input_proj_{i}']
+      model.matcher.scale                -> params['matcher']['scale']
+      model.decoder_o.layer.{2j}.*       -> params['decoder_o_0']['conv_j']
+      model.decoder_b.layer.{2j}.*       -> params['decoder_b_0']['conv_j']
+      model.objectness_head.head.0.*     -> params['objectness_head_0']['conv']
+      model.ltrbs_head.head.0.*          -> params['ltrbs_head_0']['conv']
+    """
+    sd = {k[len("model."):]: v for k, v in sd.items() if k.startswith("model.")}
+    p: dict = {}
+    if backbone.startswith("sam"):
+        p["backbone"] = convert_sam_vit(sd, prefix="encoder.backbone.backbone.")
+    else:
+        p["backbone"] = convert_resnet50(sd, prefix="encoder.backbone.backbone.")
+
+    i = 0
+    while f"input_proj.{i}.weight" in sd:
+        p[f"input_proj_{i}"] = {
+            "kernel": _conv(sd[f"input_proj.{i}.weight"]),
+            "bias": _np(sd[f"input_proj.{i}.bias"]),
+        }
+        i += 1
+
+    if "matcher.scale" in sd:
+        p["matcher"] = {"scale": _np(sd["matcher.scale"])}
+
+    for dec in ("decoder_o", "decoder_b"):
+        convs = {}
+        j = 0
+        while f"{dec}.layer.{2 * j}.weight" in sd:
+            convs[f"conv_{j}"] = {
+                "kernel": _conv(sd[f"{dec}.layer.{2 * j}.weight"]),
+                "bias": _np(sd[f"{dec}.layer.{2 * j}.bias"]),
+            }
+            j += 1
+        if convs:
+            p[f"{dec}_0"] = convs
+
+    for head, mine in (("objectness_head", "objectness_head_0"),
+                       ("ltrbs_head", "ltrbs_head_0")):
+        if f"{head}.head.0.weight" in sd:
+            p[mine] = {"conv": {
+                "kernel": _conv(sd[f"{head}.head.0.weight"]),
+                "bias": _np(sd[f"{head}.head.0.bias"]),
+            }}
+    return p
