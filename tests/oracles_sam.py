@@ -1,0 +1,269 @@
+"""Torch oracle for the SAM decoding stack (prompt encoder / two-way
+transformer / mask decoder), used to golden-test the Flax rebuild in
+tmr_tpu/models/sam_decoder.py and the weight converter.
+
+Independent compact implementation of the public SAM decoder semantics
+(reference: utils/segment_anything/modeling/*), with state_dict key names
+matching the SAM checkpoint layout so utils/convert.convert_sam_refiner can
+consume `oracle.state_dict()` directly. Test-only; torch never enters the
+framework proper.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import torch
+from torch import nn
+from torch.nn import functional as F
+
+
+class LayerNorm2dT(nn.Module):
+    def __init__(self, c, eps=1e-6):
+        super().__init__()
+        self.weight = nn.Parameter(torch.ones(c))
+        self.bias = nn.Parameter(torch.zeros(c))
+        self.eps = eps
+
+    def forward(self, x):  # (B, C, H, W)
+        u = x.mean(1, keepdim=True)
+        s = ((x - u) ** 2).mean(1, keepdim=True)
+        x = (x - u) / torch.sqrt(s + self.eps)
+        return x * self.weight[:, None, None] + self.bias[:, None, None]
+
+
+class PositionEmbeddingRandomT(nn.Module):
+    def __init__(self, num_pos_feats=128):
+        super().__init__()
+        self.register_buffer(
+            "positional_encoding_gaussian_matrix",
+            torch.randn(2, num_pos_feats),
+        )
+
+    def encode(self, coords01):  # (..., 2) in [0, 1]
+        c = 2 * coords01 - 1
+        c = c @ self.positional_encoding_gaussian_matrix
+        c = 2 * math.pi * c
+        return torch.cat([torch.sin(c), torch.cos(c)], dim=-1)
+
+    def grid(self, h, w):
+        ys = (torch.arange(h).float() + 0.5) / h
+        xs = (torch.arange(w).float() + 0.5) / w
+        gy, gx = torch.meshgrid(ys, xs, indexing="ij")
+        return self.encode(torch.stack([gx, gy], dim=-1))  # (h, w, C)
+
+
+class PromptEncoderT(nn.Module):
+    """Box-prompt path of the SAM prompt encoder + mask downscaling."""
+
+    def __init__(self, embed_dim=256, mask_in_chans=16):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.pe_layer = PositionEmbeddingRandomT(embed_dim // 2)
+        self.point_embeddings = nn.ModuleList(
+            [nn.Embedding(1, embed_dim) for _ in range(4)]
+        )
+        self.not_a_point_embed = nn.Embedding(1, embed_dim)
+        self.no_mask_embed = nn.Embedding(1, embed_dim)
+        self.mask_downscaling = nn.Sequential(
+            nn.Conv2d(1, mask_in_chans // 4, 2, stride=2),
+            LayerNorm2dT(mask_in_chans // 4),
+            nn.GELU(),
+            nn.Conv2d(mask_in_chans // 4, mask_in_chans, 2, stride=2),
+            LayerNorm2dT(mask_in_chans),
+            nn.GELU(),
+            nn.Conv2d(mask_in_chans, embed_dim, 1),
+        )
+
+    def embed_boxes(self, boxes, image_size):  # (N, 4) px
+        h, w = image_size
+        corners = (boxes + 0.5).reshape(-1, 2, 2)
+        corners = corners / torch.tensor([w, h], dtype=torch.float32)
+        emb = self.pe_layer.encode(corners)
+        emb[:, 0, :] += self.point_embeddings[2].weight[0]
+        emb[:, 1, :] += self.point_embeddings[3].weight[0]
+        return emb
+
+    def dense_pe(self, emb_size):
+        return self.pe_layer.grid(*emb_size)  # (h, w, C)
+
+    def no_mask_dense(self, n, emb_size):
+        h, w = emb_size
+        return self.no_mask_embed.weight.reshape(1, 1, 1, -1).expand(
+            n, h, w, self.embed_dim
+        )
+
+
+class AttentionT(nn.Module):
+    def __init__(self, embedding_dim, num_heads, downsample_rate=1):
+        super().__init__()
+        self.internal_dim = embedding_dim // downsample_rate
+        self.num_heads = num_heads
+        self.q_proj = nn.Linear(embedding_dim, self.internal_dim)
+        self.k_proj = nn.Linear(embedding_dim, self.internal_dim)
+        self.v_proj = nn.Linear(embedding_dim, self.internal_dim)
+        self.out_proj = nn.Linear(self.internal_dim, embedding_dim)
+
+    def forward(self, q, k, v):
+        q, k, v = self.q_proj(q), self.k_proj(k), self.v_proj(v)
+
+        def split(x):
+            b, n, c = x.shape
+            return x.reshape(
+                b, n, self.num_heads, c // self.num_heads
+            ).transpose(1, 2)
+
+        q, k, v = split(q), split(k), split(v)
+        attn = q @ k.transpose(2, 3) / math.sqrt(q.shape[-1])
+        attn = torch.softmax(attn, dim=-1)
+        out = attn @ v
+        b, h, n, c = out.shape
+        return self.out_proj(out.transpose(1, 2).reshape(b, n, h * c))
+
+
+class MLPBlockT(nn.Module):
+    def __init__(self, dim, mlp_dim):
+        super().__init__()
+        self.lin1 = nn.Linear(dim, mlp_dim)
+        self.lin2 = nn.Linear(mlp_dim, dim)
+
+    def forward(self, x):
+        return self.lin2(F.relu(self.lin1(x)))
+
+
+class TwoWayAttentionBlockT(nn.Module):
+    def __init__(self, dim, num_heads, mlp_dim, downsample_rate=2,
+                 skip_first_layer_pe=False):
+        super().__init__()
+        self.self_attn = AttentionT(dim, num_heads)
+        self.norm1 = nn.LayerNorm(dim)
+        self.cross_attn_token_to_image = AttentionT(
+            dim, num_heads, downsample_rate
+        )
+        self.norm2 = nn.LayerNorm(dim)
+        self.mlp = MLPBlockT(dim, mlp_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.norm4 = nn.LayerNorm(dim)
+        self.cross_attn_image_to_token = AttentionT(
+            dim, num_heads, downsample_rate
+        )
+        self.skip_first_layer_pe = skip_first_layer_pe
+
+    def forward(self, queries, keys, query_pe, key_pe):
+        if self.skip_first_layer_pe:
+            queries = self.self_attn(queries, queries, queries)
+        else:
+            q = queries + query_pe
+            queries = queries + self.self_attn(q, q, queries)
+        queries = self.norm1(queries)
+
+        q = queries + query_pe
+        k = keys + key_pe
+        queries = queries + self.cross_attn_token_to_image(q, k, keys)
+        queries = self.norm2(queries)
+
+        queries = self.norm3(queries + self.mlp(queries))
+
+        q = queries + query_pe
+        k = keys + key_pe
+        keys = keys + self.cross_attn_image_to_token(k, q, queries)
+        keys = self.norm4(keys)
+        return queries, keys
+
+
+class TwoWayTransformerT(nn.Module):
+    def __init__(self, depth, dim, num_heads, mlp_dim):
+        super().__init__()
+        self.layers = nn.ModuleList(
+            [
+                TwoWayAttentionBlockT(
+                    dim, num_heads, mlp_dim, skip_first_layer_pe=(i == 0)
+                )
+                for i in range(depth)
+            ]
+        )
+        self.final_attn_token_to_image = AttentionT(dim, num_heads, 2)
+        self.norm_final_attn = nn.LayerNorm(dim)
+
+    def forward(self, image_embedding, image_pe, point_embedding):
+        # image_embedding (B, C, h, w) NCHW like the reference
+        b, c, h, w = image_embedding.shape
+        keys = image_embedding.flatten(2).permute(0, 2, 1)
+        key_pe = image_pe.flatten(2).permute(0, 2, 1)
+        queries = point_embedding
+        for layer in self.layers:
+            queries, keys = layer(queries, keys, point_embedding, key_pe)
+        q = queries + point_embedding
+        k = keys + key_pe
+        queries = queries + self.final_attn_token_to_image(q, k, keys)
+        return self.norm_final_attn(queries), keys
+
+
+class MLPT(nn.Module):
+    def __init__(self, in_dim, hidden, out_dim, num_layers):
+        super().__init__()
+        dims = [in_dim] + [hidden] * (num_layers - 1)
+        self.layers = nn.ModuleList(
+            nn.Linear(a, b) for a, b in zip(dims, dims[1:] + [out_dim])
+        )
+
+    def forward(self, x):
+        for i, layer in enumerate(self.layers):
+            x = F.relu(layer(x)) if i < len(self.layers) - 1 else layer(x)
+        return x
+
+
+class MaskDecoderT(nn.Module):
+    """SAM mask decoder with the reference's best-IoU selection patch."""
+
+    def __init__(self, dim=256, num_multimask_outputs=3, depth=2,
+                 num_heads=8, mlp_dim=2048):
+        super().__init__()
+        self.num_mask_tokens = num_multimask_outputs + 1
+        self.iou_token = nn.Embedding(1, dim)
+        self.mask_tokens = nn.Embedding(self.num_mask_tokens, dim)
+        self.transformer = TwoWayTransformerT(depth, dim, num_heads, mlp_dim)
+        self.output_upscaling = nn.Sequential(
+            nn.ConvTranspose2d(dim, dim // 4, 2, stride=2),
+            LayerNorm2dT(dim // 4),
+            nn.GELU(),
+            nn.ConvTranspose2d(dim // 4, dim // 8, 2, stride=2),
+            nn.GELU(),
+        )
+        self.output_hypernetworks_mlps = nn.ModuleList(
+            [MLPT(dim, dim, dim // 8, 3) for _ in range(self.num_mask_tokens)]
+        )
+        self.iou_prediction_head = MLPT(dim, 256, self.num_mask_tokens, 3)
+
+    def forward(self, image_embeddings, image_pe, sparse, dense):
+        # image_embeddings (1, C, h, w); image_pe (1, C, h, w);
+        # sparse (N, P, C); dense (N, C, h, w)
+        n = sparse.shape[0]
+        output_tokens = torch.cat(
+            [self.iou_token.weight, self.mask_tokens.weight], dim=0
+        )
+        tokens = torch.cat(
+            [output_tokens.unsqueeze(0).expand(n, -1, -1), sparse], dim=1
+        )
+        src = image_embeddings.expand(n, -1, -1, -1) + dense
+        pos = image_pe.expand(n, -1, -1, -1)
+        b, c, h, w = src.shape
+        hs, keys = self.transformer(src, pos, tokens)
+        iou_token_out = hs[:, 0, :]
+        mask_tokens_out = hs[:, 1 : 1 + self.num_mask_tokens, :]
+        src = keys.transpose(1, 2).reshape(b, c, h, w)
+        up = self.output_upscaling(src)
+        hyper = torch.stack(
+            [
+                self.output_hypernetworks_mlps[i](mask_tokens_out[:, i, :])
+                for i in range(self.num_mask_tokens)
+            ],
+            dim=1,
+        )
+        b, c, h, w = up.shape
+        masks = (hyper @ up.reshape(b, c, h * w)).reshape(b, -1, h, w)
+        iou_pred = self.iou_prediction_head(iou_token_out)
+        ids = torch.argmax(iou_pred, dim=1)
+        ar = torch.arange(n)
+        return masks[ar, ids], iou_pred[ar, ids]

@@ -1,0 +1,296 @@
+"""Golden tests: Flax SAM decoder stack vs the torch oracle, plus the
+fixed-shape refiner pipeline (tmr_tpu/refine.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import torch
+
+from tests.oracles_sam import MaskDecoderT, PromptEncoderT
+from tmr_tpu.models.sam_decoder import (
+    MaskDecoder,
+    PromptEncoder,
+    masks_to_boxes,
+    resize_align_corners,
+)
+from tmr_tpu.refine import SamRefineModule
+from tmr_tpu.utils.convert import (
+    convert_mask_decoder,
+    convert_prompt_encoder,
+    convert_sam_refiner,
+)
+
+DIM = 32  # small transformer dim for fast tests (divisible by 8 heads, /8=4)
+
+
+def _tiny_torch_pair(seed=0):
+    """Build torch oracle modules + converted Flax params at DIM=32."""
+    torch.manual_seed(seed)
+    pe_t = PromptEncoderT(embed_dim=DIM, mask_in_chans=16).eval()
+    md_t = MaskDecoderT(dim=DIM, depth=2, num_heads=4, mlp_dim=64).eval()
+    sd = {f"prompt_encoder.{k}": v for k, v in pe_t.state_dict().items()}
+    sd.update({f"mask_decoder.{k}": v for k, v in md_t.state_dict().items()})
+    params = convert_sam_refiner(sd)
+    pe_f = PromptEncoder(embed_dim=DIM, mask_in_chans=16)
+    md_f = MaskDecoder(
+        transformer_dim=DIM,
+        transformer_num_heads=4,
+        transformer_mlp_dim=64,
+    )
+    return pe_t, md_t, pe_f, md_f, params
+
+
+class TestPromptEncoderGolden:
+    def test_box_embedding_matches_torch(self):
+        pe_t, _, pe_f, _, params = _tiny_torch_pair()
+        boxes = np.array(
+            [[10.0, 20.0, 110.0, 160.0], [0.0, 0.0, 64.0, 64.0]], np.float32
+        )
+        with torch.no_grad():
+            want = pe_t.embed_boxes(torch.from_numpy(boxes), (256, 256)).numpy()
+        got = pe_f.apply(
+            {"params": params["prompt_encoder"]},
+            jnp.asarray(boxes),
+            (256, 256),
+            method=PromptEncoder.embed_boxes,
+        )
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_dense_pe_matches_torch(self):
+        pe_t, _, pe_f, _, params = _tiny_torch_pair()
+        with torch.no_grad():
+            want = pe_t.dense_pe((8, 8)).numpy()
+        got = pe_f.apply(
+            {"params": params["prompt_encoder"]},
+            (8, 8),
+            method=PromptEncoder.dense_pe,
+        )
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+class TestMaskDecoderGolden:
+    def test_masks_and_iou_match_torch(self):
+        pe_t, md_t, pe_f, md_f, params = _tiny_torch_pair()
+        rng = np.random.default_rng(0)
+        h = w = 8
+        n = 3
+        feats = rng.standard_normal((1, h, w, DIM)).astype(np.float32)
+        boxes = np.abs(rng.standard_normal((n, 4))).astype(np.float32) * 50
+        boxes[:, 2:] += boxes[:, :2] + 10
+
+        with torch.no_grad():
+            sparse_t = pe_t.embed_boxes(torch.from_numpy(boxes), (256, 256))
+            dense_t = pe_t.no_mask_dense(n, (h, w)).permute(0, 3, 1, 2)
+            pe_grid_t = pe_t.dense_pe((h, w)).permute(2, 0, 1).unsqueeze(0)
+            feats_t = torch.from_numpy(feats).permute(0, 3, 1, 2)
+            want_masks, want_iou = md_t(feats_t, pe_grid_t, sparse_t, dense_t)
+
+        sparse, dense = pe_f.apply(
+            {"params": params["prompt_encoder"]},
+            jnp.asarray(boxes),
+            (256, 256),
+            (h, w),
+        )
+        pe_grid = pe_f.apply(
+            {"params": params["prompt_encoder"]},
+            (h, w),
+            method=PromptEncoder.dense_pe,
+        )
+        got_masks, got_iou = md_f.apply(
+            {"params": params["mask_decoder"]},
+            jnp.asarray(feats),
+            pe_grid,
+            sparse,
+            dense,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_iou), want_iou.numpy(), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_masks), want_masks.numpy(), atol=1e-3
+        )
+
+
+class TestResizeAlignCorners:
+    @pytest.mark.parametrize("shape,out", [((2, 7, 5), (21, 15)),
+                                           ((1, 8, 8), (32, 32))])
+    def test_matches_torch_bilinear(self, shape, out):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal(shape).astype(np.float32)
+        want = (
+            torch.nn.functional.interpolate(
+                torch.from_numpy(x)[None], out, mode="bilinear",
+                align_corners=True,
+            )[0]
+            .numpy()
+        )
+        got = np.asarray(resize_align_corners(jnp.asarray(x), out))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestPointAndMaskPrompts:
+    def test_point_and_mask_paths_init_and_run(self):
+        ref = SamRefineModule()
+        ref.prompt_encoder = PromptEncoder(embed_dim=DIM)
+        ref.mask_decoder = MaskDecoder(
+            transformer_dim=DIM, transformer_num_heads=4,
+            transformer_mlp_dim=64,
+        )
+        params = ref.init_params(seed=0)["prompt_encoder"]
+        pts = jnp.asarray([[[10.0, 20.0], [30.0, 40.0]]])
+        labels = jnp.asarray([[1, -1]], jnp.int32)
+        emb = ref.prompt_encoder.apply(
+            {"params": params}, pts, labels, (64, 64),
+            method=PromptEncoder.embed_points,
+        )
+        assert emb.shape == (1, 2, DIM)
+        masks = jnp.zeros((2, 32, 32, 1))
+        dense = ref.prompt_encoder.apply(
+            {"params": params}, masks, method=PromptEncoder.embed_masks
+        )
+        assert dense.shape == (2, 8, 8, DIM)
+
+
+class TestMasksToBoxes:
+    def test_tight_boxes_and_empty(self):
+        masks = np.zeros((3, 16, 16), bool)
+        masks[0, 3:9, 4:12] = True   # box (4, 3, 11, 8)
+        masks[1, 5, 5] = True        # single pixel
+        # masks[2] empty
+        boxes, nonempty = masks_to_boxes(jnp.asarray(masks))
+        np.testing.assert_array_equal(
+            np.asarray(boxes),
+            [[4, 3, 11, 8], [5, 5, 5, 5], [0, 0, 0, 0]],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nonempty), [True, True, False]
+        )
+
+
+class TestRefiner:
+    def _dets(self, b=1, n=8):
+        rng = np.random.default_rng(2)
+        boxes = np.zeros((b, n, 4), np.float32)
+        xy = rng.uniform(0.1, 0.6, (b, n, 2))
+        boxes[..., :2] = xy
+        boxes[..., 2:] = xy + rng.uniform(0.05, 0.3, (b, n, 2))
+        return {
+            "boxes": jnp.asarray(boxes),
+            "scores": jnp.asarray(rng.uniform(0.3, 1.0, (b, n)).astype(np.float32)),
+            "refs": jnp.zeros((b, n, 2), jnp.float32),
+            "valid": jnp.asarray(np.array([[True] * 5 + [False] * 3] * b)),
+        }
+
+    def test_refine_shapes_and_score_semantics(self):
+        ref = SamRefineModule(chunk=4)
+        ref.prompt_encoder = PromptEncoder(embed_dim=DIM)
+        ref.mask_decoder = MaskDecoder(
+            transformer_dim=DIM, transformer_num_heads=4,
+            transformer_mlp_dim=64,
+        )
+        _, _, _, _, params = _tiny_torch_pair()
+        dets = self._dets()
+        feats = jnp.asarray(
+            np.random.default_rng(3)
+            .standard_normal((1, 8, 8, DIM))
+            .astype(np.float32)
+        )
+        out = jax.jit(
+            lambda p, f, d: ref.refine(p, f, d, (64, 64))
+        )(params, feats, dets)
+        assert out["boxes"].shape == dets["boxes"].shape
+        assert out["scores"].shape == dets["scores"].shape
+        got = np.asarray(out["scores"])
+        orig = np.asarray(dets["scores"])
+        valid = np.asarray(dets["valid"])
+        # invalid slots keep their original score; valid = iou * orig
+        np.testing.assert_allclose(got[~valid], orig[~valid])
+        # refined boxes stay normalized-ish and finite
+        assert np.isfinite(np.asarray(out["boxes"])).all()
+        # refs recomputed as centers
+        b = np.asarray(out["boxes"])
+        np.testing.assert_allclose(
+            np.asarray(out["refs"]),
+            np.stack([(b[..., 0] + b[..., 2]) / 2,
+                      (b[..., 1] + b[..., 3]) / 2], axis=-1),
+            atol=1e-6,
+        )
+
+    def test_exemplar_scaling_variant_runs(self):
+        ref = SamRefineModule(chunk=4)
+        ref.prompt_encoder = PromptEncoder(embed_dim=DIM)
+        ref.mask_decoder = MaskDecoder(
+            transformer_dim=DIM, transformer_num_heads=4,
+            transformer_mlp_dim=64,
+        )
+        _, _, _, _, params = _tiny_torch_pair()
+        dets = self._dets()
+        feats = jnp.asarray(
+            np.random.default_rng(4)
+            .standard_normal((1, 8, 8, DIM))
+            .astype(np.float32)
+        )
+        ex = jnp.asarray(np.array([[0.2, 0.2, 0.5, 0.5]], np.float32))
+        out = ref.refine_with_exemplar_scaling(
+            params, feats, dets, ex, (64, 64)
+        )
+        assert out["boxes"].shape == dets["boxes"].shape
+        assert np.isfinite(np.asarray(out["boxes"])).all()
+
+    def test_predictor_end_to_end_with_refine(self):
+        from tmr_tpu.config import Config
+        from tmr_tpu.inference import Predictor
+        from tmr_tpu.models.matching_net import MatchingNet
+        from tmr_tpu.models.vit import SamViT
+
+        cfg = Config(
+            backbone="sam_vit_b", emb_dim=16, fusion=True,
+            image_size=64, NMS_cls_threshold=0.01, NMS_iou_threshold=0.5,
+            max_detections=16, template_buckets=(9,), refine_box=True,
+            compute_dtype="float32",
+        )
+        tiny = MatchingNet(
+            backbone=SamViT(
+                embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+                patch_size=8, window_size=3, out_chans=DIM,
+                pretrain_img_size=64,
+            ),
+            emb_dim=16, fusion=True, template_capacity=9,
+        )
+        refiner = SamRefineModule(chunk=8)
+        refiner.prompt_encoder = PromptEncoder(embed_dim=DIM)
+        refiner.mask_decoder = MaskDecoder(
+            transformer_dim=DIM, transformer_num_heads=4,
+            transformer_mlp_dim=64,
+        )
+        _, _, _, _, rparams = _tiny_torch_pair()
+        pred = Predictor(cfg, model=tiny, refiner=refiner,
+                         refiner_params=rparams)
+        pred.init_params(seed=0, image_size=64)
+        rng = np.random.default_rng(7)
+        image = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+        ex = np.array([[[0.2, 0.2, 0.5, 0.5]]], np.float32)
+        out = pred(image, ex)
+        assert out["boxes"].shape == (1, cfg.max_detections, 4)
+        assert np.isfinite(np.asarray(out["boxes"])).all()
+
+    def test_decode_masks_union(self):
+        ref = SamRefineModule(chunk=4)
+        ref.prompt_encoder = PromptEncoder(embed_dim=DIM)
+        ref.mask_decoder = MaskDecoder(
+            transformer_dim=DIM, transformer_num_heads=4,
+            transformer_mlp_dim=64,
+        )
+        _, _, _, _, params = _tiny_torch_pair()
+        feats = jnp.asarray(
+            np.random.default_rng(5)
+            .standard_normal((1, 8, 8, DIM))
+            .astype(np.float32)
+        )
+        boxes = jnp.asarray(
+            np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]], np.float32)
+        )
+        masks = ref.decode_masks(params, feats, boxes, (64, 64))
+        assert masks.shape == (1, 64, 64)
+        assert masks.dtype == jnp.bool_

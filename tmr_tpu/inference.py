@@ -27,12 +27,22 @@ from tmr_tpu.ops.postprocess import batched_nms, decode_detections
 
 
 class Predictor:
-    """Bucketed-jit inference wrapper around MatchingNet."""
+    """Bucketed-jit inference wrapper around MatchingNet.
 
-    def __init__(self, cfg, params=None, model=None):
+    With ``refiner`` set (and cfg.refine_box), the pipeline becomes
+    forward -> decode -> SAM box refinement -> NMS, the reference test-step
+    order (trainer.py:143-150) — still one fused XLA program. The refiner
+    consumes the model's own pre-upsample backbone features instead of the
+    reference's second ViT-H pass (trainer.py:146-147).
+    """
+
+    def __init__(self, cfg, params=None, model=None, refiner=None,
+                 refiner_params=None):
         self.cfg = cfg
         self.model = model if model is not None else build_model(cfg)
         self.params = params
+        self.refiner = refiner
+        self.refiner_params = refiner_params
         self._compiled: Dict[Tuple[int, int], callable] = {}
         self._nms_fn = None
 
@@ -59,9 +69,11 @@ class Predictor:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
         cfg = self.cfg
+        refine = self.refiner is not None and getattr(cfg, "refine_box", False)
+        refiner = self.refiner
 
         @jax.jit
-        def run(params, image, exemplars):
+        def run(params, refiner_params, image, exemplars):
             out = model.apply({"params": params}, image, exemplars)
             dets = decode_detections(
                 out["objectness"],
@@ -73,6 +85,13 @@ class Predictor:
                 scale_imgsize=cfg.regression_scaling_imgsize,
                 scale_wh_only=cfg.regression_scaling_WH_only,
             )
+            if refine:
+                dets = refiner.refine(
+                    refiner_params,
+                    out["backbone_feature"],
+                    dets,
+                    (image.shape[1], image.shape[2]),
+                )
             return batched_nms(dets, cfg.NMS_iou_threshold)
 
         self._compiled[key] = run
@@ -96,7 +115,12 @@ class Predictor:
             raise RuntimeError("call init_params() or load params first")
         cap = self.pick_capacity(exemplars, int(image.shape[1]))
         fn = self._get_fn(cap)
-        return fn(self.params, jnp.asarray(image), jnp.asarray(exemplars))
+        return fn(
+            self.params,
+            self.refiner_params,
+            jnp.asarray(image),
+            jnp.asarray(exemplars),
+        )
 
     def predict_multi_exemplar(self, image, exemplars) -> dict:
         """Reference multi-exemplar eval (trainer.py:75-121): independent

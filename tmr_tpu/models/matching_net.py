@@ -77,6 +77,11 @@ class MatchingNet(nn.Module):
         (the matcher uses exemplar 0, like template_matching.py:85)."""
         f = self.backbone(image)
         feats: Sequence[jnp.ndarray] = f if isinstance(f, (list, tuple)) else [f]
+        # pre-upsample encoder output: what the reference's separate
+        # ``temp_sam(image)`` pass recomputes for the box refiner
+        # (trainer.py:146-147) — exposed here so refinement reuses the
+        # already-computed activations instead of a second ViT-H forward.
+        backbone_feature = feats[0]
 
         if self.feature_upsample:
             feats = [
@@ -89,7 +94,13 @@ class MatchingNet(nn.Module):
                 for x in feats
             ]  # F.interpolate(scale 2, bilinear, align_corners=False)
 
-        out = {"objectness": [], "regressions": [], "f_tm": [], "feature": feats[0]}
+        out = {
+            "objectness": [],
+            "regressions": [],
+            "f_tm": [],
+            "feature": feats[0],
+            "backbone_feature": backbone_feature,
+        }
         for i, fi in enumerate(feats):
             fp = nn.Conv(
                 self.emb_dim, (1, 1), dtype=self.dtype, name=f"input_proj_{i}"

@@ -1,0 +1,462 @@
+"""SAM prompt encoder + two-way transformer + mask decoder in Flax.
+
+TPU-first rebuild of the reference's vendored segment-anything decoding
+stack (utils/segment_anything/modeling/{prompt_encoder,transformer,
+mask_decoder}.py), which the eval-only box refiner drives
+(utils/box_refine.py:22-60). Differences from the reference by design:
+
+- Everything is shape-static and jittable: prompts arrive as fixed-size
+  padded arrays, masks come out at the fixed low-res grid; no per-image
+  module construction (the reference rebuilds its PromptEncoder per image,
+  box_refine.py:207 — here the module is built once and the image/grid
+  sizes are ordinary call inputs).
+- NHWC feature layout end to end (TPU-native); the reference is NCHW.
+- The dense positional encoding is computed directly at the runtime feature
+  grid, so the 1.5x-upsample patch of the reference's mask_decoder
+  (mask_decoder.py:131-138) never needs to fire.
+- Best-mask auto-selection (argmax over predicted IoU) mirrors the
+  reference's modification of Meta's decoder (mask_decoder.py:100-103).
+
+Weight layout mirrors the torch module tree so utils/convert.py can remap
+``sam_vit_h`` checkpoints (prompt_encoder.* / mask_decoder.* subtrees)
+mechanically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmr_tpu.models.common import LayerNorm2d
+
+
+class PositionEmbeddingRandom(nn.Module):
+    """Random-Fourier positional encoding (prompt_encoder.py:171-214).
+
+    The gaussian projection matrix is a (frozen) parameter so converted SAM
+    checkpoints reproduce the reference encoding exactly.
+    """
+
+    num_pos_feats: int = 128
+
+    @nn.compact
+    def __call__(self, coords01: jnp.ndarray) -> jnp.ndarray:
+        """coords01 (..., 2) in [0,1] -> (..., 2*num_pos_feats)."""
+        mat = self.param(
+            "positional_encoding_gaussian_matrix",
+            nn.initializers.normal(stddev=1.0),
+            (2, self.num_pos_feats),
+        )
+        c = (2.0 * coords01 - 1.0) @ mat
+        c = 2.0 * jnp.pi * c
+        return jnp.concatenate([jnp.sin(c), jnp.cos(c)], axis=-1)
+
+    def grid_pe(self, size: Tuple[int, int]) -> jnp.ndarray:
+        """Dense PE for an (h, w) grid -> (h, w, C), half-pixel centers
+        (prompt_encoder.py:194-205)."""
+        h, w = size
+        ys = (jnp.arange(h, dtype=jnp.float32) + 0.5) / h
+        xs = (jnp.arange(w, dtype=jnp.float32) + 0.5) / w
+        grid = jnp.stack(
+            jnp.meshgrid(xs, ys, indexing="xy"), axis=-1
+        )  # (h, w, 2) as (x, y)
+        return self(grid)
+
+
+class PromptEncoder(nn.Module):
+    """Sparse (points/boxes) + dense (mask) prompt embeddings
+    (prompt_encoder.py:16-168), shape-static.
+
+    Call with padded fixed-size prompt arrays; the image size is a call
+    argument, not a constructor constant, so one module instance serves
+    every resolution bucket.
+    """
+
+    embed_dim: int = 256
+    mask_in_chans: int = 16
+
+    def setup(self):
+        self.pe_layer = PositionEmbeddingRandom(self.embed_dim // 2)
+        # 4 point embeddings: neg point, pos point, box corner 1, box corner 2
+        self.point_embeddings = self.param(
+            "point_embeddings",
+            nn.initializers.normal(stddev=1.0),
+            (4, self.embed_dim),
+        )
+        self.not_a_point_embed = self.param(
+            "not_a_point_embed",
+            nn.initializers.normal(stddev=1.0),
+            (1, self.embed_dim),
+        )
+        self.no_mask_embed = self.param(
+            "no_mask_embed",
+            nn.initializers.normal(stddev=1.0),
+            (1, self.embed_dim),
+        )
+        self.mask_downscaling = [
+            nn.Conv(self.mask_in_chans // 4, (2, 2), strides=(2, 2),
+                    name="mask_down_0"),
+            LayerNorm2d(name="mask_down_1"),
+            nn.Conv(self.mask_in_chans, (2, 2), strides=(2, 2),
+                    name="mask_down_3"),
+            LayerNorm2d(name="mask_down_4"),
+            nn.Conv(self.embed_dim, (1, 1), name="mask_down_6"),
+        ]
+
+    def embed_boxes(
+        self, boxes: jnp.ndarray, image_size: Tuple[int, int]
+    ) -> jnp.ndarray:
+        """boxes (N, 4) xyxy in pixels -> (N, 2, embed_dim)
+        (prompt_encoder.py:93-100)."""
+        h, w = image_size
+        corners = (boxes + 0.5).reshape(-1, 2, 2)
+        corners = corners / jnp.asarray([w, h], jnp.float32)
+        emb = self.pe_layer(corners)
+        emb = emb.at[:, 0, :].add(self.point_embeddings[2])
+        emb = emb.at[:, 1, :].add(self.point_embeddings[3])
+        return emb
+
+    def embed_points(
+        self,
+        points: jnp.ndarray,
+        labels: jnp.ndarray,
+        image_size: Tuple[int, int],
+    ) -> jnp.ndarray:
+        """points (N, K, 2) px, labels (N, K) in {-1,0,1} -> (N, K, C)
+        (prompt_encoder.py:73-91). Label -1 = padding slot."""
+        h, w = image_size
+        pts = (points + 0.5) / jnp.asarray([w, h], jnp.float32)
+        emb = self.pe_layer(pts)
+        lab = labels[..., None]
+        emb = jnp.where(lab == -1, self.not_a_point_embed[0], emb)
+        emb = jnp.where(lab == 0, emb + self.point_embeddings[0], emb)
+        emb = jnp.where(lab == 1, emb + self.point_embeddings[1], emb)
+        return emb
+
+    def embed_masks(self, masks: jnp.ndarray) -> jnp.ndarray:
+        """masks (N, 4h, 4w, 1) -> (N, h, w, embed_dim)."""
+        x = self.mask_downscaling[0](masks)
+        x = self.mask_downscaling[1](x)
+        x = nn.gelu(x, approximate=False)
+        x = self.mask_downscaling[2](x)
+        x = self.mask_downscaling[3](x)
+        x = nn.gelu(x, approximate=False)
+        return self.mask_downscaling[4](x)
+
+    def no_mask_dense(
+        self, n: int, emb_size: Tuple[int, int]
+    ) -> jnp.ndarray:
+        """(n, h, w, embed_dim) broadcast of the no-mask embedding."""
+        h, w = emb_size
+        return jnp.broadcast_to(
+            self.no_mask_embed[0][None, None, None, :],
+            (n, h, w, self.embed_dim),
+        )
+
+    def dense_pe(self, emb_size: Tuple[int, int]) -> jnp.ndarray:
+        """(h, w, embed_dim) grid positional encoding."""
+        return self.pe_layer.grid_pe(emb_size)
+
+    def __call__(self, boxes, image_size, emb_size):
+        """Convenience: box-prompt path (the only one the refiner uses).
+        boxes (N, 4) px xyxy -> sparse (N, 2, C), dense (N, h, w, C)."""
+        sparse = self.embed_boxes(boxes, image_size)
+        dense = self.no_mask_dense(boxes.shape[0], emb_size)
+        return sparse, dense
+
+
+class DownsampledAttention(nn.Module):
+    """Attention with optional internal-dim downsampling
+    (transformer.py:185-240)."""
+
+    num_heads: int
+    downsample_rate: int = 1
+
+    @nn.compact
+    def __call__(self, q, k, v):
+        embedding_dim = q.shape[-1]
+        internal_dim = embedding_dim // self.downsample_rate
+        head_dim = internal_dim // self.num_heads
+        q = nn.Dense(internal_dim, name="q_proj")(q)
+        k = nn.Dense(internal_dim, name="k_proj")(k)
+        v = nn.Dense(internal_dim, name="v_proj")(v)
+
+        def split(x):
+            b, n, c = x.shape
+            return x.reshape(b, n, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        attn = jnp.einsum("bhqc,bhkc->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, jnp.float32)
+        )
+        attn = jax.nn.softmax(attn, axis=-1)
+        out = jnp.einsum("bhqk,bhkc->bhqc", attn, v)
+        b, h, n, c = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, h * c)
+        return nn.Dense(embedding_dim, name="out_proj")(out)
+
+
+class TwoWayAttentionBlock(nn.Module):
+    """Sparse<->dense cross-attention block (transformer.py:109-182)."""
+
+    num_heads: int
+    mlp_dim: int = 2048
+    attention_downsample_rate: int = 2
+    skip_first_layer_pe: bool = False
+
+    @nn.compact
+    def __call__(self, queries, keys, query_pe, key_pe):
+        if self.skip_first_layer_pe:
+            queries = DownsampledAttention(
+                num_heads=self.num_heads, name="self_attn"
+            )(queries, queries, queries)
+        else:
+            q = queries + query_pe
+            queries = queries + DownsampledAttention(
+                num_heads=self.num_heads, name="self_attn"
+            )(q, q, queries)
+        queries = nn.LayerNorm(epsilon=1e-5, name="norm1")(queries)
+
+        q = queries + query_pe
+        k = keys + key_pe
+        queries = queries + DownsampledAttention(
+            num_heads=self.num_heads,
+            downsample_rate=self.attention_downsample_rate,
+            name="cross_attn_token_to_image",
+        )(q, k, keys)
+        queries = nn.LayerNorm(epsilon=1e-5, name="norm2")(queries)
+
+        mlp = nn.Dense(self.mlp_dim, name="mlp_lin1")(queries)
+        mlp = nn.relu(mlp)
+        mlp = nn.Dense(queries.shape[-1], name="mlp_lin2")(mlp)
+        queries = nn.LayerNorm(epsilon=1e-5, name="norm3")(queries + mlp)
+
+        q = queries + query_pe
+        k = keys + key_pe
+        keys = keys + DownsampledAttention(
+            num_heads=self.num_heads,
+            downsample_rate=self.attention_downsample_rate,
+            name="cross_attn_image_to_token",
+        )(k, q, queries)
+        keys = nn.LayerNorm(epsilon=1e-5, name="norm4")(keys)
+        return queries, keys
+
+
+class TwoWayTransformer(nn.Module):
+    """Token<->image two-way decoder transformer (transformer.py:16-106)."""
+
+    depth: int = 2
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    attention_downsample_rate: int = 2
+
+    @nn.compact
+    def __call__(self, image_embedding, image_pe, point_embedding):
+        """image_embedding/image_pe (B, h, w, C); point_embedding (B, N, C).
+        Returns (queries (B, N, C), keys (B, h*w, C))."""
+        b, h, w, c = image_embedding.shape
+        keys = image_embedding.reshape(b, h * w, c)
+        key_pe = image_pe.reshape(b, h * w, c)
+        queries = point_embedding
+
+        for i in range(self.depth):
+            queries, keys = TwoWayAttentionBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                attention_downsample_rate=self.attention_downsample_rate,
+                skip_first_layer_pe=(i == 0),
+                name=f"layers_{i}",
+            )(queries, keys, point_embedding, key_pe)
+
+        q = queries + point_embedding
+        k = keys + key_pe
+        queries = queries + DownsampledAttention(
+            num_heads=self.num_heads,
+            downsample_rate=self.attention_downsample_rate,
+            name="final_attn_token_to_image",
+        )(q, k, keys)
+        queries = nn.LayerNorm(epsilon=1e-5, name="norm_final_attn")(queries)
+        return queries, keys
+
+
+class UpConv2x(nn.Module):
+    """Non-overlapping 2x transposed conv (kernel 2, stride 2), written as an
+    explicit einsum so the semantics match torch's ConvTranspose2d exactly:
+    out[2i+u, 2j+v] = sum_c in[i, j, c] * kernel[u, v, c, o] + bias."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (2, 2, c, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = jnp.einsum("bhwc,uvco->bhuwvo", x, kernel)
+        y = y.reshape(b, h * 2, w * 2, self.features)
+        return y + bias
+
+
+class HyperMLP(nn.Module):
+    """3-layer relu MLP head (mask_decoder.py:166-188)."""
+
+    hidden_dim: int
+    output_dim: int
+    num_layers: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.num_layers - 1):
+            x = nn.relu(nn.Dense(self.hidden_dim, name=f"layers_{i}")(x))
+        return nn.Dense(self.output_dim, name=f"layers_{self.num_layers - 1}")(x)
+
+
+class MaskDecoder(nn.Module):
+    """SAM mask decoder with best-IoU mask auto-selection
+    (mask_decoder.py:16-161 incl. the reference's argmax patch :100-103).
+
+    Inputs are NHWC; output masks are at the 4x-upscaled feature grid
+    (4h, 4w) — callers upsample/threshold as needed.
+    """
+
+    transformer_dim: int = 256
+    num_multimask_outputs: int = 3
+    iou_head_depth: int = 3
+    iou_head_hidden_dim: int = 256
+    transformer_depth: int = 2
+    transformer_num_heads: int = 8
+    transformer_mlp_dim: int = 2048
+
+    @nn.compact
+    def __call__(
+        self,
+        image_embeddings: jnp.ndarray,  # (1 or N, h, w, C)
+        image_pe: jnp.ndarray,  # (h, w, C)
+        sparse_prompt_embeddings: jnp.ndarray,  # (N, P, C)
+        dense_prompt_embeddings: jnp.ndarray,  # (N, h, w, C)
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (masks (N, 4h, 4w), iou (N,)) — the best mask per prompt."""
+        num_mask_tokens = self.num_multimask_outputs + 1
+        d = self.transformer_dim
+        n = sparse_prompt_embeddings.shape[0]
+
+        iou_token = self.param(
+            "iou_token", nn.initializers.normal(stddev=1.0), (1, d)
+        )
+        mask_tokens = self.param(
+            "mask_tokens", nn.initializers.normal(stddev=1.0),
+            (num_mask_tokens, d),
+        )
+        output_tokens = jnp.concatenate([iou_token, mask_tokens], axis=0)
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(output_tokens[None], (n, *output_tokens.shape)),
+             sparse_prompt_embeddings],
+            axis=1,
+        )
+
+        src = jnp.broadcast_to(
+            image_embeddings, (n, *image_embeddings.shape[1:])
+        ) + dense_prompt_embeddings
+        pos_src = jnp.broadcast_to(image_pe[None], src.shape)
+
+        hs, keys = TwoWayTransformer(
+            depth=self.transformer_depth,
+            num_heads=self.transformer_num_heads,
+            mlp_dim=self.transformer_mlp_dim,
+            name="transformer",
+        )(src, pos_src, tokens)
+        iou_token_out = hs[:, 0, :]
+        mask_tokens_out = hs[:, 1 : 1 + num_mask_tokens, :]
+
+        h, w = src.shape[1], src.shape[2]
+        src = keys.reshape(n, h, w, d)
+        # output upscaling: convT 2x -> LN2d -> gelu -> convT 2x -> gelu
+        up = UpConv2x(d // 4, name="upscale_0")(src)
+        up = LayerNorm2d(name="upscale_1")(up)
+        up = nn.gelu(up, approximate=False)
+        up = UpConv2x(d // 8, name="upscale_3")(up)
+        up = nn.gelu(up, approximate=False)  # (N, 4h, 4w, d//8)
+
+        hyper = jnp.stack(
+            [
+                HyperMLP(d, d // 8, name=f"hyper_mlps_{i}")(
+                    mask_tokens_out[:, i, :]
+                )
+                for i in range(num_mask_tokens)
+            ],
+            axis=1,
+        )  # (N, T, d//8)
+        masks = jnp.einsum("ntc,nhwc->nthw", hyper, up)
+
+        iou_pred = HyperMLP(
+            self.iou_head_hidden_dim,
+            num_mask_tokens,
+            num_layers=self.iou_head_depth,
+            name="iou_prediction_head",
+        )(iou_token_out)  # (N, T)
+
+        # reference patch: keep the best-IoU mask per prompt
+        best = jnp.argmax(iou_pred, axis=1)
+        masks = jnp.take_along_axis(
+            masks, best[:, None, None, None], axis=1
+        )[:, 0]
+        iou = jnp.take_along_axis(iou_pred, best[:, None], axis=1)[:, 0]
+        return masks, iou
+
+
+def resize_align_corners(x: jnp.ndarray, out_hw: Tuple[int, int]) -> jnp.ndarray:
+    """Bilinear resize with align_corners=True semantics over the trailing
+    two spatial axes of (..., H, W) — matches the reference's
+    F.interpolate(..., mode='bilinear', align_corners=True) used on mask
+    logits (box_refine.py:103,158)."""
+
+    def interp_axis(arr, axis, out_len):
+        in_len = arr.shape[axis]
+        if in_len == out_len:
+            return arr
+        if in_len == 1:
+            return jnp.repeat(arr, out_len, axis=axis)
+        pos = jnp.arange(out_len, dtype=jnp.float32) * (in_len - 1) / (out_len - 1)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_len - 1)
+        frac = pos - lo.astype(jnp.float32)
+        a = jnp.take(arr, lo, axis=axis)
+        b = jnp.take(arr, hi, axis=axis)
+        shape = [1] * arr.ndim
+        shape[axis] = out_len
+        frac = frac.reshape(shape)
+        return a * (1.0 - frac) + b * frac
+
+    x = interp_axis(x, x.ndim - 2, out_hw[0])
+    x = interp_axis(x, x.ndim - 1, out_hw[1])
+    return x
+
+
+def masks_to_boxes(masks: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tight pixel bboxes of boolean masks, fully in-XLA.
+
+    masks (N, H, W) bool -> (boxes (N, 4) xyxy float px, nonempty (N,) bool).
+    Replaces the reference's per-mask torch.where python loop
+    (box_refine.py:236-242); empty masks yield zeros like the reference's
+    zero-initialized output.
+    """
+    n, h, w = masks.shape
+    any_x = jnp.any(masks, axis=1)  # (N, W) columns with any pixel
+    any_y = jnp.any(masks, axis=2)  # (N, H)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    big = jnp.float32(1e9)
+    min_x = jnp.min(jnp.where(any_x, xs, big), axis=1)
+    max_x = jnp.max(jnp.where(any_x, xs, -big), axis=1)
+    min_y = jnp.min(jnp.where(any_y, ys, big), axis=1)
+    max_y = jnp.max(jnp.where(any_y, ys, -big), axis=1)
+    nonempty = jnp.any(any_x, axis=1)
+    boxes = jnp.stack([min_x, min_y, max_x, max_y], axis=1)
+    return jnp.where(nonempty[:, None], boxes, 0.0), nonempty

@@ -135,6 +135,133 @@ def convert_resnet50(sd: Dict[str, np.ndarray], prefix: str = "") -> dict:
     return p
 
 
+def convert_prompt_encoder(
+    sd: Dict[str, np.ndarray], prefix: str = "prompt_encoder."
+) -> dict:
+    """SAM ``prompt_encoder.*`` subtree -> PromptEncoder (models/sam_decoder)
+    params. Source layout: utils/segment_anything/modeling/prompt_encoder.py;
+    the refiner loads the same subtree (box_refine.py:55-60)."""
+    sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+    p: dict = {
+        "pe_layer": {
+            "positional_encoding_gaussian_matrix": _np(
+                sd["pe_layer.positional_encoding_gaussian_matrix"]
+            ),
+        },
+        "point_embeddings": np.concatenate(
+            [_np(sd[f"point_embeddings.{i}.weight"]) for i in range(4)], axis=0
+        ),
+        "not_a_point_embed": _np(sd["not_a_point_embed.weight"]),
+        "no_mask_embed": _np(sd["no_mask_embed.weight"]),
+    }
+    for torch_i, mine in ((0, "mask_down_0"), (3, "mask_down_3"),
+                          (6, "mask_down_6")):
+        p[mine] = {
+            "kernel": _conv(sd[f"mask_downscaling.{torch_i}.weight"]),
+            "bias": _np(sd[f"mask_downscaling.{torch_i}.bias"]),
+        }
+    for torch_i, mine in ((1, "mask_down_1"), (4, "mask_down_4")):
+        p[mine] = {
+            "weight": _np(sd[f"mask_downscaling.{torch_i}.weight"]),
+            "bias": _np(sd[f"mask_downscaling.{torch_i}.bias"]),
+        }
+    return p
+
+
+def _attn_params(sd: Dict[str, np.ndarray], base: str) -> dict:
+    return {
+        name: {
+            "kernel": _dense(sd[f"{base}.{name}.weight"]),
+            "bias": _np(sd[f"{base}.{name}.bias"]),
+        }
+        for name in ("q_proj", "k_proj", "v_proj", "out_proj")
+    }
+
+
+def _ln_params(sd: Dict[str, np.ndarray], base: str) -> dict:
+    return {"scale": _np(sd[base + ".weight"]), "bias": _np(sd[base + ".bias"])}
+
+
+def convert_mask_decoder(
+    sd: Dict[str, np.ndarray], prefix: str = "mask_decoder.", depth: int = 2
+) -> dict:
+    """SAM ``mask_decoder.*`` subtree -> MaskDecoder params
+    (mask_decoder.py module tree; refiner load at box_refine.py:41-46).
+
+    torch ConvTranspose2d weight is (I, O, kh, kw); UpConv2x expects
+    (kh, kw, I, O)."""
+    sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+
+    def upconv(base: str) -> dict:
+        return {
+            "kernel": _np(sd[base + ".weight"]).transpose(2, 3, 0, 1),
+            "bias": _np(sd[base + ".bias"]),
+        }
+
+    def mlp(base: str, layers: int = 3) -> dict:
+        return {
+            f"layers_{i}": {
+                "kernel": _dense(sd[f"{base}.layers.{i}.weight"]),
+                "bias": _np(sd[f"{base}.layers.{i}.bias"]),
+            }
+            for i in range(layers)
+        }
+
+    t: dict = {}
+    for i in range(depth):
+        lb = f"transformer.layers.{i}"
+        t[f"layers_{i}"] = {
+            "self_attn": _attn_params(sd, lb + ".self_attn"),
+            "cross_attn_token_to_image": _attn_params(
+                sd, lb + ".cross_attn_token_to_image"
+            ),
+            "cross_attn_image_to_token": _attn_params(
+                sd, lb + ".cross_attn_image_to_token"
+            ),
+            "norm1": _ln_params(sd, lb + ".norm1"),
+            "norm2": _ln_params(sd, lb + ".norm2"),
+            "norm3": _ln_params(sd, lb + ".norm3"),
+            "norm4": _ln_params(sd, lb + ".norm4"),
+            "mlp_lin1": {
+                "kernel": _dense(sd[lb + ".mlp.lin1.weight"]),
+                "bias": _np(sd[lb + ".mlp.lin1.bias"]),
+            },
+            "mlp_lin2": {
+                "kernel": _dense(sd[lb + ".mlp.lin2.weight"]),
+                "bias": _np(sd[lb + ".mlp.lin2.bias"]),
+            },
+        }
+    t["final_attn_token_to_image"] = _attn_params(
+        sd, "transformer.final_attn_token_to_image"
+    )
+    t["norm_final_attn"] = _ln_params(sd, "transformer.norm_final_attn")
+
+    p: dict = {
+        "iou_token": _np(sd["iou_token.weight"]),
+        "mask_tokens": _np(sd["mask_tokens.weight"]),
+        "transformer": t,
+        "upscale_0": upconv("output_upscaling.0"),
+        "upscale_1": {
+            "weight": _np(sd["output_upscaling.1.weight"]),
+            "bias": _np(sd["output_upscaling.1.bias"]),
+        },
+        "upscale_3": upconv("output_upscaling.3"),
+        "iou_prediction_head": mlp("iou_prediction_head"),
+    }
+    num_mask_tokens = p["mask_tokens"].shape[0]
+    for i in range(num_mask_tokens):
+        p[f"hyper_mlps_{i}"] = mlp(f"output_hypernetworks_mlps.{i}")
+    return p
+
+
+def convert_sam_refiner(sd: Dict[str, np.ndarray]) -> dict:
+    """Full sam_vit_h-style checkpoint -> SamRefineModule params dict."""
+    return {
+        "prompt_encoder": convert_prompt_encoder(sd),
+        "mask_decoder": convert_mask_decoder(sd),
+    }
+
+
 def convert_matching_net(sd: Dict[str, np.ndarray], backbone: str = "sam") -> dict:
     """Lightning ``model.*`` state_dict -> MatchingNet params.
 
