@@ -87,6 +87,9 @@ def config_parser(argv=None):
     p.add_argument("--mesh_model", default=1, type=int,
                    help="tensor-parallel mesh size for the ViT")
     p.add_argument("--compute_dtype", default="bfloat16", type=str)
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="capture an XLA profiler trace of the first epoch "
+                        "into this directory (TensorBoard/xprof)")
 
     args = p.parse_args(argv)
     return args

@@ -89,6 +89,9 @@ class Config:
     max_detections: int = 2000
     # compute dtype for the encoder ("bfloat16" or "float32").
     compute_dtype: str = "bfloat16"
+    # when set, the train loop captures an XLA profiler trace of the first
+    # epoch into this directory (view with TensorBoard/xprof).
+    profile_dir: Optional[str] = None
     # mesh axes: (data, model). Products must equal device count.
     mesh_shape: Tuple[int, int] = (1, 1)
     max_gt_boxes: int = 800  # padding capacity for GT boxes per image

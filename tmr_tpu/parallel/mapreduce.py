@@ -158,10 +158,17 @@ def run_stream(
     masked out of the stats). ``save_features(shard, image_name, features)``
     is the .npy side-effect hook (mapper.py:117-118).
     """
+    from tmr_tpu.utils.profiling import log_progress, log_warning
+
     acc = StatAccumulator()
 
     def load_shard(path):
-        return list(iter_tar_images(path))
+        # bad/missing tar -> log + skip the whole shard (mapper.py:79-81)
+        try:
+            return list(iter_tar_images(path))
+        except Exception as e:
+            log_warning(f"skipping shard {path}: {e}")
+            return []
 
     from collections import deque
 
@@ -180,6 +187,10 @@ def run_stream(
             if nxt is not None:
                 queue.append((nxt, pool.submit(load_shard, nxt)))
             cat = category_of(path)
+            log_progress(
+                f"shard {os.path.basename(path)}: {len(images)} images "
+                f"({CATEGORIES[cat]})"
+            )
             for i in range(0, len(images), batch_size):
                 chunk = images[i : i + batch_size]
                 names = [n for n, _ in chunk]

@@ -30,6 +30,7 @@ from tmr_tpu.train.state import (
     make_train_step,
 )
 from tmr_tpu.utils.checkpoint import CheckpointManager
+from tmr_tpu.utils.profiling import PhaseTimer, step_annotation, trace
 from tmr_tpu.utils.metrics import (
     coco_style_annotation_generator,
     del_img_log_path,
@@ -188,18 +189,36 @@ class Trainer:
             t0 = time.time()
             sums: Dict[str, float] = {}
             n = 0
-            for i, batch in enumerate(train):
-                if i >= steps:
-                    break
-                self.state, losses = self._train_step(
-                    self.state, self._to_device(batch)
-                )
-                for k, v in losses.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-                n += 1
+            timers = PhaseTimer()
+            # capture an xprof trace of the first post-resume epoch
+            profile = cfg.profile_dir if epoch == start_epoch else None
+            with trace(profile):
+                it = iter(train)
+                try:
+                    for i in range(steps):
+                        with timers.phase("data"):
+                            batch = next(it, None)
+                            if batch is None:
+                                break
+                            batch = self._to_device(batch)
+                        with timers.phase("step"), step_annotation("train", i):
+                            self.state, losses = self._train_step(
+                                self.state, batch
+                            )
+                        with timers.phase("metrics"):
+                            # float() blocks on the device step — 'metrics'
+                            # time is device compute not hidden by 'step'
+                            for k, v in losses.items():
+                                sums[k] = sums.get(k, 0.0) + float(v)
+                        n += 1
+                finally:
+                    # release the loader's worker pool + prefetch window now,
+                    # not whenever the suspended generator gets GC'd
+                    it.close()
             row = {f"train/{k}": v / max(n, 1) for k, v in sums.items()}
             row["epoch"] = epoch
             row["train/sec"] = time.time() - t0
+            row.update(timers.as_dict())
 
             ap_epoch = epoch == 0 or (epoch % cfg.AP_term == cfg.AP_term - 1)
             if ap_epoch:

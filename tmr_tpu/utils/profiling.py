@@ -1,0 +1,138 @@
+"""Tracing / profiling / protocol logging — a first-class subsystem.
+
+The reference has none of this (SURVEY §5.1: no profiler hooks, no timing
+instrumentation); its only observability artifacts are the Hadoop mapper's
+timestamped stderr logs (reference ``logs/mapper_debug_*.txt``) and the
+``[INFO]/[WARNING]/[ERROR]/[PROGRESS]`` stderr protocol of ``reducer.py:29-94``.
+This module supplies the TPU-native versions of both, plus what a real
+framework needs:
+
+- :func:`trace` — capture an XLA/TPU profiler trace (view with
+  TensorBoard/xprof) around any region.
+- :func:`annotate` / :func:`step_annotation` — named trace regions that show
+  up on the TPU timeline inside a capture.
+- :class:`PhaseTimer` — cheap host-side per-phase wall-clock accounting with
+  an aggregate report (count / total / mean), used by the training loop and
+  the streaming pipeline.
+- :func:`log_info` etc. — the reference's stderr logging protocol, kept
+  line-compatible (``[LEVEL] message``) so log-scraping tooling carries over.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Dict, Iterator, Optional
+
+
+# ----------------------------------------------------------------- logging
+def _emit(level: str, msg: str) -> None:
+    """stderr protocol line, format-compatible with reducer.py:29-94."""
+    print(f"[{level}] {msg}", file=sys.stderr, flush=True)
+
+
+def log_info(msg: str) -> None:
+    _emit("INFO", msg)
+
+
+def log_warning(msg: str) -> None:
+    _emit("WARNING", msg)
+
+
+def log_error(msg: str) -> None:
+    _emit("ERROR", msg)
+
+
+def log_progress(msg: str) -> None:
+    _emit("PROGRESS", msg)
+
+
+# ----------------------------------------------------------------- tracing
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a device profiler trace into ``logdir`` (no-op when None).
+
+    Wraps ``jax.profiler.trace`` so callers don't import jax at module load;
+    the resulting trace includes XLA HLO timelines, TPU step markers, and any
+    :func:`annotate` regions entered inside.
+    """
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region on the profiler timeline (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step: int) -> Iterator[None]:
+    """Step marker (StepTraceAnnotation) — lets xprof group per-step work."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield
+
+
+# ------------------------------------------------------------------ timing
+class PhaseTimer:
+    """Host-side wall-clock accounting by phase name.
+
+    Usage::
+
+        timers = PhaseTimer()
+        with timers.phase("data"):
+            batch = next(it)
+        with timers.phase("step"):
+            state, losses = train_step(state, batch)
+        print(timers.report())
+
+    Device work is async under jit; a phase that must include device time
+    should block (e.g. ``jax.block_until_ready``) before exiting — the train
+    loop's loss readback already does this implicitly.
+    """
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def mean(self, name: str) -> float:
+        return self.totals[name] / max(self.counts.get(name, 0), 1)
+
+    def as_dict(self, prefix: str = "time/") -> Dict[str, float]:
+        """Totals keyed for the metrics CSV (``time/<phase>`` seconds)."""
+        return {f"{prefix}{k}": v for k, v in self.totals.items()}
+
+    def report(self) -> str:
+        rows = [f"{'PHASE':<16} | {'CALLS':>6} | {'TOTAL_S':>9} | {'MEAN_MS':>9}"]
+        rows.append("-" * 51)
+        for name in sorted(self.totals):
+            rows.append(
+                f"{name:<16} | {self.counts[name]:>6} | "
+                f"{self.totals[name]:>9.3f} | {self.mean(name) * 1e3:>9.2f}"
+            )
+        return "\n".join(rows)
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
