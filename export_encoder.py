@@ -1,0 +1,69 @@
+"""Export the SAM encoder as a portable serialized artifact.
+
+The TPU-native counterpart of the reference's ``export_onnx.py``: instead of
+``torch.onnx.export`` (opset 12, dynamic batch axis, export_onnx.py:76-89)
+we lower the jitted Flax encoder to serialized StableHLO via ``jax.export``
+with a symbolic batch dimension, runnable on TPU or CPU with no model code.
+The artifact is what the streaming feature-extraction pipeline (the Hadoop
+mapper replacement) loads on workers — see
+``tmr_tpu.parallel.mapreduce.make_encode_stats_fn_from_artifact``.
+
+Like export_onnx.py:39-52, an optional SAM-HQ ``.pth`` checkpoint is key-
+remapped (``image_encoder.*``) into the encoder; without one the artifact
+carries fresh random weights (the reference builds without weights too,
+export_onnx.py:27).
+
+Usage:
+  python export_encoder.py --model_type vit_b \
+      [--checkpoint checkpoints/sam_hq_vit_b.pth] \
+      [--output exported/sam_vit_b_encoder.stablehlo] [--image_size 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def export_model(
+    model_type: str = "vit_b",
+    checkpoint: str | None = None,
+    output: str = "exported/sam_vit_b_encoder.stablehlo",
+    image_size: int = 1024,
+    compute_dtype: str = "bfloat16",
+    seed: int = 0,
+):
+    import jax.numpy as jnp
+
+    from tmr_tpu.models import build_sam_encoder
+    from tmr_tpu.utils.export import export_encoder, save_exported
+
+    dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    model, params = build_sam_encoder(
+        model_type, checkpoint, image_size, dtype=dtype, seed=seed
+    )
+    print(f"weights: {'converted from ' + checkpoint if checkpoint else 'fresh random init'}")
+
+    data = export_encoder(model, params, image_size=image_size)
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    save_exported(data, output)
+    print(f"wrote {output} ({len(data) / 1e6:.1f} MB, symbolic batch, "
+          f"input (b, {image_size}, {image_size}, 3) float32)")
+    return output
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_type", default="vit_b", choices=["vit_b", "vit_h"])
+    p.add_argument("--checkpoint", default=None,
+                   help="SAM-HQ .pth with image_encoder.* keys")
+    p.add_argument("--output", default="exported/sam_vit_b_encoder.stablehlo")
+    p.add_argument("--image_size", default=1024, type=int)
+    p.add_argument("--compute_dtype", default="bfloat16")
+    args = p.parse_args(argv)
+    export_model(args.model_type, args.checkpoint, args.output,
+                 args.image_size, args.compute_dtype)
+
+
+if __name__ == "__main__":
+    main()

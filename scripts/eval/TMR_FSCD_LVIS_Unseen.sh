@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Eval with the best checkpoint (reference scripts/eval/TMR_FSCD_LVIS_Unseen.sh):
+# batch 1, per-dataset NMS cls threshold 0.1. Append --refine_box for
+# SAM box refinement (commented out in the reference too).
+python main.py \
+  --project_name "Few-Shot Pattern Detection" \
+  --datapath /data/fscd-lvis \
+  --logpath ./outputs/FSCD_LVIS_Unseen \
+  --modeltype matching_net \
+  --template_type roi_align \
+  --dataset FSCD_LVIS_Unseen \
+  --num_workers 1 \
+  --batch_size 1 \
+  --num_exemplars 1 \
+  --backbone sam \
+  --encoder original \
+  --emb_dim 512 \
+  --decoder_num_layer 1 \
+  --decoder_kernel_size 3 \
+  --feature_upsample \
+  --positive_threshold 0.5 \
+  --negative_threshold 0.5 \
+  --NMS_cls_threshold 0.1 \
+  --NMS_iou_threshold 0.5 \
+  --fusion \
+  --nowandb \
+  --device tpu \
+  --eval #\
+#  --refine_box

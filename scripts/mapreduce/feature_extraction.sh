@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Streaming feature extraction over tar shards — the Hadoop Streaming job
+# (`hadoop jar streaming … -mapper mapper.py -reducer reducer.py` over
+# list_tars*.txt) collapsed to one TPU-accelerated pipeline. The mapper's
+# HDFS get/put becomes a posix/NFS/FUSE --data_dir; the sort/shuffle is a
+# dict aggregation (or an on-device psum over a mesh, see
+# tmr_tpu.parallel.mapreduce.allreduce_stats).
+#
+# Usage: feature_extraction.sh LIST_FILE DATA_DIR [ARTIFACT]
+set -euo pipefail
+LIST=${1:?list_tars*.txt}
+DATA_DIR=${2:?tar shard directory}
+ARTIFACT=${3:-exported/sam_vit_b_encoder.stablehlo}
+[ -f "$ARTIFACT" ] || python export_encoder.py --output "$ARTIFACT"
+cat "$LIST" \
+  | python -m tmr_tpu.parallel.mapreduce map \
+      --data_dir "$DATA_DIR" --artifact "$ARTIFACT" \
+      --features_out features_output \
+  | sort \
+  | python -m tmr_tpu.parallel.mapreduce reduce

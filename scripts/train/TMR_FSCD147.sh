@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# TPU-native training, the paper recipe (reference scripts/train/TMR_FSCD147.sh):
+# SAM backbone, emb 512, roi_align templates, 2x feature upsample, fusion,
+# pos/neg 0.5, bs 4, 200 epochs, AdamW lr 1e-4 / frozen backbone, lr drop.
+# Data parallelism over every local TPU chip (--mesh_data -1 = all devices);
+# add --mesh_model N to also tensor-parallel the ViT over N chips.
+python main.py \
+  --project_name "Few-Shot Pattern Detection" \
+  --datapath /data/fscd-147 \
+  --logpath ./outputs/FSCD147 \
+  --modeltype matching_net \
+  --template_type roi_align \
+  --dataset FSCD147 \
+  --num_workers 4 \
+  --max_epochs 200 \
+  --batch_size 4 \
+  --num_exemplars 1 \
+  --backbone sam \
+  --encoder original \
+  --emb_dim 512 \
+  --decoder_num_layer 1 \
+  --decoder_kernel_size 3 \
+  --feature_upsample \
+  --positive_threshold 0.5 \
+  --negative_threshold 0.5 \
+  --NMS_cls_threshold 0.1 \
+  --NMS_iou_threshold 0.5 \
+  --fusion \
+  --lr 1e-4 \
+  --lr_backbone 0 \
+  --lr_drop \
+  --nowandb \
+  --device tpu \
+  --mesh_data -1 \
+  --multi_gpu

@@ -1,0 +1,144 @@
+"""Export artifact (export_encoder.py / utils/export.py — the ONNX-export
+equivalent, reference export_onnx.py) and the feature-extractor CLI
+(extract_feature.py, reference extract_feature.py:12-123)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmr_tpu.models.vit import SamViT
+from tmr_tpu.utils.export import (
+    export_encoder,
+    exported_input_spec,
+    load_exported,
+    save_exported,
+)
+
+TINY = dict(embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            window_size=2, out_chans=16, pretrain_img_size=64)
+SIZE = 64
+
+
+@pytest.fixture(scope="module")
+def tiny_encoder():
+    model = SamViT(**TINY)
+    img = jnp.zeros((1, SIZE, SIZE, 3), jnp.float32)
+    params = model.init(jax.random.key(0), img)["params"]
+    return model, params
+
+
+def test_export_roundtrip_matches_apply(tiny_encoder, tmp_path):
+    model, params = tiny_encoder
+    data = export_encoder(model, params, image_size=SIZE,
+                          platforms=("cpu",))
+    path = str(tmp_path / "enc.stablehlo")
+    save_exported(data, path)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, SIZE, SIZE, 3)), jnp.float32)
+    want = model.apply({"params": params}, x)
+    got = load_exported(path)(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_export_symbolic_batch(tiny_encoder, tmp_path):
+    """One artifact serves several batch sizes (the reference's dynamic
+    batch axis, export_onnx.py:85-88)."""
+    model, params = tiny_encoder
+    path = str(tmp_path / "enc.stablehlo")
+    save_exported(
+        export_encoder(model, params, image_size=SIZE, platforms=("cpu",)),
+        path,
+    )
+    shape, dtype = exported_input_spec(path)
+    assert str(shape[0]) == "b" and shape[1:] == (SIZE, SIZE, 3)
+    fn = load_exported(path)
+    for b in (1, 3):
+        out = fn(jnp.zeros((b, SIZE, SIZE, 3), jnp.float32))
+        assert out.shape[0] == b
+
+
+def test_mapreduce_from_artifact(tiny_encoder, tmp_path):
+    from tmr_tpu.parallel.mapreduce import (
+        feature_stats,
+        make_encode_stats_fn_from_artifact,
+    )
+
+    model, params = tiny_encoder
+    path = str(tmp_path / "enc.stablehlo")
+    save_exported(
+        export_encoder(model, params, image_size=SIZE, platforms=("cpu",)),
+        path,
+    )
+    fn = make_encode_stats_fn_from_artifact(path)
+    x = jnp.ones((2, SIZE, SIZE, 3), jnp.float32) * 0.5
+    feats, stats = fn(x)
+    assert stats.shape == (2, 4)
+    want = model.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(feats), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats), np.asarray(feature_stats(want)), rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+# --------------------------------------------------------- extract_feature
+def test_sam_preprocess_geometry():
+    from tmr_tpu.data.transforms import sam_longest_side_preprocess
+
+    img = np.full((50, 100, 3), 255, np.uint8)  # wide -> pad bottom
+    out = sam_longest_side_preprocess(img, target=64)
+    assert out.shape == (64, 64, 3)
+    # bottom rows are padding (zeros), top-left is normalized white
+    assert np.all(out[40:] == 0.0)
+    assert out[0, 0, 0] > 2.0  # (255 - 123.675) / 58.395 ≈ 2.25
+
+
+def test_extract_feature_cli(tiny_encoder, tmp_path, capsys):
+    import extract_feature
+
+    model, params = tiny_encoder
+    img_path = str(tmp_path / "img.png")
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    Image.fromarray(rng.integers(0, 255, (48, 80, 3), dtype=np.uint8).astype(
+        np.uint8)).save(img_path)
+
+    stats = extract_feature.run_extraction_and_analyze(
+        img_path, output_dir=str(tmp_path / "feat"), model=model,
+        params=params, image_size=SIZE,
+    )
+    out = capsys.readouterr().out
+    assert "FEATURE ANALYSIS" in out and "VERDICT" in out
+    saved = np.load(stats["save_path"])
+    assert saved.shape == (1, SIZE // 16, SIZE // 16, TINY["out_chans"])
+    np.testing.assert_allclose(stats["mean"], saved.mean(), rtol=1e-5)
+    np.testing.assert_allclose(stats["sparsity"], (saved <= 0).mean(),
+                               rtol=1e-5)
+
+
+def test_extract_feature_dummy_fallback(tiny_encoder, tmp_path, monkeypatch):
+    """Missing image -> synthesized dummy (extract_feature.py:116-121)."""
+    import extract_feature
+
+    model, params = tiny_encoder
+    monkeypatch.chdir(tmp_path)
+    stats = extract_feature.run_extraction_and_analyze(
+        "does/not/exist.jpg", output_dir="feat", model=model, params=params,
+        image_size=SIZE,
+    )
+    assert os.path.exists(stats["save_path"])
+
+
+def test_verdict_thresholds():
+    from extract_feature import verdict
+
+    assert verdict(0.0120).startswith("HARD")
+    assert verdict(0.0140).startswith("EASY")
+    assert verdict(0.0133) == "MEDIUM"

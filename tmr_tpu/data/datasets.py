@@ -222,7 +222,12 @@ def build_dataset(cfg, split: str, eval_mode: Optional[bool] = None):
         max_exemplars=cfg.num_exemplars,
         eval_mode=eval_mode,
     )
-    name = cfg.dataset
+    # accept the reference's spellings too (FSCD_LVIS_seen, datamodules
+    # __init__.py:12-18) so its shell scripts port verbatim
+    name = {"FSCD_LVIS_seen": "FSCD_LVIS_Seen",
+            "FSCD_LVIS_unseen": "FSCD_LVIS_Unseen"}.get(
+        cfg.dataset, cfg.dataset
+    )
     if name == "FSCD147":
         return FSCD147Dataset(cfg.datapath, split=split, **kw)
     if name == "FSCD_LVIS_Seen":

@@ -47,6 +47,36 @@ def resize_normalize(image: np.ndarray, size: int) -> np.ndarray:
     return normalize_image(img)
 
 
+SAM_PIXEL_MEAN = np.array([123.675, 116.28, 103.53], np.float32)
+SAM_PIXEL_STD = np.array([58.395, 57.12, 57.375], np.float32)
+
+
+def sam_longest_side_preprocess(
+    image: np.ndarray, target: int = 1024
+) -> np.ndarray:
+    """The SAM-native preprocessing of extract_feature.py:50-64: resize the
+    longest side to ``target`` (ResizeLongestSide semantics — round(scale *
+    dim), cv2 INTER_LINEAR), normalize with SAM pixel mean/std (on 0-255
+    values), zero-pad bottom/right to (target, target). HWC RGB in, float32
+    (target, target, 3) NHWC-ready out."""
+    import cv2
+
+    img = np.asarray(image)
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    if img.shape[-1] == 4:
+        img = img[..., :3]
+    h, w = img.shape[:2]
+    scale = target / max(h, w)
+    # ResizeLongestSide rounds half UP (int(x + 0.5)), not banker's-rounds
+    nh, nw = int(h * scale + 0.5), int(w * scale + 0.5)
+    img = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    img = (img.astype(np.float32) - SAM_PIXEL_MEAN) / SAM_PIXEL_STD
+    out = np.zeros((target, target, 3), np.float32)
+    out[:nh, :nw] = img
+    return out
+
+
 def pick_image_size(orig_boxes: np.ndarray, base: int = 1024,
                     large: int = 1536, eval_mode: bool = False,
                     split: str = "train") -> int:

@@ -27,6 +27,38 @@ def build_backbone(cfg):
     raise KeyError(f"unknown backbone {name!r}")
 
 
+def build_sam_encoder(
+    model_type: str = "vit_b",
+    checkpoint: str = None,
+    image_size: int = 1024,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+):
+    """Standalone SAM encoder + params, shared by the export / extraction /
+    mapreduce entry points. ``model_type`` accepts the reference aliases
+    ('sam' == vit_h, models/backbone/__init__.py:22). With ``checkpoint``,
+    weights come from the SAM-HQ ``.pth`` via the image_encoder.* key remap
+    (sam.py:63-65); otherwise fresh random init (export_onnx.py:27 builds
+    weightless too)."""
+    import jax
+
+    kind = {"sam": "vit_h", "sam_vit_h": "vit_h", "sam_vit_b": "vit_b"}.get(
+        model_type, model_type
+    )
+    model = build_sam_vit(kind, dtype=dtype)
+    if checkpoint:
+        from tmr_tpu.utils.convert import (
+            convert_sam_vit,
+            load_torch_state_dict,
+        )
+
+        params = convert_sam_vit(load_torch_state_dict(checkpoint), kind)
+    else:
+        img = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+        params = model.init(jax.random.key(seed), img)["params"]
+    return model, params
+
+
 def build_model(cfg) -> MatchingNet:
     """Model registry (models/__init__.py:4-9; only 'matching_net')."""
     if cfg.modeltype != "matching_net":

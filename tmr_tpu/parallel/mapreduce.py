@@ -55,7 +55,9 @@ def preprocess_image(data: bytes, size: int = 1024) -> Optional[np.ndarray]:
         return None  # bad image -> skip, like mapper.py:31-32
 
 
-def iter_tar_images(path: str) -> Iterator[tuple[str, np.ndarray]]:
+def iter_tar_images(
+    path: str, size: int = 1024
+) -> Iterator[tuple[str, np.ndarray]]:
     """Stream (name, image) from a tar shard; corrupt members skipped."""
     with tarfile.open(path, "r") as tar:
         for member in tar:
@@ -66,7 +68,7 @@ def iter_tar_images(path: str) -> Iterator[tuple[str, np.ndarray]]:
             data = tar.extractfile(member)
             if data is None:
                 continue
-            img = preprocess_image(data.read())
+            img = preprocess_image(data.read(), size)
             if img is not None:
                 yield member.name, img
 
@@ -97,6 +99,22 @@ def make_encode_stats_fn(encoder, params) -> Callable:
     return run
 
 
+def make_encode_stats_fn_from_artifact(path: str) -> Callable:
+    """Worker-side encode fn from a serialized artifact (export_encoder.py) —
+    the onnxruntime-session equivalent of mapper.py:40-45: no model code or
+    weights needed on the worker, just the artifact file."""
+    from tmr_tpu.utils.export import load_exported
+
+    encoder = load_exported(path)
+
+    @jax.jit
+    def run(images):
+        feats = encoder(images)
+        return feats, feature_stats(feats)
+
+    return run
+
+
 class StatAccumulator:
     """Per-category running sums — the mapper emit + reducer aggregation
     state, as a dense (4 categories x 5 values) matrix."""
@@ -122,25 +140,59 @@ class StatAccumulator:
         return lines
 
 
-def reducer_table(table: np.ndarray) -> str:
-    """Format the final averages exactly like reducer.py:25-27,39-42."""
+def format_stats_table(sums_by_key: dict) -> str:
+    """Averages table over {key: (5,) sums} exactly like reducer.py:25-27."""
     out = [
         f"{'CATEGORY':<12} | {'IMAGES':>6} | "
         f"{'AVG_MEAN':>8} | {'AVG_STD':>8} | "
         f"{'AVG_MAX':>8} | {'SPARSITY':>9}",
         "-" * 70,
     ]
-    for i, cat in enumerate(CATEGORIES):
-        n = table[i, 4]
+    for cat, sums in sums_by_key.items():
+        n = sums[4]
         if n <= 0:
             continue
-        avg = table[i, :4] / n
+        avg = np.asarray(sums[:4]) / n
         out.append(
             f"{cat:<12} | {int(n):>6} | "
             f"{avg[0]:>8.4f} | {avg[1]:>8.4f} | "
             f"{avg[2]:>8.4f} | {avg[3]:>7.2%}"
         )
     return "\n".join(out)
+
+
+def reducer_table(table: np.ndarray) -> str:
+    """Format a StatAccumulator matrix (reducer.py:25-27,39-42)."""
+    return format_stats_table(
+        {cat: table[i] for i, cat in enumerate(CATEGORIES)}
+    )
+
+
+def reduce_lines(lines: Iterable[str]) -> dict:
+    """The reducer's group-by-key aggregation (reducer.py:47-92) over
+    ``category\\tsum_mean,sum_std,sum_max,sum_spar,count`` records.
+
+    Unlike Hadoop's sorted-stream protocol, input need not be sorted (we
+    aggregate in a dict — the 'shuffle' is free on one host). Malformed
+    lines are logged and skipped (reducer.py:53-76)."""
+    from tmr_tpu.utils.profiling import log_warning
+
+    sums: dict = {}
+    for raw in lines:
+        line = raw.strip()
+        if not line:
+            continue
+        try:
+            key, payload = line.split("\t")
+            vals = [float(v) for v in payload.split(",")]
+            if len(vals) != 5:
+                raise ValueError(f"expected 5 values, got {len(vals)}")
+        except Exception as e:
+            log_warning(f"skipping malformed line {line!r}: {e}")
+            continue
+        acc = sums.setdefault(key, np.zeros(5, np.float64))
+        acc += np.asarray(vals, np.float64)
+    return sums
 
 
 def run_stream(
@@ -165,7 +217,7 @@ def run_stream(
     def load_shard(path):
         # bad/missing tar -> log + skip the whole shard (mapper.py:79-81)
         try:
-            return list(iter_tar_images(path))
+            return list(iter_tar_images(path, image_size))
         except Exception as e:
             log_warning(f"skipping shard {path}: {e}")
             return []
@@ -215,3 +267,102 @@ def allreduce_stats(table: jnp.ndarray, axis_name: str = "data") -> jnp.ndarray:
     """The shuffle replacement: psum per-device (4, 5) partials over the
     mesh axis. Use inside shard_map/pmap; see tests/test_parallel.py."""
     return jax.lax.psum(table, axis_name)
+
+
+# --------------------------------------------------------------------- CLI
+# Hadoop-Streaming-compatible entry points:
+#   cat list_tars.txt | python -m tmr_tpu.parallel.mapreduce map \
+#       --data_dir /data/tars --artifact exported/encoder.stablehlo \
+#       --features_out features_output \
+#   | sort | python -m tmr_tpu.parallel.mapreduce reduce
+# The map phase reads tar names from stdin (mapper.py:51), prefixes
+# --data_dir (the `hadoop fs -get` replacement: a posix/NFS/FUSE path),
+# streams every shard through the jitted encoder, writes per-image feature
+# .npy files under features_out/<category>/ (mapper.py:126-130), and emits
+# aggregated `category\tsums,count` records (mapper.py:138; aggregated
+# per-run rather than per-tar — reduce semantics are identical since the
+# reducer sums). The reduce phase needs no sort (dict aggregation) but
+# tolerates sorted Hadoop-style streams identically.
+
+
+def _cli_map(args) -> int:
+    import sys
+
+    from tmr_tpu.utils.profiling import log_info, log_warning
+
+    names = [ln.strip() for ln in sys.stdin if ln.strip()]
+    paths = [
+        n if os.path.isabs(n) else os.path.join(args.data_dir, n)
+        for n in names
+    ]
+    log_info(f"map: {len(paths)} shards from stdin")
+
+    if args.artifact:
+        fn = make_encode_stats_fn_from_artifact(args.artifact)
+    else:
+        from tmr_tpu.models import build_sam_encoder
+
+        if not args.checkpoint:
+            log_warning("map: no --artifact/--checkpoint, random weights")
+        model, params = build_sam_encoder(
+            args.model_type, args.checkpoint, args.image_size
+        )
+        fn = make_encode_stats_fn(model, params)
+
+    save = None
+    if args.features_out:
+
+        def save(shard: str, name: str, feat: np.ndarray) -> None:
+            cat = CATEGORIES[category_of(shard)]
+            d = os.path.join(args.features_out, cat,
+                             shard.replace(".tar", ""))
+            os.makedirs(d, exist_ok=True)
+            base = os.path.splitext(os.path.basename(name))[0]
+            np.save(os.path.join(d, base + ".npy"), feat)
+
+    acc = run_stream(
+        paths, fn, batch_size=args.batch_size, image_size=args.image_size,
+        save_features=save, feeder_threads=args.feeder_threads,
+    )
+    for line in acc.emit_lines():
+        print(line)
+    return 0
+
+
+def _cli_reduce(_args) -> int:
+    import sys
+
+    sums = reduce_lines(sys.stdin)
+    print(format_stats_table(sums))
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m tmr_tpu.parallel.mapreduce",
+        description="Streaming feature extraction (Hadoop mapper/reducer "
+                    "replacement)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser("map", help="tar names on stdin -> stat records")
+    m.add_argument("--data_dir", default=".",
+                   help="prefix for shard names (the HDFS tar directory)")
+    m.add_argument("--artifact", default=None,
+                   help="serialized encoder from export_encoder.py")
+    m.add_argument("--checkpoint", default=None)
+    m.add_argument("--model_type", default="vit_b")
+    m.add_argument("--features_out", default=None,
+                   help="write per-image feature .npy under "
+                        "<dir>/<category>/<shard>/ (mapper.py:126-130)")
+    m.add_argument("--batch_size", default=8, type=int)
+    m.add_argument("--image_size", default=1024, type=int)
+    m.add_argument("--feeder_threads", default=4, type=int)
+    sub.add_parser("reduce", help="stat records on stdin -> averages table")
+    args = p.parse_args(argv)
+    return _cli_map(args) if args.cmd == "map" else _cli_reduce(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

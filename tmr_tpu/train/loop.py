@@ -177,7 +177,11 @@ class Trainer:
         )
 
         start_epoch = 0
-        first = next(iter(train))
+        it0 = iter(train)
+        try:
+            first = next(it0)
+        finally:
+            it0.close()  # don't leave the prefetch pool suspended
         self._init_state(first, steps)
         if cfg.resume and self.ckpt.last_path():
             self.state = self.ckpt.restore(self.ckpt.last_path(), self.state)

@@ -1,0 +1,87 @@
+"""Serialized inference artifacts — the ONNX-export equivalent.
+
+The reference ships its encoder to Hadoop workers as an ONNX file
+(``export_onnx.py:76-89``: opset 12, dynamic batch axis) consumed by
+onnxruntime in the mapper (``mapper.py:40-45``). On TPU the portable,
+runtime-loadable artifact is a serialized StableHLO program from
+``jax.export``: the jitted Flax encoder is lowered once (optionally for
+several platforms), written to disk, and later deserialized and called with
+no Flax/model code present — exactly the deployment decoupling the ONNX hop
+provided, without leaving the XLA toolchain.
+
+The dynamic batch axis of the reference export maps to a *symbolic* batch
+dimension here (``jax.export.symbolic_shape``), so one artifact serves any
+batch size.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+# Serialized artifacts run on these backends; matching the reference's
+# CPU-or-CUDA onnxruntime flexibility (mapper.py:44).
+DEFAULT_PLATFORMS = ("tpu", "cpu")
+
+
+def export_encoder(
+    model,
+    params,
+    image_size: int = 1024,
+    channels: int = 3,
+    platforms: Sequence[str] = DEFAULT_PLATFORMS,
+    dynamic_batch: bool = True,
+    batch: int = 1,
+) -> bytes:
+    """Lower ``model.apply`` with bound params to serialized StableHLO.
+
+    The params are closed over (baked into the artifact as constants), so the
+    file is self-contained like the reference's .onnx — load and call.
+    ``dynamic_batch`` mirrors export_onnx.py's dynamic batch axis via a
+    symbolic leading dimension.
+    """
+
+    def fn(images):
+        return model.apply({"params": params}, images)
+
+    if dynamic_batch:
+        (b,) = jax_export.symbolic_shape("b")
+        spec_shape = (b, image_size, image_size, channels)
+    else:
+        spec_shape = (batch, image_size, image_size, channels)
+    spec = jax.ShapeDtypeStruct(spec_shape, jnp.float32)
+    exported = jax_export.export(jax.jit(fn), platforms=list(platforms))(spec)
+    return exported.serialize()
+
+
+def save_exported(data: bytes, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load_exported(path: str) -> Callable:
+    """Deserialize an artifact into a plain callable (images) -> features.
+
+    The returned callable is jitted (jax.export requires calls from within a
+    traced context for platform dispatch) and re-traces per batch size, each
+    specialization hitting the serialized program's symbolic batch.
+    """
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+
+    @jax.jit
+    def call(images):
+        return exported.call(images)
+
+    return call
+
+
+def exported_input_spec(path: str):
+    """(shape, dtype) of the artifact's input, for feeder-side validation."""
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    avals = exported.in_avals
+    return avals[0].shape, avals[0].dtype
