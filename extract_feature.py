@@ -87,17 +87,15 @@ def run_extraction_and_analyze(
 
         feats = load_exported(artifact)(jnp.asarray(x))
     else:
-        if model is None or params is None:
+        if (model is None) != (params is None):
+            raise ValueError("pass model and params together (or neither)")
+        if model is None:
             from tmr_tpu.models import build_sam_encoder
 
             if not checkpoint:
                 print("      no checkpoint: random weights (stats are still "
                       "well-defined, like the reference without weights)")
-            built_model, built_params = build_sam_encoder(
-                backbone, checkpoint, image_size
-            )
-            model = model if model is not None else built_model
-            params = params if params is not None else built_params
+            model, params = build_sam_encoder(backbone, checkpoint, image_size)
         feats = jax.jit(
             lambda p, v: model.apply({"params": p}, v)
         )(params, jnp.asarray(x))

@@ -43,7 +43,7 @@ class Predictor:
         self.params = params
         self.refiner = refiner
         self.refiner_params = refiner_params
-        self._compiled: Dict[Tuple[int, int], callable] = {}
+        self._compiled: Dict[Tuple[int, bool], callable] = {}  # (capacity, refine)
         self._nms_fn = None
 
     def init_params(self, seed: int = 0, image_size: Optional[int] = None):
@@ -64,12 +64,14 @@ class Predictor:
         return base * 2 if self.cfg.feature_upsample else base
 
     def _get_fn(self, capacity: int):
-        key = capacity
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        key = (capacity, refine)  # refine is baked into the compiled program
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
         cfg = self.cfg
-        refine = self.refiner is not None and getattr(cfg, "refine_box", False)
         refiner = self.refiner
 
         @jax.jit

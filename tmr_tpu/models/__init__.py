@@ -52,7 +52,15 @@ def build_sam_encoder(
             load_torch_state_dict,
         )
 
-        params = convert_sam_vit(load_torch_state_dict(checkpoint), kind)
+        sd = load_torch_state_dict(checkpoint)
+        # SAM-HQ checkpoints nest under image_encoder.*; a bare encoder
+        # export has no prefix
+        prefix = (
+            "image_encoder."
+            if any(k.startswith("image_encoder.") for k in sd)
+            else ""
+        )
+        params = convert_sam_vit(sd, prefix)
     else:
         img = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
         params = model.init(jax.random.key(seed), img)["params"]
