@@ -86,6 +86,9 @@ def config_parser(argv=None):
                    help="data-parallel mesh size (-1: all devices)")
     p.add_argument("--mesh_model", default=1, type=int,
                    help="tensor-parallel mesh size for the ViT")
+    p.add_argument("--mesh_seq", default=1, type=int,
+                   help="sequence/context-parallel mesh size: global "
+                        "attention blocks run ring attention over this axis")
     p.add_argument("--compute_dtype", default="bfloat16", type=str)
     p.add_argument("--profile_dir", default=None, type=str,
                    help="capture an XLA profiler trace of the first epoch "
@@ -122,8 +125,11 @@ def main(argv=None):
     from tmr_tpu.train.loop import Trainer
 
     mesh = None
-    if args.multi_gpu or args.mesh_model > 1:
-        mesh = make_mesh((args.mesh_data, args.mesh_model))
+    if args.multi_gpu or args.mesh_model > 1 or args.mesh_seq > 1:
+        if args.mesh_seq > 1:
+            mesh = make_mesh((args.mesh_data, args.mesh_model, args.mesh_seq))
+        else:
+            mesh = make_mesh((args.mesh_data, args.mesh_model))
 
     trainer = Trainer(cfg, mesh=mesh)
     if cfg.eval:

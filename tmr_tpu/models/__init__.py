@@ -10,18 +10,24 @@ from tmr_tpu.models.resnet import RESNET_VARIANTS, build_resnet
 from tmr_tpu.models.vit import SamViT, build_sam_vit  # noqa: F401
 
 
-def build_backbone(cfg):
+def build_backbone(cfg, mesh=None):
     """Backbone registry (models/backbone/__init__.py:4-24).
 
     'sam' maps to vit_h like the reference; 'sam_vit_b'/'sam_vit_h' select
     explicitly (the reference reaches vit_b only via export_onnx.py).
+    A ``mesh`` with a 'seq' axis of size > 1 enables sequence/context
+    parallelism: the ViT's global-attention blocks run ring attention over
+    that axis (see parallel/ring.py).
     """
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    seq_mesh = None
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        seq_mesh = mesh
     name = cfg.backbone
     if name == "sam" or name == "sam_vit_h":
-        return build_sam_vit("vit_h", dtype=dtype)
+        return build_sam_vit("vit_h", dtype=dtype, seq_mesh=seq_mesh)
     if name == "sam_vit_b":
-        return build_sam_vit("vit_b", dtype=dtype)
+        return build_sam_vit("vit_b", dtype=dtype, seq_mesh=seq_mesh)
     if name in RESNET_VARIANTS:
         return build_resnet(name, dilation=cfg.dilation)
     raise KeyError(f"unknown backbone {name!r}")
@@ -67,13 +73,13 @@ def build_sam_encoder(
     return model, params
 
 
-def build_model(cfg) -> MatchingNet:
+def build_model(cfg, mesh=None) -> MatchingNet:
     """Model registry (models/__init__.py:4-9; only 'matching_net')."""
     if cfg.modeltype != "matching_net":
         raise KeyError(f"unknown modeltype {cfg.modeltype!r}")
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     return MatchingNet(
-        backbone=build_backbone(cfg),
+        backbone=build_backbone(cfg, mesh=mesh),
         emb_dim=cfg.emb_dim,
         fusion=cfg.fusion,
         squeeze=cfg.squeeze,

@@ -89,12 +89,23 @@ class Attention(nn.Module):
     grid (window size for windowed blocks, native image grid for global
     blocks); get_rel_pos interpolates the tables whenever the runtime grid
     differs (the 1536 bucket).
+
+    ``seq_mesh`` (global-attention blocks only) turns the quadratic
+    attention core into a ring-attention shard_map island over the mesh's
+    'seq' axis: q/k/v reshard to contiguous token-row bands, K/V rotate via
+    ppermute over ICI, and no device ever materializes more than an
+    (S/n x S/n) score block. This is the long-context path — the reference
+    has nothing like it (SURVEY §5.7); it makes the 1536/9216-token (and
+    larger) buckets scale past one chip's HBM.
     """
 
     num_heads: int
     use_rel_pos: bool = True
     rel_pos_size: Optional[Tuple[int, int]] = None
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None  # jax.sharding.Mesh with a 'seq' axis
+    seq_axis: str = "seq"
+    batch_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -109,8 +120,7 @@ class Attention(nn.Module):
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
 
-        attn = jnp.einsum("bnqc,bnkc->bnqk", q * scale, k)
-
+        rh = rw = None
         if self.use_rel_pos:
             rel_pos_h = self.param(
                 "rel_pos_h",
@@ -124,17 +134,52 @@ class Attention(nn.Module):
             )
             rh = get_rel_pos(h, h, rel_pos_h).astype(self.dtype)  # (h, h, hd)
             rw = get_rel_pos(w, w, rel_pos_w).astype(self.dtype)  # (w, w, hd)
-            r_q = q.reshape(b, self.num_heads, h, w, head_dim)
-            rel_h = jnp.einsum("bnhwc,hkc->bnhwk", r_q, rh)
-            rel_w = jnp.einsum("bnhwc,wkc->bnhwk", r_q, rw)
-            attn = attn.reshape(b, self.num_heads, h, w, h, w)
-            attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
-            attn = attn.reshape(b, self.num_heads, h * w, h * w)
 
-        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
-        x = jnp.einsum("bnqk,bnkc->bnqc", attn, v)
-        x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
+        if self.seq_mesh is not None:
+            x = self._ring_attn(q, k, v, rh, rw, (b, h, w, dim), head_dim)
+        else:
+            attn = jnp.einsum("bnqc,bnkc->bnqk", q * scale, k)
+            if self.use_rel_pos:
+                r_q = q.reshape(b, self.num_heads, h, w, head_dim)
+                rel_h = jnp.einsum("bnhwc,hkc->bnhwk", r_q, rh)
+                rel_w = jnp.einsum("bnhwc,wkc->bnhwk", r_q, rw)
+                attn = attn.reshape(b, self.num_heads, h, w, h, w)
+                attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
+                attn = attn.reshape(b, self.num_heads, h * w, h * w)
+            attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(
+                self.dtype
+            )
+            x = jnp.einsum("bnqk,bnkc->bnqc", attn, v)
+            x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         return nn.Dense(dim, dtype=self.dtype, name="proj")(x)
+
+    def _ring_attn(self, q, k, v, rh, rw, bhwd, head_dim):
+        """Sequence-parallel attention core (ring over token-row bands)."""
+        from tmr_tpu.parallel.ring import make_ring_attention_fn
+
+        b, h, w, dim = bhwd
+        mesh = self.seq_mesh
+        axis_names = getattr(mesh, "axis_names", ())
+        n = mesh.shape[self.seq_axis]
+        if h % n:
+            raise ValueError(
+                f"token rows {h} not divisible by seq axis size {n}"
+            )
+        # shard batch over 'data' when it divides; heads over 'model' so the
+        # island composes with TP instead of re-gathering head shards
+        batch_axis = self.batch_axis if self.batch_axis in axis_names else None
+        if batch_axis and b % mesh.shape[batch_axis]:
+            batch_axis = None  # e.g. eval batch 1 on a dp>1 mesh
+        head_axis = "model" if "model" in axis_names else None
+        if head_axis and self.num_heads % mesh.shape[head_axis]:
+            head_axis = None
+
+        fn = make_ring_attention_fn(
+            mesh, self.seq_axis, batch_axis=batch_axis, head_axis=head_axis,
+            decomposed=self.use_rel_pos, grid_w=w, scale=head_dim**-0.5,
+        )
+        out = fn(q, k, v, rh, rw) if self.use_rel_pos else fn(q, k, v)
+        return out.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
 
 
 class Block(nn.Module):
@@ -145,6 +190,8 @@ class Block(nn.Module):
     window_size: int = 0
     rel_pos_size: Optional[Tuple[int, int]] = None  # native grid for global attn
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None  # sequence parallelism (global attn only)
+    batch_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -163,6 +210,10 @@ class Block(nn.Module):
             num_heads=self.num_heads,
             rel_pos_size=attn_size,
             dtype=self.dtype,
+            # windowed attention is local (196-token windows) — sequence
+            # parallelism applies to the quadratic global blocks only
+            seq_mesh=self.seq_mesh if self.window_size == 0 else None,
+            batch_axis=self.batch_axis,
             name="attn",
         )(x)
         if self.window_size > 0:
@@ -187,6 +238,10 @@ class SamViT(nn.Module):
     mlp_ratio: float = 4.0
     pretrain_img_size: int = 1024  # pos_embed native grid = 1024/16 = 64
     dtype: jnp.dtype = jnp.float32
+    # sequence/context parallelism: a Mesh with a 'seq' axis turns every
+    # global-attention block into a ring-attention shard_map island
+    seq_mesh: Optional[object] = None
+    batch_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -220,6 +275,8 @@ class SamViT(nn.Module):
                 window_size=win,
                 rel_pos_size=(grid, grid),
                 dtype=self.dtype,
+                seq_mesh=self.seq_mesh,
+                batch_axis=self.batch_axis,
                 name=f"blocks_{i}",
             )(x)
 
@@ -249,5 +306,7 @@ VIT_CONFIGS = {
 }
 
 
-def build_sam_vit(model_type: str = "vit_h", dtype=jnp.float32) -> SamViT:
-    return SamViT(dtype=dtype, **VIT_CONFIGS[model_type])
+def build_sam_vit(
+    model_type: str = "vit_h", dtype=jnp.float32, seq_mesh=None
+) -> SamViT:
+    return SamViT(dtype=dtype, seq_mesh=seq_mesh, **VIT_CONFIGS[model_type])

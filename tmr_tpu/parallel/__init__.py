@@ -11,12 +11,22 @@ The TPU replacement for BOTH of the reference's distribution mechanisms
   stream, the sort/shuffle collapses into an on-device reduction of
   fixed-size stat tuples.
 
-Mesh axes: ('data', 'model'). 'model' tensor-parallelism shards the ViT
-attention/MLP feature dims — not required for reference parity (the
-reference has no TP) but first-class here for scaling ViT-H beyond one chip.
+Mesh axes: ('data', 'model') — plus an optional 'seq' axis for
+sequence/context parallelism. 'model' tensor-parallelism shards the ViT
+attention/MLP feature dims; 'seq' runs the global-attention blocks as ring
+attention over token-row bands (parallel/ring.py). Neither is required for
+reference parity (the reference has no TP/SP) but both are first-class here
+for scaling ViT-H and long token grids beyond one chip.
 """
 
 from tmr_tpu.parallel.mesh import make_mesh  # noqa: F401
+from tmr_tpu.parallel.ring import (  # noqa: F401
+    dense_attention,
+    make_ring_attention_fn,
+    ring_attention,
+    ring_decomposed_attention,
+    ulysses_attention,
+)
 from tmr_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_spec,

@@ -10,33 +10,44 @@ from jax.sharding import Mesh
 
 
 def make_mesh(
-    shape: Tuple[int, int] = (-1, 1),
-    axis_names: Tuple[str, str] = ("data", "model"),
+    shape: Tuple[int, ...] = (-1, 1),
+    axis_names: Optional[Tuple[str, ...]] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a ('data', 'model') mesh. shape=(-1, tp) fills 'data' with all
-    remaining devices. Works identically on a real slice and on the
-    virtual CPU mesh used in tests/dry runs.
+    """Build a device mesh — ('data', 'model') by default, or
+    ('data', 'model', 'seq') when a third (sequence/context-parallel) size
+    is given. A single -1 entry fills with all remaining devices. Works
+    identically on a real slice and on the virtual CPU mesh used in
+    tests/dry runs.
 
     Device order: jax.experimental.mesh_utils picks an ICI-friendly layout on
     real TPU topologies; on hosts it's the flat device list.
     """
     devices = list(devices if devices is not None else jax.devices())
-    dp, tp = shape
-    if dp == -1:
-        if len(devices) % tp:
-            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
-        dp = len(devices) // tp
-    n = dp * tp
+    if axis_names is None:
+        axis_names = ("data", "model", "seq")[: len(shape)]
+    elif len(shape) != len(axis_names):
+        raise ValueError(
+            f"shape {shape} and axis_names {axis_names} length mismatch"
+        )
+    sizes = list(shape)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one -1 mesh dimension")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devices) % fixed:
+            raise ValueError(f"{len(devices)} devices not divisible by {fixed}")
+        sizes[sizes.index(-1)] = len(devices) // fixed
+    n = int(np.prod(sizes))
     if n > len(devices):
-        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
+        raise ValueError(f"mesh {sizes} needs {n} devices, have {len(devices)}")
     try:
         from jax.experimental import mesh_utils
 
-        arr = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
+        arr = mesh_utils.create_device_mesh(tuple(sizes), devices=devices[:n])
     except Exception:
-        arr = np.array(devices[:n]).reshape(dp, tp)
-    return Mesh(arr, axis_names)
+        arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, tuple(axis_names))
 
 
 def initialize_multihost(coordinator: Optional[str] = None,

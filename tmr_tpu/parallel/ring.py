@@ -1,0 +1,246 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context machinery (SURVEY §5.7): its SAM ViT bounds
+attention cost with 14x14 windows and only 4 global-attention blocks over a
+4096-token grid (sam_ViT.py:166-177), and escalates resolution to 1536 (9216
+tokens) for small objects. This module makes sequence scaling first-class for
+the TPU framework so the encoder (or any transformer) can grow past what one
+chip's HBM holds:
+
+- :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation; K/V shards rotate around the mesh axis ring via
+  ``lax.ppermute`` so each device only ever materializes its local
+  (S/n x S/n) score block. O(S) memory per device, exact (not approximate)
+  attention, fp32 accumulation. Optional additive bias supplied per
+  (q-shard, k-shard) pair via ``bias_fn`` — this is how the ViT's decomposed
+  relative-position bias (sam_ViT.py:325-361) stays computable under
+  sharding without materializing the full S x S bias.
+- :func:`ulysses_attention` — the all-to-all alternative: resharding
+  sequence -> heads with ``lax.all_to_all``, dense local attention over the
+  full sequence for the local head group, then heads -> sequence back.
+  Cheaper collectives on all-to-all-friendly fabrics when H >= n.
+
+Both are pure jax functions meant to run inside ``shard_map`` over a mesh
+axis (tests use the 8-device CPU mesh; on hardware the ring rides ICI
+neighbor links). Both are differentiable (plain jax ops, so XLA derives the
+backward ring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn_update(q, k, v, bias, scale, m, l, o):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); bias: (B|1, H|1, Sq, Sk) or None;
+    m/l/o: running max (B, H, Sq), denom (B, H, Sq), accum (B, H, Sq, D).
+    Returns updated (m, l, o). All f32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rescale previous accumulators to the new max
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    bias_fn: Optional[Callable[[jnp.ndarray, jnp.ndarray], Optional[jnp.ndarray]]] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Each device holds q/k/v of shape (B, H, S_local, D) — its contiguous
+    sequence shard. K/V rotate n-1 times around the ring; each step the
+    device accumulates its q-block against the visiting k/v-block with the
+    numerically stable online softmax. Output is the local (B, H, S_local, D)
+    attention result, bitwise-equivalent (up to fp reordering) to dense
+    softmax attention over the gathered sequence.
+
+    ``bias_fn(q_index, k_index) -> (B|1, H|1, S_local, S_local) or None``
+    receives the *shard indices* (traced int32) of the query block (fixed,
+    this device) and the currently visiting key block, and returns the
+    additive attention bias for that block pair — e.g. decomposed rel-pos
+    sliced to the two shards' coordinate ranges.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    qf = q.astype(jnp.float32)
+    B, H, S, D = q.shape
+    m = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        k_idx = (my - i) % n  # block that arrived after i rotations
+        bias = bias_fn(my, k_idx) if bias_fn is not None else None
+        m, l, o = _block_attn_update(
+            qf, k_blk.astype(jnp.float32), v_blk, bias, scale, m, l, o
+        )
+        # pass k/v to the next device in the ring (receive from the previous)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    k_blk, v_blk, m, l, o = lax.fori_loop(0, n, step, (k, v, m, l, o))
+    out = o / l[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    In: (B, H, S_local, D) sequence-sharded. ``lax.all_to_all`` reshards to
+    (B, H_local, S_full, D) — every device sees the full sequence for H/n
+    heads — then dense softmax attention runs locally, and a second
+    all-to-all reshards back to sequence. Requires H % n == 0.
+    """
+    n = lax.psum(1, axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def seq_to_heads(x):
+        # (B, H, S_local, D) -> concat over seq of (B, H/n, S, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def dense_attention(q, k, v, bias=None, scale=None):
+    """Single-device reference: softmax(q k^T * scale + bias) v, f32 accum.
+    The oracle the ring/ulysses tests compare against."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def ring_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rel_h_table: jnp.ndarray,
+    rel_w_table: jnp.ndarray,
+    grid_w: int,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Ring attention with the SAM ViT's decomposed relative-position bias
+    (sam_ViT.py:325-361) for a token grid row-sharded over ``axis_name``.
+
+    The (H_grid, W_grid) token grid is split into contiguous row bands; each
+    device holds q/k/v (B, heads, rows_local * W_grid, head_dim) for its
+    band. ``rel_h_table`` (H, H, hd) and ``rel_w_table`` (W, W, hd) are the
+    full get_rel_pos outputs (replicated — ~1 MB at ViT scale, vs the
+    S x S bias this avoids materializing). The bias for a (q-band, k-band)
+    pair is rebuilt on the fly from the q band's features and a dynamic
+    row-slice of the H-table, so the result matches the dense decomposed
+    attention exactly (up to fp reordering).
+    """
+    B, H, S_local, D = q.shape
+    rows_local = S_local // grid_w
+    qf = q.astype(jnp.float32)
+    r_q = qf.reshape(B, H, rows_local, grid_w, D)
+    # rel_w term is k-band independent: (B, H, rows, W, W_k)
+    rel_w = jnp.einsum(
+        "bnhwc,wkc->bnhwk", r_q, rel_w_table.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+    def bias_fn(q_idx, k_idx):
+        rh = lax.dynamic_slice(
+            rel_h_table.astype(jnp.float32),
+            (q_idx * rows_local, k_idx * rows_local, 0),
+            (rows_local, rows_local, rel_h_table.shape[-1]),
+        )
+        rel_h = jnp.einsum(
+            "bnhwc,hkc->bnhwk", r_q, rh, preferred_element_type=jnp.float32
+        )
+        bias = rel_h[..., :, None] + rel_w[..., None, :]
+        return bias.reshape(B, H, S_local, rows_local * grid_w)
+
+    return ring_attention(q, k, v, axis_name, bias_fn=bias_fn, scale=scale)
+
+
+def make_ring_attention_fn(
+    mesh,
+    axis_name: str = "seq",
+    batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    decomposed: bool = False,
+    grid_w: Optional[int] = None,
+    scale: Optional[float] = None,
+):
+    """shard_map-wrapped ring attention over ``mesh``'s ``axis_name``:
+    (B, H, S, D) global arrays in/out, sequence dim sharded internally.
+    ``batch_axis``/``head_axis`` additionally shard batch (data parallel)
+    and heads (tensor parallel) so the island composes with dp/tp meshes.
+    With ``decomposed=True`` the callable takes (q, k, v, rel_h_table,
+    rel_w_table) and applies the ViT decomposed rel-pos bias (``grid_w``
+    required)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, head_axis, axis_name, None)
+    if decomposed:
+        if grid_w is None:
+            raise ValueError("decomposed=True requires grid_w")
+        return shard_map(
+            partial(
+                ring_decomposed_attention, grid_w=grid_w,
+                axis_name=axis_name, scale=scale,
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec, P(), P()),
+            out_specs=spec, check_vma=False,
+        )
+    return shard_map(
+        partial(ring_attention, axis_name=axis_name, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )

@@ -72,7 +72,7 @@ class Trainer:
     def __init__(self, cfg, mesh=None):
         self.cfg = cfg
         self.mesh = mesh
-        self.model = build_model(cfg)
+        self.model = build_model(cfg, mesh=mesh)
         self.predictor = Predictor(cfg, model=self.model)
         self.logger = CSVLogger(cfg.logpath)
         self.ckpt = CheckpointManager(
