@@ -50,9 +50,11 @@ class Predictor:
         s = image_size or self.cfg.image_size
         image = jnp.zeros((1, s, s, 3), jnp.float32)
         exemplars = jnp.array([[[0.4, 0.4, 0.6, 0.6]]], jnp.float32)
-        self.params = self.model.init(jax.random.key(seed), image, exemplars)[
-            "params"
-        ]
+        # jit the init: eager init dispatches thousands of tiny ops, which
+        # is pathologically slow over a remote-device tunnel
+        self.params = jax.jit(self.model.init)(
+            jax.random.key(seed), image, exemplars
+        )["params"]
         return self.params
 
     def feature_hw(self, image_size: int) -> int:

@@ -29,6 +29,11 @@ def build_backbone(cfg, mesh=None):
     if name == "sam_vit_b":
         return build_sam_vit("vit_b", dtype=dtype, seq_mesh=seq_mesh)
     if name in RESNET_VARIANTS:
+        if seq_mesh is not None:
+            raise ValueError(
+                "sequence parallelism ('seq' mesh axis > 1) only applies to "
+                "SAM-ViT backbones; resnet has no global attention to shard"
+            )
         return build_resnet(name, dilation=cfg.dilation)
     raise KeyError(f"unknown backbone {name!r}")
 
@@ -69,7 +74,7 @@ def build_sam_encoder(
         params = convert_sam_vit(sd, prefix)
     else:
         img = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-        params = model.init(jax.random.key(seed), img)["params"]
+        params = jax.jit(model.init)(jax.random.key(seed), img)["params"]
     return model, params
 
 

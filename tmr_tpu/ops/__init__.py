@@ -14,6 +14,9 @@ leans on (SURVEY.md §2.4):
                    (reference utils/TM_utils.py:337-377).
 - ``boxes``      — box codecs + IoU/gIoU (reference criterion/criterions_TM.py:7-13 /
                    torchvision generalized_box_iou_loss).
+- ``pallas_nms`` — the same greedy NMS as a Pallas TPU kernel (true
+                   sequential pass in VMEM); auto-selected on TPU by
+                   ``postprocess.batched_nms``.
 """
 
 from tmr_tpu.ops.boxes import (  # noqa: F401
@@ -32,5 +35,6 @@ from tmr_tpu.ops.xcorr import (  # noqa: F401
     template_geometry,
 )
 from tmr_tpu.ops.nms import nms_keep_mask  # noqa: F401
+from tmr_tpu.ops.pallas_nms import nms_keep_mask_pallas  # noqa: F401
 from tmr_tpu.ops.peaks import adaptive_kernel, masked_maxpool3x3  # noqa: F401
 from tmr_tpu.ops.postprocess import batched_nms, decode_detections  # noqa: F401

@@ -1,0 +1,101 @@
+"""Greedy NMS as a Pallas TPU kernel.
+
+The pure-XLA path (ops/nms.py) expresses greedy NMS as a fixpoint of masked
+bool-matmuls: each iteration is an (N, N) matrix product, and the iteration
+count is the suppression-chain depth. This kernel instead runs the *true*
+sequential greedy algorithm — the one torchvision's CUDA kernel implements
+(reference utils/TM_utils.py:6,322) — in one pass: boxes live in VMEM
+(N x 4 floats, KBs), a ``fori_loop`` walks boxes in score order, and each
+step suppresses all later boxes overlapping the current survivor with one
+N-wide VPU IoU evaluation. O(N^2) lanes total, no (N, N) matrix ever
+materialized, sequential dependency expressed directly instead of iterated
+to convergence.
+
+Input must be pre-sorted by descending score (do the sort with XLA outside —
+its bitonic sorter is fine); wrapper :func:`nms_keep_mask_pallas` handles
+sort/unsort and matches ops/nms.py bit-for-bit on the keep decision.
+
+Runs compiled on TPU; ``interpret=True`` (automatic off-TPU) keeps CPU tests
+honest.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _nms_kernel(boxes_ref, valid_ref, thr_ref, keep_ref):
+    """boxes (N, 4) score-sorted; valid (N,) int32; keep (N,) int32 out."""
+    n = boxes_ref.shape[0]
+    x1 = boxes_ref[:, 0]
+    y1 = boxes_ref[:, 1]
+    x2 = boxes_ref[:, 2]
+    y2 = boxes_ref[:, 3]
+    area = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+    thr = thr_ref[0]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]
+
+    keep_ref[:] = valid_ref[:]
+
+    def body(i, _):
+        # IoU of box i against every box (vectorized over lanes)
+        bx1 = boxes_ref[i, 0]
+        by1 = boxes_ref[i, 1]
+        bx2 = boxes_ref[i, 2]
+        by2 = boxes_ref[i, 3]
+        barea = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+        iw = jnp.maximum(jnp.minimum(x2, bx2) - jnp.maximum(x1, bx1), 0.0)
+        ih = jnp.maximum(jnp.minimum(y2, by2) - jnp.maximum(y1, by1), 0.0)
+        inter = iw * ih
+        iou = inter / jnp.maximum(area + barea - inter, 1e-12)
+
+        alive = keep_ref[i] > 0
+        suppress = alive & (idx > i) & (iou > thr)
+        keep_ref[:] = jnp.where(suppress, 0, keep_ref[:])
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run_nms_kernel(boxes, valid, thr, interpret: bool = False):
+    n = boxes.shape[0]
+    return pl.pallas_call(
+        _nms_kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(boxes, valid, thr)
+
+
+def nms_keep_mask_pallas(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    valid: jnp.ndarray | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops/nms.py nms_keep_mask (same semantics,
+    same original-order output). ``interpret`` defaults to True off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = boxes.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+    sort_scores = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-sort_scores)
+    b = boxes[order].astype(jnp.float32)
+    v = valid[order].astype(jnp.int32)
+    thr = jnp.asarray([iou_threshold], jnp.float32)
+    keep_sorted = _run_nms_kernel(b, v, thr, interpret=interpret) > 0
+    return jnp.zeros((n,), bool).at[order].set(keep_sorted)

@@ -98,12 +98,24 @@ def decode_detections(
     }
 
 
-def batched_nms(dets: dict, iou_threshold: float) -> dict:
+def batched_nms(dets: dict, iou_threshold: float, backend: str = "auto") -> dict:
     """Apply greedy NMS per image over the fixed candidate slots
-    (reference utils/TM_utils.py:307-323)."""
-    keep = jax.vmap(
-        lambda b, s, v: nms_keep_mask(b, s, iou_threshold, v)
-    )(dets["boxes"], dets["scores"], dets["valid"])
+    (reference utils/TM_utils.py:307-323).
+
+    backend: 'auto' picks the Pallas sequential-greedy kernel on TPU and the
+    pure-XLA fixpoint elsewhere; 'pallas'/'xla' force. Both are exact greedy
+    NMS with identical keep decisions (tests/test_pallas_ops.py)."""
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend == "pallas":
+        from tmr_tpu.ops.pallas_nms import nms_keep_mask_pallas
+
+        fn = lambda b, s, v: nms_keep_mask_pallas(
+            b, s, iou_threshold, v, interpret=jax.default_backend() != "tpu"
+        )
+    else:
+        fn = lambda b, s, v: nms_keep_mask(b, s, iou_threshold, v)
+    keep = jax.vmap(fn)(dets["boxes"], dets["scores"], dets["valid"])
     out = dict(dets)
     out["valid"] = dets["valid"] & keep
     out["scores"] = jnp.where(out["valid"], dets["scores"], 0.0)

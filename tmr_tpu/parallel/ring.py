@@ -92,20 +92,26 @@ def ring_attention(
     l = jnp.zeros((B, H, S), jnp.float32)
     o = jnp.zeros((B, H, S, D), jnp.float32)
 
-    def step(i, carry):
-        k_blk, v_blk, m, l, o = carry
+    def accumulate(i, k_blk, v_blk, m, l, o):
         k_idx = (my - i) % n  # block that arrived after i rotations
         bias = bias_fn(my, k_idx) if bias_fn is not None else None
-        m, l, o = _block_attn_update(
+        return _block_attn_update(
             qf, k_blk.astype(jnp.float32), v_blk, bias, scale, m, l, o
         )
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        m, l, o = accumulate(i, k_blk, v_blk, m, l, o)
         # pass k/v to the next device in the ring (receive from the previous)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m, l, o
 
-    k_blk, v_blk, m, l, o = lax.fori_loop(0, n, step, (k, v, m, l, o))
+    # n-1 rotations; the final visiting block is consumed without another
+    # (dead) ppermute pair burning ICI bandwidth
+    k_blk, v_blk, m, l, o = lax.fori_loop(0, n - 1, step, (k, v, m, l, o))
+    m, l, o = accumulate(n - 1, k_blk, v_blk, m, l, o)
     out = o / l[..., None]
     return out.astype(q.dtype)
 
