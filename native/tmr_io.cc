@@ -157,6 +157,10 @@ struct Stream {
       if (fread(hdr, 1, 512, f) != 512) break;
       if (header_zero(hdr)) break;  // end-of-archive marker
       int64_t size = tar_size(hdr + 124);
+      if (size < 0 || size > (int64_t(1) << 40)) {  // corrupt size field
+        ok = false;
+        break;
+      }
       char type = hdr[156];
       // member path: prefix (ustar) + name
       char name[257];

@@ -1,0 +1,98 @@
+"""Visualization subsystem (tmr_tpu/utils/visualize.py — reference
+log_utils.py:311-531 + trainer.py presence dumps)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tmr_tpu.utils.visualize import (
+    per_image_ap50,
+    plot_pr_curves,
+    save_presence_maps,
+    save_triptychs,
+)
+
+
+def _write_eval_jsons(log_path, stage="test"):
+    imgs = [
+        {"id": 1, "height": 64, "width": 96, "file_name": "a.png",
+         "img_url": "/nonexistent/a.png",
+         "exemplar_boxes": [[5, 5, 10, 10]]},
+        {"id": 2, "height": 64, "width": 96, "file_name": "b.png",
+         "img_url": "/nonexistent/b.png", "exemplar_boxes": []},
+    ]
+    gts = {"categories": [{"name": "fg", "id": 1}], "images": imgs,
+           "annotations": [
+               {"id": 1, "image_id": 1, "bbox": [10, 10, 20, 20],
+                "area": 400, "iscrowd": 0, "category_id": 1},
+               {"id": 2, "image_id": 1, "bbox": [50, 30, 20, 20],
+                "area": 400, "iscrowd": 0, "category_id": 1},
+               {"id": 3, "image_id": 2, "bbox": [4, 4, 12, 12],
+                "area": 144, "iscrowd": 0, "category_id": 1},
+           ]}
+    preds = {"categories": [{"name": "fg", "id": 1}], "images": imgs,
+             "annotations": [
+                 {"id": 1, "image_id": 1, "bbox": [11, 11, 20, 20],
+                  "area": 400, "category_id": 1, "score": 0.9,
+                  "point": [20, 20]},
+                 {"id": 2, "image_id": 1, "bbox": [80, 50, 10, 10],
+                  "area": 100, "category_id": 1, "score": 0.4,
+                  "point": [85, 55]},
+                 {"id": 3, "image_id": 2, "bbox": [5, 5, 12, 12],
+                  "area": 144, "category_id": 1, "score": 0.8,
+                  "point": [10, 10]},
+             ]}
+    with open(os.path.join(log_path, f"instances_{stage}.json"), "w") as f:
+        json.dump(gts, f)
+    with open(os.path.join(log_path, f"predictions_{stage}.json"), "w") as f:
+        json.dump(preds, f)
+
+
+def test_per_image_ap50_perfect_and_miss():
+    gt = np.array([[10, 10, 20, 20]])
+    assert per_image_ap50(gt, np.array([[10, 10, 20, 20]]),
+                          np.array([0.9])) == pytest.approx(100.0, abs=1.0)
+    assert per_image_ap50(gt, np.array([[60, 60, 5, 5]]),
+                          np.array([0.9])) == 0.0
+    assert per_image_ap50(np.zeros((0, 4)), np.zeros((0, 4)),
+                          np.zeros(0)) == 100.0
+
+
+def test_triptychs_written_with_loader(tmp_path):
+    _write_eval_jsons(str(tmp_path))
+    rng = np.random.default_rng(0)
+
+    def loader(img_info):
+        return rng.integers(0, 255, (img_info["height"], img_info["width"],
+                                     3), dtype=np.uint8).astype(np.uint8)
+
+    paths = save_triptychs(str(tmp_path), "test", image_loader=loader)
+    assert len(paths) == 2
+    import cv2
+
+    img = cv2.imread(paths[0])
+    assert img is not None and img.shape == (64, 96 * 3, 3)  # 3 panels
+
+
+def test_triptychs_missing_pixels_skipped(tmp_path):
+    """img_url that can't be opened -> skipped, not raised."""
+    _write_eval_jsons(str(tmp_path))
+    assert save_triptychs(str(tmp_path), "test") == []
+
+
+def test_pr_curves_written(tmp_path):
+    _write_eval_jsons(str(tmp_path))
+    path = plot_pr_curves(str(tmp_path), "test")
+    assert path is not None and os.path.exists(path)
+
+
+def test_presence_maps(tmp_path):
+    maps = [np.random.default_rng(1).standard_normal((2, 16, 16))]
+    paths = save_presence_maps(maps, str(tmp_path / "pm"), step=3)
+    assert len(paths) == 1 and os.path.exists(paths[0])
+    import cv2
+
+    img = cv2.imread(paths[0], cv2.IMREAD_GRAYSCALE)
+    assert img.shape == (16, 16)

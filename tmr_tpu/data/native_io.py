@@ -97,6 +97,8 @@ class NativeTarStream:
                  queue_cap: int = 64):
         lib = _load()
         self._lib = lib
+        self._errors_at_close = 0
+        self._handle = None
         self._paths = [os.fsencode(p) for p in paths]
         arr = (ctypes.c_char_p * len(self._paths))(*self._paths)
         self._handle = lib.tmr_io_open(arr, len(self._paths), threads,
@@ -119,10 +121,15 @@ class NativeTarStream:
 
     @property
     def errors(self) -> int:
+        if self._handle is None:
+            return self._errors_at_close
         return int(self._lib.tmr_io_error(self._handle))
 
     def close(self) -> None:
         if self._handle:
+            self._errors_at_close = int(
+                self._lib.tmr_io_error(self._handle)
+            )
             self._lib.tmr_io_close(self._handle)
             self._handle = None
 

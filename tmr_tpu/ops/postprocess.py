@@ -110,9 +110,7 @@ def batched_nms(dets: dict, iou_threshold: float, backend: str = "auto") -> dict
     if backend == "pallas":
         from tmr_tpu.ops.pallas_nms import nms_keep_mask_pallas
 
-        fn = lambda b, s, v: nms_keep_mask_pallas(
-            b, s, iou_threshold, v, interpret=jax.default_backend() != "tpu"
-        )
+        fn = lambda b, s, v: nms_keep_mask_pallas(b, s, iou_threshold, v)
     else:
         fn = lambda b, s, v: nms_keep_mask(b, s, iou_threshold, v)
     keep = jax.vmap(fn)(dets["boxes"], dets["scores"], dets["valid"])

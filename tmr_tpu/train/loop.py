@@ -286,6 +286,20 @@ class Trainer:
                 f"{stage}/AP75: {ap75:.2f} | {stage}/MAE: {mae:.2f} | "
                 f"{stage}/RMSE: {rmse:.2f}"
             )
+            if cfg.visualize:
+                # triptychs + PR curves (log_utils.py:311-377, 447-491);
+                # best-effort: visualization must never fail an eval run
+                from tmr_tpu.utils.profiling import log_warning
+                from tmr_tpu.utils.visualize import (
+                    plot_pr_curves,
+                    save_triptychs,
+                )
+
+                try:
+                    save_triptychs(cfg.logpath, stage)
+                    plot_pr_curves(cfg.logpath, stage)
+                except Exception as e:  # pragma: no cover
+                    log_warning(f"visualization failed: {e}")
             del_img_log_path(cfg.logpath, stage)
         return metrics
 

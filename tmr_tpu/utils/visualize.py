@@ -1,0 +1,204 @@
+"""Debug/visual-inspection outputs (reference utils/log_utils.py:311-531 +
+trainer.py:155-170).
+
+The reference's correctness strategy leans on visual artifacts instead of
+asserts (SURVEY §4): per-image GT/Pred/combined triptychs with a per-image
+AP caption (log_utils.py:311-377), PR curves per IoU threshold
+(log_utils.py:447-491), and presence-map image dumps during training
+(trainer.py:155-170). This module rebuilds all three on top of the merged
+COCO-style jsons the metrics pipeline already writes, so visualization is a
+pure post-processing pass — nothing touches the jitted path.
+
+Enabled by ``--visualize`` (reference main.py:49); outputs land under
+``{logpath}/visualizations/{stage}/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+GT_COLOR = (80, 220, 80)      # green (BGR-agnostic: we draw on RGB)
+PRED_COLOR = (255, 80, 80)    # red
+EX_COLOR = (80, 120, 255)     # blue
+
+
+def _draw_xywh(img: np.ndarray, boxes, color, thickness: int = 2):
+    import cv2
+
+    out = img
+    for x, y, w, h in np.asarray(boxes, np.float64).reshape(-1, 4):
+        out = cv2.rectangle(
+            out, (int(x), int(y)), (int(x + w), int(y + h)), color, thickness
+        )
+    return out
+
+
+def per_image_ap50(
+    gt_xywh: np.ndarray, pred_xywh: np.ndarray, scores: np.ndarray
+) -> float:
+    """Single-image AP@0.5 via greedy score-ordered matching — the role of
+    the reference's per-image torchmetrics mAP caption (log_utils.py:493-531)."""
+    from tmr_tpu.utils.coco_eval import iou_xywh
+
+    gt = np.asarray(gt_xywh, np.float64).reshape(-1, 4)
+    pred = np.asarray(pred_xywh, np.float64).reshape(-1, 4)
+    scores = np.asarray(scores, np.float64).reshape(-1)
+    if len(gt) == 0:
+        return 0.0 if len(pred) else 100.0
+    if len(pred) == 0:
+        return 0.0
+    order = np.argsort(-scores)
+    iou = iou_xywh(pred[order], gt)
+    matched = np.zeros(len(gt), bool)
+    tp = np.zeros(len(pred))
+    for d in range(len(pred)):
+        best, best_iou = -1, 0.5
+        for g in range(len(gt)):
+            if not matched[g] and iou[d, g] >= best_iou:
+                best, best_iou = g, iou[d, g]
+        if best >= 0:
+            matched[best] = True
+            tp[d] = 1
+    cum_tp = np.cumsum(tp)
+    recall = cum_tp / len(gt)
+    precision = cum_tp / np.arange(1, len(pred) + 1)
+    # 101-point interpolation
+    ap = 0.0
+    for r in np.linspace(0, 1, 101):
+        p = precision[recall >= r]
+        ap += (p.max() if len(p) else 0.0) / 101
+    return float(ap * 100)
+
+
+def save_triptychs(
+    log_path: str,
+    stage: str,
+    max_images: Optional[int] = None,
+    image_loader=None,
+) -> List[str]:
+    """GT | Pred | combined panels per image (log_utils.py:311-377).
+
+    Reads the merged instances/predictions jsons; original pixels come from
+    each image's ``img_url`` (or ``image_loader(img_info) -> HxWx3 uint8``
+    for tests / relocated datasets). Images whose pixels can't be loaded are
+    skipped — visualization never fails an eval run. Returns written paths.
+    """
+    import cv2
+
+    from tmr_tpu.utils.metrics import GTS_NAME_FORMAT, PRED_NAME_FORMAT
+
+    with open(os.path.join(log_path, f"{GTS_NAME_FORMAT}_{stage}.json")) as f:
+        gts = json.load(f)
+    with open(os.path.join(log_path, f"{PRED_NAME_FORMAT}_{stage}.json")) as f:
+        preds = json.load(f)
+
+    g_by_img: Dict[object, list] = {}
+    for a in gts["annotations"]:
+        g_by_img.setdefault(a["image_id"], []).append(a["bbox"])
+    p_by_img: Dict[object, list] = {}
+    s_by_img: Dict[object, list] = {}
+    for a in preds["annotations"]:
+        p_by_img.setdefault(a["image_id"], []).append(a["bbox"])
+        s_by_img.setdefault(a["image_id"], []).append(a["score"])
+
+    out_dir = os.path.join(log_path, "visualizations", stage)
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for img_info in preds["images"][: max_images or len(preds["images"])]:
+        try:
+            if image_loader is not None:
+                img = np.asarray(image_loader(img_info), np.uint8)
+            else:
+                from PIL import Image
+
+                img = np.asarray(
+                    Image.open(img_info["img_url"]).convert("RGB")
+                )
+        except Exception:
+            continue
+        i = img_info["id"]
+        gt = g_by_img.get(i, [])
+        pd = p_by_img.get(i, [])
+        sc = s_by_img.get(i, [])
+        ap = per_image_ap50(gt, pd, sc)
+
+        panel_gt = _draw_xywh(img.copy(), gt, GT_COLOR)
+        panel_gt = _draw_xywh(panel_gt, img_info.get("exemplar_boxes", []),
+                              EX_COLOR, 3)
+        panel_pred = _draw_xywh(img.copy(), pd, PRED_COLOR)
+        panel_both = _draw_xywh(_draw_xywh(img.copy(), gt, GT_COLOR), pd,
+                                PRED_COLOR)
+        trip = np.concatenate([panel_gt, panel_pred, panel_both], axis=1)
+        trip = cv2.putText(
+            np.ascontiguousarray(trip),
+            f"GT {len(gt)} | Pred {len(pd)} | AP50 {ap:.1f}",
+            (8, 24), cv2.FONT_HERSHEY_SIMPLEX, 0.7, (255, 255, 0), 2,
+        )
+        name = os.path.splitext(os.path.basename(
+            str(img_info.get("file_name", i))
+        ))[0]
+        path = os.path.join(out_dir, f"{name}_triptych.png")
+        cv2.imwrite(path, trip[..., ::-1])  # RGB -> BGR for cv2
+        written.append(path)
+    return written
+
+
+def plot_pr_curves(log_path: str, stage: str) -> Optional[str]:
+    """Precision-recall curves at IoU .5/.75/.95 (log_utils.py:447-491),
+    from the evaluator's accumulated precision array."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:  # pragma: no cover
+        return None
+
+    from tmr_tpu.utils.metrics import _load_by_image
+    from tmr_tpu.utils.coco_eval import COCOEvalLite
+
+    g, p, _, _ = _load_by_image(log_path, stage)
+    ev = COCOEvalLite(g, p).run()
+    rec = ev.rec_thrs
+    fig, ax = plt.subplots(figsize=(6, 5))
+    for ti, thr in enumerate(ev.iou_thrs):
+        if not any(np.isclose(thr, t) for t in (0.5, 0.75, 0.95)):
+            continue
+        pr = ev.precision[ti, :, 0, -1]
+        pr = np.where(pr >= 0, pr, 0.0)
+        ax.plot(rec, pr, label=f"IoU {thr:.2f}")
+    ax.set_xlabel("recall")
+    ax.set_ylabel("precision")
+    ax.set_title(f"{stage} PR curves")
+    ax.legend()
+    out_dir = os.path.join(log_path, "visualizations", stage)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "pr_curves.png")
+    fig.savefig(path, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def save_presence_maps(
+    objectness_maps, out_dir: str, step: int, prefix: str = "presence"
+) -> List[str]:
+    """Objectness heat-map dumps during training (trainer.py:155-170):
+    per-level post-sigmoid maps as grayscale PNGs."""
+    import cv2
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for lvl, m in enumerate(objectness_maps):
+        arr = np.asarray(m, np.float32)
+        if arr.ndim == 3:  # (B, H, W) -> first image
+            arr = arr[0]
+        arr = 1.0 / (1.0 + np.exp(-arr))  # logits -> sigmoid
+        img = (arr * 255).clip(0, 255).astype(np.uint8)
+        path = os.path.join(out_dir, f"{prefix}_step{step}_lvl{lvl}.png")
+        cv2.imwrite(path, img)
+        written.append(path)
+    return written
