@@ -1,0 +1,129 @@
+"""Small parity components: GT-based random crop (datamodules/transforms.py
+GTBasedRandomCrop), encoder registry (models/encoders.py), worker payload
+packaging (Package_Modules.zip), refiner save_masks."""
+
+import os
+import sys
+import zipfile
+
+import numpy as np
+import pytest
+
+
+def test_gt_based_random_crop_contains_anchor_box():
+    from tmr_tpu.data.transforms import gt_based_random_crop
+
+    rng = np.random.default_rng(0)
+    img = np.arange(100 * 80 * 3, dtype=np.uint8).reshape(100, 80, 3)
+    boxes = np.array([[0.3, 0.3, 0.5, 0.6]], np.float32)
+    for _ in range(10):
+        crop, out_boxes, kept = gt_based_random_crop(img, boxes, rng)
+        # the anchor box always survives, normalized inside the crop
+        assert len(out_boxes) == 1 and kept.tolist() == [0]
+        x1, y1, x2, y2 = out_boxes[0]
+        assert 0 <= x1 < x2 <= 1 and 0 <= y1 < y2 <= 1
+        assert crop.shape[0] >= 1 and crop.shape[1] >= 1
+        # crop window contains the full anchor box: its pixel extent must be
+        # at least the box's pixel extent
+        assert crop.shape[1] >= int(0.2 * 80) - 1
+        assert crop.shape[0] >= int(0.3 * 100) - 1
+
+
+def test_gt_based_random_crop_drops_outside_boxes():
+    from tmr_tpu.data.transforms import gt_based_random_crop
+
+    img = np.zeros((100, 100, 3), np.uint8)
+    boxes = np.array(
+        [[0.05, 0.05, 0.15, 0.15], [0.8, 0.8, 0.95, 0.95]], np.float32
+    )
+    rng = np.random.default_rng(3)
+    seen_drop = False
+    for _ in range(20):
+        _, out_boxes, kept = gt_based_random_crop(img, boxes, rng)
+        assert 1 <= len(out_boxes) <= 2
+        if len(out_boxes) == 1:
+            seen_drop = True
+    assert seen_drop  # far-apart boxes must sometimes fall outside the crop
+
+
+def test_gt_based_random_crop_empty_raises():
+    from tmr_tpu.data.transforms import gt_based_random_crop
+
+    with pytest.raises(ValueError):
+        gt_based_random_crop(np.zeros((10, 10, 3)), np.zeros((0, 4)),
+                             np.random.default_rng(0))
+
+
+def test_encoder_registry():
+    from tmr_tpu.models import build_encoder
+    from tmr_tpu.models.vit import SamViT
+
+    cls = build_encoder("original")
+    enc = cls(SamViT(out_chans=256), emb_dim=512)
+    assert enc.num_channels == 256 and enc.emb_dim == 512
+    with pytest.raises(KeyError):
+        build_encoder("nonexistent")
+
+
+def test_package_modules(tmp_path, monkeypatch):
+    from tmr_tpu.utils.package import package_modules
+
+    out = str(tmp_path / "Package_Modules.zip")
+    package_modules(out)
+    with zipfile.ZipFile(out) as z:
+        names = z.namelist()
+    assert "tmr_tpu/__init__.py" in names
+    assert "tmr_tpu/models/matching_net.py" in names
+    assert not any("__pycache__" in n for n in names)
+    # consumable exactly like the reference payload (export_onnx.py:14)
+    saved = list(sys.path)
+    saved_mods = {k: sys.modules.pop(k) for k in list(sys.modules)
+                  if k == "tmr_tpu" or k.startswith("tmr_tpu.")}
+    try:
+        sys.path.insert(0, out)
+        import tmr_tpu.ops.boxes as bx
+
+        assert bx.__file__.startswith(out)
+    finally:
+        sys.path[:] = saved
+        for k in [k for k in sys.modules
+                  if k == "tmr_tpu" or k.startswith("tmr_tpu.")]:
+            del sys.modules[k]
+        sys.modules.update(saved_mods)
+
+
+def test_refiner_save_masks(tmp_path):
+    import jax.numpy as jnp
+
+    from tmr_tpu.models.sam_decoder import MaskDecoder, PromptEncoder
+    from tmr_tpu.refine import SamRefineModule
+
+    DIM = 32
+    refiner = SamRefineModule(chunk=4)
+    refiner.prompt_encoder = PromptEncoder(embed_dim=DIM, mask_in_chans=4)
+    refiner.mask_decoder = MaskDecoder(
+        transformer_dim=DIM, transformer_mlp_dim=64,
+        iou_head_hidden_dim=DIM,
+    )
+    params = refiner.init_params(seed=0)
+
+    B, N = 2, 4
+    feats = jnp.asarray(
+        np.random.default_rng(1).standard_normal((B, 8, 8, DIM)), jnp.float32
+    )
+    dets = {
+        "boxes": jnp.asarray(
+            np.random.default_rng(2).uniform(0.2, 0.8, (B, N, 4)), jnp.float32
+        ),
+        "scores": jnp.ones((B, N)),
+        "valid": jnp.array([[True, True, False, False]] * B),
+    }
+    paths = refiner.save_masks(
+        params, feats, dets, (32, 32), str(tmp_path), ["im_a", "im_b"]
+    )
+    assert len(paths) == 2
+    import cv2
+
+    m = cv2.imread(paths[0], cv2.IMREAD_GRAYSCALE)
+    assert m.shape == (32, 32)
+    assert set(np.unique(m)) <= {0, 255}

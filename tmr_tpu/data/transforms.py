@@ -47,6 +47,64 @@ def resize_normalize(image: np.ndarray, size: int) -> np.ndarray:
     return normalize_image(img)
 
 
+def gt_based_random_crop(
+    image: np.ndarray,
+    bboxes: np.ndarray,
+    rng: np.random.Generator,
+    keep_all_boxes: bool = False,
+    labels: np.ndarray = None,
+):
+    """GT-anchored random crop (reference datamodules/transforms.py:10-35,
+    the unused ``GTBasedRandomCrop`` augmentation, rebuilt without
+    albumentations): pick a random GT box, grow a crop window from it by
+    random amounts toward the image borders, crop, and re-normalize the
+    boxes to the crop (dropping boxes whose center falls outside unless
+    ``keep_all_boxes``).
+
+    image: (H, W, C); bboxes: (N, 4) normalized xyxy; ``labels`` (N,)
+    optional — when given, the anchor is sampled only from label-0 boxes
+    (the reference restricts to label column == 0, transforms.py:23).
+    Returns (cropped image, adjusted normalized boxes (M, 4), kept-index
+    array).
+    """
+    if len(bboxes) == 0:
+        raise ValueError("len(bboxes) must be > 0")
+    h, w = image.shape[:2]
+    candidates = np.arange(len(bboxes))
+    if labels is not None:
+        candidates = np.nonzero(np.asarray(labels) == 0)[0]
+        if len(candidates) == 0:
+            raise ValueError("no label-0 boxes to anchor the crop on")
+    anchor = candidates[rng.integers(len(candidates))]
+    x, y, x2, y2 = np.asarray(bboxes, np.float64)[anchor]
+
+    bx = x * rng.random()
+    by = y * rng.random()
+    bx2 = x2 + (1 - x2) * rng.random()
+    by2 = y2 + (1 - y2) * rng.random()
+
+    px, py = int(bx * w), int(by * h)
+    px2, py2 = max(int(bx2 * w), px + 1), max(int(by2 * h), py + 1)
+    crop = image[py:py2, px:px2]
+    cw, ch = px2 - px, py2 - py
+
+    out_boxes, kept = [], []
+    for i, (a, b, c, d) in enumerate(np.asarray(bboxes, np.float64)):
+        nx1 = (a * w - px) / cw
+        ny1 = (b * h - py) / ch
+        nx2 = (c * w - px) / cw
+        ny2 = (d * h - py) / ch
+        cx, cy = (nx1 + nx2) / 2, (ny1 + ny2) / 2
+        if not keep_all_boxes and not (0 <= cx <= 1 and 0 <= cy <= 1):
+            continue
+        out_boxes.append([np.clip(nx1, 0, 1), np.clip(ny1, 0, 1),
+                          np.clip(nx2, 0, 1), np.clip(ny2, 0, 1)])
+        kept.append(i)
+    return crop, np.asarray(out_boxes, np.float32).reshape(-1, 4), np.asarray(
+        kept, np.int64
+    )
+
+
 SAM_PIXEL_MEAN = np.array([123.675, 116.28, 103.53], np.float32)
 SAM_PIXEL_STD = np.array([58.395, 57.12, 57.375], np.float32)
 

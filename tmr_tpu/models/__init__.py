@@ -38,6 +38,35 @@ def build_backbone(cfg, mesh=None):
     raise KeyError(f"unknown backbone {name!r}")
 
 
+class BackboneEncoder:
+    """Thin encoder wrapper (reference models/encoders.py:6-18
+    ``Backbone_Encoder``): passthrough to the backbone, exposing
+    ``num_channels`` for downstream projection sizing."""
+
+    def __init__(self, backbone, emb_dim: int):
+        self.backbone = backbone
+        self.emb_dim = emb_dim
+        self.num_channels = getattr(backbone, "out_chans", None) or getattr(
+            backbone, "num_channels", None
+        )
+        if self.num_channels is None:  # fail at build, not deep in a Dense
+            raise AttributeError(
+                f"{type(backbone).__name__} exposes neither out_chans nor "
+                "num_channels"
+            )
+
+    def apply(self, variables, x):
+        return self.backbone.apply(variables, x)
+
+
+def build_encoder(name: str = "original"):
+    """Encoder registry (reference models/encoders.py ``build_encoder``;
+    only 'original' exists)."""
+    if name != "original":
+        raise KeyError(f"unknown encoder {name!r}")
+    return BackboneEncoder
+
+
 def build_sam_encoder(
     model_type: str = "vit_b",
     checkpoint: str = None,

@@ -242,32 +242,81 @@ class SamRefineModule:
         features: jnp.ndarray,  # (B, h, w, 256)
         boxes: jnp.ndarray,  # (B, N, 4) normalized
         image_size: Tuple[int, int],
+        valid: Optional[jnp.ndarray] = None,  # (B, N) bool
     ) -> jnp.ndarray:
         """Union mask per image (B, H, W) bool — the ``save_masks`` path
-        (box_refine.py:260-307) minus the cv2 file write."""
+        (box_refine.py:260-307) minus the cv2 file write. Padding slots
+        (``valid`` False) contribute nothing to the union."""
         h_img, w_img = image_size
         res = jnp.asarray([w_img, h_img, w_img, h_img], jnp.float32)
+        if valid is None:
+            valid = jnp.ones(boxes.shape[:2], bool)
+        n = boxes.shape[1]
+        chunk = min(self.chunk, n)
+        n_pad = math.ceil(n / chunk) * chunk
 
-        def per_image(feat, bxs):
+        def per_image(feat, bxs, val):
             image_pe = self.prompt_encoder.apply(
                 {"params": params["prompt_encoder"]},
                 feat.shape[0:2],
                 method=PromptEncoder.dense_pe,
             )
-            sparse, dense = self.prompt_encoder.apply(
-                {"params": params["prompt_encoder"]},
-                bxs * res,
-                image_size,
-                feat.shape[0:2],
-            )
-            masks, _ = self.mask_decoder.apply(
-                {"params": params["mask_decoder"]},
-                feat[None],
-                image_pe,
-                sparse,
-                dense,
-            )
-            masks = resize_align_corners(masks, image_size) > 0
-            return jnp.any(masks, axis=0)
+            bxs_p = jnp.pad(bxs * res, ((0, n_pad - n), (0, 0)))
+            val_p = jnp.pad(val, (0, n_pad - n))
 
-        return jax.vmap(per_image)(features, boxes)
+            def one_chunk(args):
+                cb, cv = args
+                sparse, dense = self.prompt_encoder.apply(
+                    {"params": params["prompt_encoder"]},
+                    cb,
+                    image_size,
+                    feat.shape[0:2],
+                )
+                masks, _ = self.mask_decoder.apply(
+                    {"params": params["mask_decoder"]},
+                    feat[None],
+                    image_pe,
+                    sparse,
+                    dense,
+                )
+                masks = resize_align_corners(masks, image_size) > 0
+                return jnp.any(masks & cv[:, None, None], axis=0)
+
+            # bound HBM like refine(): self.chunk prompts per decode
+            # (the reference steps by 50, box_refine.py:279)
+            chunk_masks = jax.lax.map(
+                one_chunk,
+                (bxs_p.reshape(n_pad // chunk, chunk, 4),
+                 val_p.reshape(n_pad // chunk, chunk)),
+            )
+            return jnp.any(chunk_masks, axis=0)
+
+        return jax.vmap(per_image)(features, boxes, valid)
+
+    def save_masks(
+        self,
+        params: dict,
+        features: jnp.ndarray,
+        dets: dict,
+        image_size: Tuple[int, int],
+        log_path: str,
+        img_names,
+    ) -> list:
+        """Dump per-image union masks to {log_path}/masks/{img_name}.png
+        (box_refine.py:260-307: 255 = covered by some predicted box mask)."""
+        import os
+
+        import cv2
+
+        out_dir = os.path.join(log_path, "masks")
+        os.makedirs(out_dir, exist_ok=True)
+        masks = self.decode_masks(
+            params, features, dets["boxes"], image_size,
+            valid=dets.get("valid"),
+        )
+        written = []
+        for mask, name in zip(np.asarray(masks), img_names):
+            path = os.path.join(out_dir, f"{name}.png")
+            cv2.imwrite(path, (mask * 255).astype(np.uint8))
+            written.append(path)
+        return written

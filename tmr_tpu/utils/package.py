@@ -1,0 +1,46 @@
+"""Worker payload packaging (reference ``Package_Modules.zip``, SURVEY §2.1
+#29): the reference zips its ``datamodules/`` + ``models/`` trees so Hadoop
+workers can ``sys.path``-import them (export_onnx.py:14).
+
+On TPU the serialized StableHLO artifact (export_encoder.py) already removes
+the need to ship model *code* to workers; this utility exists for the cases
+that still want the source tree on a worker (custom postprocessing, the
+mapreduce CLI itself):
+
+  python -m tmr_tpu.utils.package [-o Package_Modules.zip]
+
+The zip contains the ``tmr_tpu`` package (sources only) and can be consumed
+exactly like the reference's: ``sys.path.insert(0, "Package_Modules.zip")``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import zipfile
+
+
+def package_modules(output: str = "Package_Modules.zip") -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = os.path.dirname(root)
+    with zipfile.ZipFile(output, "w", zipfile.ZIP_DEFLATED) as z:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                z.write(full, os.path.relpath(full, base))
+    return output
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-o", "--output", default="Package_Modules.zip")
+    args = p.parse_args(argv)
+    out = package_modules(args.output)
+    print(f"wrote {out} ({os.path.getsize(out) / 1e3:.0f} kB)")
+
+
+if __name__ == "__main__":
+    main()
