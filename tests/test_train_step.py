@@ -95,3 +95,67 @@ def test_trainable_backbone_updates():
         )
     )
     assert moved
+
+
+def test_nonfinite_loss_skips_update():
+    """A batch producing a non-finite loss must leave params unchanged
+    (failure containment; the reference trains through NaNs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.models import build_model
+    from tmr_tpu.train.state import create_train_state, make_train_step
+
+    cfg = Config(backbone="resnet50_layer1", emb_dim=8, fusion=False,
+                 image_size=32, compute_dtype="float32", max_gt_boxes=4)
+    model = build_model(cfg)
+    img = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    ex = jnp.array([[[0.3, 0.3, 0.6, 0.6]]], jnp.float32)
+    state = create_train_state(model, cfg, jax.random.key(0), img, ex,
+                               steps_per_epoch=10)
+    step = jax.jit(make_train_step(model, cfg))
+
+    bad_batch = {
+        "image": jnp.full((1, 32, 32, 3), jnp.nan),  # poisoned input
+        "exemplars": ex,
+        "gt_boxes": jnp.array([[[0.3, 0.3, 0.6, 0.6]]] , jnp.float32),
+        "gt_valid": jnp.ones((1, 1), bool),
+    }
+    good_batch = dict(
+        bad_batch,
+        image=jnp.asarray(
+            np.random.default_rng(0).standard_normal((1, 32, 32, 3)),
+            jnp.float32,
+        ),
+    )
+
+    # build real Adam moments first — a 'skipped' step must not move params
+    # via momentum/weight-decay either (the subtle failure mode)
+    state, _ = step(state, good_batch)
+    state, _ = step(state, good_batch)
+
+    new_state, losses = step(state, bad_batch)
+    assert float(losses["skipped_nonfinite"]) == 1.0
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state.params, new_state.params,
+    )
+    # optimizer state and step count also untouched
+    assert int(new_state.step) == int(state.step)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state.opt_state, new_state.opt_state,
+    )
+
+    new_state2, losses2 = step(new_state, good_batch)
+    assert float(losses2["skipped_nonfinite"]) == 0.0
+    # and a good step does change params
+    leaves_eq = jax.tree_util.tree_map(
+        lambda a, b: bool(np.allclose(np.asarray(a), np.asarray(b))),
+        new_state.params, new_state2.params,
+    )
+    assert not all(jax.tree_util.tree_leaves(leaves_eq))

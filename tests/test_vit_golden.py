@@ -140,3 +140,28 @@ def test_vit_bf16_close_to_f32():
     err = np.abs(np.asarray(bf16, np.float32) - np.asarray(f32))
     scale = np.abs(np.asarray(f32)).max() + 1e-6
     assert float(err.max()) / float(scale) < 0.1
+
+
+def test_forward_interm_returns_per_block_embeddings():
+    """return_interm matches the reference's forward_interm (sam.py:97-113):
+    final features plus every block's token embeddings."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmr_tpu.models.vit import SamViT
+
+    tiny = dict(embed_dim=16, depth=3, num_heads=2, global_attn_indexes=(1,),
+                window_size=2, out_chans=8, pretrain_img_size=32)
+    model = SamViT(**tiny)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, 32, 32, 3)), jnp.float32
+    )
+    params = model.init(jax.random.key(0), x)["params"]
+    final, interm = model.apply({"params": params}, x, return_interm=True)
+    plain = model.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(final), np.asarray(plain),
+                               rtol=1e-6)
+    assert len(interm) == 3
+    for emb in interm:
+        assert emb.shape == (1, 2, 2, 16)

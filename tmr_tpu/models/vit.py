@@ -244,7 +244,12 @@ class SamViT(nn.Module):
     batch_axis: Optional[str] = "data"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, return_interm: bool = False
+    ) -> jnp.ndarray:
+        """``return_interm=True`` additionally returns the per-block token
+        embeddings (B, h, w, embed_dim) — the reference's ``forward_interm``
+        (sam.py:97-113), used by SAM-HQ-style consumers."""
         grid = self.pretrain_img_size // self.patch_size
         x = nn.Conv(
             self.embed_dim,
@@ -267,6 +272,7 @@ class SamViT(nn.Module):
             )
         x = x + pos_embed.astype(x.dtype)
 
+        interm = []
         for i in range(self.depth):
             win = 0 if i in self.global_attn_indexes else self.window_size
             x = Block(
@@ -279,6 +285,8 @@ class SamViT(nn.Module):
                 batch_axis=self.batch_axis,
                 name=f"blocks_{i}",
             )(x)
+            if return_interm:
+                interm.append(x)
 
         # neck: 1x1 conv -> LN2d -> 3x3 conv -> LN2d (sam_ViT.py:88-104)
         x = nn.Conv(
@@ -290,6 +298,8 @@ class SamViT(nn.Module):
             name="neck_2",
         )(x.astype(self.dtype))
         x = LayerNorm2d(name="neck_3")(x.astype(jnp.float32))
+        if return_interm:
+            return x, interm
         return x
 
 

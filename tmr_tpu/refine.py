@@ -252,6 +252,8 @@ class SamRefineModule:
         if valid is None:
             valid = jnp.ones(boxes.shape[:2], bool)
         n = boxes.shape[1]
+        if n == 0:  # zero detection slots -> empty union masks
+            return jnp.zeros((boxes.shape[0],) + tuple(image_size), bool)
         chunk = min(self.chunk, n)
         n_pad = math.ceil(n / chunk) * chunk
 

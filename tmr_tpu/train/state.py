@@ -153,10 +153,23 @@ def make_train_step(model, cfg) -> Callable:
             )
             return losses["loss"], losses
 
-        (_, losses), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, losses), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
-        state = state.apply_gradients(grads=grads)
+        # failure containment the reference lacks (SURVEY §5.3: "training
+        # side: none"): a non-finite loss OR any non-finite gradient leaf
+        # (backward-only overflow) discards the whole step — params,
+        # optimizer moments, and the schedule step all keep their previous
+        # values — while the loss dict still reports the event.
+        finite_leaves = [
+            jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)
+        ]
+        ok = jnp.isfinite(loss) & jnp.all(jnp.stack(finite_leaves))
+        new_state = state.apply_gradients(grads=grads)
+        state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), new_state, state
+        )
+        losses["skipped_nonfinite"] = (~ok).astype(jnp.float32)
         return state, losses
 
     return train_step
