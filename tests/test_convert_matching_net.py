@@ -1,0 +1,139 @@
+"""Checkpoint conversion fidelity for the full detector
+(utils/convert.py:convert_matching_net — the Lightning `model.*` state_dict
+layout of reference trainer.py:21 / matching_net.py)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.models.matching_net import MatchingNet
+from tmr_tpu.models.vit import SamViT
+from tmr_tpu.utils.convert import convert_matching_net
+
+EMB = 16  # tiny embed dims, reference layout
+DEPTH = 2
+HEADS = 2
+C_OUT = 8  # backbone neck channels
+PROJ = 12  # emb_dim of the detector
+
+
+def _tiny_reference_state_dict(rng):
+    """A Lightning-style `model.*` state_dict with the reference's module
+    paths, tiny shapes (grid 4 => pretrain 64, patch 16)."""
+    t = lambda *s: torch.tensor(rng.standard_normal(s), dtype=torch.float32)
+    sd = {}
+    bb = "encoder.backbone.backbone."
+    sd[bb + "patch_embed.proj.weight"] = t(EMB, 3, 16, 16)
+    sd[bb + "patch_embed.proj.bias"] = t(EMB)
+    sd[bb + "pos_embed"] = t(1, 4, 4, EMB)
+    hd = EMB // HEADS
+    for i in range(DEPTH):
+        b = f"{bb}blocks.{i}."
+        sd[b + "norm1.weight"] = t(EMB)
+        sd[b + "norm1.bias"] = t(EMB)
+        sd[b + "norm2.weight"] = t(EMB)
+        sd[b + "norm2.bias"] = t(EMB)
+        sd[b + "attn.qkv.weight"] = t(3 * EMB, EMB)
+        sd[b + "attn.qkv.bias"] = t(3 * EMB)
+        sd[b + "attn.proj.weight"] = t(EMB, EMB)
+        sd[b + "attn.proj.bias"] = t(EMB)
+        # windowed blocks use the window grid; global the native grid — the
+        # converter copies whatever lengths the checkpoint has
+        size = 4 if i == 1 else 2
+        sd[b + "attn.rel_pos_h"] = t(2 * size - 1, hd)
+        sd[b + "attn.rel_pos_w"] = t(2 * size - 1, hd)
+        sd[b + "mlp.lin1.weight"] = t(4 * EMB, EMB)
+        sd[b + "mlp.lin1.bias"] = t(4 * EMB)
+        sd[b + "mlp.lin2.weight"] = t(EMB, 4 * EMB)
+        sd[b + "mlp.lin2.bias"] = t(EMB)
+    sd[bb + "neck.0.weight"] = t(C_OUT, EMB, 1, 1)
+    sd[bb + "neck.1.weight"] = t(C_OUT)
+    sd[bb + "neck.1.bias"] = t(C_OUT)
+    sd[bb + "neck.2.weight"] = t(C_OUT, C_OUT, 3, 3)
+    sd[bb + "neck.3.weight"] = t(C_OUT)
+    sd[bb + "neck.3.bias"] = t(C_OUT)
+
+    sd["input_proj.0.weight"] = t(PROJ, C_OUT, 1, 1)
+    sd["input_proj.0.bias"] = t(PROJ)
+    sd["matcher.scale"] = t(1)
+    d = 2 * PROJ  # fusion doubles the decoder width, kept through the convs
+    for dec in ("decoder_o", "decoder_b"):
+        sd[f"{dec}.layer.0.weight"] = t(d, d, 3, 3)
+        sd[f"{dec}.layer.0.bias"] = t(d)
+    sd["objectness_head.head.0.weight"] = t(1, d, 1, 1)
+    sd["objectness_head.head.0.bias"] = t(1)
+    sd["ltrbs_head.head.0.weight"] = t(4, d, 1, 1)
+    sd["ltrbs_head.head.0.bias"] = t(4)
+    return {f"model.{k}": v for k, v in sd.items()}
+
+
+def _tiny_model():
+    return MatchingNet(
+        backbone=SamViT(
+            embed_dim=EMB, depth=DEPTH, num_heads=HEADS,
+            global_attn_indexes=(1,), window_size=2, out_chans=C_OUT,
+            pretrain_img_size=64,
+        ),
+        emb_dim=PROJ, fusion=True, template_capacity=5,
+    )
+
+
+def test_converted_tree_matches_init_structure():
+    rng = np.random.default_rng(0)
+    sd = {k: v.numpy() for k, v in _tiny_reference_state_dict(rng).items()}
+    params = convert_matching_net(sd, backbone="sam")
+
+    model = _tiny_model()
+    want = model.init(
+        jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32),
+        jnp.array([[[0.3, 0.3, 0.6, 0.6]]], jnp.float32),
+    )["params"]
+
+    flat_got = {
+        "/".join(k): v.shape
+        for k, v in jax.tree_util.tree_leaves_with_path(params)
+        for k in [[str(p.key) for p in k]]
+    }
+    flat_want = {
+        "/".join(k): v.shape
+        for k, v in jax.tree_util.tree_leaves_with_path(want)
+        for k in [[str(p.key) for p in k]]
+    }
+    assert flat_got == flat_want
+
+
+def test_converted_params_run_and_respect_weights():
+    rng = np.random.default_rng(1)
+    torch_sd = _tiny_reference_state_dict(rng)
+    sd = {k: v.numpy() for k, v in torch_sd.items()}
+    params = convert_matching_net(sd, backbone="sam")
+    model = _tiny_model()
+
+    img = jnp.asarray(rng.standard_normal((1, 64, 64, 3)), jnp.float32)
+    ex = jnp.array([[[0.3, 0.3, 0.6, 0.6]]], jnp.float32)
+    out = model.apply({"params": params}, img, ex)
+    assert np.all(np.isfinite(np.asarray(out["objectness"][0])))
+
+    # spot-check weight placement: the patch embed conv kernel must be the
+    # torch OIHW weight transposed to HWIO
+    k = np.asarray(params["backbone"]["patch_embed"]["kernel"])
+    np.testing.assert_allclose(
+        k,
+        torch_sd["model.encoder.backbone.backbone.patch_embed.proj.weight"]
+        .numpy().transpose(2, 3, 1, 0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["matcher"]["scale"]),
+        torch_sd["model.matcher.scale"].numpy(),
+    )
+    # square Linear weight: the (out, in) -> (in, out) transpose must be
+    # applied (a missing transpose would be shape-invisible here)
+    np.testing.assert_allclose(
+        np.asarray(params["backbone"]["blocks_0"]["attn"]["proj"]["kernel"]),
+        torch_sd["model.encoder.backbone.backbone.blocks.0.attn.proj.weight"]
+        .numpy().T,
+    )
