@@ -93,6 +93,9 @@ def config_parser(argv=None):
     p.add_argument("--profile_dir", default=None, type=str,
                    help="capture an XLA profiler trace of the first epoch "
                         "into this directory (TensorBoard/xprof)")
+    p.add_argument("--remat_backbone", action="store_true",
+                   help="gradient-checkpoint the ViT blocks (activation "
+                        "memory ~1/depth for one extra forward)")
 
     args = p.parse_args(argv)
     return args

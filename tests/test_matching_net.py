@@ -105,3 +105,17 @@ def test_select_capacity_bucket():
     assert select_capacity_bucket([0.1, 0.1, 0.4, 0.4], 64, 64, buckets) == 33
     # oversized exemplar -> clamped to largest
     assert select_capacity_bucket([0.0, 0.0, 1.0, 1.0], 64, 64, buckets) == 33
+
+
+def test_backbone_flag_validation():
+    """resnet + seq-mesh or remat must fail fast; sam accepts both."""
+    import pytest
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.models import build_backbone
+
+    with pytest.raises(ValueError, match="remat"):
+        build_backbone(Config(backbone="resnet50_layer1",
+                              remat_backbone=True))
+    bb = build_backbone(Config(backbone="sam_vit_b", remat_backbone=True))
+    assert bb.remat is True

@@ -165,3 +165,40 @@ def test_forward_interm_returns_per_block_embeddings():
     assert len(interm) == 3
     for emb in interm:
         assert emb.shape == (1, 2, 2, 16)
+
+
+def test_remat_blocks_preserve_values_and_grads():
+    """remat=True must be numerically identical fwd+bwd (it only changes
+    what is stored vs recomputed)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmr_tpu.models.vit import SamViT
+
+    tiny = dict(embed_dim=16, depth=2, num_heads=2, global_attn_indexes=(1,),
+                window_size=2, out_chans=8, pretrain_img_size=32)
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 32, 32, 3)), jnp.float32
+    )
+    plain = SamViT(**tiny)
+    remat = SamViT(**tiny, remat=True)
+    params = plain.init(jax.random.key(0), x)["params"]
+
+    np.testing.assert_allclose(
+        np.asarray(plain.apply({"params": params}, x)),
+        np.asarray(remat.apply({"params": params}, x)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+    def loss(model, p):
+        return (model.apply({"params": p}, x) ** 2).mean()
+
+    g1 = jax.grad(lambda p: loss(plain, p))(params)
+    g2 = jax.grad(lambda p: loss(remat, p))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        g1, g2,
+    )

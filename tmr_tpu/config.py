@@ -92,6 +92,10 @@ class Config:
     # when set, the train loop captures an XLA profiler trace of the first
     # epoch into this directory (view with TensorBoard/xprof).
     profile_dir: Optional[str] = None
+    # rematerialize ViT blocks on backward (jax.checkpoint): activation
+    # memory ~1/depth at the cost of one extra forward — enables larger
+    # train batches / the 1536 bucket on small-HBM chips.
+    remat_backbone: bool = False
     # mesh axes: (data, model). Products must equal device count.
     mesh_shape: Tuple[int, int] = (1, 1)
     max_gt_boxes: int = 800  # padding capacity for GT boxes per image

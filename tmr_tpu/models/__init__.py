@@ -23,16 +23,24 @@ def build_backbone(cfg, mesh=None):
     seq_mesh = None
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
         seq_mesh = mesh
+    remat = cfg.remat_backbone
     name = cfg.backbone
     if name == "sam" or name == "sam_vit_h":
-        return build_sam_vit("vit_h", dtype=dtype, seq_mesh=seq_mesh)
+        return build_sam_vit("vit_h", dtype=dtype, seq_mesh=seq_mesh,
+                             remat=remat)
     if name == "sam_vit_b":
-        return build_sam_vit("vit_b", dtype=dtype, seq_mesh=seq_mesh)
+        return build_sam_vit("vit_b", dtype=dtype, seq_mesh=seq_mesh,
+                             remat=remat)
     if name in RESNET_VARIANTS:
         if seq_mesh is not None:
             raise ValueError(
                 "sequence parallelism ('seq' mesh axis > 1) only applies to "
                 "SAM-ViT backbones; resnet has no global attention to shard"
+            )
+        if remat:
+            raise ValueError(
+                "--remat_backbone applies to SAM-ViT backbones only; the "
+                "resnet variants have no block rematerialization"
             )
         return build_resnet(name, dilation=cfg.dilation)
     raise KeyError(f"unknown backbone {name!r}")

@@ -242,6 +242,10 @@ class SamViT(nn.Module):
     # global-attention block into a ring-attention shard_map island
     seq_mesh: Optional[object] = None
     batch_axis: Optional[str] = "data"
+    # rematerialize each transformer block on the backward pass
+    # (jax.checkpoint): trades ~1 extra forward of FLOPs for activation
+    # memory, the standard lever for bigger batches / longer token grids
+    remat: bool = False
 
     @nn.compact
     def __call__(
@@ -273,9 +277,10 @@ class SamViT(nn.Module):
         x = x + pos_embed.astype(x.dtype)
 
         interm = []
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.depth):
             win = 0 if i in self.global_attn_indexes else self.window_size
-            x = Block(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 window_size=win,
@@ -317,6 +322,8 @@ VIT_CONFIGS = {
 
 
 def build_sam_vit(
-    model_type: str = "vit_h", dtype=jnp.float32, seq_mesh=None
+    model_type: str = "vit_h", dtype=jnp.float32, seq_mesh=None,
+    remat: bool = False,
 ) -> SamViT:
-    return SamViT(dtype=dtype, seq_mesh=seq_mesh, **VIT_CONFIGS[model_type])
+    return SamViT(dtype=dtype, seq_mesh=seq_mesh, remat=remat,
+                  **VIT_CONFIGS[model_type])
