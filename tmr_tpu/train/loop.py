@@ -199,15 +199,27 @@ class Trainer:
             with trace(profile):
                 it = iter(train)
                 try:
+                    # one-batch device prefetch: the NEXT batch's host decode
+                    # + H2D transfer run while the CURRENT step computes on
+                    # device (jit dispatch is async; the loss float() below
+                    # is the only sync point)
+                    with timers.phase("data"):
+                        nxt = next(it, None)
+                        nxt = self._to_device(nxt) if nxt is not None else None
                     for i in range(steps):
-                        with timers.phase("data"):
-                            batch = next(it, None)
-                            if batch is None:
-                                break
-                            batch = self._to_device(batch)
+                        if nxt is None:
+                            break
+                        batch = nxt
                         with timers.phase("step"), step_annotation("train", i):
                             self.state, losses = self._train_step(
                                 self.state, batch
+                            )
+                        with timers.phase("data"):
+                            # no dead fetch past the epoch's last step
+                            nxt = next(it, None) if i + 1 < steps else None
+                            nxt = (
+                                self._to_device(nxt)
+                                if nxt is not None else None
                             )
                         with timers.phase("metrics"):
                             # float() blocks on the device step — 'metrics'
