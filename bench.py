@@ -37,6 +37,9 @@ def main() -> None:
 
     from tmr_tpu.config import preset
     from tmr_tpu.inference import Predictor
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     cfg = preset(
         "TMR_FSCD147",

@@ -197,6 +197,10 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", "cpu")
 
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     engine = DemoEngine(demo_config(args))
     if args.ckpt:
         engine.load_checkpoint(args.ckpt)

@@ -139,6 +139,9 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
     run_extraction_and_analyze(
         args.image, args.output_dir, args.backbone, args.checkpoint,
         args.artifact, image_size=args.image_size,

@@ -118,6 +118,10 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", "cpu")
 
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     # seed_everything (reference main.py:86)
     random.seed(args.seed)
     np.random.seed(args.seed)

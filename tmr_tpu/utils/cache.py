@@ -1,0 +1,28 @@
+"""Persistent XLA compilation cache.
+
+First compiles of the ViT-H/B programs cost tens of seconds to minutes;
+the jax persistent cache makes every later process on the same machine
+reuse them. Enabled by the CLIs (main.py, bench.py, demo.py,
+extract_feature.py) — library code never mutates global jax config.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "tmr_tpu", "xla"
+)
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Turn on the persistent compilation cache (idempotent)."""
+    import jax
+
+    path = path or os.environ.get("TMR_COMPILATION_CACHE", DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every program regardless of size/compile time
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return path
