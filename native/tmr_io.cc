@@ -46,12 +46,22 @@ struct Queue {
   std::deque<Item> items;
   size_t cap;
   int producers_left;  // when 0 and empty -> end of stream
+  bool stopped = false;  // early close: producers must not block (guarded by mu)
 
   explicit Queue(size_t cap_, int producers) : cap(cap_), producers_left(producers) {}
 
   void push(Item it) {
     std::unique_lock<std::mutex> lk(mu);
-    not_full.wait(lk, [&] { return items.size() < cap; });
+    // early-close safety: with n_threads > cap, every worker can be parked
+    // in this wait with no consumer left — shutdown() must wake them, and a
+    // post-shutdown push drops its item instead of enqueueing
+    not_full.wait(lk, [&] { return items.size() < cap || stopped; });
+    if (stopped) {
+      lk.unlock();
+      free(it.name);
+      free(it.data);
+      return;
+    }
     items.push_back(it);
     not_empty.notify_one();
   }
@@ -59,12 +69,20 @@ struct Queue {
   // 1 = got item, 0 = stream finished
   int pop(Item* out) {
     std::unique_lock<std::mutex> lk(mu);
-    not_empty.wait(lk, [&] { return !items.empty() || producers_left == 0; });
+    not_empty.wait(lk,
+                   [&] { return !items.empty() || producers_left == 0 || stopped; });
     if (items.empty()) return 0;
     *out = items.front();
     items.pop_front();
     not_full.notify_one();
     return 1;
+  }
+
+  void shutdown() {  // wake all waiters for early close
+    std::unique_lock<std::mutex> lk(mu);
+    stopped = true;
+    not_full.notify_all();
+    not_empty.notify_all();
   }
 
   void producer_done() {
@@ -220,6 +238,7 @@ struct Stream {
 
   ~Stream() {
     stop.store(true);
+    queue.shutdown();  // unblock any worker parked in push()
     queue.drain();
     for (auto& w : workers)
       if (w.joinable()) w.join();

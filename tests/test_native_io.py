@@ -81,6 +81,20 @@ def test_stream_early_close_no_hang(tmp_path):
     stream.close()  # workers blocked on the full queue must unblock
 
 
+def test_stream_early_close_threads_exceed_cap(tmp_path):
+    """n_threads > queue_cap, close without consuming anything: every worker
+    can be parked in Queue::push with no consumer draining — shutdown() must
+    wake them or ~Stream's join() hangs forever (ADVICE r1 finding)."""
+    paths = []
+    for s in range(8):
+        files = [(f"s{s}_f{i}.png", b"z" * 4000) for i in range(20)]
+        paths.append(_make_tar(str(tmp_path), f"shard_{s}.tar", files))
+    for _ in range(3):  # a few rounds to catch the race, not just one lucky run
+        stream = native_io.NativeTarStream(paths, threads=8, queue_cap=2)
+        iter(stream)
+        stream.close()  # must return promptly, not deadlock in join()
+
+
 def test_native_run_stream_parity(tmp_path):
     """run_stream_native produces the same stat table and feature dumps as
     the Python run_stream."""

@@ -122,6 +122,24 @@ def test_mask_geometry_unpads_before_resize(tiny_sam):
     assert mask.mean() > 0.95  # whole real image positive, no padding bands
 
 
+def test_to_original_rounding_matches_preprocess(tiny_sam):
+    """Regression (ADVICE r1): _to_original must use the same half-up
+    rounding as sam_longest_side_preprocess. h=85 at scale 64/128 gives
+    42.5 real rows: half-up keeps 43, int(round()) banker's-rounds to 42 and
+    crops the last real row. A mask positive ONLY on that last row must
+    survive to the original-resolution output."""
+    pred = SamPredictor(tiny_sam)
+    h, w = 85, 128  # scale = 64/128 = 0.5 -> h*scale = 42.5 exactly
+    pred.set_image(np.zeros((h, w, 3), np.uint8))
+    s = tiny_sam.image_size
+    sh = int(h * pred.scale + 0.5)  # 43, matching the preprocess resize
+    logits = np.full((s, s), -5.0, np.float32)
+    logits[sh - 1, :] = 5.0  # only the last real row is positive
+    mask = pred._to_original(logits)
+    assert mask.shape == (h, w)
+    assert mask.any(), "last real row was cropped away by rounding mismatch"
+
+
 def test_auto_mask_generator_strict_thresholds_empty(tiny_sam):
     amg = SamAutomaticMaskGenerator(
         tiny_sam, points_per_side=2, points_per_batch=4,

@@ -142,9 +142,10 @@ def test_vit_bf16_close_to_f32():
     assert float(err.max()) / float(scale) < 0.1
 
 
-def test_forward_interm_returns_per_block_embeddings():
+def test_forward_interm_returns_global_block_embeddings():
     """return_interm matches the reference's forward_interm (sam.py:97-113):
-    final features plus every block's token embeddings."""
+    final features plus ONLY the global-attention blocks' token embeddings
+    (the reference appends iff blk.window_size == 0)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -162,9 +163,36 @@ def test_forward_interm_returns_per_block_embeddings():
     plain = model.apply({"params": params}, x)
     np.testing.assert_allclose(np.asarray(final), np.asarray(plain),
                                rtol=1e-6)
-    assert len(interm) == 3
+    assert len(interm) == len(tiny["global_attn_indexes"])
     for emb in interm:
         assert emb.shape == (1, 2, 2, 16)
+
+
+def test_forward_interm_golden_vs_reference():
+    """interm embeddings match the reference forward_interm on shared weights
+    (sam.py:97-113: appends x after blocks with window_size == 0)."""
+    import torch
+
+    ref, mine, params = _build_pair(seed=3)
+    x = np.random.default_rng(3).standard_normal((1, 3, 32, 32)).astype(np.float32)
+
+    with torch.no_grad():
+        h = ref.patch_embed(torch.from_numpy(x))
+        h = h + ref.pos_embed
+        want = []
+        for blk in ref.blocks:
+            h = blk(h)
+            if blk.window_size == 0:
+                want.append(h.numpy())
+
+    _, interm = mine.apply(
+        {"params": params}, jnp.array(x.transpose(0, 2, 3, 1)), return_interm=True
+    )
+    assert len(interm) == len(want) == len(TINY["global_attn_indexes"])
+    for got, ref_emb in zip(interm, want):
+        np.testing.assert_allclose(
+            np.asarray(got), ref_emb, rtol=2e-4, atol=2e-5
+        )
 
 
 def test_remat_blocks_preserve_values_and_grads():

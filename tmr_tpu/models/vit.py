@@ -290,7 +290,9 @@ class SamViT(nn.Module):
                 batch_axis=self.batch_axis,
                 name=f"blocks_{i}",
             )(x)
-            if return_interm:
+            # the reference's forward_interm (sam.py:97-113) collects only the
+            # global-attention blocks' embeddings, not every block
+            if return_interm and win == 0:
                 interm.append(x)
 
         # neck: 1x1 conv -> LN2d -> 3x3 conv -> LN2d (sam_ViT.py:88-104)

@@ -96,6 +96,42 @@ def nms_keep_mask_pallas(
     order = jnp.argsort(-sort_scores)
     b = boxes[order].astype(jnp.float32)
     v = valid[order].astype(jnp.int32)
+    # pad rows to a lane multiple (128): VMEM vectors with ragged trailing
+    # sizes are a classic Mosaic failure mode; padded slots are valid=0 so
+    # they neither suppress nor survive
+    pad = (-n) % 128
+    if pad:
+        b = jnp.pad(b, ((0, pad), (0, 0)))
+        v = jnp.pad(v, (0, pad))
     thr = jnp.asarray([iou_threshold], jnp.float32)
-    keep_sorted = _run_nms_kernel(b, v, thr, interpret=interpret) > 0
+    keep_sorted = _run_nms_kernel(b, v, thr, interpret=interpret)[:n] > 0
     return jnp.zeros((n,), bool).at[order].set(keep_sorted)
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_nms_compiled_ok() -> bool:
+    """One-time self-check of the *compiled* kernel on this backend.
+
+    Runs a small randomized case (N deliberately not a lane multiple) through
+    the compiled Pallas kernel and the XLA fixpoint (ops/nms.py) and compares
+    keep decisions. Any exception (Mosaic lowering, VMEM indexing) or any
+    mismatch returns False so callers can fall back to the XLA path instead
+    of crashing — or silently mis-suppressing — the default TPU eval path.
+    """
+    import numpy as np
+
+    from tmr_tpu.ops.nms import nms_keep_mask
+
+    try:
+        rng = np.random.default_rng(0)
+        n = 150  # not a multiple of 128 -> exercises the padding path
+        xy = rng.uniform(0.0, 0.8, (n, 2)).astype(np.float32)
+        wh = rng.uniform(0.05, 0.3, (n, 2)).astype(np.float32)
+        boxes = jnp.asarray(np.concatenate([xy, xy + wh], -1))
+        scores = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+        valid = jnp.asarray(rng.uniform(size=n) > 0.2)
+        got = nms_keep_mask_pallas(boxes, scores, 0.5, valid, interpret=False)
+        want = nms_keep_mask(boxes, scores, 0.5, valid)
+        return bool(jnp.array_equal(got, want))
+    except Exception:
+        return False

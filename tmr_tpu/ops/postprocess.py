@@ -102,11 +102,18 @@ def batched_nms(dets: dict, iou_threshold: float, backend: str = "auto") -> dict
     """Apply greedy NMS per image over the fixed candidate slots
     (reference utils/TM_utils.py:307-323).
 
-    backend: 'auto' picks the Pallas sequential-greedy kernel on TPU and the
-    pure-XLA fixpoint elsewhere; 'pallas'/'xla' force. Both are exact greedy
-    NMS with identical keep decisions (tests/test_pallas_ops.py)."""
+    backend: 'auto' picks the Pallas sequential-greedy kernel on TPU — after
+    a one-time compiled self-check against the XLA fixpoint, falling back to
+    'xla' if the kernel fails to lower or disagrees — and the pure-XLA
+    fixpoint elsewhere; 'pallas'/'xla' force. Both are exact greedy NMS with
+    identical keep decisions (tests/test_pallas_ops.py)."""
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if jax.default_backend() == "tpu":
+            from tmr_tpu.ops.pallas_nms import pallas_nms_compiled_ok
+
+            backend = "pallas" if pallas_nms_compiled_ok() else "xla"
+        else:
+            backend = "xla"
     if backend == "pallas":
         from tmr_tpu.ops.pallas_nms import nms_keep_mask_pallas
 

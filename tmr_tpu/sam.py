@@ -212,7 +212,9 @@ class SamPredictor:
         import cv2
 
         h, w = self.orig_hw
-        sh, sw = int(round(h * self.scale)), int(round(w * self.scale))
+        # same half-up rounding as sam_longest_side_preprocess — int(round())
+        # banker's-rounds and crops one pixel short when h*scale lands on .5
+        sh, sw = int(h * self.scale + 0.5), int(w * self.scale + 0.5)
         crop = mask_logits[:sh, :sw]
         full = cv2.resize(crop, (w, h), interpolation=cv2.INTER_LINEAR)
         return full > 0
