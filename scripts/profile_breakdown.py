@@ -1,0 +1,151 @@
+"""Per-component timing breakdown of the flagship inference program.
+
+Times each stage of the fused FSCD-147 eval program (SAM ViT-B @ 1024,
+feature upsample, 512-d matcher, decoders, peak decode + NMS) in isolation
+on the current default device, so perf work has a measured target instead of
+guesses. Run on the real TPU:
+
+    python scripts/profile_breakdown.py
+
+Prints a JSON breakdown {stage: seconds_per_batch}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmr_tpu.config import preset
+from tmr_tpu.models import build_model
+from tmr_tpu.utils.cache import enable_compilation_cache
+
+BATCH = 4
+SIZE = 1024
+ITERS = 5
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main():
+    enable_compilation_cache()
+    cfg = preset(
+        "TMR_FSCD147",
+        backbone="sam_vit_b",
+        image_size=SIZE,
+        compute_dtype="bfloat16",
+        batch_size=BATCH,
+    )
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+    image = jnp.asarray(
+        rng.standard_normal((BATCH, SIZE, SIZE, 3)), jnp.float32
+    )
+    exemplars = jnp.tile(
+        jnp.array([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
+    )
+    params = jax.jit(model.init)(jax.random.key(0), image, exemplars)["params"]
+
+    report = {}
+
+    # 1. full model forward
+    fwd = jax.jit(lambda p, im, ex: model.apply({"params": p}, im, ex))
+    report["full_forward"] = timeit(fwd, params, image, exemplars)
+
+    # 2. backbone only
+    bb = model.backbone
+    bb_params = params["backbone"]
+    bb_fwd = jax.jit(lambda p, im: bb.apply({"params": p}, im))
+    report["backbone"] = timeit(bb_fwd, bb_params, image)
+    feat = bb_fwd(bb_params, image)
+
+    # 3. single global-attention block vs windowed block (isolated)
+    from tmr_tpu.models.vit import Block
+
+    tokens = jnp.asarray(
+        rng.standard_normal((BATCH, 64, 64, 768)), jnp.bfloat16
+    )
+    gblk = Block(num_heads=12, window_size=0, rel_pos_size=(64, 64),
+                 dtype=jnp.bfloat16)
+    gp = jax.jit(gblk.init)(jax.random.key(1), tokens)["params"]
+    g_fwd = jax.jit(lambda p, x: gblk.apply({"params": p}, x))
+    report["one_global_block"] = timeit(g_fwd, gp, tokens)
+
+    wblk = Block(num_heads=12, window_size=14, rel_pos_size=(64, 64),
+                 dtype=jnp.bfloat16)
+    wp = jax.jit(wblk.init)(jax.random.key(1), tokens)["params"]
+    w_fwd = jax.jit(lambda p, x: wblk.apply({"params": p}, x))
+    report["one_windowed_block"] = timeit(w_fwd, wp, tokens)
+
+    # 4. feature upsample + input_proj + matcher (xcorr) on 128^2 @ 512
+    from tmr_tpu.ops.xcorr import match_templates
+
+    up = jax.image.resize(feat, (BATCH, 128, 128, 256), method="bilinear")
+    proj = jnp.asarray(
+        rng.standard_normal((BATCH, 128, 128, 512)), jnp.float32
+    )
+    xc = jax.jit(
+        lambda f, e: match_templates(
+            f.transpose(0, 3, 1, 2), e[:, 0, :], capacity=17
+        )
+    )
+    report["xcorr_cap17"] = timeit(xc, proj, exemplars)
+    xc65 = jax.jit(
+        lambda f, e: match_templates(
+            f.transpose(0, 3, 1, 2), e[:, 0, :], capacity=65
+        )
+    )
+    report["xcorr_cap65"] = timeit(xc65, proj, exemplars)
+
+    # 5. decoder convs + heads on fused input (1024ch with fusion)
+    from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
+
+    f_cat = jnp.asarray(
+        rng.standard_normal((BATCH, 128, 128, 1024)), jnp.bfloat16
+    )
+    dec = Decoder(num_layers=1, kernel_size=3, dtype=jnp.bfloat16)
+    dp = jax.jit(dec.init)(jax.random.key(2), f_cat)["params"]
+    d_fwd = jax.jit(lambda p, x: dec.apply({"params": p}, x))
+    report["one_decoder_stack"] = timeit(d_fwd, dp, f_cat)
+
+    # 6. decode + NMS
+    from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+
+    obj = jnp.asarray(rng.standard_normal((BATCH, 128, 128)), jnp.float32)
+    regs = jnp.asarray(
+        rng.standard_normal((BATCH, 128, 128, 4)), jnp.float32
+    )
+
+    def post(o, r, ex):
+        dets = decode_detections(
+            [o], [r], ex[:, 0, :],
+            cls_threshold=cfg.NMS_cls_threshold,
+            max_detections=cfg.max_detections,
+            box_reg=cfg.box_reg,
+            scale_imgsize=cfg.regression_scaling_imgsize,
+            scale_wh_only=cfg.regression_scaling_WH_only,
+        )
+        return batched_nms(dets, cfg.NMS_iou_threshold)
+
+    post_fn = jax.jit(post)
+    report["decode_nms"] = timeit(post_fn, obj, regs, exemplars)
+
+    report = {k: round(v, 5) for k, v in report.items()}
+    report["batch"] = BATCH
+    report["device"] = str(jax.devices()[0])
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Independent brute-force implementation of single-category COCOeval.
+
+pycocotools cannot be installed in this image (VERDICT r2 #9 wanted a
+pycocotools cross-check), so this is the strongest substitute available: a
+second, from-the-spec implementation of the COCOeval algorithm written with
+deliberately different structure from tmr_tpu/utils/coco_eval.py — scalar
+loops everywhere, no shared helpers, per-(threshold, area, maxdet) full
+recomputation, explicit suffix-max precision envelope — so a bug in either
+implementation shows up as disagreement on randomized inputs.
+
+Semantics implemented (the published COCOeval procedure for iscrowd=0,
+single category):
+- per image: detections sorted by score (descending, stable), truncated to
+  maxDet; GTs ordered with ignored (area outside range) last;
+- per IoU threshold: greedy in detection order — each det takes the
+  still-unmatched GT with the highest IoU >= threshold, never trading a
+  non-ignored match for an ignored one;
+- a det matched to an ignored GT is ignored; an unmatched det with area
+  outside the range is ignored;
+- accumulate: concatenate dets over images (image order), stable sort by
+  -score, cumulate TP/FP excluding ignored, recall = TP/#(non-ignored GT),
+  precision envelope made non-increasing, sampled at 101 recall points.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IOU_THRS = [0.5 + 0.05 * i for i in range(10)]
+REC_THRS = [i / 100.0 for i in range(101)]
+AREAS = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 1024.0),
+    "medium": (1024.0, 9216.0),
+    "large": (9216.0, 1e10),
+}
+
+
+def _iou(d, g):
+    dx1, dy1, dw, dh = d
+    gx1, gy1, gw, gh = g
+    ix = min(dx1 + dw, gx1 + gw) - max(dx1, gx1)
+    iy = min(dy1 + dh, gy1 + gh) - max(dy1, gy1)
+    if ix <= 0 or iy <= 0:
+        return 0.0
+    inter = ix * iy
+    union = dw * dh + gw * gh - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _match_image(gts, preds, area, max_det, iou_thr):
+    """-> (scores, is_tp, is_ignored, n_gt) for one image at one setting."""
+    lo, hi = AREAS[area]
+    g_all = [(g["bbox"], not (lo <= g.get("area", g["bbox"][2] * g["bbox"][3]) <= hi))
+             for g in gts]
+    # ignored GTs last, original order otherwise
+    g_sorted = [g for g in g_all if not g[1]] + [g for g in g_all if g[1]]
+
+    order = sorted(range(len(preds)), key=lambda i: (-preds[i]["score"], i))
+    order = order[:max_det]
+    dets = [(preds[i]["bbox"], preds[i]["score"]) for i in order]
+
+    gt_taken = [False] * len(g_sorted)
+    scores, is_tp, is_ign = [], [], []
+    for box, score in dets:
+        best_iou = iou_thr
+        best_g = -1
+        for gi, (gbox, gig) in enumerate(g_sorted):
+            if gt_taken[gi]:
+                continue
+            if best_g >= 0 and not g_sorted[best_g][1] and gig:
+                break  # have a real match; only ignored GTs remain
+            iou = _iou(box, gbox)
+            if iou >= best_iou:
+                best_iou = iou
+                best_g = gi
+        matched = best_g >= 0
+        if matched:
+            gt_taken[best_g] = True
+        ignored = (matched and g_sorted[best_g][1]) or (
+            not matched and not (lo <= box[2] * box[3] <= hi)
+        )
+        scores.append(score)
+        is_tp.append(matched and not ignored)
+        is_ign.append(ignored)
+    n_gt = sum(1 for _, gig in g_sorted if not gig)
+    return scores, is_tp, is_ign, n_gt
+
+
+def _pr_curve(img_results):
+    """Merge per-image matches -> (ap, final_recall)."""
+    scores, tps, igns = [], [], []
+    n_gt = 0
+    for s, t, ig, n in img_results:
+        scores += s
+        tps += t
+        igns += ig
+        n_gt += n
+    if n_gt == 0:
+        return None, None
+    order = np.argsort(-np.array(scores), kind="mergesort")
+    tp = fp = 0
+    rc, pr = [], []
+    for i in order:
+        if igns[i]:
+            continue
+        if tps[i]:
+            tp += 1
+        else:
+            fp += 1
+        rc.append(tp / n_gt)
+        pr.append(tp / (tp + fp + np.spacing(1)))
+    # envelope: precision at recall r = max precision at any recall >= r
+    for i in range(len(pr) - 2, -1, -1):
+        pr[i] = max(pr[i], pr[i + 1])
+    q = []
+    for r in REC_THRS:
+        # first index with recall >= r
+        pi = next((i for i, rv in enumerate(rc) if rv >= r), None)
+        q.append(pr[pi] if pi is not None else 0.0)
+    ap = float(np.mean(q))
+    final_rc = rc[-1] if rc else 0.0
+    return ap, final_rc
+
+
+def evaluate(gts, preds, max_dets=(900, 1000, 1100)):
+    """gts/preds: {img_id: [dict]}. Returns the 12-entry stats vector in
+    COCOevalMaxDets._summarizeDets order."""
+    img_ids = sorted(set(gts) | set(preds), key=str)
+
+    def setting(area, max_det, thr_filter):
+        aps, rcs = [], []
+        for t in IOU_THRS:
+            if thr_filter is not None and abs(t - thr_filter) > 1e-9:
+                continue
+            results = []
+            for i in img_ids:
+                g = gts.get(i, [])
+                p = preds.get(i, [])
+                if not g and not p:
+                    continue
+                results.append(_match_image(g, p, area, max_det, t))
+            ap, rc = _pr_curve(results)
+            if ap is not None:
+                aps.append(ap)
+                rcs.append(rc)
+        mean = lambda xs: float(np.mean(xs)) if xs else -1.0
+        return mean(aps), mean(rcs)
+
+    md = list(max_dets)
+    stats = [
+        setting("all", md[2] if len(md) > 2 else md[-1], None)[0],
+        setting("all", md[-1], 0.5)[0],
+        setting("all", md[-1], 0.75)[0],
+        setting("small", md[-1], None)[0],
+        setting("medium", md[-1], None)[0],
+        setting("large", md[-1], None)[0],
+        setting("all", md[0], None)[1],
+        setting("all", md[min(1, len(md) - 1)], None)[1],
+        setting("all", md[-1], None)[1],
+        setting("small", md[-1], None)[1],
+        setting("medium", md[-1], None)[1],
+        setting("large", md[-1], None)[1],
+    ]
+    return np.array(stats)

@@ -172,3 +172,79 @@ def test_metrics_pipeline_end_to_end(tmp_path):
     ap, ap50, ap75 = get_ap_scores(log_path, "test")
     assert 0 < ap50 <= 100
     assert ap50 >= ap  # AP50 is the loosest threshold
+
+
+# ------------------------------------------------- independent-oracle check
+def _random_case(rng, n_imgs, max_preds, tie_quant=None, big_boxes=False):
+    gts, preds = {}, {}
+    for i in range(n_imgs):
+        ng = int(rng.integers(0, 12))
+        npred = int(rng.integers(0, max_preds))
+        scale = 300.0 if big_boxes else 60.0
+        g = []
+        for _ in range(ng):
+            x, y = rng.uniform(0, 900, 2)
+            w, h = rng.uniform(2, scale, 2)
+            g.append({"bbox": [x, y, w, h]})
+        p = []
+        for _ in range(npred):
+            if g and rng.random() < 0.6:  # perturb a GT -> realistic TPs
+                b = g[int(rng.integers(0, ng))]["bbox"]
+                jit = rng.uniform(-6, 6, 4)
+                bbox = [b[0] + jit[0], b[1] + jit[1],
+                        max(1.0, b[2] + jit[2]), max(1.0, b[3] + jit[3])]
+            else:
+                x, y = rng.uniform(0, 900, 2)
+                w, h = rng.uniform(2, scale, 2)
+                bbox = [x, y, w, h]
+            s = float(rng.uniform(0, 1))
+            if tie_quant:
+                s = round(s * tie_quant) / tie_quant  # force score ties
+            p.append({"bbox": bbox, "score": s})
+        if ng or npred:
+            gts[i], preds[i] = g, p
+    return gts, preds
+
+
+def test_cross_check_vs_independent_bruteforce_oracle():
+    """pycocotools is not installable here (VERDICT r2 #9), so cross-check
+    against a second from-the-spec implementation written with a different
+    structure (tests/oracle_cocoeval.py): randomized multi-image cases with
+    score ties and mixed object areas must agree to float precision on the
+    full 12-entry stats vector."""
+    import oracle_cocoeval
+
+    rng = np.random.default_rng(7)
+    for case in range(6):
+        gts, preds = _random_case(
+            rng, n_imgs=4, max_preds=40,
+            tie_quant=8 if case % 2 else None, big_boxes=case >= 3,
+        )
+        got = COCOEvalLite(gts, preds, max_dets=(5, 10, 20)).run().stats
+        want = oracle_cocoeval.evaluate(gts, preds, max_dets=(5, 10, 20))
+        np.testing.assert_allclose(got, want, atol=1e-9,
+                                   err_msg=f"case {case}")
+
+
+def test_cross_check_beyond_max_dets_and_ties():
+    """> maxDets detections in one image (the reference's 1100 ceiling,
+    log_utils.py:193) with heavy score ties: truncation must happen after
+    the stable score sort, identically in both implementations."""
+    import oracle_cocoeval
+
+    rng = np.random.default_rng(11)
+    gts, preds = _random_case(rng, n_imgs=2, max_preds=2, tie_quant=4)
+    # one dense image: 150 predictions, quantized scores, 30 gts
+    g = [{"bbox": [10.0 * k, 10.0 * k, 8.0, 8.0]} for k in range(30)]
+    p = []
+    for k in range(150):
+        b = g[k % 30]["bbox"]
+        p.append({
+            "bbox": [b[0] + (k % 7) - 3, b[1], 8.0, 8.0],
+            "score": round(rng.uniform(0, 1) * 4) / 4,
+        })
+    gts[99], preds[99] = g, p
+    for md in [(40, 80, 120), (100,), (120, 160)]:
+        got = COCOEvalLite(gts, preds, max_dets=md).run().stats
+        want = oracle_cocoeval.evaluate(gts, preds, max_dets=md)
+        np.testing.assert_allclose(got, want, atol=1e-9, err_msg=str(md))

@@ -103,8 +103,11 @@ def test_select_capacity_bucket():
     assert select_capacity_bucket([0.1, 0.1, 0.12, 0.12], 64, 64, buckets) == 9
     # mid exemplar spanning ~20 cells -> 33
     assert select_capacity_bucket([0.1, 0.1, 0.4, 0.4], 64, 64, buckets) == 33
-    # oversized exemplar -> clamped to largest
-    assert select_capacity_bucket([0.0, 0.0, 1.0, 1.0], 64, 64, buckets) == 33
+    # oversized exemplar -> loud failure instead of silent coarsening
+    import pytest
+
+    with pytest.raises(ValueError):
+        select_capacity_bucket([0.0, 0.0, 1.0, 1.0], 64, 64, buckets)
 
 
 def test_backbone_flag_validation():

@@ -147,3 +147,33 @@ def test_training_converges_to_perfect_ap(tmp_path):
     last = rows[-1]
     assert float(last["val/AP50"]) > 90.0, last
     assert float(last["val/MAE"]) < 0.5, last
+
+
+def test_fresh_guard_refuses_existing_logpath(tmp_path):
+    """Reference callbacks.py:12-13: a fresh (non-resume, non-eval) training
+    must refuse to start into a logpath that already holds checkpoints."""
+    import pytest
+
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    _write_fixture(root)
+
+    trainer = _make_trainer(root, logdir)
+    trainer.fit()
+    with pytest.raises(FileExistsError):
+        _make_trainer(root, logdir)  # fresh, same logpath -> guarded
+    # resume and eval both still allowed
+    _make_trainer(root, logdir, resume=True)
+
+
+def test_wandb_sink_degrades_gracefully(tmp_path, capsys):
+    """nowandb=False without the wandb package must warn and no-op, not
+    fail (reference main.py:113 defaults to WandbLogger)."""
+    from tmr_tpu.utils.wandb_logger import WandbLogger
+
+    logger = WandbLogger("proj", name="run", config={"a": 1})
+    # this environment has no wandb package -> disabled but safe to use
+    logger.log({"train/loss": 1.0, "epoch": 0}, step=0)
+    logger.finish()
+    assert not logger.enabled

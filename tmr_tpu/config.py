@@ -78,9 +78,12 @@ class Config:
 
     # ---- TPU-native additions (no reference equivalent) ----
     device: str = "tpu"  # BASELINE.json requires a --device tpu flag
-    # static capacity of the template kernel (odd). Templates larger than the
-    # active bucket re-trace at the next bucket; see ops/xcorr.py.
-    template_buckets: Tuple[int, ...] = (9, 17, 33, 65)
+    # Static template-kernel capacities (odd). 127/191 cover exemplars up to
+    # the full upsampled feature grid at 1024/1536 input (128/192 cells), so
+    # no legal exemplar ever clamps (reference roi_align handles any size,
+    # template_matching.py:55-76); capacities > 65 run the FFT correlation
+    # path (ops/xcorr.py) whose cost is independent of template size.
+    template_buckets: Tuple[int, ...] = (9, 17, 33, 65, 127, 191)
     # fixed detection capacity. AP's maxDets tops out at 1100
     # (log_utils.py:193), so 2000 leaves headroom for MAE/RMSE counting on
     # extremely dense images (the reference's post-NMS count is unbounded;

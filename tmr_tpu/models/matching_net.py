@@ -163,4 +163,10 @@ def select_capacity_bucket(exemplar, feat_h: int, feat_w: int, buckets) -> int:
     for b in buckets:
         if b >= need:
             return b
-    return buckets[-1]
+    # With the default buckets (config.py) this is unreachable for any legal
+    # exemplar: 127/191 cover a full-grid span at 1024/1536. Refusing loudly
+    # beats the silent coarsening the in-jit clamp would apply.
+    raise ValueError(
+        f"exemplar needs a {need}-cell template but the largest bucket is "
+        f"{buckets[-1]}; extend cfg.template_buckets"
+    )

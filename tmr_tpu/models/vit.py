@@ -82,6 +82,79 @@ def get_rel_pos(q_size: int, k_size: int, rel_pos: jnp.ndarray) -> jnp.ndarray:
     return rel[rel_coords.astype(np.int64)]
 
 
+def _q_block_rows(h: int, w: int, target_tokens: int = 512) -> int:
+    """Largest divisor of ``h`` whose row-band holds <= target_tokens."""
+    best = 1
+    for rows in range(1, h + 1):
+        if h % rows == 0 and rows * w <= target_tokens:
+            best = rows
+    return best
+
+
+def blockwise_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Attention with decomposed rel-pos bias, scanned over query row-bands.
+
+    q/k/v: (B, H, S, D) with S = h*w tokens on a (h, w) grid; rh: (h, h, D),
+    rw: (w, w, D) get_rel_pos tables (None to skip the bias). Semantics match
+    the reference's dense path (sam_ViT.py:224-240, 325-361): f32 softmax
+    over the full key axis, bias[q=(y,x), k=(ky,kx)] = q.rh[y,ky] + q.rw[x,kx].
+
+    The S x S scores (3.2 GB f32 at ViT's 4096-token grid, batch 4) and the
+    (B, H, h, w, h, w) bias are never materialized: each scan step computes
+    one (rows*w, S) f32 tile, softmaxes it (full key axis present, so the
+    numerics equal dense attention exactly — no online-softmax rescaling),
+    applies it to V, and emits its output band. HBM high-water drops from
+    O(S^2) to O(S * rows * w).
+    """
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    rows = _q_block_rows(gh, gw)
+    nb = gh // rows
+    work = q.dtype
+
+    q_g = q.reshape(B, H, nb, rows, gw, D)
+    q_blocks = jnp.moveaxis(q_g, 2, 0)  # (nb, B, H, rows, gw, D)
+    if rh is not None:
+        rh_blocks = rh.reshape(nb, rows, gh, D)
+    else:
+        rh_blocks = jnp.zeros((nb, 0), q.dtype)  # unused placeholder
+
+    def one_band(args):
+        qb, rhb = args  # (B, H, rows, gw, D), (rows, gh, D)
+        s = jnp.einsum(
+            "bhrwd,bhkd->bhrwk", qb, k,
+            preferred_element_type=jnp.float32,
+        ) * scale  # (B, H, rows, gw, S)
+        if rh is not None:
+            qf = qb.astype(jnp.float32)
+            rel_h = jnp.einsum(
+                "bhrwd,rkd->bhrwk", qf, rhb.astype(jnp.float32)
+            )  # (B, H, rows, gw, gh)
+            rel_w = jnp.einsum(
+                "bhrwd,wkd->bhrwk", qf, rw.astype(jnp.float32)
+            )  # (B, H, rows, gw, gw)
+            s = s.reshape(B, H, rows, gw, gh, gw)
+            s = s + rel_h[..., :, None] + rel_w[..., None, :]
+            s = s.reshape(B, H, rows, gw, S)
+        p = jax.nn.softmax(s, axis=-1)
+        ob = jnp.einsum(
+            "bhrwk,bhkd->bhrwd", p.astype(work), v,
+            preferred_element_type=jnp.float32,
+        )
+        return ob.astype(work)
+
+    out = jax.lax.map(one_band, (q_blocks, rh_blocks))  # (nb, B, H, rows, gw, D)
+    return jnp.moveaxis(out, 0, 2).reshape(B, H, S, D)
+
+
 class Attention(nn.Module):
     """Multi-head attention with decomposed rel-pos (sam_ViT.py:185-240).
 
@@ -132,24 +205,43 @@ class Attention(nn.Module):
                 nn.initializers.zeros,
                 (2 * self.rel_pos_size[1] - 1, head_dim),
             )
-            rh = get_rel_pos(h, h, rel_pos_h).astype(self.dtype)  # (h, h, hd)
-            rw = get_rel_pos(w, w, rel_pos_w).astype(self.dtype)  # (w, w, hd)
+            rh = get_rel_pos(h, h, rel_pos_h)  # (h, h, hd) f32
+            rw = get_rel_pos(w, w, rel_pos_w)  # (w, w, hd) f32
 
         if self.seq_mesh is not None:
             x = self._ring_attn(q, k, v, rh, rw, (b, h, w, dim), head_dim)
+        elif h * w >= 1024:
+            # global-attention blocks (4096+ tokens): never materialize the
+            # S x S scores or the (B, H, h, w, h, w) bias
+            x = blockwise_decomposed_attention(
+                q, k, v,
+                rh if self.use_rel_pos else None,
+                rw if self.use_rel_pos else None,
+                (h, w), scale,
+            )
+            x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         else:
-            attn = jnp.einsum("bnqc,bnkc->bnqk", q * scale, k)
+            attn = jnp.einsum(
+                "bnqc,bnkc->bnqk", q, k, preferred_element_type=jnp.float32
+            ) * scale
             if self.use_rel_pos:
-                r_q = q.reshape(b, self.num_heads, h, w, head_dim)
-                rel_h = jnp.einsum("bnhwc,hkc->bnhwk", r_q, rh)
-                rel_w = jnp.einsum("bnhwc,wkc->bnhwk", r_q, rw)
+                r_q = q.astype(jnp.float32).reshape(
+                    b, self.num_heads, h, w, head_dim
+                )
+                rel_h = jnp.einsum(
+                    "bnhwc,hkc->bnhwk", r_q, rh.astype(jnp.float32)
+                )
+                rel_w = jnp.einsum(
+                    "bnhwc,wkc->bnhwk", r_q, rw.astype(jnp.float32)
+                )
                 attn = attn.reshape(b, self.num_heads, h, w, h, w)
                 attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
                 attn = attn.reshape(b, self.num_heads, h * w, h * w)
-            attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(
-                self.dtype
-            )
-            x = jnp.einsum("bnqk,bnkc->bnqc", attn, v)
+            attn = jax.nn.softmax(attn, axis=-1).astype(self.dtype)
+            x = jnp.einsum(
+                "bnqk,bnkc->bnqc", attn, v,
+                preferred_element_type=jnp.float32,
+            ).astype(self.dtype)
             x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         return nn.Dense(dim, dtype=self.dtype, name="proj")(x)
 

@@ -113,6 +113,47 @@ def extract_prototype(
     return template, jnp.stack([ones, ones])
 
 
+#: capacities above this run the FFT correlation path: a depthwise SAME conv
+#: at T in the 100s costs O(H^2 T^2 C) on the MXU (petaFLOPs at T=191), while
+#: the FFT correlation is O(H'^2 log H' C) regardless of template size.
+FFT_CAPACITY_THRESHOLD = 65
+
+
+def _fft_size(n: int) -> int:
+    """Smallest 2^a * 3^b >= n (sizes XLA's TPU FFT handles efficiently)."""
+    best = 1 << (n - 1).bit_length()
+    for b in (1, 3, 9):
+        m = b
+        while m < n:
+            m *= 2
+        if n <= m < best:
+            best = m
+    return best
+
+
+def _xcorr_fft(feature: jnp.ndarray, template: jnp.ndarray) -> jnp.ndarray:
+    """Exact linear cross-correlation via the correlation theorem.
+
+    feature: (B, C, H, W); template: (B, C, T, T), T odd. Returns the same
+    (B, C, H, W) map the SAME-padded depthwise conv produces: out[y, x] =
+    sum_{i,j} feature[y - T//2 + i, x - T//2 + j] * template[i, j] with
+    zero padding. Zero-padding both signals to L >= H + T - 1 makes the
+    circular correlation equal the linear one; the template's zero capacity
+    ring contributes nothing, so this is bit-compatible (up to f32 FFT
+    rounding ~1e-5 relative) with the direct path for any template size.
+    """
+    B, C, H, W = feature.shape
+    T = template.shape[-1]
+    c = T // 2
+    L = _fft_size(max(H, W) + T - 1)
+    ff = jnp.fft.rfft2(feature.astype(jnp.float32), s=(L, L))
+    ft = jnp.fft.rfft2(template.astype(jnp.float32), s=(L, L))
+    corr = jnp.fft.irfft2(ff * jnp.conj(ft), s=(L, L))
+    ys = (jnp.arange(H) - c) % L
+    xs = (jnp.arange(W) - c) % L
+    return corr[:, :, ys][:, :, :, xs]
+
+
 def cross_correlation(
     feature: jnp.ndarray,
     template: jnp.ndarray,
@@ -126,20 +167,29 @@ def cross_correlation(
     or (B, 1, H, W) when squeeze (channel sum, template_matching.py:34-35).
     Matches template_matching.py:23-41: interior = VALID conv / (ht*wt+1e-14),
     border band of (ht//2, wt//2) zeroed.
+
+    Small capacities (T <= FFT_CAPACITY_THRESHOLD) run one depthwise grouped
+    conv on the MXU; larger ones switch to the FFT path, whose cost is
+    independent of T — this is what makes the 127/191 buckets (exemplars up
+    to the full image at 1024/1536) affordable, where a direct SAME conv
+    would do O(H^2 T^2) work mostly on positions the reference zeroes.
     """
     B, C, H, W = feature.shape
     T = template.shape[-1]
-    lhs = feature.reshape(1, B * C, H, W)
-    rhs = template.reshape(B * C, 1, T, T)
-    out = lax.conv_general_dilated(
-        lhs,
-        rhs,
-        window_strides=(1, 1),
-        padding=[(T // 2, T // 2), (T // 2, T // 2)],
-        feature_group_count=B * C,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        precision=lax.Precision.HIGHEST,
-    ).reshape(B, C, H, W)
+    if T > FFT_CAPACITY_THRESHOLD:
+        out = _xcorr_fft(feature, template)
+    else:
+        lhs = feature.reshape(1, B * C, H, W)
+        rhs = template.reshape(B * C, 1, T, T)
+        out = lax.conv_general_dilated(
+            lhs,
+            rhs,
+            window_strides=(1, 1),
+            padding=[(T // 2, T // 2), (T // 2, T // 2)],
+            feature_group_count=B * C,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=lax.Precision.HIGHEST,
+        ).reshape(B, C, H, W)
 
     ht = template_hw[:, 0]
     wt = template_hw[:, 1]

@@ -12,6 +12,7 @@ and CSV metric logging (the --nowandb path of main.py:113).
 from __future__ import annotations
 
 import csv
+import dataclasses
 import os
 import time
 from typing import Dict, Optional
@@ -75,15 +76,32 @@ class Trainer:
         self.model = build_model(cfg, mesh=mesh)
         self.predictor = Predictor(cfg, model=self.model)
         self.logger = CSVLogger(cfg.logpath)
+        self.wandb = None
+        # process-0 gated like every other host-side sink (the reference's
+        # WandbLogger is rank-0 only under Lightning DDP)
+        if not cfg.nowandb and not cfg.eval and jax.process_index() == 0:
+            from tmr_tpu.utils.wandb_logger import WandbLogger
+
+            self.wandb = WandbLogger(
+                cfg.project_name, name=os.path.basename(cfg.logpath),
+                config=dataclasses.asdict(cfg),
+            )
         self.ckpt = CheckpointManager(
             os.path.join(cfg.logpath, "checkpoints"),
             monitor="val/MAE" if cfg.best_model_count else "val/AP",
             mode="min" if cfg.best_model_count else "max",
             every_n_epochs=cfg.AP_term,
+            # reference callbacks.py:12-13: a fresh (non-resume, non-eval,
+            # single-process) training refuses to clobber an existing logpath
+            fresh_guard=not cfg.resume and not cfg.eval
+            and jax.process_count() == 1,
         )
         self.state = None
         self._train_step = None
         self._eval_loss_fn = None
+        # device-side loss accumulator: one tiny jitted add per step instead
+        # of a host float() sync (which would stall the prefetch pipeline)
+        self._acc_fn = jax.jit(lambda s, l: jax.tree.map(jnp.add, s, l))
 
     # ------------------------------------------------------------ plumbing
     def _loaders(self):
@@ -191,7 +209,7 @@ class Trainer:
         for epoch in range(start_epoch, cfg.max_epochs):
             train.set_epoch(epoch)
             t0 = time.time()
-            sums: Dict[str, float] = {}
+            sums = None  # device-scalar pytree, fetched once per epoch
             n = 0
             timers = PhaseTimer()
             # capture an xprof trace of the first post-resume epoch
@@ -222,16 +240,24 @@ class Trainer:
                                 if nxt is not None else None
                             )
                         with timers.phase("metrics"):
-                            # float() blocks on the device step — 'metrics'
-                            # time is device compute not hidden by 'step'
-                            for k, v in losses.items():
-                                sums[k] = sums.get(k, 0.0) + float(v)
+                            # accumulate ON DEVICE: the step loop has no host
+                            # sync point, so compute overlaps the next batch's
+                            # decode + H2D end to end (VERDICT r2 #7)
+                            sums = (
+                                losses if sums is None
+                                else self._acc_fn(sums, losses)
+                            )
                         n += 1
                 finally:
                     # release the loader's worker pool + prefetch window now,
                     # not whenever the suspended generator gets GC'd
                     it.close()
-            row = {f"train/{k}": v / max(n, 1) for k, v in sums.items()}
+            # single per-epoch device fetch of the loss sums
+            sums_host = (
+                {} if sums is None
+                else {k: float(v) for k, v in jax.device_get(sums).items()}
+            )
+            row = {f"train/{k}": v / max(n, 1) for k, v in sums_host.items()}
             row["epoch"] = epoch
             row["train/sec"] = time.time() - t0
             row.update(timers.as_dict())
@@ -240,23 +266,26 @@ class Trainer:
             if ap_epoch:
                 row.update(self.eval_epoch(val, "val", self.state.params))
             self.logger.log(row)
+            if self.wandb is not None:
+                self.wandb.log(row, step=epoch)
             line = f"Epoch {epoch}: | " + " | ".join(
                 f"{k}: {v:.4f}" for k, v in sorted(row.items()) if k != "epoch"
             )
             print(line)
             self.ckpt.save_epoch(self.state, epoch, row)
         self.ckpt.wait()
+        if self.wandb is not None:
+            self.wandb.finish()
 
     # ----------------------------------------------------------------- eval
     def eval_epoch(self, loader, stage: str, params) -> Dict[str, float]:
         cfg = self.cfg
         self.predictor.params = params
-        sums: Dict[str, float] = {}
+        sums = None  # device-scalar pytree, fetched once per epoch
         n = 0
         for batch in loader:
             losses = self._eval_losses(params, batch)
-            for k, v in losses.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
+            sums = losses if sums is None else self._acc_fn(sums, losses)
             n += 1
 
             if cfg.num_exemplars > 1:
@@ -271,7 +300,11 @@ class Trainer:
                 cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
             )
 
-        metrics = {f"{stage}/{k}": v / max(n, 1) for k, v in sums.items()}
+        sums_host = (
+            {} if sums is None
+            else {k: float(v) for k, v in jax.device_get(sums).items()}
+        )
+        metrics = {f"{stage}/{k}": v / max(n, 1) for k, v in sums_host.items()}
 
         # epoch-end rendezvous (trainer.py:181-199): process 0 merges the
         # per-image JSONs; every process computes the metrics from the files.

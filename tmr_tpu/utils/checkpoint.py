@@ -33,7 +33,10 @@ class CheckpointManager:
         """fresh_guard: refuse to start a fresh run into an existing logpath
         (callbacks.py:12-13 applies this to single-process fresh training)."""
         self.directory = os.path.abspath(directory)
-        if fresh_guard and os.path.isdir(os.path.join(self.directory, "best")):
+        has_prior = os.path.exists(
+            os.path.join(self.directory, "ckpt_meta.json")
+        ) or os.path.isdir(os.path.join(self.directory, "last"))
+        if fresh_guard and has_prior:
             raise FileExistsError(
                 f"logpath {self.directory} already contains checkpoints; "
                 "pass resume=True or choose a fresh logpath"

@@ -1,0 +1,57 @@
+"""Optional wandb metrics sink (reference main.py:113 uses WandbLogger by
+default, CSVLogger with --nowandb).
+
+The CSV logger (train/loop.py) always runs — it is the durable record the
+eval pipeline and tests read. This sink mirrors each epoch row to wandb when
+(a) the user did not pass --nowandb and (b) the ``wandb`` package exists in
+the environment. Import failures degrade to a logged warning, never an
+error: TPU pods are routinely airgapped.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from tmr_tpu.utils.profiling import log_warning
+
+
+class WandbLogger:
+    """Best-effort wandb run. ``enabled`` is False when wandb is missing."""
+
+    def __init__(self, project: str, name: Optional[str] = None,
+                 config: Optional[dict] = None):
+        self._run = None
+        try:
+            import wandb  # noqa: F811 - optional dependency
+        except Exception:
+            log_warning(
+                "wandb requested (nowandb=False) but the package is not "
+                "installed; falling back to CSV-only logging"
+            )
+            return
+        try:
+            self._run = wandb.init(
+                project=project, name=name, config=config or {}
+            )
+        except Exception as e:  # offline/unauthenticated envs
+            log_warning(f"wandb.init failed ({e}); CSV-only logging")
+
+    @property
+    def enabled(self) -> bool:
+        return self._run is not None
+
+    def log(self, row: Dict[str, float], step: Optional[int] = None) -> None:
+        if self._run is None:
+            return
+        try:
+            metrics = {k: v for k, v in row.items() if k != "epoch"}
+            self._run.log(metrics, step=step)
+        except Exception as e:  # pragma: no cover - network flake
+            log_warning(f"wandb.log failed ({e})")
+
+    def finish(self) -> None:
+        if self._run is not None:
+            try:
+                self._run.finish()
+            finally:
+                self._run = None
