@@ -1,0 +1,446 @@
+"""Whole-detector golden parity vs. the reference PyTorch model (VERDICT r2
+missing #1): the reference's own matching_net / template_matching / TM_utils
+/ criterions_TM are imported by file path and run head-to-head against
+tmr_tpu on shared converted weights — forward maps, target assignment, loss
+values, and decoded+NMS'd boxes must all agree.
+
+torchvision is absent in this image, so its three ops the reference files
+import (`roi_align`, `nms`, `generalized_box_iou_loss`) are stubbed with the
+independently tested numpy ports from tests/oracles.py wrapped in torch —
+exactly the substitution VERDICT r2 prescribed.
+"""
+
+import importlib.util
+import sys
+import types
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oracles import giou_loss_np, nms_np, roi_align_np
+from test_vit_golden import TINY, _build_pair
+
+REF = "/root/reference"
+
+
+# ------------------------------------------------------- torchvision stub
+def _stub_torchvision():
+    if "torchvision" in sys.modules:
+        return
+    import torch
+
+    tv = types.ModuleType("torchvision")
+    ops = types.ModuleType("torchvision.ops")
+    boxes_mod = types.ModuleType("torchvision.ops.boxes")
+
+    def roi_align(input, boxes, output_size, spatial_scale=1.0,
+                  sampling_ratio=-1, aligned=False):
+        # the reference only calls this with batch-1 input and a one-element
+        # box list (template_matching.py:75)
+        feats = input.detach().numpy()
+        outs = []
+        for b, rois in enumerate(boxes):
+            out = roi_align_np(
+                feats[b], rois.detach().numpy(), tuple(output_size),
+                spatial_scale, sampling_ratio, aligned,
+            )
+            outs.append(out)
+        return torch.from_numpy(
+            np.concatenate(outs, axis=0).astype(np.float32)
+        )
+
+    def nms(boxes, scores, iou_threshold):
+        keep = nms_np(
+            boxes.detach().numpy(), scores.detach().numpy(), iou_threshold
+        )
+        return torch.as_tensor(list(keep), dtype=torch.int64)
+
+    def generalized_box_iou_loss(pred, target, reduction="none", eps=1e-7):
+        out = giou_loss_np(
+            pred.detach().numpy().astype(np.float64),
+            target.detach().numpy().astype(np.float64), eps=eps,
+        )
+        t = torch.from_numpy(out).to(pred.dtype)
+        if reduction == "sum":
+            return t.sum()
+        if reduction == "mean":
+            return t.mean()
+        return t
+
+    def box_area(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    ops.roi_align = roi_align
+    ops.nms = nms
+    ops.generalized_box_iou_loss = generalized_box_iou_loss
+    boxes_mod.box_area = box_area
+    ops.boxes = boxes_mod
+    tv.ops = ops
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.ops"] = ops
+    sys.modules["torchvision.ops.boxes"] = boxes_mod
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_ref_detector():
+    """Reference detector modules by file path (test_vit_golden pattern)."""
+    if "refdet.models.matching_net" in sys.modules:
+        return (
+            sys.modules["refdet.models.matching_net"],
+            sys.modules["refdet.TM_utils"],
+            sys.modules["refdet.criterions_TM"],
+        )
+    _stub_torchvision()
+    for pkg_name, path in (
+        ("refdet", None),
+        ("refdet.models", f"{REF}/models"),
+        ("refdet.models.backbone", f"{REF}/models/backbone"),
+        ("refdet.models.backbone.sam", f"{REF}/models/backbone/sam"),
+    ):
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [path] if path else []
+        sys.modules[pkg_name] = pkg
+    _load("refdet.models.backbone.sam.common",
+          f"{REF}/models/backbone/sam/common.py")
+    _load("refdet.models.regression_head", f"{REF}/models/regression_head.py")
+    _load("refdet.models.encoders", f"{REF}/models/encoders.py")
+    _load("refdet.models.template_matching",
+          f"{REF}/models/template_matching.py")
+    mn = _load("refdet.models.matching_net", f"{REF}/models/matching_net.py")
+    tm_utils = _load("refdet.TM_utils", f"{REF}/utils/TM_utils.py")
+    crit = _load("refdet.criterions_TM", f"{REF}/criterion/criterions_TM.py")
+    return mn, tm_utils, crit
+
+
+# ------------------------------------------------------------ model pair
+ARGS = dict(
+    emb_dim=8,
+    fusion=True,
+    ablation_no_box_regression=False,
+    no_matcher=False,
+    template_type="roi_align",
+    squeeze=False,
+    feature_upsample=True,
+    decoder_num_layer=1,
+    decoder_kernel_size=3,
+    encoder="original",
+    positive_threshold=0.5,
+    negative_threshold=0.5,
+    modeltype="matching_net",
+)
+BATCH_FLAGS = {"regression_ablation_b": False, "regression_ablation_c": False}
+
+
+def _build_detector_pair(seed=0):
+    """Reference matching_net (tiny ViT backbone) + our MatchingNet sharing
+    converted weights."""
+    import torch
+
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.utils.convert import convert_matching_net
+
+    mn, _, _ = _load_ref_detector()
+    ref_vit, _, _ = _build_pair(seed=seed)
+
+    class RefBackbone(torch.nn.Module):
+        """Sam_Backbone stand-in: .backbone = the encoder, num_channels
+        exposed (models/backbone/sam/sam.py wraps ImageEncoderViT the same
+        way, so converted key paths line up: encoder.backbone.backbone.*)."""
+
+        def __init__(self, vit):
+            super().__init__()
+            self.backbone = vit
+            self.num_channels = TINY["out_chans"]
+
+        def forward(self, x):
+            return self.backbone(x)
+
+    args = SimpleNamespace(**ARGS)
+    torch.manual_seed(seed + 100)
+    ref_model = mn.matching_net(RefBackbone(ref_vit), args)
+    # the std=0.01 head init yields near-flat maps; re-randomize the
+    # detector-specific weights so the comparison exercises real structure
+    with torch.no_grad():
+        for name, p in ref_model.named_parameters():
+            if not name.startswith("encoder.") and p.dim() > 1:
+                p.normal_(std=0.3)
+        ref_model.matcher.scale.fill_(1.7)
+    ref_model.eval()
+
+    mine = MatchingNet(
+        backbone=SamViT(
+            embed_dim=TINY["embed_dim"],
+            depth=TINY["depth"],
+            num_heads=TINY["num_heads"],
+            global_attn_indexes=TINY["global_attn_indexes"],
+            patch_size=TINY["patch_size"],
+            window_size=TINY["window_size"],
+            out_chans=TINY["out_chans"],
+            pretrain_img_size=TINY["img_size"],
+        ),
+        emb_dim=ARGS["emb_dim"],
+        fusion=True,
+        feature_upsample=True,
+        template_capacity=9,
+        decoder_num_layer=1,
+        decoder_kernel_size=3,
+    )
+    sd = {f"model.{k}": v for k, v in ref_model.state_dict().items()}
+    params = convert_matching_net(sd, backbone="sam")
+    return ref_model, mine, params
+
+
+RNG = np.random.default_rng(42)
+IMAGE = RNG.standard_normal((2, 3, 32, 32)).astype(np.float32)
+EXEMPLARS = np.array(
+    [[[0.30, 0.25, 0.62, 0.60]], [[0.55, 0.50, 0.80, 0.86]]], np.float32
+)
+GT_BOXES = [
+    np.array([[0.28, 0.22, 0.64, 0.62], [0.05, 0.55, 0.35, 0.95],
+              [0.60, 0.05, 0.95, 0.40]], np.float32),
+    np.array([[0.52, 0.48, 0.82, 0.88], [0.10, 0.10, 0.40, 0.45]],
+             np.float32),
+]
+
+
+def _run_pair(seed=0):
+    import torch
+
+    ref_model, mine, params = _build_detector_pair(seed=seed)
+    with torch.no_grad():
+        os_, bs_, f_tms, feat = ref_model(
+            torch.from_numpy(IMAGE), torch.from_numpy(EXEMPLARS)
+        )
+    out = mine.apply(
+        {"params": params},
+        jnp.asarray(IMAGE.transpose(0, 2, 3, 1)),
+        jnp.asarray(EXEMPLARS),
+    )
+    return ref_model, mine, params, (os_, bs_, f_tms, feat), out
+
+
+@pytest.fixture(scope="module")
+def pair():
+    return _run_pair(seed=0)
+
+
+def test_forward_maps_match(pair):
+    """objectness / regression / f_TM / feature maps agree < 1e-4 f32."""
+    _, _, _, (os_, bs_, f_tms, feat), out = pair
+    np.testing.assert_allclose(
+        np.asarray(out["objectness"][0]), os_[0].numpy()[:, 0],
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["regressions"][0]),
+        bs_[0].numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["f_tm"][0]), f_tms[0].numpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["feature"]), feat.numpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_target_maps_match_reference_gt_map(pair):
+    """assign_targets' positive/negative/ignore partition equals the real
+    Get_pred_gts gt_map (1.0 / 0.0 / 0.5 coding) on the shared forward."""
+    import torch
+
+    from tmr_tpu.train.targets import assign_targets
+
+    _, tm_utils, _ = _load_ref_detector()
+    _, _, _, (os_, bs_, _, _), out = pair
+
+    gt_t = [torch.from_numpy(b) for b in GT_BOXES]
+    _, _, gt_maps = tm_utils.GT_map(SimpleNamespace(**ARGS)).Get_pred_gts(
+        os_, bs_, gt_t, torch.from_numpy(EXEMPLARS), dict(BATCH_FLAGS)
+    )
+
+    M = max(len(b) for b in GT_BOXES)
+    gt_boxes = np.zeros((2, M, 4), np.float32)
+    gt_valid = np.zeros((2, M), bool)
+    for i, b in enumerate(GT_BOXES):
+        gt_boxes[i, : len(b)] = b
+        gt_valid[i, : len(b)] = True
+
+    h, w = out["objectness"][0].shape[1:3]
+    tgt = assign_targets(
+        jnp.asarray(gt_boxes), jnp.asarray(gt_valid),
+        jnp.asarray(EXEMPLARS[:, 0]), h, w, 0.5, 0.5, is_last_level=True,
+    )
+    ref_map = gt_maps[0][:, 0].numpy()  # (B, H, W): 1 pos, 0 neg, 0.5 ignore
+    got_map = (
+        np.asarray(tgt["positive"], np.float32)
+        + 0.5 * (~(np.asarray(tgt["positive"]) | np.asarray(tgt["negative"])))
+    )
+    np.testing.assert_array_equal(got_map, ref_map)
+
+
+def test_loss_values_match_reference_criterion(pair):
+    """compute_losses == real Get_pred_gts + SetCriterion_TM end to end."""
+    import torch
+
+    _, tm_utils, crit = _load_ref_detector()
+    _, _, _, (os_, bs_, _, _), out = pair
+
+    gt_t = [torch.from_numpy(b) for b in GT_BOXES]
+    preds, gts, _ = tm_utils.GT_map(SimpleNamespace(**ARGS)).Get_pred_gts(
+        os_, bs_, gt_t, torch.from_numpy(EXEMPLARS), dict(BATCH_FLAGS)
+    )
+    with torch.no_grad():
+        want = crit.SetCriterion_TM(use_focal_loss=False)(preds, gts)
+
+    from tmr_tpu.train.state import compute_losses
+
+    M = max(len(b) for b in GT_BOXES)
+    gt_boxes = np.zeros((2, M, 4), np.float32)
+    gt_valid = np.zeros((2, M), bool)
+    for i, b in enumerate(GT_BOXES):
+        gt_boxes[i, : len(b)] = b
+        gt_valid[i, : len(b)] = True
+    got = compute_losses(
+        out,
+        {"exemplars": jnp.asarray(EXEMPLARS),
+         "gt_boxes": jnp.asarray(gt_boxes),
+         "gt_valid": jnp.asarray(gt_valid)},
+        positive_threshold=0.5, negative_threshold=0.5,
+    )
+    np.testing.assert_allclose(
+        float(got["loss_ce"]), float(want["loss_ce"]), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(got["loss_giou"]), float(want["loss_giou"]), rtol=1e-4
+    )
+
+
+def test_decoded_nms_boxes_match_reference(pair):
+    """Get_pred_boxes + NMS vs decode_detections + batched_nms: same
+    surviving (score, box, ref) sets per image."""
+    import torch
+
+    from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+
+    _, tm_utils, _ = _load_ref_detector()
+    _, _, _, (os_, bs_, _, _), out = pair
+
+    cls_thr, iou_thr = 0.45, 0.5
+    logits, boxes, refs = tm_utils.Get_pred_boxes(
+        [o.detach() for o in os_], [b.detach() for b in bs_],
+        torch.from_numpy(EXEMPLARS), dict(BATCH_FLAGS), cls_ths=cls_thr,
+    )
+    logits, boxes, refs = tm_utils.NMS(logits, boxes, refs,
+                                       iou_threshold=iou_thr)
+
+    dets = decode_detections(
+        out["objectness"], out["regressions"], jnp.asarray(EXEMPLARS[:, 0]),
+        cls_threshold=cls_thr, max_detections=64,
+    )
+    dets = batched_nms(dets, iou_thr, backend="xla")
+
+    for b in range(2):
+        want_scores = logits[b][:, 0].numpy()
+        want_boxes = boxes[b].numpy()
+        want_refs = refs[b].numpy()
+        order = np.argsort(-want_scores, kind="mergesort")
+
+        valid = np.asarray(dets["valid"][b])
+        got_scores = np.asarray(dets["scores"][b])[valid]
+        got_boxes = np.asarray(dets["boxes"][b])[valid]
+        got_refs = np.asarray(dets["refs"][b])[valid]
+        g_order = np.argsort(-got_scores, kind="mergesort")
+
+        assert len(got_scores) == len(want_scores), (
+            f"image {b}: {len(got_scores)} vs {len(want_scores)} detections"
+        )
+        assert len(want_scores) > 1  # the case must be non-trivial
+        np.testing.assert_allclose(
+            got_scores[g_order], want_scores[order], rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            got_boxes[g_order], want_boxes[order], rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            got_refs[g_order], want_refs[order], rtol=1e-4, atol=1e-5
+        )
+
+
+def test_targets_loss_randomized_vs_reference():
+    """Re-oracle the target/criterion stack against the real
+    Get_pred_gts/SetCriterion_TM on randomized synthetic maps (VERDICT r2:
+    hand-ported oracles prove self-consistency, this proves fidelity),
+    including a zero-positive image exercising the 1e-14 dummy path."""
+    import torch
+
+    from tmr_tpu.train.state import compute_losses
+
+    _, tm_utils, crit = _load_ref_detector()
+    rng = np.random.default_rng(9)
+    H = W = 8
+    for case in range(4):
+        B = 2
+        obj = rng.standard_normal((B, 1, H, W)).astype(np.float32)
+        reg = (rng.standard_normal((B, 4, H, W)) * 0.3).astype(np.float32)
+        ex = rng.uniform(0.2, 0.6, (B, 1, 2)).astype(np.float32)
+        ex = np.concatenate([ex, ex + rng.uniform(0.15, 0.35, (B, 1, 2))],
+                            axis=-1).astype(np.float32)
+        gt_list = []
+        for b in range(B):
+            if case == 3 and b == 1:
+                # far-corner tiny box -> zero positives for this image
+                gt_list.append(np.array([[0.0, 0.0, 0.02, 0.02]], np.float32))
+                continue
+            n = int(rng.integers(1, 4))
+            xy = rng.uniform(0.05, 0.55, (n, 2))
+            wh = rng.uniform(0.1, 0.4, (n, 2))
+            gt_list.append(
+                np.concatenate([xy, np.minimum(xy + wh, 1.0)], axis=1)
+                .astype(np.float32)
+            )
+
+        preds, gts, _ = tm_utils.GT_map(
+            SimpleNamespace(**ARGS)
+        ).Get_pred_gts(
+            [torch.from_numpy(obj)], [torch.from_numpy(reg)],
+            [torch.from_numpy(g) for g in gt_list], torch.from_numpy(ex),
+            dict(BATCH_FLAGS),
+        )
+        with torch.no_grad():
+            want = crit.SetCriterion_TM(False)(preds, gts)
+
+        M = max(len(g) for g in gt_list)
+        gt_boxes = np.zeros((B, M, 4), np.float32)
+        gt_valid = np.zeros((B, M), bool)
+        for i, g in enumerate(gt_list):
+            gt_boxes[i, : len(g)] = g
+            gt_valid[i, : len(g)] = True
+        got = compute_losses(
+            {"objectness": [jnp.asarray(obj[:, 0])],
+             "regressions": [jnp.asarray(reg.transpose(0, 2, 3, 1))]},
+            {"exemplars": jnp.asarray(ex), "gt_boxes": jnp.asarray(gt_boxes),
+             "gt_valid": jnp.asarray(gt_valid)},
+            positive_threshold=0.5, negative_threshold=0.5,
+        )
+        np.testing.assert_allclose(
+            float(got["loss_ce"]), float(want["loss_ce"]), rtol=1e-4,
+            err_msg=f"case {case}",
+        )
+        np.testing.assert_allclose(
+            float(got["loss_giou"]), float(want["loss_giou"]), rtol=1e-4,
+            err_msg=f"case {case}",
+        )
