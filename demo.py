@@ -65,18 +65,12 @@ class DemoEngine:
         """Build the SAM box refiner once (vs. the reference's per-image
         PromptEncoder rebuild, box_refine.py:207). With ``checkpoint``,
         weights convert from the SAM .pth; else random init (smoke)."""
-        from tmr_tpu.refine import SamRefineModule
+        import dataclasses
 
-        refiner = SamRefineModule()
-        if checkpoint:
-            from tmr_tpu.utils.convert import (
-                convert_sam_refiner,
-                load_torch_state_dict,
-            )
+        from tmr_tpu.refine import build_refiner
 
-            rparams = convert_sam_refiner(load_torch_state_dict(checkpoint))
-        else:
-            rparams = refiner.init_params(seed=seed)
+        cfg = dataclasses.replace(self.cfg, refiner_checkpoint=checkpoint)
+        refiner, rparams = build_refiner(cfg, seed=seed)
         self.predictor.refiner = refiner
         self.predictor.refiner_params = rparams
 

@@ -64,6 +64,9 @@ def config_parser(argv=None):
     p.add_argument("--NMS_cls_threshold", default=0.1, type=float)
     p.add_argument("--NMS_iou_threshold", default=0.15, type=float)
     p.add_argument("--refine_box", action="store_true")
+    p.add_argument("--refiner_checkpoint", default=None, type=str,
+                   help="SAM .pth for the --refine_box mask decoder "
+                        "(random init with a warning when omitted)")
     p.add_argument("--ablation_no_box_regression", action="store_true")
     p.add_argument("--template_type", type=str, default="roi_align")
     p.add_argument("--feature_upsample", action="store_true")
@@ -90,6 +93,9 @@ def config_parser(argv=None):
                    help="sequence/context-parallel mesh size: global "
                         "attention blocks run ring attention over this axis")
     p.add_argument("--compute_dtype", default="bfloat16", type=str)
+    p.add_argument("--max_detections", default=2000, type=int,
+                   help="fixed detection-slot capacity of the fused decode/"
+                        "refine/NMS program (AP maxDets tops out at 1100)")
     p.add_argument("--profile_dir", default=None, type=str,
                    help="capture an XLA profiler trace of the first epoch "
                         "into this directory (TensorBoard/xprof)")

@@ -233,3 +233,78 @@ def test_iter_tar_skips_corrupt_members(tmp_path):
         tar.addfile(info, io.BytesIO(b"x"))
     images = list(iter_tar_images(path))
     assert [n for n, _ in images] == ["good.png"]
+
+
+def test_state_sharding_matches_by_exact_path_not_shape():
+    """Two same-shaped params with different specs must not collide: the
+    optimizer moments inherit each parameter's spec via its exact dict path
+    (round-2 verdict flagged the old by-shape heuristic as fragile)."""
+    import optax
+    from flax import struct
+    from jax.sharding import PartitionSpec as P
+
+    from tmr_tpu.parallel.sharding import param_spec
+
+    mesh = make_mesh((2, 2))
+    # qkv kernel shards (None, 'model'); proj kernel ('model', None); give
+    # them identical shapes so a by-shape match would have to pick wrong.
+    params = {
+        "backbone": {
+            "blocks_0": {
+                "attn": {
+                    "qkv": {"kernel": jnp.zeros((8, 8))},
+                    "proj": {"kernel": jnp.zeros((8, 8))},
+                }
+            }
+        }
+    }
+    assert param_spec(
+        ("backbone", "blocks_0", "attn", "qkv", "kernel"), jnp.zeros((8, 8))
+    ) == P(None, "model")
+
+    @struct.dataclass
+    class S:
+        step: int
+        params: dict
+        opt_state: object
+
+    # the PRODUCTION optimizer: optax.chain + multi_transform nests each
+    # group's moments under a label key ('backbone'/'head'), so the moment
+    # paths carry a prefix the matcher must see through
+    from tmr_tpu.train.state import make_optimizer
+
+    cfg = Config(lr=1e-3, lr_backbone=1e-4, max_epochs=2)
+    tx = make_optimizer(cfg, steps_per_epoch=10)
+    state = S(step=0, params=params, opt_state=tx.init(params))
+    tree = state_sharding(state, mesh)
+
+    def spec_of(shard_tree, *names):
+        node = shard_tree
+        for n in names:
+            node = node[n]
+        return node.spec
+
+    path = ("backbone", "blocks_0", "attn")
+    assert spec_of(tree.params, *path, "qkv", "kernel") == P(None, "model")
+    assert spec_of(tree.params, *path, "proj", "kernel") == P("model", None)
+    # AdamW moments mirror their own parameter exactly, through the
+    # multi_transform label prefix
+    inner = tree.opt_state[1].inner_states["backbone"].inner_state[0]
+    for moments in (inner.mu, inner.nu):
+        assert spec_of(moments, *path, "qkv", "kernel") == P(None, "model")
+        assert spec_of(moments, *path, "proj", "kernel") == P("model", None)
+    # non-param leaves replicate
+    assert tree.step.spec == P()
+
+
+def test_validate_tp_divisibility():
+    from tmr_tpu.parallel.sharding import validate_tp
+
+    mesh = make_mesh((2, 2))
+    validate_tp(mesh, 768, 12)  # vit_b widths divide tp=2
+    with pytest.raises(ValueError, match="num_heads"):
+        validate_tp(mesh, 768, 13)
+    with pytest.raises(ValueError, match="embed_dim"):
+        validate_tp(mesh, 7, 2)
+    # tp=1 never constrains
+    validate_tp(make_mesh((4, 1)), 7, 13)

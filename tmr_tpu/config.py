@@ -59,6 +59,10 @@ class Config:
     NMS_cls_threshold: float = 0.1
     NMS_iou_threshold: float = 0.15
     refine_box: bool = False
+    # SAM .pth for the --refine_box mask decoder (the reference downloads
+    # from fbaipublicfiles at refiner construction, box_refine.py:41-60;
+    # airgapped runs fall back to random init with a warning)
+    refiner_checkpoint: Optional[str] = None
     ablation_no_box_regression: bool = False
     template_type: str = "roi_align"  # or "prototype"
     feature_upsample: bool = False

@@ -25,12 +25,21 @@ def build_backbone(cfg, mesh=None):
         seq_mesh = mesh
     remat = cfg.remat_backbone
     name = cfg.backbone
+
+    def _vit(kind: str):
+        if mesh is not None:
+            from tmr_tpu.models.vit import VIT_CONFIGS
+            from tmr_tpu.parallel.sharding import validate_tp
+
+            vc = VIT_CONFIGS[kind]
+            validate_tp(mesh, vc["embed_dim"], vc["num_heads"])
+        return build_sam_vit(kind, dtype=dtype, seq_mesh=seq_mesh,
+                             remat=remat)
+
     if name == "sam" or name == "sam_vit_h":
-        return build_sam_vit("vit_h", dtype=dtype, seq_mesh=seq_mesh,
-                             remat=remat)
+        return _vit("vit_h")
     if name == "sam_vit_b":
-        return build_sam_vit("vit_b", dtype=dtype, seq_mesh=seq_mesh,
-                             remat=remat)
+        return _vit("vit_b")
     if name in RESNET_VARIANTS:
         if seq_mesh is not None:
             raise ValueError(

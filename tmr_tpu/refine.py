@@ -322,3 +322,34 @@ class SamRefineModule:
             cv2.imwrite(path, (mask * 255).astype(np.uint8))
             written.append(path)
         return written
+
+
+def build_refiner(cfg, seed: int = 0):
+    """Build-once refiner + params for --refine_box runs (the reference
+    constructs its SAM refiner inside the test step, trainer.py:146-148,
+    pulling weights from public URLs, box_refine.py:41-60).
+
+    With ``cfg.refiner_checkpoint`` the SAM ``.pth`` converts to Flax params;
+    without one (airgapped TPU pods cannot hit the reference's download
+    URLs) the decoder initializes randomly with a loud warning — the
+    pipeline shape/order is exercised either way.
+    """
+    refiner = SamRefineModule()
+    ckpt = getattr(cfg, "refiner_checkpoint", None)
+    if ckpt:
+        from tmr_tpu.utils.convert import (
+            convert_sam_refiner,
+            load_torch_state_dict,
+        )
+
+        params = convert_sam_refiner(load_torch_state_dict(ckpt))
+    else:
+        from tmr_tpu.utils.profiling import log_warning
+
+        log_warning(
+            "refine_box: no refiner_checkpoint configured; using random-init "
+            "SAM decoder weights (boxes will be refined by an untrained mask "
+            "decoder)"
+        )
+        params = refiner.init_params(seed=seed)
+    return refiner, params

@@ -74,7 +74,18 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh
         self.model = build_model(cfg, mesh=mesh)
-        self.predictor = Predictor(cfg, model=self.model)
+        # --refine_box: build the SAM refiner once and hand it to the
+        # Predictor, which runs decode -> refine -> NMS inside the fused
+        # program (reference test-step order, trainer.py:143-150)
+        refiner = refiner_params = None
+        if cfg.refine_box:
+            from tmr_tpu.refine import build_refiner
+
+            refiner, refiner_params = build_refiner(cfg, seed=cfg.seed)
+        self.predictor = Predictor(
+            cfg, model=self.model, refiner=refiner,
+            refiner_params=refiner_params,
+        )
         self.logger = CSVLogger(cfg.logpath)
         self.wandb = None
         # process-0 gated like every other host-side sink (the reference's
