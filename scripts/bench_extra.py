@@ -1,0 +1,308 @@
+"""Extended benchmarks: the BASELINE.md configs beyond bench.py's headline.
+
+bench.py stays the driver's single-JSON-line headline (FSCD-147 eval,
+ViT-B @ 1024, batch 4). This script measures the remaining tracked configs
+(BASELINE.md "Benchmark configs to track") and prints ONE JSON dict:
+
+  1. demo-style single-image 3-shot inference (per-exemplar passes + merged
+     NMS, batch 1) — config #1;
+  2. RPINE-style eval with vit_h + --refine_box (batch 1) — config #3;
+  4. streaming map/reduce inference over synthetic tar shards, native C++ IO
+     vs pure-python IO, reducer table emitted — config #4 (reference anchor:
+     ~25 s/img for the ONNX-CPU mapper, logs/mapper_debug_*.txt);
+  5. one training step, ViT-B @ 1024 batch 4 — config #5's inner loop;
+  plus the 1536 small-object bucket (eval protocol, batch 1).
+
+Usage:  python scripts/bench_extra.py [--only demo,refine,stream,train,1536]
+Results are committed as BENCH_EXTRA.json next to BENCH_r{N}.json.
+
+Same measurement rules as bench.py: device-staged inputs, chained execution
+via a scalar data dependency, single closing fetch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import tarfile
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _chain_time(step, n, *args):
+    """Chained timing: step(*args, fb) -> (out, fb'); returns sec/iter."""
+    import jax
+    import jax.numpy as jnp
+
+    fb = jnp.zeros((), jnp.float32)
+    out, fb = step(*args, fb)
+    _ = jax.device_get(fb)
+    t0 = time.perf_counter()
+    fb = fb * 0.0
+    for _ in range(n):
+        out, fb = step(*args, fb)
+    _ = jax.device_get(fb)
+    return (time.perf_counter() - t0) / n
+
+
+def bench_demo() -> dict:
+    """Config #1: single image, 3 exemplars, per-exemplar passes + one NMS.
+
+    The demo path is inherently a host-driven multi-call pipeline (one
+    forward per exemplar, merged NMS — trainer.py:75-121), so unlike the
+    single fused program it cannot be chained through one scalar; the image
+    is staged on device once, dispatches queue asynchronously, and a single
+    closing fetch ends the timing (dispatch latency is part of this path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=1024,
+                 compute_dtype="bfloat16", batch_size=1)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=1024)
+    rng = np.random.default_rng(0)
+    image = jnp.asarray(
+        rng.standard_normal((1, 1024, 1024, 3)), jnp.float32
+    )  # staged on device once
+    exemplars = np.array(
+        [[0.45, 0.45, 0.53, 0.55], [0.2, 0.2, 0.27, 0.28],
+         [0.7, 0.6, 0.78, 0.69]], np.float32,
+    )
+    out = pred.predict_multi_exemplar(image, exemplars)  # compile
+    _ = jax.device_get(out["scores"])
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = pred.predict_multi_exemplar(image, exemplars)
+    _ = jax.device_get(out["scores"])
+    dt = (time.perf_counter() - t0) / n
+    return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4),
+            "exemplars": 3}
+
+
+def _fused_eval_step(cfg, capacity, image_size, refiner=None,
+                     refiner_params=None):
+    import jax
+    import jax.numpy as jnp
+
+    from tmr_tpu.models import build_model
+    from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+
+    model = build_model(cfg).clone(template_capacity=capacity)
+    rng = np.random.default_rng(0)
+    image = jnp.asarray(
+        rng.standard_normal((cfg.batch_size, image_size, image_size, 3)),
+        jnp.float32,
+    )
+    ex = jnp.tile(jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32),
+                  (cfg.batch_size, 1, 1))
+    params = jax.jit(model.init)(jax.random.key(0), image, ex)["params"]
+
+    @jax.jit
+    def step(p, im, e, fb):
+        out = model.apply({"params": p}, im + fb, e)
+        dets = decode_detections(
+            out["objectness"], out["regressions"], e[:, 0, :],
+            cls_threshold=cfg.NMS_cls_threshold,
+            max_detections=cfg.max_detections, box_reg=cfg.box_reg,
+            scale_imgsize=cfg.regression_scaling_imgsize,
+            scale_wh_only=cfg.regression_scaling_WH_only,
+        )
+        if refiner is not None:
+            dets = refiner.refine(
+                refiner_params, out["backbone_feature"], dets,
+                (image_size, image_size),
+            )
+        dets = batched_nms(dets, cfg.NMS_iou_threshold)
+        return dets, jnp.sum(dets["scores"]) * 0.0
+
+    return step, params, image, ex
+
+
+def bench_1536() -> dict:
+    """The small-object escalation bucket (eval protocol: batch 1)."""
+    from tmr_tpu.config import preset
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=1536,
+                 compute_dtype="bfloat16", batch_size=1)
+    step, params, image, ex = _fused_eval_step(cfg, 17, 1536)
+    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), 8, params, image, ex)
+    return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
+
+
+def bench_refine() -> dict:
+    """Config #3: RPINE protocol — vit_h, batch 1, SAM-decoder refinement."""
+    from tmr_tpu.config import preset
+    from tmr_tpu.refine import build_refiner
+
+    cfg = preset("TMR_RPINE", backbone="sam_vit_h", image_size=1024,
+                 compute_dtype="bfloat16", batch_size=1, refine_box=True,
+                 max_detections=1100)
+    refiner, rparams = build_refiner(cfg, seed=0)
+    step, params, image, ex = _fused_eval_step(
+        cfg, 33, 1024, refiner=refiner, refiner_params=rparams
+    )
+    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), 5, params, image, ex)
+    return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
+
+
+def bench_train() -> dict:
+    """Config #5's inner loop: one training step, ViT-B @ 1024, batch 4."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmr_tpu.config import preset
+    from tmr_tpu.train.state import create_train_state, make_train_step
+
+    cfg = preset("TMR_FSCD_LVIS_Unseen", backbone="sam_vit_b",
+                 image_size=1024, compute_dtype="bfloat16", batch_size=4)
+    from tmr_tpu.models import build_model
+
+    model = build_model(cfg).clone(template_capacity=17)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(
+            rng.standard_normal((4, 1024, 1024, 3)), jnp.float32
+        ),
+        "exemplars": jnp.tile(
+            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (4, 1, 1)
+        ),
+        "gt_boxes": jnp.tile(
+            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (4, 8, 1)
+        ),
+        "gt_valid": jnp.ones((4, 8), bool),
+    }
+    state = create_train_state(
+        model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
+        steps_per_epoch=100,
+    )
+    step = jax.jit(make_train_step(model, cfg))
+
+    state, losses = step(state, batch)  # compile
+    _ = jax.device_get(losses["loss"])
+    n = 8
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, losses = step(state, batch)
+    _ = jax.device_get(losses["loss"])
+    dt = (time.perf_counter() - t0) / n
+    return {"img_per_sec": round(4.0 / dt, 3), "sec_per_step": round(dt, 4),
+            "batch": 4}
+
+
+def _write_synthetic_shards(root: str, n_shards=4, imgs_per_shard=8,
+                            size=512) -> list:
+    """Easy_/Normal_/Hard_ tar shards of random JPEGs (mapper.py layout)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    cats = ["Easy", "Normal", "Hard"]
+    paths = []
+    for s in range(n_shards):
+        name = f"{cats[s % 3]}_shard_{s:03d}.tar"
+        path = os.path.join(root, name)
+        with tarfile.open(path, "w") as tar:
+            for i in range(imgs_per_shard):
+                arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"img_{s:03d}_{i:02d}.jpg")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        paths.append(path)
+    return paths
+
+
+def bench_stream() -> dict:
+    """Config #4: streaming map/reduce feature extraction over tar shards.
+
+    Reference anchor: the Hadoop mapper ran ~25 s/img on ONNX CPU
+    (logs/mapper_debug_20251228_162952.txt). Reports native C++ IO vs pure
+    python IO and emits the reducer table like reducer.py:25-27.
+    """
+    from tmr_tpu.models import build_sam_encoder
+    from tmr_tpu.parallel.mapreduce import (
+        make_encode_stats_fn,
+        reduce_lines,
+        format_stats_table,
+        run_stream,
+        run_stream_native,
+    )
+
+    encoder, params = build_sam_encoder("vit_b", image_size=1024)
+    fn = make_encode_stats_fn(encoder, params)
+    out = {}
+    with tempfile.TemporaryDirectory() as root:
+        paths = _write_synthetic_shards(root)
+        n_imgs = 4 * 8
+        # warmup/compile on one shard
+        run_stream(paths[:1], fn, batch_size=8, image_size=1024)
+        for label, runner in (("native", run_stream_native),
+                              ("python", run_stream)):
+            try:
+                t0 = time.perf_counter()
+                acc = runner(paths, fn, batch_size=8, image_size=1024)
+                dt = time.perf_counter() - t0
+                out[label] = {
+                    "img_per_sec": round(n_imgs / dt, 3),
+                    "sec_per_image": round(dt / n_imgs, 4),
+                    "vs_mapper_25s_per_img": round((n_imgs / dt) / 0.04, 1),
+                }
+                if label == "native":
+                    table = format_stats_table(
+                        reduce_lines(acc.emit_lines())
+                    )
+                    out["reducer_table"] = table.splitlines()
+            except Exception as e:  # native lib may be unbuilt
+                out[label] = {"error": str(e)}
+    return out
+
+
+ALL = {
+    "demo": bench_demo,
+    "1536": bench_1536,
+    "refine": bench_refine,
+    "train": bench_train,
+    "stream": bench_stream,
+}
+
+
+def main(argv=None) -> int:
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of " + ",".join(ALL))
+    args = ap.parse_args(argv)
+    names = list(ALL) if not args.only else args.only.split(",")
+    import jax
+
+    results = {"device": str(jax.devices()[0])}
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            results[name] = ALL[name]()
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        results[name]["wall_s"] = round(time.perf_counter() - t0, 1)
+        print(f"[bench_extra] {name}: {results[name]}", file=sys.stderr,
+              flush=True)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

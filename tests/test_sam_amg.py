@@ -1,0 +1,308 @@
+"""Tests for tmr_tpu.sam_amg (the reference utils/segment_anything/utils/
+amg.py surface) and the crop-pyramid automatic mask generator."""
+
+import numpy as np
+import pytest
+
+from tmr_tpu import sam_amg
+
+
+# ---------------------------------------------------------------- point grids
+def test_build_point_grid_matches_reference_layout():
+    g = sam_amg.build_point_grid(2)
+    # offset 1/4: [[.25,.25],[.75,.25],[.25,.75],[.75,.75]] (x varies fastest)
+    np.testing.assert_allclose(
+        g, [[0.25, 0.25], [0.75, 0.25], [0.25, 0.75], [0.75, 0.75]]
+    )
+
+
+def test_build_all_layer_point_grids_downscales():
+    grids = sam_amg.build_all_layer_point_grids(8, 2, 2)
+    assert [len(g) for g in grids] == [64, 16, 4]
+
+
+# ----------------------------------------------------------------- crop boxes
+def test_generate_crop_boxes_layer_counts_and_cover():
+    boxes, layers = sam_amg.generate_crop_boxes((600, 900), 2, 512 / 1500)
+    assert layers.count(0) == 1 and layers.count(1) == 4 and layers.count(2) == 16
+    assert boxes[0] == [0, 0, 900, 600]
+    for (x0, y0, x1, y1) in boxes:
+        assert 0 <= x0 < x1 <= 900 and 0 <= y0 < y1 <= 600
+    # layer-1 crops overlap: total covered width > image width
+    l1 = [b for b, l in zip(boxes, layers) if l == 1]
+    assert sum(b[2] - b[0] for b in l1[:2]) > 900 / 2 * 2
+
+
+def test_uncrop_roundtrip():
+    crop = [10, 20, 50, 60]
+    boxes = np.array([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(
+        sam_amg.uncrop_boxes_xyxy(boxes, crop), [[11, 22, 13, 24]]
+    )
+    np.testing.assert_allclose(
+        sam_amg.uncrop_points(np.array([[5.0, 6.0]]), crop), [[15, 26]]
+    )
+    m = np.ones((40, 40), bool)
+    full = sam_amg.uncrop_mask(m, crop, 100, 200)
+    assert full.shape == (100, 200)
+    assert full[20:60, 10:50].all() and full.sum() == 40 * 40
+
+
+def test_is_box_near_crop_edge():
+    crop = [0, 0, 50, 50]
+    orig = [0, 0, 100, 100]
+    boxes = np.array(
+        [[5.0, 5.0, 49.0, 30.0],   # touches crop right edge (not image edge)
+         [5.0, 5.0, 30.0, 30.0],   # interior
+         [0.0, 0.0, 30.0, 30.0]],  # touches image edge -> NOT filtered
+    )
+    near = sam_amg.is_box_near_crop_edge(boxes, crop, orig, atol=5.0)
+    assert near.tolist() == [True, False, False]
+
+
+# ------------------------------------------------------------------------ RLE
+def test_rle_roundtrip_and_area():
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        m = rng.random((13, 17)) > 0.5
+        rle = sam_amg.mask_to_rle(m)
+        assert rle["size"] == [13, 17]
+        assert sum(rle["counts"]) == 13 * 17
+        np.testing.assert_array_equal(sam_amg.rle_to_mask(rle), m)
+        assert sam_amg.area_from_rle(rle) == int(m.sum())
+    # empty + full masks
+    z = np.zeros((4, 6), bool)
+    assert sam_amg.mask_to_rle(z)["counts"] == [24]
+    f = np.ones((4, 6), bool)
+    assert sam_amg.mask_to_rle(f)["counts"] == [0, 24]
+
+
+def test_rle_is_column_major():
+    # single pixel at (row 1, col 0) of a 3x2 mask: fortran index = 1
+    m = np.zeros((3, 2), bool)
+    m[1, 0] = True
+    assert sam_amg.mask_to_rle(m)["counts"] == [1, 1, 4]
+
+
+# ------------------------------------------------------------- small regions
+def test_remove_small_regions_holes_and_islands():
+    m = np.zeros((20, 20), bool)
+    m[2:18, 2:18] = True
+    m[8:10, 8:10] = False      # small hole
+    m2 = m.copy()
+    m2[0, 19] = True           # 1px island
+    filled, changed = sam_amg.remove_small_regions(m2, 8, "holes")
+    assert changed and filled[8:10, 8:10].all()
+    cleaned, changed = sam_amg.remove_small_regions(m2, 8, "islands")
+    assert changed and not cleaned[0, 19] and cleaned[2:18, 2:18].sum() > 0
+    # below-threshold everything: keep the largest island
+    tiny = np.zeros((10, 10), bool)
+    tiny[0:2, 0:2] = True
+    tiny[5, 5] = True
+    kept, changed = sam_amg.remove_small_regions(tiny, 100, "islands")
+    assert changed and kept[0:2, 0:2].all() and not kept[5, 5]
+    # no change case
+    _, changed = sam_amg.remove_small_regions(m, 1, "islands")
+    assert not changed
+
+
+def test_stability_score():
+    logits = np.array([[[2.0, 0.5], [-0.5, -2.0]]])
+    # offset 1: >1 -> 1 px; >-1 -> 3 px
+    np.testing.assert_allclose(
+        sam_amg.calculate_stability_score(logits, 0.0, 1.0), [1 / 3]
+    )
+
+
+# ----------------------------------------------------------- batched records
+def test_records_cat_and_filter():
+    a = {"x": np.arange(3), "l": ["a", "b", "c"]}
+    b = {"x": np.arange(3, 5), "l": ["d", "e"]}
+    c = sam_amg.cat_records(a, b)
+    np.testing.assert_array_equal(c["x"], np.arange(5))
+    assert c["l"] == ["a", "b", "c", "d", "e"]
+    f = sam_amg.filter_records(c, np.array([True, False, True, False, True]))
+    np.testing.assert_array_equal(f["x"], [0, 2, 4])
+    assert f["l"] == ["a", "c", "e"]
+
+
+def test_batch_iterator():
+    chunks = list(sam_amg.batch_iterator(2, list(range(5))))
+    assert [c[0] for c in chunks] == [[0, 1], [2, 3], [4]]
+
+
+# --------------------------------------------------- crop-pyramid generator
+def test_amg_crop_pyramid_end_to_end():
+    """crop_n_layers=1 runs 5 crops (1 + 4), output carries crop_box, and
+    results stay within image bounds; min_mask_region_area smoke."""
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.sam import Sam, SamAutomaticMaskGenerator
+
+    sam = Sam(model_type="vit_b")
+    sam.image_encoder = SamViT(
+        embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+        patch_size=8, window_size=3, out_chans=8, pretrain_img_size=32,
+    )
+    sam.image_size = 32
+    from tmr_tpu.models.sam_decoder import MaskDecoder, PromptEncoder
+
+    sam.prompt_encoder = PromptEncoder(embed_dim=8)
+    sam.mask_decoder = MaskDecoder(
+        transformer_dim=8, transformer_num_heads=2, transformer_mlp_dim=16
+    )
+    sam.init_random(seed=0)
+
+    amg = SamAutomaticMaskGenerator(
+        sam, points_per_side=2, points_per_batch=4,
+        pred_iou_thresh=-1e9, stability_score_thresh=-1.0,
+        box_nms_thresh=0.95, crop_n_layers=1, crop_nms_thresh=0.95,
+        min_mask_region_area=1,
+    )
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 255, (40, 56, 3), dtype=np.uint8).astype(np.uint8)
+    out = amg.generate(img)
+    assert isinstance(out, list)
+    for d in out:
+        assert d["segmentation"].shape == (40, 56)
+        x, y, w, h = d["bbox"]
+        assert 0 <= x < 56 and 0 <= y < 40 and w > 0 and h > 0
+        assert len(d["crop_box"]) == 4
+    # uncompressed_rle output mode
+    amg.output_mode = "uncompressed_rle"
+    out2 = amg.generate(img)
+    for d in out2:
+        assert set(d["segmentation"]) == {"size", "counts"}
+
+
+def test_amg_arg_validation():
+    from tmr_tpu.sam import Sam, SamAutomaticMaskGenerator
+
+    sam = Sam(model_type="vit_b")
+    with pytest.raises(ValueError):
+        SamAutomaticMaskGenerator(sam, points_per_side=None)
+    with pytest.raises(ValueError):
+        SamAutomaticMaskGenerator(
+            sam, points_per_side=4, output_mode="bogus"
+        )
+    with pytest.raises(ImportError):
+        SamAutomaticMaskGenerator(
+            sam, points_per_side=4, output_mode="coco_rle"
+        )
+
+
+# ------------------------------------------------------ deploy decoder
+def _tiny_decoder_sam():
+    from tmr_tpu.models.sam_decoder import MaskDecoder, PromptEncoder
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.sam import Sam
+
+    sam = Sam(model_type="vit_b")
+    sam.image_encoder = SamViT(
+        embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+        patch_size=8, window_size=3, out_chans=8, pretrain_img_size=32,
+    )
+    sam.image_size = 32
+    sam.prompt_encoder = PromptEncoder(embed_dim=8)
+    sam.mask_decoder = MaskDecoder(
+        transformer_dim=8, transformer_num_heads=2, transformer_mlp_dim=16
+    )
+    sam.init_random(seed=0)
+    return sam
+
+
+def test_deploy_decoder_shapes_and_modes():
+    """SamDeployDecoder mirrors SamOnnxModel.forward (onnx.py:110-144):
+    output shapes, single-mask selection, stability scoring, extra metrics,
+    and the has_mask_input switch."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.sam import SamDeployDecoder
+
+    sam = _tiny_decoder_sam()
+    emb_hw = (4, 4)
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.standard_normal((1, 4, 4, 8)), jnp.float32)
+    pts = jnp.asarray([[[8.0, 8.0], [0.0, 0.0]],
+                       [[20.0, 12.0], [0.0, 0.0]]], jnp.float32)
+    labs = jnp.asarray([[1, -1], [1, -1]], jnp.int32)
+    mask_in = jnp.zeros((2, 16, 16, 1), jnp.float32)
+    no_mask = jnp.zeros((2,), jnp.float32)
+
+    multi = SamDeployDecoder(sam, return_single_mask=False)
+    out, scores, low = multi(sam.params, emb, pts, labs, mask_in, no_mask,
+                             (24, 30))
+    assert out.shape == (2, 4, 24, 30)  # all 4 mask tokens
+    assert scores.shape == (2, 4) and low.shape == (2, 4, 16, 16)
+
+    single = SamDeployDecoder(sam, return_single_mask=True)
+    out1, scores1, _ = single(sam.params, emb, pts, labs, mask_in, no_mask,
+                              (24, 30))
+    assert out1.shape == (2, 1, 24, 30) and scores1.shape == (2, 1)
+    # 2 point slots (single click + pad): token 0 penalized by -500, so the
+    # best MULTIMASK token (1..3) by predicted IoU is selected (onnx.py
+    # score-reweight semantics)
+    expect = np.argmax(
+        np.asarray(scores) + (2 - 2.5) * np.array([1000.0, 0, 0, 0]), axis=1
+    )
+    assert (expect > 0).all()
+    for b in range(2):
+        np.testing.assert_allclose(
+            np.asarray(out1[b, 0]), np.asarray(out[b, expect[b]]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    # has_mask_input switches the dense embedding -> different logits
+    out_m, _, _ = multi(
+        sam.params, emb, pts, labs,
+        jnp.asarray(rng.standard_normal((2, 16, 16, 1)), jnp.float32),
+        jnp.ones((2,), jnp.float32), (24, 30),
+    )
+    assert not np.allclose(np.asarray(out_m), np.asarray(out))
+
+    extra = SamDeployDecoder(sam, return_single_mask=False,
+                             use_stability_score=True,
+                             return_extra_metrics=True)
+    o, s, stab, areas, low = extra(sam.params, emb, pts, labs, mask_in,
+                                   no_mask, (24, 30))
+    assert s.shape == (2, 4) and stab.shape == (2, 4)
+    assert np.all((np.asarray(s) >= 0) & (np.asarray(s) <= 1))
+    assert areas.shape == (2, 4)
+
+
+def test_deploy_decoder_export_roundtrip(tmp_path):
+    """Serialized StableHLO artifact (the ONNX-file equivalent) loads and
+    reproduces the live program, including the symbolic prompt axis."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.sam import SamDeployDecoder
+    from tmr_tpu.utils.export import (
+        export_sam_decoder,
+        load_exported_decoder,
+        save_exported,
+    )
+
+    sam = _tiny_decoder_sam()
+    deploy = SamDeployDecoder(sam, return_single_mask=True)
+    data = export_sam_decoder(
+        deploy, sam.params, (4, 4), num_points=2, orig_im_size=(24, 30),
+        platforms=("cpu",),
+    )
+    path = str(tmp_path / "decoder.stablehlo")
+    save_exported(data, path)
+    call = load_exported_decoder(path)
+
+    rng = np.random.default_rng(1)
+    emb = jnp.asarray(rng.standard_normal((1, 4, 4, 8)), jnp.float32)
+    for n in (1, 3):  # symbolic prompt axis serves several batch sizes
+        pts = jnp.asarray(rng.uniform(0, 32, (n, 2, 2)), jnp.float32)
+        labs = jnp.concatenate(
+            [jnp.ones((n, 1), jnp.int32), -jnp.ones((n, 1), jnp.int32)], 1
+        )
+        mask_in = jnp.zeros((n, 16, 16, 1), jnp.float32)
+        has = jnp.zeros((n,), jnp.float32)
+        got = call(emb, pts, labs, mask_in, has)
+        want = deploy(sam.params, emb, pts, labs, mask_in, has, (24, 30))
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )

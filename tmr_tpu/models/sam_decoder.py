@@ -333,6 +333,10 @@ class MaskDecoder(nn.Module):
     transformer_depth: int = 2
     transformer_num_heads: int = 8
     transformer_mlp_dim: int = 2048
+    # True: return every mask token (N, T, 4h, 4w) + (N, T) ious instead of
+    # the auto-selected best — the deploy/export surface (utils/onnx.py's
+    # SamOnnxModel drives its own mask selection). Params are identical.
+    return_all_masks: bool = False
 
     @nn.compact
     def __call__(
@@ -402,6 +406,8 @@ class MaskDecoder(nn.Module):
             name="iou_prediction_head",
         )(iou_token_out)  # (N, T)
 
+        if self.return_all_masks:
+            return masks, iou_pred
         # reference patch: keep the best-IoU mask per prompt
         best = jnp.argmax(iou_pred, axis=1)
         masks = jnp.take_along_axis(

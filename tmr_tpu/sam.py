@@ -222,27 +222,59 @@ class SamPredictor:
 
 class SamAutomaticMaskGenerator:
     """Grid-prompted whole-image mask proposals
-    (automatic_mask_generator.py:33-372, single-crop configuration):
-    points_per_side grid -> batched single-point decodes -> IoU-prediction +
-    stability filtering -> mask boxes -> padded-NMS dedupe."""
+    (automatic_mask_generator.py:33-372): per crop-pyramid layer, a point
+    grid -> batched single-point decodes -> IoU-prediction + stability +
+    crop-edge filtering -> within-crop NMS -> uncrop -> cross-crop NMS
+    (smaller crops preferred) -> optional small-region cleanup -> RLE/binary
+    output. Mask bookkeeping lives in tmr_tpu.sam_amg."""
 
     def __init__(
         self,
         sam: Sam,
-        points_per_side: int = 16,
+        points_per_side: Optional[int] = 32,
         points_per_batch: int = 64,
         pred_iou_thresh: float = 0.88,
         stability_score_thresh: float = 0.95,
         stability_score_offset: float = 1.0,
         box_nms_thresh: float = 0.7,
+        crop_n_layers: int = 0,
+        crop_nms_thresh: float = 0.7,
+        crop_overlap_ratio: float = 512 / 1500,
+        crop_n_points_downscale_factor: int = 1,
+        point_grids: Optional[list] = None,
+        min_mask_region_area: int = 0,
+        output_mode: str = "binary_mask",
     ):
+        from tmr_tpu.sam_amg import build_all_layer_point_grids
+
+        if (points_per_side is None) == (point_grids is None):
+            raise ValueError(
+                "exactly one of points_per_side / point_grids must be set"
+            )
+        if points_per_side is not None:
+            self.point_grids = build_all_layer_point_grids(
+                points_per_side, crop_n_layers, crop_n_points_downscale_factor
+            )
+        else:
+            self.point_grids = point_grids
+        if output_mode not in ("binary_mask", "uncompressed_rle", "coco_rle"):
+            raise ValueError(f"unknown output_mode {output_mode!r}")
+        if output_mode == "coco_rle":
+            # fail at construction like the reference
+            # (automatic_mask_generator.py:119-121)
+            from pycocotools import mask as _  # noqa: F401
+
         self.predictor = SamPredictor(sam)
-        self.points_per_side = points_per_side
         self.points_per_batch = points_per_batch
         self.pred_iou_thresh = pred_iou_thresh
         self.stability_score_thresh = stability_score_thresh
         self.stability_score_offset = stability_score_offset
         self.box_nms_thresh = box_nms_thresh
+        self.crop_n_layers = crop_n_layers
+        self.crop_nms_thresh = crop_nms_thresh
+        self.crop_overlap_ratio = crop_overlap_ratio
+        self.min_mask_region_area = min_mask_region_area
+        self.output_mode = output_mode
         self._chunk_fn = None
 
     def _decode_points_chunk(self):
@@ -284,89 +316,343 @@ class SamAutomaticMaskGenerator:
         self._chunk_fn = run
         return run
 
-    def generate(self, image: np.ndarray) -> list:
-        """image (H, W, 3) uint8 -> list of {segmentation, area, bbox
-        (XYWH px), predicted_iou, stability_score, point_coords} dicts,
-        NMS-deduped, sorted by predicted IoU."""
+    def _nms_keep(self, boxes: np.ndarray, scores: np.ndarray,
+                  thresh: float, scale: float) -> np.ndarray:
+        from tmr_tpu.ops.nms import nms_keep_mask
+
+        return np.asarray(
+            nms_keep_mask(
+                jnp.asarray(boxes / scale, jnp.float32),
+                jnp.asarray(scores, jnp.float32), thresh,
+            )
+        )
+
+    def _process_crop(self, image: np.ndarray, crop_box: list,
+                      layer_idx: int, orig_size: tuple) -> dict:
+        """One crop: embed -> point-grid decodes -> quality + crop-edge
+        filters -> within-crop NMS -> uncrop to the image frame
+        (automatic_mask_generator.py:228-271)."""
+        from tmr_tpu import sam_amg
+
+        orig_h, orig_w = orig_size
+        cx0, cy0, cx1, cy1 = crop_box
+        cropped = image[cy0:cy1, cx0:cx1]
+        ch, cw = cropped.shape[:2]
         pred = self.predictor
-        pred.set_image(image)
+        pred.set_image(cropped)
         s = pred.sam.image_size
-        h, w = pred.orig_hw
-        sh, sw = h * pred.scale, w * pred.scale
 
-        n = self.points_per_side
-        xs = (np.arange(n) + 0.5) / n * sw
-        ys = (np.arange(n) + 0.5) / n * sh
-        grid = np.stack(np.meshgrid(xs, ys), axis=-1).reshape(-1, 2)
-
+        grid_crop = self.point_grids[layer_idx] * np.array([[cw, ch]])
         run = self._decode_points_chunk()
         chunk = self.points_per_batch
-        n_pad = math.ceil(len(grid) / chunk) * chunk
-        grid_p = np.pad(grid, ((0, n_pad - len(grid)), (0, 0)))
+        n_pts = len(grid_crop)
+        n_pad = math.ceil(n_pts / chunk) * chunk
+        grid_model = np.pad(
+            grid_crop * pred.scale, ((0, n_pad - n_pts), (0, 0))
+        )
 
-        results = []
+        masks_crop, boxes_crop, ious, stabs, points = [], [], [], [], []
         for i in range(0, n_pad, chunk):
-            pts = jnp.asarray(grid_p[i : i + chunk], jnp.float32)
-            masks, iou, stab, area, boxes, nonempty = run(
+            pts = jnp.asarray(grid_model[i : i + chunk], jnp.float32)
+            mask_logits, iou, stab, _, _, nonempty = run(
                 pred.sam.params, pred.features, pts
             )
             iou = np.asarray(iou)
             stab = np.asarray(stab)
+            # reference thresholds: iou strictly >, stability >=
+            # (automatic_mask_generator.py _process_batch)
             keep = (
                 (iou > self.pred_iou_thresh)
-                & (stab > self.stability_score_thresh)
+                & (stab >= self.stability_score_thresh)
                 & np.asarray(nonempty)
             )
-            keep[max(0, len(grid) - i):] = False  # padding points
-            for j in np.nonzero(keep)[0]:
-                results.append(
-                    {
-                        "mask_logits": np.asarray(masks[j]),
-                        "predicted_iou": float(iou[j]),
-                        "stability_score": float(stab[j]),
-                        "box_model": np.asarray(boxes[j]) * (s / masks.shape[1]),
-                        "point_coords": grid_p[i + j] / pred.scale,
-                    }
-                )
-
-        if not results:
-            return []
-
-        # NMS dedupe on mask boxes (automatic_mask_generator.py box_nms)
-        from tmr_tpu.ops.nms import nms_keep_mask
-
-        bx = jnp.asarray(
-            np.stack([r["box_model"] for r in results]), jnp.float32
-        )
-        sc = jnp.asarray([r["predicted_iou"] for r in results], jnp.float32)
-        keep = np.asarray(nms_keep_mask(bx / s, sc, self.box_nms_thresh))
-
-        out = []
-        for r, k in zip(results, keep):
-            if not k:
+            keep[max(0, n_pts - i):] = False  # padding points
+            kept = np.nonzero(keep)[0]
+            if len(kept) == 0:
                 continue
-            # low-res decoder logits -> full padded-square resolution first;
-            # _to_original's unpad-crop works in model-space pixels
+            # low-res logits -> padded-square resolution, then unpad-crop
             full = np.asarray(
-                resize_align_corners(
-                    jnp.asarray(r["mask_logits"])[None], (s, s)
-                )[0]
+                resize_align_corners(mask_logits[kept], (s, s))
             )
-            mask = pred._to_original(full)
+            for row, j in enumerate(kept):
+                mask = pred._to_original(full[row])  # (ch, cw) bool
+                ys_, xs_ = np.nonzero(mask)
+                if len(xs_) == 0:
+                    continue
+                masks_crop.append(mask)
+                boxes_crop.append(
+                    [xs_.min(), ys_.min(), xs_.max(), ys_.max()]
+                )
+                ious.append(float(iou[j]))
+                stabs.append(float(stab[j]))
+                points.append(grid_crop[i + j])
+
+        if not masks_crop:
+            return {}
+        boxes_crop = np.asarray(boxes_crop, np.float32)
+        ious = np.asarray(ious, np.float32)
+
+        # drop masks cut by the crop edge (amg.py:78-89) BEFORE deduping, like the
+        # reference (_process_batch filters, then _process_crop NMSes) — an
+        # edge-cut mask must never suppress a valid interior mask
+        edge = sam_amg.is_box_near_crop_edge(
+            boxes_crop, crop_box, [0, 0, orig_w, orig_h]
+        )
+        keep = ~edge
+        if keep.any():
+            from tmr_tpu.ops.nms import nms_keep_mask
+
+            nms_keep = np.asarray(
+                nms_keep_mask(
+                    jnp.asarray(boxes_crop / max(ch, cw), jnp.float32),
+                    jnp.asarray(ious, jnp.float32),
+                    self.box_nms_thresh,
+                    valid=jnp.asarray(keep),
+                )
+            )
+            keep &= nms_keep
+        idx = np.nonzero(keep)[0]
+        if len(idx) == 0:
+            return {}
+
+        rles = [
+            sam_amg.mask_to_rle(
+                sam_amg.uncrop_mask(masks_crop[i], crop_box, orig_h, orig_w)
+            )
+            for i in idx
+        ]
+        return {
+            "rles": rles,
+            "boxes": sam_amg.uncrop_boxes_xyxy(boxes_crop[idx], crop_box),
+            "iou_preds": ious[idx],
+            "stability": np.asarray(stabs, np.float32)[idx],
+            "points": sam_amg.uncrop_points(
+                np.asarray(points, np.float32)[idx], crop_box
+            ),
+            "crop_boxes": np.tile(
+                np.asarray(crop_box, np.float32)[None], (len(idx), 1)
+            ),
+        }
+
+    def _postprocess_small_regions(self, data: dict, min_area: int,
+                                   nms_thresh: float, orig_size: tuple) -> dict:
+        """Fill small holes / drop small islands, then re-dedupe preferring
+        untouched masks (automatic_mask_generator.py:283-332)."""
+        from tmr_tpu import sam_amg
+
+        new_rles, new_boxes, unchanged = [], [], []
+        for rle in data["rles"]:
+            mask = sam_amg.rle_to_mask(rle)
+            mask, ch_holes = sam_amg.remove_small_regions(
+                mask, min_area, "holes"
+            )
+            mask, ch_isl = sam_amg.remove_small_regions(
+                mask, min_area, "islands"
+            )
+            new_rles.append(sam_amg.mask_to_rle(mask))
             ys_, xs_ = np.nonzero(mask)
             if len(xs_) == 0:
+                new_boxes.append([0.0, 0.0, 0.0, 0.0])
+            else:
+                new_boxes.append(
+                    [xs_.min(), ys_.min(), xs_.max(), ys_.max()]
+                )
+            unchanged.append(not (ch_holes or ch_isl))
+        new_boxes = np.asarray(new_boxes, np.float32)
+        # prefer masks NMS didn't have to touch
+        keep = self._nms_keep(
+            new_boxes, np.asarray(unchanged, np.float32), nms_thresh,
+            max(orig_size),
+        )
+        data = dict(data)
+        data["rles"] = new_rles
+        data["boxes"] = new_boxes
+        return sam_amg.filter_records(data, keep)
+
+    def generate(self, image: np.ndarray) -> list:
+        """image (H, W, 3) uint8 -> list of {segmentation, area, bbox
+        (XYWH px), predicted_iou, stability_score, point_coords, crop_box}
+        dicts, NMS-deduped, sorted by predicted IoU
+        (automatic_mask_generator.py:122-226)."""
+        from tmr_tpu import sam_amg
+
+        orig_h, orig_w = image.shape[:2]
+        crop_boxes, layer_idxs = sam_amg.generate_crop_boxes(
+            (orig_h, orig_w), self.crop_n_layers, self.crop_overlap_ratio
+        )
+        data = sam_amg.cat_records(
+            *[
+                self._process_crop(image, cb, li, (orig_h, orig_w))
+                for cb, li in zip(crop_boxes, layer_idxs)
+            ]
+        )
+        if not data or len(data["rles"]) == 0:
+            return []
+
+        if len(crop_boxes) > 1:
+            # dedupe across crops, preferring masks from smaller crops
+            areas = (data["crop_boxes"][:, 2] - data["crop_boxes"][:, 0]) * (
+                data["crop_boxes"][:, 3] - data["crop_boxes"][:, 1]
+            )
+            keep = self._nms_keep(
+                data["boxes"], 1.0 / np.maximum(areas, 1.0),
+                self.crop_nms_thresh, max(orig_h, orig_w),
+            )
+            data = sam_amg.filter_records(data, keep)
+
+        if self.min_mask_region_area > 0:
+            data = self._postprocess_small_regions(
+                data, self.min_mask_region_area,
+                max(self.box_nms_thresh, self.crop_nms_thresh),
+                (orig_h, orig_w),
+            )
+
+        out = []
+        for i, rle in enumerate(data["rles"]):
+            if self.output_mode == "coco_rle":
+                seg = sam_amg.coco_encode_rle(rle)
+            elif self.output_mode == "binary_mask":
+                seg = sam_amg.rle_to_mask(rle)
+            else:
+                seg = rle
+            area = sam_amg.area_from_rle(rle)
+            if area == 0:
                 continue
-            x0, y0 = int(xs_.min()), int(ys_.min())
-            bw, bh = int(xs_.max() - x0 + 1), int(ys_.max() - y0 + 1)
             out.append(
                 {
-                    "segmentation": mask,
-                    "area": int(mask.sum()),
-                    "bbox": [x0, y0, bw, bh],
-                    "predicted_iou": r["predicted_iou"],
-                    "stability_score": r["stability_score"],
-                    "point_coords": [r["point_coords"].tolist()],
+                    "segmentation": seg,
+                    "area": area,
+                    # XYWH with w = x_max - x_min (inclusive-max XYXY through
+                    # box_xyxy_to_xywh — the reference's batched_mask_to_box
+                    # + box_xyxy_to_xywh convention)
+                    "bbox": sam_amg.box_xyxy_to_xywh(
+                        data["boxes"][i]
+                    ).tolist(),
+                    "predicted_iou": float(data["iou_preds"][i]),
+                    "stability_score": float(data["stability"][i]),
+                    "point_coords": [np.asarray(data["points"][i]).tolist()],
+                    "crop_box": sam_amg.box_xyxy_to_xywh(
+                        np.asarray(data["crop_boxes"][i])
+                    ).tolist(),
                 }
             )
         out.sort(key=lambda d: -d["predicted_iou"])
         return out
+
+
+class SamDeployDecoder:
+    """Deployable prompt->mask program (utils/segment_anything/utils/onnx.py
+    ``SamOnnxModel``): prompt encoding + mask decoding + mask postprocessing
+    in one traceable function with the same input surface, so a runtime with
+    no model code can drive SAM from cached image embeddings.
+
+    Where the reference exports to ONNX with dynamic shapes, the TPU-native
+    artifact is serialized StableHLO (utils/export.export_sam_decoder) with
+    a symbolic prompt-count dimension; ``orig_im_size`` is a static build
+    argument (XLA compiles per output resolution — resolutions are few and
+    the compile is cached, vs. ONNX carrying dynamic resize ops).
+    """
+
+    def __init__(
+        self,
+        sam: Sam,
+        return_single_mask: bool,
+        use_stability_score: bool = False,
+        return_extra_metrics: bool = False,
+        stability_score_offset: float = 1.0,
+        mask_threshold: float = 0.0,
+    ):
+        self.sam = sam
+        self.decoder_all = sam.mask_decoder.clone(return_all_masks=True)
+        self.return_single_mask = return_single_mask
+        self.use_stability_score = use_stability_score
+        self.return_extra_metrics = return_extra_metrics
+        self.stability_score_offset = stability_score_offset
+        self.mask_threshold = mask_threshold
+
+    @staticmethod
+    def resize_longest_image_size(orig_hw, longest_side: int):
+        """floor(scale * size + 0.5) (onnx.py:41-48)."""
+        h, w = orig_hw
+        scale = longest_side / max(h, w)
+        return (int(scale * h + 0.5), int(scale * w + 0.5))
+
+    def _select_masks(self, masks, iou_preds, num_points):
+        """Single-click vs multi-click token choice without control flow
+        (onnx.py:95-108): with <= 2 point slots (one click + padding) token 0
+        is penalized by -500 so the best multimask token (1..3) wins; with
+        > 2 real clicks token 0 is boosted by +500 and always wins."""
+        t = masks.shape[1]
+        reweight = jnp.asarray([1000.0] + [0.0] * (t - 1))
+        score = iou_preds + (num_points - 2.5) * reweight[None]
+        best = jnp.argmax(score, axis=1)
+        m = jnp.take_along_axis(masks, best[:, None, None, None], axis=1)
+        s = jnp.take_along_axis(iou_preds, best[:, None], axis=1)
+        return m, s  # (N, 1, H, W), (N, 1)
+
+    def _stability(self, masks):
+        off = self.stability_score_offset
+        hi = (masks > self.mask_threshold + off).sum((-1, -2))
+        lo = (masks > self.mask_threshold - off).sum((-1, -2))
+        return hi / jnp.maximum(lo, 1)
+
+    def __call__(
+        self,
+        params: dict,
+        image_embeddings: jnp.ndarray,  # (1, h, w, C)
+        point_coords: jnp.ndarray,  # (N, P, 2) px in model space
+        point_labels: jnp.ndarray,  # (N, P) in {-1, 0, 1}
+        mask_input: jnp.ndarray,  # (N, 4h, 4w, 1)
+        has_mask_input: jnp.ndarray,  # (N,) or scalar in {0., 1.}
+        orig_im_size,  # static (H, W)
+    ):
+        """Mirrors SamOnnxModel.forward (onnx.py:110-144). Jittable."""
+        sam = self.sam
+        s = sam.image_size
+        pe_params = {"params": params["prompt_encoder"]}
+        emb_hw = image_embeddings.shape[1:3]
+        pe = sam.prompt_encoder
+
+        sparse = pe.apply(pe_params, point_coords, point_labels, (s, s),
+                          method=PromptEncoder.embed_points)
+        n = point_coords.shape[0]
+        masked = pe.apply(pe_params, mask_input, method=PromptEncoder.embed_masks)
+        unmasked = pe.apply(pe_params, n, emb_hw,
+                            method=PromptEncoder.no_mask_dense)
+        has = jnp.reshape(
+            jnp.broadcast_to(jnp.asarray(has_mask_input, jnp.float32), (n,)),
+            (n, 1, 1, 1),
+        )
+        dense = has * masked + (1.0 - has) * unmasked
+        image_pe = pe.apply(pe_params, emb_hw, method=PromptEncoder.dense_pe)
+
+        masks, scores = self.decoder_all.apply(
+            {"params": params["mask_decoder"]},
+            image_embeddings.astype(jnp.float32), image_pe, sparse, dense,
+        )  # (N, T, 4h, 4w), (N, T)
+
+        if self.use_stability_score:
+            scores = self._stability(masks)
+        if self.return_single_mask:
+            masks, scores = self._select_masks(
+                masks, scores, point_coords.shape[1]
+            )
+
+        # postprocess: 4h grid -> model square -> unpad -> original size
+        # (onnx.py:77-93; align_corners=False at both resizes)
+        up = jax.image.resize(
+            masks, masks.shape[:2] + (s, s), method="bilinear",
+            antialias=False,
+        )
+        ph, pw = self.resize_longest_image_size(orig_im_size, s)
+        up = up[..., :ph, :pw]
+        out = jax.image.resize(
+            up, up.shape[:2] + tuple(orig_im_size), method="bilinear",
+            antialias=False,
+        )
+
+        if self.return_extra_metrics:
+            stab = self._stability(out)
+            areas = (out > self.mask_threshold).sum((-1, -2))
+            return out, scores, stab, areas, masks
+        return out, scores, masks

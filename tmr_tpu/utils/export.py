@@ -85,3 +85,61 @@ def exported_input_spec(path: str):
         exported = jax_export.deserialize(f.read())
     avals = exported.in_avals
     return avals[0].shape, avals[0].dtype
+
+
+def export_sam_decoder(
+    deploy,
+    params: dict,
+    embed_hw,
+    num_points: int = 2,
+    orig_im_size=(1024, 1024),
+    platforms: Sequence[str] = DEFAULT_PLATFORMS,
+    dynamic_prompts: bool = True,
+    n_prompts: int = 1,
+) -> bytes:
+    """Serialize a SamDeployDecoder (the reference SamOnnxModel surface,
+    utils/segment_anything/utils/onnx.py) to StableHLO.
+
+    ``deploy``: tmr_tpu.sam.SamDeployDecoder. Inputs of the artifact mirror
+    the ONNX export's: (image_embeddings, point_coords, point_labels,
+    mask_input, has_mask_input); the prompt-count axis is symbolic when
+    ``dynamic_prompts`` (the ONNX dynamic axis), while points-per-prompt and
+    the output resolution are static specializations.
+    """
+    h, w = embed_hw
+    dim = deploy.sam.prompt_encoder.embed_dim
+
+    def fn(image_embeddings, point_coords, point_labels, mask_input,
+           has_mask_input):
+        return deploy(
+            params, image_embeddings, point_coords, point_labels,
+            mask_input, has_mask_input, orig_im_size,
+        )
+
+    if dynamic_prompts:
+        (n,) = jax_export.symbolic_shape("n")
+    else:
+        n = n_prompts
+    specs = (
+        jax.ShapeDtypeStruct((1, h, w, dim), jnp.float32),
+        jax.ShapeDtypeStruct((n, num_points, 2), jnp.float32),
+        jax.ShapeDtypeStruct((n, num_points), jnp.int32),
+        jax.ShapeDtypeStruct((n, 4 * h, 4 * w, 1), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+    )
+    exported = jax_export.export(jax.jit(fn), platforms=list(platforms))(
+        *specs
+    )
+    return exported.serialize()
+
+
+def load_exported_decoder(path: str) -> Callable:
+    """Deserialize an export_sam_decoder artifact into a plain callable."""
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+
+    @jax.jit
+    def call(*args):
+        return exported.call(*args)
+
+    return call
