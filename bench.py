@@ -41,9 +41,14 @@ import numpy as np
 A100_BASELINE_IMG_PER_SEC = 30.0  # documented estimate, see module docstring
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak of one TPU v5e chip
 
-BATCH = 4
-IMAGE_SIZE = 1024
-CHAIN = 20
+# env overrides exist so the full script logic can be exercised on CPU at
+# tiny sizes (TMR_BENCH_SIZE=256 TMR_BENCH_BATCH=1 ...); the driver runs the
+# defaults on the real chip.
+import os
+
+BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
+IMAGE_SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
+CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 20))
 
 
 def forward_tflops_per_image(

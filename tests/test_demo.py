@@ -126,3 +126,79 @@ def test_checkpoint_roundtrip(tmp_path):
     _, b1, s1 = e1.infer(img, [[8, 8, 24, 24]])
     _, b2, s2 = e2.infer(img, [[8, 8, 24, 24]])
     np.testing.assert_allclose(s1, s2, rtol=1e-5)
+
+
+def test_multi_exemplar_batched_equals_sequential():
+    """The encode-once K-batched multi-exemplar program must reproduce the
+    REFERENCE composition (trainer.py:75-121): per-exemplar forward +
+    decode with NO per-exemplar NMS, concat, one union NMS. Also checks
+    that k-bucket padding rows are fully masked (k=3 pads to bucket 3; a
+    second call with k=2 shares no padded detections)."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.ops.postprocess import batched_nms
+
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True, image_size=64,
+        NMS_cls_threshold=0.05, NMS_iou_threshold=0.5, max_detections=32,
+        template_buckets=(5, 9), compute_dtype="float32",
+    )
+    tiny = MatchingNet(
+        backbone=SamViT(
+            embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64,
+        ),
+        emb_dim=16, fusion=True, template_capacity=9,
+    )
+    pred = Predictor(cfg, model=tiny)
+    pred.init_params(seed=0, image_size=64)
+    rng = np.random.default_rng(11)
+    image = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+    exemplars = np.array(
+        [[0.1, 0.1, 0.35, 0.3], [0.5, 0.55, 0.72, 0.8], [0.3, 0.6, 0.45, 0.75]],
+        np.float32,
+    )
+
+    def reference_composition(exs):
+        cap = pred.pick_capacity(exs, 64)
+        model = tiny.clone(template_capacity=cap)
+        parts = []
+        for ex in exs:
+            out = model.apply(
+                {"params": pred.params}, jnp.asarray(image),
+                jnp.asarray(ex)[None, None, :],
+            )
+            parts.append(pred._decode(out, jnp.asarray(ex)[None, :]))
+        merged = {
+            k: jnp.concatenate([p[k] for p in parts], axis=1)
+            for k in ("boxes", "scores", "refs", "valid")
+        }
+        return batched_nms(merged, cfg.NMS_iou_threshold)
+
+    for exs in (exemplars, exemplars[:2]):  # bucket 3 exact + padded (2->2)
+        got = pred.predict_multi_exemplar(image, exs)
+        want = reference_composition(exs)
+        gv = np.asarray(got["valid"][0])
+        wv = np.asarray(want["valid"][0])
+        assert gv.sum() == wv.sum() and gv.sum() > 0
+        gs = np.sort(np.asarray(got["scores"][0])[gv])
+        ws = np.sort(np.asarray(want["scores"][0])[wv])
+        np.testing.assert_allclose(gs, ws, rtol=1e-5, atol=1e-6)
+        gb = np.asarray(got["boxes"][0])[gv]
+        wb = np.asarray(want["boxes"][0])[wv]
+        np.testing.assert_allclose(
+            gb[np.lexsort(gb.T)], wb[np.lexsort(wb.T)], rtol=1e-5, atol=1e-5
+        )
+
+    # forcing a padded bucket: k=4 pads to bucket 4; k=5 pads to 6
+    ex5 = np.concatenate([exemplars, exemplars[:2]], axis=0)
+    got5 = pred.predict_multi_exemplar(image, ex5)
+    want5 = reference_composition(ex5)
+    assert (
+        np.asarray(got5["valid"][0]).sum()
+        == np.asarray(want5["valid"][0]).sum()
+    )

@@ -21,9 +21,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import flax.linen as nn
+
 from tmr_tpu.models import build_model
 from tmr_tpu.models.matching_net import select_capacity_bucket
 from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+
+
+class _PassthroughBackbone(nn.Module):
+    """Stand-in backbone for head-only programs fed precomputed features."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x
 
 
 class Predictor:
@@ -43,8 +53,7 @@ class Predictor:
         self.params = params
         self.refiner = refiner
         self.refiner_params = refiner_params
-        self._compiled: Dict[Tuple[int, bool], callable] = {}  # (capacity, refine)
-        self._nms_fn = None
+        self._compiled: Dict[tuple, callable] = {}
 
     def init_params(self, seed: int = 0, image_size: Optional[int] = None):
         s = image_size or self.cfg.image_size
@@ -65,6 +74,31 @@ class Predictor:
         base = image_size // stride
         return base * 2 if self.cfg.feature_upsample else base
 
+    def _decode(self, out: dict, exemplars: jnp.ndarray) -> dict:
+        """Peak-pick + decode model outputs into fixed detection slots
+        (shared by the single- and multi-exemplar programs)."""
+        cfg = self.cfg
+        return decode_detections(
+            out["objectness"],
+            out["regressions"],
+            exemplars,
+            cls_threshold=cfg.NMS_cls_threshold,
+            max_detections=cfg.max_detections,
+            box_reg=cfg.box_reg,
+            scale_imgsize=cfg.regression_scaling_imgsize,
+            scale_wh_only=cfg.regression_scaling_WH_only,
+        )
+
+    def _refine_nms(self, dets: dict, feature, image_hw, refiner_params,
+                    refine: bool) -> dict:
+        """[refine ->] NMS tail (reference test-step order trainer.py:143-150,
+        shared by the single- and multi-exemplar programs)."""
+        if refine:
+            dets = self.refiner.refine(
+                refiner_params, feature, dets, image_hw
+            )
+        return batched_nms(dets, self.cfg.NMS_iou_threshold)
+
     def _get_fn(self, capacity: int):
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
@@ -73,30 +107,15 @@ class Predictor:
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
-        cfg = self.cfg
-        refiner = self.refiner
 
         @jax.jit
         def run(params, refiner_params, image, exemplars):
             out = model.apply({"params": params}, image, exemplars)
-            dets = decode_detections(
-                out["objectness"],
-                out["regressions"],
-                exemplars[:, 0, :],
-                cls_threshold=cfg.NMS_cls_threshold,
-                max_detections=cfg.max_detections,
-                box_reg=cfg.box_reg,
-                scale_imgsize=cfg.regression_scaling_imgsize,
-                scale_wh_only=cfg.regression_scaling_WH_only,
+            dets = self._decode(out, exemplars[:, 0, :])
+            return self._refine_nms(
+                dets, out["backbone_feature"],
+                (image.shape[1], image.shape[2]), refiner_params, refine,
             )
-            if refine:
-                dets = refiner.refine(
-                    refiner_params,
-                    out["backbone_feature"],
-                    dets,
-                    (image.shape[1], image.shape[2]),
-                )
-            return batched_nms(dets, cfg.NMS_iou_threshold)
 
         self._compiled[key] = run
         return run
@@ -126,22 +145,87 @@ class Predictor:
             jnp.asarray(exemplars),
         )
 
+    #: static exemplar-count buckets for the multi-exemplar program: the
+    #: compiled fn is keyed by bucket, real counts pad up and padded rows'
+    #: detections are masked out — variable per-image exemplar counts
+    #: (FSCD-LVIS) don't trigger a full recompile each.
+    K_BUCKETS = (1, 2, 3, 4, 6, 8)
+
+    def _get_multi_fn(self, capacity: int, k_bucket: int):
+        """One fused program for K-exemplar inference: encoder ONCE, then the
+        matcher/decode pipeline batched over the K exemplars, union NMS.
+
+        The reference runs a full forward per exemplar and one union NMS at
+        the end (trainer.py:75-121: per-exemplar Get_pred_boxes with NO
+        per-exemplar NMS, concat, [refine], NMS — demo.py:111-132 likewise),
+        recomputing the frozen encoder K times. Here the encoder output is
+        broadcast to a K-batch for the heads — identical numerics (the
+        encoder is deterministic), ~K x fewer encoder FLOPs, one dispatch.
+        """
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        key = ("multi", capacity, k_bucket, refine)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model.clone(template_capacity=capacity)
+        heads = model.clone(backbone=_PassthroughBackbone())
+
+        @jax.jit
+        def run(params, refiner_params, image, exemplars, k_real):
+            # image (1, S, S, 3); exemplars (k_bucket, 4); k_real () int32
+            feat = model.backbone.apply(
+                {"params": params["backbone"]}, image
+            )
+            if isinstance(feat, (list, tuple)):
+                if len(feat) != 1:
+                    raise NotImplementedError(
+                        "fused multi-exemplar inference supports single-"
+                        "level backbones only (every shipped backbone is)"
+                    )
+                feat = feat[0]
+            head_params = {n: v for n, v in params.items() if n != "backbone"}
+            out = heads.apply(
+                {"params": head_params},
+                jnp.repeat(feat, k_bucket, axis=0),
+                exemplars[:, None, :],
+            )
+            dets = self._decode(out, exemplars)
+            # mask padded exemplar rows, then concat the K per-exemplar slot
+            # sets into one image's union
+            row_ok = jnp.arange(k_bucket) < k_real
+            dets["valid"] = dets["valid"] & row_ok[:, None]
+            merged = {
+                name: dets[name].reshape((1, -1) + dets[name].shape[2:])
+                for name in ("boxes", "scores", "refs", "valid")
+            }
+            return self._refine_nms(
+                merged, feat, (image.shape[1], image.shape[2]),
+                refiner_params, refine,
+            )
+
+        self._compiled[key] = run
+        return run
+
     def predict_multi_exemplar(self, image, exemplars) -> dict:
-        """Reference multi-exemplar eval (trainer.py:75-121): independent
-        per-exemplar passes, detections concatenated, single NMS over the
-        union. image (1, S, S, 3); exemplars (K, 4)."""
-        parts = [
-            self(image, np.asarray(ex, np.float32)[None, None, :])
-            for ex in np.asarray(exemplars).reshape(-1, 4)
-        ]
-        merged = {
-            k: jnp.concatenate([p[k] for p in parts], axis=1)
-            for k in ("boxes", "scores", "refs", "valid")
-        }
-        if self._nms_fn is None:
-            iou = self.cfg.NMS_iou_threshold
-            self._nms_fn = jax.jit(lambda d: batched_nms(d, iou))
-        return self._nms_fn(merged)
+        """Reference multi-exemplar eval (trainer.py:75-121): per-exemplar
+        decode, concatenated, single NMS over the union. image (1, S, S, 3);
+        exemplars (K, 4)."""
+        if self.params is None:
+            raise RuntimeError("call init_params() or load params first")
+        exemplars = np.asarray(exemplars, np.float32).reshape(-1, 4)
+        k = len(exemplars)
+        k_bucket = next((b for b in self.K_BUCKETS if b >= k), k)
+        pad = np.tile(exemplars[-1:], (k_bucket - k, 1))  # masked below
+        cap = self.pick_capacity(exemplars, int(image.shape[1]))
+        fn = self._get_multi_fn(cap, k_bucket)
+        return fn(
+            self.params,
+            self.refiner_params,
+            jnp.asarray(image),
+            jnp.asarray(np.concatenate([exemplars, pad], axis=0)),
+            jnp.asarray(k, jnp.int32),
+        )
 
 
 def detections_to_numpy(dets: dict) -> list:
