@@ -310,3 +310,85 @@ def test_blockwise_attention_matches_dense_at_global_grid():
     want_nb = dense_attention(q, k, v, scale=scale)
     np.testing.assert_allclose(np.asarray(got_nb), np.asarray(want_nb),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fold_rel_pos_into_qk_exact():
+    """The augmented-QK trick (ops/flash_attn.py) must reproduce the biased
+    scores EXACTLY in f32: q'.k'^T == scale*q.k^T + decomposed bias."""
+    import numpy as np
+
+    from tmr_tpu.ops.flash_attn import fold_rel_pos_into_qk
+    from tmr_tpu.parallel.ring import dense_attention
+
+    rng = np.random.default_rng(3)
+    B, H, gh, gw, D = 2, 2, 6, 10, 16
+    S = gh * gw
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    rh = jnp.asarray(rng.standard_normal((gh, gh, D)), jnp.float32) * 0.3
+    rw = jnp.asarray(rng.standard_normal((gw, gw, D)), jnp.float32) * 0.3
+    scale = D**-0.5
+
+    r_q = q.reshape(B, H, gh, gw, D)
+    rel_h = jnp.einsum("bnhwc,hkc->bnhwk", r_q, rh)
+    rel_w = jnp.einsum("bnhwc,wkc->bnhwk", r_q, rw)
+    bias = (rel_h[..., :, None] + rel_w[..., None, :]).reshape(B, H, S, S)
+    want_scores = (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + bias
+    )
+
+    q_aug, k_aug = fold_rel_pos_into_qk(q, k, rh, rw, (gh, gw), scale,
+                                        pad_to=128)
+    assert q_aug.shape[-1] == 128 and k_aug.shape[-1] == 128
+    got_scores = jnp.einsum("bhqd,bhkd->bhqk", q_aug, k_aug)
+    np.testing.assert_allclose(
+        np.asarray(got_scores), np.asarray(want_scores), rtol=1e-5, atol=1e-5
+    )
+
+    # end to end: softmax(q'.k') @ v == biased dense attention
+    want = dense_attention(q, k, v, bias=bias, scale=scale)
+    got = dense_attention(q_aug, k_aug, v, scale=1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # no-bias variant: just scaled/padded passthrough
+    q2, k2 = fold_rel_pos_into_qk(q, k, None, None, (gh, gw), scale)
+    np.testing.assert_allclose(np.asarray(q2), np.asarray(q) * scale,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(k2), np.asarray(k), rtol=1e-6)
+
+
+def test_flash_attention_ok_is_false_off_tpu():
+    from tmr_tpu.ops.flash_attn import flash_attention_ok
+
+    assert flash_attention_ok() is False  # CPU test backend -> XLA path
+
+
+def test_flash_block_size_selection():
+    from tmr_tpu.ops.flash_attn import _block_for, flash_supported
+
+    assert _block_for(4096, 512) == 512
+    assert _block_for(9216, 512) == 512  # 1536 bucket: 9216 = 512*18
+    assert _block_for(2500, 512) is None  # 50x50 grid: no pow2 factor >=128
+    assert _block_for(1024, 512) == 512
+    assert _block_for(1280, 512) == 256
+    assert flash_supported(4096) and not flash_supported(2500)
+
+
+def test_flash_attention_ok_callable_under_trace():
+    """flash_attention_ok is invoked while TRACING the model; it must not
+    leak tracers or poison its cache when first called inside jit."""
+    from tmr_tpu.ops.flash_attn import flash_attention_ok
+
+    flash_attention_ok.cache_clear()
+    seen = []
+
+    @jax.jit
+    def traced(x):
+        seen.append(flash_attention_ok())  # trace-time call
+        return x + 1
+
+    traced(jnp.zeros((2,)))
+    assert seen == [False]  # CPU backend -> disabled, but no exception/tracer
+    flash_attention_ok.cache_clear()

@@ -212,8 +212,22 @@ class Attention(nn.Module):
             x = self._ring_attn(q, k, v, rh, rw, (b, h, w, dim), head_dim)
         elif h * w >= 1024:
             # global-attention blocks (4096+ tokens): never materialize the
-            # S x S scores or the (B, H, h, w, h, w) bias
-            x = blockwise_decomposed_attention(
+            # S x S scores or the (B, H, h, w, h, w) bias. On TPU in bf16,
+            # the Pallas flash kernel runs the rel-pos bias folded into the
+            # QK contraction (ops/flash_attn.py) behind a one-time compiled
+            # self-check; everywhere else (and for exact-f32 parity) the XLA
+            # blockwise path.
+            attn_fn = blockwise_decomposed_attention
+            if self.dtype == jnp.bfloat16:
+                from tmr_tpu.ops.flash_attn import (
+                    flash_attention_ok,
+                    flash_decomposed_attention,
+                    flash_supported,
+                )
+
+                if flash_supported(h * w) and flash_attention_ok():
+                    attn_fn = flash_decomposed_attention
+            x = attn_fn(
                 q, k, v,
                 rh if self.use_rel_pos else None,
                 rw if self.use_rel_pos else None,

@@ -1,0 +1,200 @@
+"""Flash attention for the ViT's global blocks, rel-pos folded into QK.
+
+The SAM encoder's global-attention blocks add a *decomposed relative
+position* bias (reference sam_ViT.py:325-361):
+
+    bias[t=(y,x), u=(ky,kx)] = q[t].RH[y,ky] + q[t].RW[x,kx]
+
+A fused (flash) attention kernel cannot take a per-pair bias without
+materializing it — which is the whole thing being avoided. The trick here
+folds the bias INTO the contraction, making biased attention a *standard*
+attention any flash kernel runs unmodified:
+
+    q' = [ q*scale | rel_h_q | rel_w_q ]        (D + gh + gw features)
+    k' = [ k       | onehot(ky) | onehot(kx) ]
+
+so  q'.k' = scale*(q.k) + rel_h_q[t, ky] + rel_w_q[t, kx]  exactly, where
+rel_h_q = einsum(q, RH) (B, H, S, gh) and rel_w_q = einsum(q, RW) are the
+cheap O(S*grid) projections. With gh = gw = 64 and D = 64 the augmented
+head dim is 192, padded to 256 for MXU lane alignment — ~4x the qk FLOPs of
+the plain path, a few extra ms at v5e peak, in exchange for ZERO S x S HBM
+traffic inside jax.experimental.pallas's TPU flash kernel (VMEM-resident
+tiles, online softmax).
+
+Used by models/vit.py on the TPU bf16 path behind a one-time compiled
+self-check (the pallas_nms pattern); every other configuration takes the
+exact XLA blockwise path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_rel_pos_into_qk(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+    pad_to: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, H, S, D) q/k + (gh, gh, D)/(gw, gw, D) tables -> augmented q', k'
+    with q'.k'^T == scale * q.k^T + decomposed rel-pos bias (exact in f32).
+
+    With rh/rw None the bias terms are skipped (q' = q*scale, k' = k, plus
+    optional zero-padding). ``pad_to`` zero-pads the feature axis (zeros
+    contribute nothing to the contraction) for lane alignment.
+    """
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    parts_q = [q * jnp.asarray(scale, q.dtype)]
+    parts_k = [k]
+    if rh is not None:
+        r_q = q.reshape(B, H, gh, gw, D).astype(jnp.float32)
+        rel_h_q = jnp.einsum(
+            "bhywd,ykd->bhywk", r_q, rh.astype(jnp.float32)
+        ).reshape(B, H, S, gh)
+        rel_w_q = jnp.einsum(
+            "bhywd,wkd->bhywk", r_q, rw.astype(jnp.float32)
+        ).reshape(B, H, S, gw)
+        parts_q += [rel_h_q.astype(q.dtype), rel_w_q.astype(q.dtype)]
+        # key token u = ky*gw + kx selects its bias entries via one-hots
+        rows = jnp.repeat(jnp.eye(gh, dtype=k.dtype), gw, axis=0)  # (S, gh)
+        cols = jnp.tile(jnp.eye(gw, dtype=k.dtype), (gh, 1))  # (S, gw)
+        parts_k += [
+            jnp.broadcast_to(rows[None, None], (B, H, S, gh)),
+            jnp.broadcast_to(cols[None, None], (B, H, S, gw)),
+        ]
+    q_aug = jnp.concatenate(parts_q, axis=-1)
+    k_aug = jnp.concatenate(parts_k, axis=-1)
+    if pad_to is not None and q_aug.shape[-1] < pad_to:
+        pad = pad_to - q_aug.shape[-1]
+        widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+        q_aug = jnp.pad(q_aug, widths)
+        k_aug = jnp.pad(k_aug, widths)
+    return q_aug, k_aug
+
+
+def _lane_pad(d: int) -> int:
+    return ((d + 127) // 128) * 128
+
+
+def _block_for(s: int, preferred: int) -> Optional[int]:
+    """Largest power-of-two block <= preferred that divides ``s`` (the stock
+    kernel asserts seq_len % block == 0); None when s has no usable
+    power-of-two factor >= 128."""
+    b = preferred
+    while b >= 128:
+        if s % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def flash_supported(seq_len: int) -> bool:
+    """True when the stock kernel's block constraints can be met for S."""
+    return _block_for(seq_len, 512) is not None
+
+
+def flash_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """Pallas TPU flash attention over the augmented q'/k' (bias exact up to
+    input-dtype rounding). q/k/v: (B, H, S, D); returns (B, H, S, D)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
+
+    B, H, S, D = q.shape
+    d_aug = D + (grid_hw[0] + grid_hw[1] if rh is not None else 0)
+    pad_to = _lane_pad(d_aug)
+    q_aug, k_aug = fold_rel_pos_into_qk(
+        q, k, rh, rw, grid_hw, scale, pad_to=pad_to
+    )
+    v_pad = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad_to - D)))
+    bq = _block_for(S, block_q)
+    bk = _block_for(S, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"sequence length {S} has no power-of-two block >= 128; gate "
+            "callers on flash_supported()"
+        )
+    sizes = BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+    out = flash_attention(
+        q_aug, k_aug, v_pad, causal=False, sm_scale=1.0, block_sizes=sizes
+    )
+    return out[..., :D].astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=1)
+def flash_attention_ok() -> bool:
+    """One-time self-check of the compiled flash path on this backend.
+
+    Compares the Pallas kernel (with folded rel-pos) against the exact XLA
+    blockwise path on a small bf16 case; any exception (Mosaic lowering,
+    unsupported backend) or disagreement beyond bf16 tolerance disables the
+    flash path for the process. TMR_NO_FLASH_ATTN=1 force-disables.
+
+    The first call happens while TRACING the model (Attention.__call__ only
+    ever runs under jit), so the whole check runs under
+    ``jax.ensure_compile_time_eval()`` — concrete values, real compiled
+    executions, no leakage into the ambient trace.
+    """
+    if os.environ.get("TMR_NO_FLASH_ATTN"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    import numpy as np
+
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    try:
+        with jax.ensure_compile_time_eval():
+            rng = np.random.default_rng(0)
+            B, H, gh, gw, D = 1, 2, 16, 32, 64  # S=512, rectangular grid
+            S = gh * gw
+            q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+            k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+            v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+            rh = jnp.asarray(
+                rng.standard_normal((gh, gh, D)) * 0.2, jnp.float32
+            )
+            rw = jnp.asarray(
+                rng.standard_normal((gw, gw, D)) * 0.2, jnp.float32
+            )
+            scale = D**-0.5
+            got = jax.jit(
+                lambda *a: flash_decomposed_attention(
+                    *a, (gh, gw), scale, block_q=256, block_k=256
+                )
+            )(q, k, v, rh, rw)
+            want = jax.jit(
+                lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+            )(q, k, v, rh, rw)
+            err = np.abs(
+                np.asarray(got, np.float32) - np.asarray(want, np.float32)
+            ).max()
+            scale_ref = np.abs(np.asarray(want, np.float32)).max() + 1e-6
+            return bool(err / scale_ref < 0.05)
+    except Exception:
+        return False
