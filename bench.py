@@ -153,12 +153,19 @@ def main() -> None:
         _ = jax.device_get(tiny(fb))
     rtt = (time.perf_counter() - t0) / 3
 
-    t0 = time.perf_counter()
+    # TMR_BENCH_PROFILE=<dir>: capture an xprof trace of the timed loop
+    # (utils/profiling.trace) for per-op analysis in TensorBoard. The timed
+    # window sits INSIDE the trace context so profiler start/flush costs
+    # don't pollute the reported number.
+    from tmr_tpu.utils.profiling import trace
+
     fb = fb * 0.0
-    for _ in range(CHAIN):
-        dets, fb = step(params, image, exemplars, fb)
-    _ = jax.device_get(fb)
-    dt = time.perf_counter() - t0
+    with trace(os.environ.get("TMR_BENCH_PROFILE")):
+        t0 = time.perf_counter()
+        for _ in range(CHAIN):
+            dets, fb = step(params, image, exemplars, fb)
+        _ = jax.device_get(fb)
+        dt = time.perf_counter() - t0
 
     per_batch = max((dt - rtt) / CHAIN, 1e-9)
     img_per_sec = BATCH / per_batch

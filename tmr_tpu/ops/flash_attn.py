@@ -171,7 +171,11 @@ def flash_attention_ok() -> bool:
     try:
         with jax.ensure_compile_time_eval():
             rng = np.random.default_rng(0)
-            B, H, gh, gw, D = 1, 2, 16, 32, 64  # S=512, rectangular grid
+            # production-shaped check: S divisible by the real 512 blocks,
+            # full-width key grid (gw=64) so d_aug lane-pads to 256 exactly
+            # like the ViT-B/H deployments — a config-specific Mosaic
+            # failure must trip HERE, inside the try, not in the model trace
+            B, H, gh, gw, D = 1, 2, 16, 64, 64  # S=1024, d_aug=144->256
             S = gh * gw
             q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
             k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
@@ -185,7 +189,7 @@ def flash_attention_ok() -> bool:
             scale = D**-0.5
             got = jax.jit(
                 lambda *a: flash_decomposed_attention(
-                    *a, (gh, gw), scale, block_q=256, block_k=256
+                    *a, (gh, gw), scale, block_q=512, block_k=512
                 )
             )(q, k, v, rh, rw)
             want = jax.jit(
