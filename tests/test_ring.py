@@ -360,8 +360,6 @@ def test_fold_rel_pos_into_qk_exact():
 
 
 def test_flash_attention_ok_is_false_off_tpu():
-    import pytest
-
     if jax.default_backend() == "tpu":  # pragma: no cover - CPU CI suite
         pytest.skip("flash path legitimately enabled on TPU")
     from tmr_tpu.ops.flash_attn import flash_attention_ok
@@ -383,8 +381,6 @@ def test_flash_block_size_selection():
 def test_flash_attention_ok_callable_under_trace():
     """flash_attention_ok is invoked while TRACING the model; it must not
     leak tracers or poison its cache when first called inside jit."""
-    import pytest
-
     if jax.default_backend() == "tpu":  # pragma: no cover - CPU CI suite
         pytest.skip("flash path legitimately enabled on TPU")
     from tmr_tpu.ops.flash_attn import flash_attention_ok

@@ -99,23 +99,37 @@ class Predictor:
             )
         return batched_nms(dets, self.cfg.NMS_iou_threshold)
 
-    def _get_fn(self, capacity: int):
+    def _get_fn(self, capacity: int, loss_fn=None):
+        """Compiled forward -> decode -> [refine] -> NMS program for one
+        template-capacity bucket.
+
+        With ``loss_fn(model_out, exemplars, *extra) -> losses`` the program
+        additionally returns losses computed from the SAME forward — the
+        trainer's eval step (the reference's each_step computes loss and
+        Get_pred_boxes from one forward, trainer.py:123-153) — and the
+        returned callable takes the extra loss inputs after ``exemplars``.
+        There is exactly one copy of this pipeline; every consumer
+        (inference, trainer eval) compiles through it.
+        """
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
         )
-        key = (capacity, refine)  # refine is baked into the compiled program
+        key = (capacity, refine, loss_fn is not None)
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
 
         @jax.jit
-        def run(params, refiner_params, image, exemplars):
+        def run(params, refiner_params, image, exemplars, *extra):
             out = model.apply({"params": params}, image, exemplars)
             dets = self._decode(out, exemplars[:, 0, :])
-            return self._refine_nms(
+            dets = self._refine_nms(
                 dets, out["backbone_feature"],
                 (image.shape[1], image.shape[2]), refiner_params, refine,
             )
+            if loss_fn is None:
+                return dets
+            return loss_fn(out, exemplars, *extra), dets
 
         self._compiled[key] = run
         return run

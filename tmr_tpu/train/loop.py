@@ -197,6 +197,28 @@ class Trainer:
             jnp.asarray(batch["gt_valid"]),
         )
 
+    def _get_eval_step(self, capacity: int):
+        """ONE forward per eval image: losses + decoded/NMS'd detections
+        from the same model outputs — the reference's each_step test branch
+        (trainer.py:123-153 computes loss and Get_pred_boxes from a single
+        forward; running the predictor separately would double the encoder
+        cost of every eval epoch). The pipeline itself lives in
+        Predictor._get_fn — this only supplies the loss closure."""
+        cfg = self.cfg
+
+        def loss_fn(out, exemplars, gt_boxes, gt_valid):
+            return compute_losses(
+                out,
+                {"exemplars": exemplars, "gt_boxes": gt_boxes,
+                 "gt_valid": gt_valid},
+                cfg.positive_threshold, cfg.negative_threshold,
+                use_focal_loss=cfg.focal_loss,
+                scale_imgsize=cfg.regression_scaling_imgsize,
+                scale_wh_only=cfg.regression_scaling_WH_only,
+            )
+
+        return self.predictor._get_fn(capacity, loss_fn=loss_fn)
+
     # ---------------------------------------------------------------- train
     def fit(self, max_steps_per_epoch: Optional[int] = None) -> None:
         cfg = self.cfg
@@ -295,18 +317,27 @@ class Trainer:
         sums = None  # device-scalar pytree, fetched once per epoch
         n = 0
         for batch in loader:
-            losses = self._eval_losses(params, batch)
-            sums = losses if sums is None else self._acc_fn(sums, losses)
-            n += 1
-
             if cfg.num_exemplars > 1:
+                losses = self._eval_losses(params, batch)
                 dets = self.predictor.predict_multi_exemplar(
                     batch["image"], batch["meta"][0]["orig_exemplars"]
                     / np.array(batch["meta"][0]["img_size"].tolist() * 2,
                                np.float32),
                 )
             else:
-                dets = self.predictor(batch["image"], batch["exemplars"])
+                # fused: losses + detections from one forward
+                cap = self.predictor.pick_capacity(
+                    batch["exemplars"], int(batch["image"].shape[1])
+                )
+                losses, dets = self._get_eval_step(cap)(
+                    params, self.predictor.refiner_params,
+                    jnp.asarray(batch["image"]),
+                    jnp.asarray(batch["exemplars"]),
+                    jnp.asarray(batch["gt_boxes"]),
+                    jnp.asarray(batch["gt_valid"]),
+                )
+            sums = losses if sums is None else self._acc_fn(sums, losses)
+            n += 1
             image_info_collector(
                 cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
             )
