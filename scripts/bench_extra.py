@@ -35,6 +35,17 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# TMR_BENCH_TINY=1: shrink every config so the whole script smoke-runs on
+# CPU in minutes (validating the code paths); real numbers use defaults.
+TINY = os.environ.get("TMR_BENCH_TINY", "") not in ("", "0", "false")
+SIZE = 256 if TINY else 1024
+SIZE_HI = 384 if TINY else 1536
+BACKBONE_B = "sam_vit_b"
+BACKBONE_H = "sam_vit_b" if TINY else "sam_vit_h"
+DTYPE = "float32" if TINY else "bfloat16"
+N_ITER = 2 if TINY else 5
+N_ITER_LONG = 2 if TINY else 8  # 1536/train keep the longer average
+
 
 def _chain_time(step, n, *args):
     """Chained timing: step(*args, fb) -> (out, fb'); returns sec/iter."""
@@ -67,13 +78,13 @@ def bench_demo() -> dict:
     from tmr_tpu.config import preset
     from tmr_tpu.inference import Predictor
 
-    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=1024,
-                 compute_dtype="bfloat16", batch_size=1)
+    cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE,
+                 compute_dtype=DTYPE, batch_size=1)
     pred = Predictor(cfg)
-    pred.init_params(seed=0, image_size=1024)
+    pred.init_params(seed=0, image_size=SIZE)
     rng = np.random.default_rng(0)
     image = jnp.asarray(
-        rng.standard_normal((1, 1024, 1024, 3)), jnp.float32
+        rng.standard_normal((1, SIZE, SIZE, 3)), jnp.float32
     )  # staged on device once
     exemplars = np.array(
         [[0.45, 0.45, 0.53, 0.55], [0.2, 0.2, 0.27, 0.28],
@@ -81,7 +92,7 @@ def bench_demo() -> dict:
     )
     out = pred.predict_multi_exemplar(image, exemplars)  # compile
     _ = jax.device_get(out["scores"])
-    n = 5
+    n = N_ITER
     t0 = time.perf_counter()
     for _ in range(n):
         out = pred.predict_multi_exemplar(image, exemplars)
@@ -134,10 +145,11 @@ def bench_1536() -> dict:
     """The small-object escalation bucket (eval protocol: batch 1)."""
     from tmr_tpu.config import preset
 
-    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=1536,
-                 compute_dtype="bfloat16", batch_size=1)
-    step, params, image, ex = _fused_eval_step(cfg, 17, 1536)
-    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), 8, params, image, ex)
+    cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE_HI,
+                 compute_dtype=DTYPE, batch_size=1)
+    step, params, image, ex = _fused_eval_step(cfg, 17, SIZE_HI)
+    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), N_ITER_LONG,
+                     params, image, ex)
     return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
 
 
@@ -146,14 +158,15 @@ def bench_refine() -> dict:
     from tmr_tpu.config import preset
     from tmr_tpu.refine import build_refiner
 
-    cfg = preset("TMR_RPINE", backbone="sam_vit_h", image_size=1024,
-                 compute_dtype="bfloat16", batch_size=1, refine_box=True,
-                 max_detections=1100)
+    cfg = preset("TMR_RPINE", backbone=BACKBONE_H, image_size=SIZE,
+                 compute_dtype=DTYPE, batch_size=1, refine_box=True,
+                 max_detections=64 if TINY else 1100)
     refiner, rparams = build_refiner(cfg, seed=0)
     step, params, image, ex = _fused_eval_step(
-        cfg, 33, 1024, refiner=refiner, refiner_params=rparams
+        cfg, 33, SIZE, refiner=refiner, refiner_params=rparams
     )
-    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), 5, params, image, ex)
+    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), N_ITER,
+                     params, image, ex)
     return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
 
 
@@ -165,23 +178,25 @@ def bench_train() -> dict:
     from tmr_tpu.config import preset
     from tmr_tpu.train.state import create_train_state, make_train_step
 
-    cfg = preset("TMR_FSCD_LVIS_Unseen", backbone="sam_vit_b",
-                 image_size=1024, compute_dtype="bfloat16", batch_size=4)
+    cfg = preset("TMR_FSCD_LVIS_Unseen", backbone=BACKBONE_B,
+                 image_size=SIZE, compute_dtype=DTYPE,
+                 batch_size=2 if TINY else 4)
     from tmr_tpu.models import build_model
 
     model = build_model(cfg).clone(template_capacity=17)
+    b = cfg.batch_size
     rng = np.random.default_rng(0)
     batch = {
         "image": jnp.asarray(
-            rng.standard_normal((4, 1024, 1024, 3)), jnp.float32
+            rng.standard_normal((b, SIZE, SIZE, 3)), jnp.float32
         ),
         "exemplars": jnp.tile(
-            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (4, 1, 1)
+            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (b, 1, 1)
         ),
         "gt_boxes": jnp.tile(
-            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (4, 8, 1)
+            jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (b, 8, 1)
         ),
-        "gt_valid": jnp.ones((4, 8), bool),
+        "gt_valid": jnp.ones((b, 8), bool),
     }
     state = create_train_state(
         model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
@@ -191,18 +206,18 @@ def bench_train() -> dict:
 
     state, losses = step(state, batch)  # compile
     _ = jax.device_get(losses["loss"])
-    n = 8
+    n = N_ITER_LONG
     t0 = time.perf_counter()
     for _ in range(n):
         state, losses = step(state, batch)
     _ = jax.device_get(losses["loss"])
     dt = (time.perf_counter() - t0) / n
-    return {"img_per_sec": round(4.0 / dt, 3), "sec_per_step": round(dt, 4),
-            "batch": 4}
+    return {"img_per_sec": round(b / dt, 3), "sec_per_step": round(dt, 4),
+            "batch": b}
 
 
 def _write_synthetic_shards(root: str, n_shards=4, imgs_per_shard=8,
-                            size=512) -> list:
+                            size=512) -> list:  # size: source JPEG side
     """Easy_/Normal_/Hard_ tar shards of random JPEGs (mapper.py layout)."""
     from PIL import Image
 
@@ -241,19 +256,34 @@ def bench_stream() -> dict:
         run_stream_native,
     )
 
-    encoder, params = build_sam_encoder("vit_b", image_size=1024)
+    if TINY:
+        from tmr_tpu.models.vit import SamViT
+
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        encoder = SamViT(
+            embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            patch_size=8, window_size=3, out_chans=16,
+            pretrain_img_size=SIZE,
+        )
+        params = _jax.jit(encoder.init)(
+            _jax.random.key(0), _jnp.zeros((1, SIZE, SIZE, 3))
+        )["params"]
+    else:
+        encoder, params = build_sam_encoder("vit_b", image_size=SIZE)
     fn = make_encode_stats_fn(encoder, params)
     out = {}
     with tempfile.TemporaryDirectory() as root:
-        paths = _write_synthetic_shards(root)
+        paths = _write_synthetic_shards(root, size=SIZE // 2)
         n_imgs = 4 * 8
         # warmup/compile on one shard
-        run_stream(paths[:1], fn, batch_size=8, image_size=1024)
+        run_stream(paths[:1], fn, batch_size=8, image_size=SIZE)
         for label, runner in (("native", run_stream_native),
                               ("python", run_stream)):
             try:
                 t0 = time.perf_counter()
-                acc = runner(paths, fn, batch_size=8, image_size=1024)
+                acc = runner(paths, fn, batch_size=8, image_size=SIZE)
                 dt = time.perf_counter() - t0
                 out[label] = {
                     "img_per_sec": round(n_imgs / dt, 3),
