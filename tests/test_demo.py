@@ -202,3 +202,122 @@ def test_multi_exemplar_batched_equals_sequential():
         np.asarray(got5["valid"][0]).sum()
         == np.asarray(want5["valid"][0]).sum()
     )
+
+
+def test_multi_exemplar_losses_sum_per_exemplar():
+    """With a loss_fn, the fused multi program returns the SUM of
+    independent per-exemplar losses (reference trainer.py:102-104,121),
+    padded k-bucket rows excluded."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.train.state import compute_losses
+
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True, image_size=64,
+        NMS_cls_threshold=0.05, NMS_iou_threshold=0.5, max_detections=32,
+        template_buckets=(5, 9), compute_dtype="float32",
+        positive_threshold=0.5, negative_threshold=0.5,
+    )
+    tiny = MatchingNet(
+        backbone=SamViT(
+            embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64,
+        ),
+        emb_dim=16, fusion=True, template_capacity=9,
+    )
+    pred = Predictor(cfg, model=tiny)
+    pred.init_params(seed=0, image_size=64)
+    rng = np.random.default_rng(3)
+    image = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+    exemplars = np.array(
+        [[0.1, 0.1, 0.35, 0.3], [0.5, 0.55, 0.72, 0.8]], np.float32
+    )
+    gt_boxes = np.array(
+        [[[0.1, 0.1, 0.35, 0.3], [0.5, 0.55, 0.72, 0.8],
+          [0.2, 0.6, 0.4, 0.8]]], np.float32,
+    )
+    gt_valid = np.ones((1, 3), bool)
+
+    def loss_fn(out, ex, gt_b, gt_v):
+        return compute_losses(
+            out, {"exemplars": ex, "gt_boxes": gt_b, "gt_valid": gt_v},
+            positive_threshold=0.5, negative_threshold=0.5,
+        )
+
+    losses, dets = pred.predict_multi_exemplar(
+        image, exemplars, loss_fn=loss_fn,
+        loss_args=(jnp.asarray(gt_boxes), jnp.asarray(gt_valid)),
+    )
+    assert "boxes" in dets
+
+    # oracle: independent full forward + loss per exemplar, summed
+    cap = pred.pick_capacity(exemplars, 64)
+    model = tiny.clone(template_capacity=cap)
+    want = None
+    for ex in exemplars:
+        out = model.apply(
+            {"params": pred.params}, jnp.asarray(image),
+            jnp.asarray(ex)[None, None, :],
+        )
+        li = loss_fn(out, jnp.asarray(ex)[None, None, :],
+                     jnp.asarray(gt_boxes), jnp.asarray(gt_valid))
+        want = li if want is None else {
+            k: want[k] + li[k] for k in want
+        }
+    for k in want:
+        np.testing.assert_allclose(
+            float(losses[k]), float(want[k]), rtol=1e-5,
+            err_msg=f"loss key {k}",
+        )
+
+
+def test_multi_exemplar_losses_with_box_reg_ablated():
+    """ablation_no_box_regression emits None regression levels; the fused
+    multi-exemplar loss path must handle them (criterion's dummy giou)."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.models.vit import SamViT
+    from tmr_tpu.train.state import compute_losses
+
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True, image_size=64,
+        NMS_cls_threshold=0.05, NMS_iou_threshold=0.5, max_detections=32,
+        template_buckets=(9,), compute_dtype="float32",
+        ablation_no_box_regression=True,
+    )
+    tiny = MatchingNet(
+        backbone=SamViT(
+            embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64,
+        ),
+        emb_dim=16, fusion=True, template_capacity=9, box_reg=False,
+    )
+    pred = Predictor(cfg, model=tiny)
+    pred.init_params(seed=0, image_size=64)
+    rng = np.random.default_rng(4)
+    image = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+    exemplars = np.array(
+        [[0.1, 0.1, 0.35, 0.3], [0.5, 0.55, 0.72, 0.8]], np.float32
+    )
+    gt_boxes = np.array([[[0.1, 0.1, 0.35, 0.3]]], np.float32)
+    gt_valid = np.ones((1, 1), bool)
+
+    def loss_fn(out, ex, gt_b, gt_v):
+        return compute_losses(
+            out, {"exemplars": ex, "gt_boxes": gt_b, "gt_valid": gt_v},
+            positive_threshold=0.5, negative_threshold=0.5,
+        )
+
+    losses, dets = pred.predict_multi_exemplar(
+        image, exemplars, loss_fn=loss_fn,
+        loss_args=(jnp.asarray(gt_boxes), jnp.asarray(gt_valid)),
+    )
+    assert np.isfinite(float(losses["loss_ce"]))
+    assert np.isfinite(np.asarray(dets["boxes"]).sum())

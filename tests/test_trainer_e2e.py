@@ -34,10 +34,10 @@ def _write_fixture(root, n_train=4, n_val=2):
             arr[cy - 5 : cy + 5, cx - 5 : cx + 5] = 220
             boxes.append([cx - 5, cy - 5, 10, 10])
         Image.fromarray(arr).save(f"{root}/images_384_VarV2/{n}")
-        x, y, w, h = boxes[0]
         annos[n] = {
             "box_examples_coordinates": [
                 [[x, y], [x, y + h], [x + w, y + h], [x + w, y]]
+                for (x, y, w, h) in boxes  # both objects -> K=2 exemplars
             ]
         }
         for b in boxes:
@@ -259,3 +259,39 @@ def test_trainer_refine_box_end_to_end(tmp_path):
         or not np.allclose(r_scores, u_scores)
     )
     assert changed, "refinement had no effect on detections"
+
+
+def test_trainer_multi_exemplar_eval_branch(tmp_path):
+    """num_exemplars > 1 routes eval through the fused multi-exemplar
+    program (per-exemplar losses summed + union NMS) end to end."""
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    _write_fixture(root)
+
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.train.loop import Trainer
+
+    cfg = Config(
+        dataset="FSCD147", datapath=root, logpath=logdir,
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        feature_upsample=False, image_size=64,
+        positive_threshold=0.5, negative_threshold=0.5,
+        NMS_cls_threshold=0.3, NMS_iou_threshold=0.5,
+        lr=2e-3, lr_backbone=0.0, max_epochs=1, AP_term=1,
+        batch_size=2, num_workers=2, max_gt_boxes=8,
+        compute_dtype="float32", max_detections=64,
+        template_buckets=(9,), num_exemplars=2,
+    )
+    trainer = Trainer(cfg)
+    tiny = MatchingNet(
+        backbone=SamViT(**TINY_VIT), emb_dim=cfg.emb_dim, fusion=True,
+        template_capacity=9,
+    )
+    trainer.model = tiny
+    trainer.predictor = Predictor(cfg, model=tiny)
+    trainer.fit()
+    csv_path = os.path.join(logdir, "metrics.csv")
+    content = open(csv_path).read()
+    assert "val/AP" in content and "val/loss_ce" in content
+    assert np.isfinite(trainer.ckpt.meta["best_value"] or 0.0)

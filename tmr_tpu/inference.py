@@ -114,7 +114,7 @@ class Predictor:
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
         )
-        key = (capacity, refine, loss_fn is not None)
+        key = (capacity, refine, loss_fn)
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
@@ -165,7 +165,7 @@ class Predictor:
     #: (FSCD-LVIS) don't trigger a full recompile each.
     K_BUCKETS = (1, 2, 3, 4, 6, 8)
 
-    def _get_multi_fn(self, capacity: int, k_bucket: int):
+    def _get_multi_fn(self, capacity: int, k_bucket: int, loss_fn=None):
         """One fused program for K-exemplar inference: encoder ONCE, then the
         matcher/decode pipeline batched over the K exemplars, union NMS.
 
@@ -175,18 +175,24 @@ class Predictor:
         recomputing the frozen encoder K times. Here the encoder output is
         broadcast to a K-batch for the heads — identical numerics (the
         encoder is deterministic), ~K x fewer encoder FLOPs, one dispatch.
+
+        ``loss_fn(out_k, exemplar_k, *extra) -> losses`` computes one
+        exemplar's losses from its B=1 slice of the heads output; the
+        program vmaps it over the K axis, masks padded rows, and returns the
+        SUM over real exemplars — the reference's multi-exemplar loss
+        semantics (trainer.py:102-104,121 sums per-exemplar losses).
         """
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
         )
-        key = ("multi", capacity, k_bucket, refine)
+        key = ("multi", capacity, k_bucket, refine, loss_fn)
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
         heads = model.clone(backbone=_PassthroughBackbone())
 
         @jax.jit
-        def run(params, refiner_params, image, exemplars, k_real):
+        def run(params, refiner_params, image, exemplars, k_real, *extra):
             # image (1, S, S, 3); exemplars (k_bucket, 4); k_real () int32
             feat = model.backbone.apply(
                 {"params": params["backbone"]}, image
@@ -213,18 +219,42 @@ class Predictor:
                 name: dets[name].reshape((1, -1) + dets[name].shape[2:])
                 for name in ("boxes", "scores", "refs", "valid")
             }
-            return self._refine_nms(
+            final = self._refine_nms(
                 merged, feat, (image.shape[1], image.shape[2]),
                 refiner_params, refine,
             )
+            if loss_fn is None:
+                return final
+
+            def one_exemplar_losses(obj_k, reg_k, ex_k):
+                out_k = {
+                    "objectness": [o[None] for o in obj_k],
+                    # None levels = box regression ablated (matching_net)
+                    "regressions": [
+                        r[None] if r is not None else None for r in reg_k
+                    ],
+                }
+                return loss_fn(out_k, ex_k[None, None, :], *extra)
+
+            per_k = jax.vmap(one_exemplar_losses)(
+                [o for o in out["objectness"]],
+                [r for r in out["regressions"]],
+                exemplars,
+            )
+            losses = jax.tree.map(
+                lambda v: jnp.where(row_ok, v, 0.0).sum(), per_k
+            )
+            return losses, final
 
         self._compiled[key] = run
         return run
 
-    def predict_multi_exemplar(self, image, exemplars) -> dict:
+    def predict_multi_exemplar(self, image, exemplars, loss_fn=None,
+                               loss_args=()):
         """Reference multi-exemplar eval (trainer.py:75-121): per-exemplar
         decode, concatenated, single NMS over the union. image (1, S, S, 3);
-        exemplars (K, 4)."""
+        exemplars (K, 4). With ``loss_fn`` (see _get_multi_fn) returns
+        (losses summed over exemplars, dets); else just dets."""
         if self.params is None:
             raise RuntimeError("call init_params() or load params first")
         exemplars = np.asarray(exemplars, np.float32).reshape(-1, 4)
@@ -232,13 +262,14 @@ class Predictor:
         k_bucket = next((b for b in self.K_BUCKETS if b >= k), k)
         pad = np.tile(exemplars[-1:], (k_bucket - k, 1))  # masked below
         cap = self.pick_capacity(exemplars, int(image.shape[1]))
-        fn = self._get_multi_fn(cap, k_bucket)
+        fn = self._get_multi_fn(cap, k_bucket, loss_fn=loss_fn)
         return fn(
             self.params,
             self.refiner_params,
             jnp.asarray(image),
             jnp.asarray(np.concatenate([exemplars, pad], axis=0)),
             jnp.asarray(k, jnp.int32),
+            *loss_args,
         )
 
 

@@ -109,7 +109,7 @@ class Trainer:
         )
         self.state = None
         self._train_step = None
-        self._eval_loss_fn = None
+        self._shared_loss_fn = None  # one closure -> one compiled program
         # device-side loss accumulator: one tiny jitted add per step instead
         # of a host float() sync (which would stall the prefetch pipeline)
         self._acc_fn = jax.jit(lambda s, l: jax.tree.map(jnp.add, s, l))
@@ -173,37 +173,13 @@ class Trainer:
             return shard_batch(arrays, self.mesh)
         return {k: jnp.asarray(v) for k, v in arrays.items()}
 
-    def _eval_losses(self, params, batch):
-        if self._eval_loss_fn is None:
-            cfg = self.cfg
-
-            @jax.jit
-            def fn(params, image, exemplars, gt_boxes, gt_valid):
-                out = self.model.apply({"params": params}, image, exemplars)
-                return compute_losses(
-                    out,
-                    {"exemplars": exemplars, "gt_boxes": gt_boxes,
-                     "gt_valid": gt_valid},
-                    cfg.positive_threshold, cfg.negative_threshold,
-                    use_focal_loss=cfg.focal_loss,
-                    scale_imgsize=cfg.regression_scaling_imgsize,
-                    scale_wh_only=cfg.regression_scaling_WH_only,
-                )
-
-            self._eval_loss_fn = fn
-        return self._eval_loss_fn(
-            params, jnp.asarray(batch["image"]),
-            jnp.asarray(batch["exemplars"]), jnp.asarray(batch["gt_boxes"]),
-            jnp.asarray(batch["gt_valid"]),
-        )
-
-    def _get_eval_step(self, capacity: int):
-        """ONE forward per eval image: losses + decoded/NMS'd detections
-        from the same model outputs — the reference's each_step test branch
-        (trainer.py:123-153 computes loss and Get_pred_boxes from a single
-        forward; running the predictor separately would double the encoder
-        cost of every eval epoch). The pipeline itself lives in
-        Predictor._get_fn — this only supplies the loss closure."""
+    def _loss_fn(self):
+        """Loss closure shared by the fused eval programs:
+        (model_out, exemplars (B,K,4), gt_boxes, gt_valid) -> loss dict.
+        Built once — the predictor's compile cache is keyed on the closure
+        object, so a fresh closure per call would recompile."""
+        if self._shared_loss_fn is not None:
+            return self._shared_loss_fn
         cfg = self.cfg
 
         def loss_fn(out, exemplars, gt_boxes, gt_valid):
@@ -217,7 +193,17 @@ class Trainer:
                 scale_wh_only=cfg.regression_scaling_WH_only,
             )
 
-        return self.predictor._get_fn(capacity, loss_fn=loss_fn)
+        self._shared_loss_fn = loss_fn
+        return loss_fn
+
+    def _get_eval_step(self, capacity: int):
+        """ONE forward per eval image: losses + decoded/NMS'd detections
+        from the same model outputs — the reference's each_step test branch
+        (trainer.py:123-153 computes loss and Get_pred_boxes from a single
+        forward; running the predictor separately would double the encoder
+        cost of every eval epoch). The pipeline itself lives in
+        Predictor._get_fn — this only supplies the loss closure."""
+        return self.predictor._get_fn(capacity, loss_fn=self._loss_fn())
 
     # ---------------------------------------------------------------- train
     def fit(self, max_steps_per_epoch: Optional[int] = None) -> None:
@@ -318,11 +304,15 @@ class Trainer:
         n = 0
         for batch in loader:
             if cfg.num_exemplars > 1:
-                losses = self._eval_losses(params, batch)
-                dets = self.predictor.predict_multi_exemplar(
+                # one fused program: per-exemplar losses SUMMED (reference
+                # trainer.py:102-104,121) + union detections
+                losses, dets = self.predictor.predict_multi_exemplar(
                     batch["image"], batch["meta"][0]["orig_exemplars"]
                     / np.array(batch["meta"][0]["img_size"].tolist() * 2,
                                np.float32),
+                    loss_fn=self._loss_fn(),
+                    loss_args=(jnp.asarray(batch["gt_boxes"]),
+                               jnp.asarray(batch["gt_valid"])),
                 )
             else:
                 # fused: losses + detections from one forward
