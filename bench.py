@@ -99,8 +99,6 @@ def main() -> None:
     import jax.numpy as jnp
 
     from tmr_tpu.config import preset
-    from tmr_tpu.models import build_model
-    from tmr_tpu.ops.postprocess import batched_nms, decode_detections
     from tmr_tpu.utils.cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -112,7 +110,13 @@ def main() -> None:
         compute_dtype="bfloat16",
         batch_size=BATCH,
     )
-    model = build_model(cfg).clone(template_capacity=17)
+    # the PRODUCTION fused program via the Predictor's chain_feedback hook —
+    # the benchmark compiles the same pipeline eval runs, no copy
+    from tmr_tpu.inference import Predictor
+
+    predictor = Predictor(cfg)
+    predictor.init_params(seed=0, image_size=IMAGE_SIZE)
+    params = predictor.params
     rng = np.random.default_rng(0)
     image = jnp.asarray(
         rng.standard_normal((BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)), jnp.float32
@@ -121,24 +125,10 @@ def main() -> None:
     exemplars = jnp.tile(
         jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
     )
-    params = jax.jit(model.init)(jax.random.key(0), image, exemplars)["params"]
+    fused = predictor._get_fn(17, chain_feedback=True)
 
-    @jax.jit
     def step(p, im, ex, fb):
-        # fb chains iterations into back-to-back device execution; the add
-        # happens INSIDE the program so no extra standalone op is timed
-        im = im + fb
-        out = model.apply({"params": p}, im, ex)
-        dets = decode_detections(
-            out["objectness"], out["regressions"], ex[:, 0, :],
-            cls_threshold=cfg.NMS_cls_threshold,
-            max_detections=cfg.max_detections,
-            box_reg=cfg.box_reg,
-            scale_imgsize=cfg.regression_scaling_imgsize,
-            scale_wh_only=cfg.regression_scaling_WH_only,
-        )
-        dets = batched_nms(dets, cfg.NMS_iou_threshold)
-        return dets, jnp.sum(dets["scores"]) * 0.0
+        return fused(p, None, im, ex, fb)
 
     # warmup / compile
     fb0 = jnp.zeros((), jnp.float32)

@@ -1,147 +1,144 @@
 """Per-component timing breakdown of the flagship inference program.
 
-Times each stage of the fused FSCD-147 eval program (SAM ViT-B @ 1024,
-feature upsample, 512-d matcher, decoders, peak decode + NMS) in isolation
-on the current default device, so perf work has a measured target instead of
-guesses. Run on the real TPU:
+Times the pipeline stages of the fused FSCD-147 eval program (SAM ViT-B @
+1024, feature upsample, 512-d matcher, decoders, peak decode + NMS) in
+isolation, with the SAME methodology as bench.py (PERF.md Finding 1):
+device-staged inputs, iterations chained through a scalar data dependency
+inside each jitted program, one closing fetch, measured RTT floor
+subtracted — `jax.block_until_ready` is advisory over the tunneled
+transport and must not be trusted.
 
-    python scripts/profile_breakdown.py
-
-Prints a JSON breakdown {stage: seconds_per_batch}.
+Run on the real TPU:   python scripts/profile_breakdown.py
+Prints a JSON breakdown {stage: seconds_per_iteration}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tmr_tpu.config import preset
-from tmr_tpu.models import build_model
-from tmr_tpu.utils.cache import enable_compilation_cache
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BATCH = 4
-SIZE = 1024
-ITERS = 5
+BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
+SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
+CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 10))
 
 
-def timeit(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
+def _rtt() -> float:
+    tiny = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros((), jnp.float32)
+    _ = jax.device_get(tiny(z))
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / ITERS
+    for _ in range(3):
+        _ = jax.device_get(tiny(z))
+    return (time.perf_counter() - t0) / 3
+
+
+def chained(fn, *args, rtt: float = 0.0) -> float:
+    """fn(*args, fb) -> (out, fb'): chained sec/iter with the RTT removed."""
+    fb = jnp.zeros((), jnp.float32)
+    out, fb = fn(*args, fb)
+    fb = fb * 0.0
+    _ = jax.device_get(fb)
+    t0 = time.perf_counter()
+    for _ in range(CHAIN):
+        out, fb = fn(*args, fb)
+    _ = jax.device_get(fb)
+    return max((time.perf_counter() - t0 - rtt) / CHAIN, 1e-9)
 
 
 def main():
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.vit import Block
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
     enable_compilation_cache()
     cfg = preset(
-        "TMR_FSCD147",
-        backbone="sam_vit_b",
-        image_size=SIZE,
-        compute_dtype="bfloat16",
-        batch_size=BATCH,
+        "TMR_FSCD147", backbone="sam_vit_b", image_size=SIZE,
+        compute_dtype="bfloat16", batch_size=BATCH,
     )
-    model = build_model(cfg)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=SIZE)
+    params = pred.params
     rng = np.random.default_rng(0)
     image = jnp.asarray(
         rng.standard_normal((BATCH, SIZE, SIZE, 3)), jnp.float32
     )
     exemplars = jnp.tile(
-        jnp.array([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
+        jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
     )
-    params = jax.jit(model.init)(jax.random.key(0), image, exemplars)["params"]
+    rtt = _rtt()
+    report = {"rtt_floor_ms": round(rtt * 1000, 1)}
 
-    report = {}
+    # 1. full fused program (the production pipeline via its bench hook)
+    fused = pred._get_fn(17, chain_feedback=True)
+    report["full_program"] = chained(
+        lambda im, ex, fb: fused(params, None, im, ex, fb),
+        image, exemplars, rtt=rtt,
+    )
 
-    # 1. full model forward
-    fwd = jax.jit(lambda p, im, ex: model.apply({"params": p}, im, ex))
-    report["full_forward"] = timeit(fwd, params, image, exemplars)
-
-    # 2. backbone only
-    bb = model.backbone
+    # 2. backbone alone (chained through the feature sum)
+    bb = pred.model.backbone
     bb_params = params["backbone"]
-    bb_fwd = jax.jit(lambda p, im: bb.apply({"params": p}, im))
-    report["backbone"] = timeit(bb_fwd, bb_params, image)
-    feat = bb_fwd(bb_params, image)
 
-    # 3. single global-attention block vs windowed block (isolated)
-    from tmr_tpu.models.vit import Block
+    @jax.jit
+    def bb_step(p, im, fb):
+        f = bb.apply({"params": p}, im + fb)
+        return f, jnp.sum(f).astype(jnp.float32) * 0.0
 
-    tokens = jnp.asarray(
-        rng.standard_normal((BATCH, 64, 64, 768)), jnp.bfloat16
+    report["backbone"] = chained(
+        lambda im, fb: bb_step(bb_params, im, fb), image, rtt=rtt
     )
-    gblk = Block(num_heads=12, window_size=0, rel_pos_size=(64, 64),
-                 dtype=jnp.bfloat16)
-    gp = jax.jit(gblk.init)(jax.random.key(1), tokens)["params"]
-    g_fwd = jax.jit(lambda p, x: gblk.apply({"params": p}, x))
-    report["one_global_block"] = timeit(g_fwd, gp, tokens)
 
-    wblk = Block(num_heads=12, window_size=14, rel_pos_size=(64, 64),
-                 dtype=jnp.bfloat16)
-    wp = jax.jit(wblk.init)(jax.random.key(1), tokens)["params"]
-    w_fwd = jax.jit(lambda p, x: wblk.apply({"params": p}, x))
-    report["one_windowed_block"] = timeit(w_fwd, wp, tokens)
+    # 3. one global vs one windowed transformer block (768-d, real grid)
+    grid = SIZE // 16
+    tokens = jnp.asarray(
+        rng.standard_normal((BATCH, grid, grid, 768)), jnp.bfloat16
+    )
+    for label, win in (("one_global_block", 0), ("one_windowed_block", 14)):
+        blk = Block(num_heads=12, window_size=win, rel_pos_size=(grid, grid),
+                    dtype=jnp.bfloat16)
+        bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
 
-    # 4. feature upsample + input_proj + matcher (xcorr) on 128^2 @ 512
+        @jax.jit
+        def blk_step(p, x, fb):
+            y = blk.apply({"params": p}, x + fb.astype(x.dtype))
+            return y, jnp.sum(y).astype(jnp.float32) * 0.0
+
+        report[label] = chained(
+            lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
+        )
+
+    # 4. matcher x-corr at two capacities on the upsampled grid
     from tmr_tpu.ops.xcorr import match_templates
 
-    up = jax.image.resize(feat, (BATCH, 128, 128, 256), method="bilinear")
+    up_hw = pred.feature_hw(SIZE)
     proj = jnp.asarray(
-        rng.standard_normal((BATCH, 128, 128, 512)), jnp.float32
+        rng.standard_normal((BATCH, cfg.emb_dim, up_hw, up_hw)), jnp.float32
     )
-    xc = jax.jit(
-        lambda f, e: match_templates(
-            f.transpose(0, 3, 1, 2), e[:, 0, :], capacity=17
+    ex0 = exemplars[:, 0, :]
+    for cap in (17, 127):
+
+        @jax.jit
+        def xc_step(f, e, fb):
+            y = match_templates(f + fb, e, capacity=cap)
+            return y, jnp.sum(y) * 0.0
+
+        report[f"xcorr_cap{cap}"] = chained(
+            lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
         )
-    )
-    report["xcorr_cap17"] = timeit(xc, proj, exemplars)
-    xc65 = jax.jit(
-        lambda f, e: match_templates(
-            f.transpose(0, 3, 1, 2), e[:, 0, :], capacity=65
-        )
-    )
-    report["xcorr_cap65"] = timeit(xc65, proj, exemplars)
 
-    # 5. decoder convs + heads on fused input (1024ch with fusion)
-    from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
-
-    f_cat = jnp.asarray(
-        rng.standard_normal((BATCH, 128, 128, 1024)), jnp.bfloat16
-    )
-    dec = Decoder(num_layers=1, kernel_size=3, dtype=jnp.bfloat16)
-    dp = jax.jit(dec.init)(jax.random.key(2), f_cat)["params"]
-    d_fwd = jax.jit(lambda p, x: dec.apply({"params": p}, x))
-    report["one_decoder_stack"] = timeit(d_fwd, dp, f_cat)
-
-    # 6. decode + NMS
-    from tmr_tpu.ops.postprocess import batched_nms, decode_detections
-
-    obj = jnp.asarray(rng.standard_normal((BATCH, 128, 128)), jnp.float32)
-    regs = jnp.asarray(
-        rng.standard_normal((BATCH, 128, 128, 4)), jnp.float32
-    )
-
-    def post(o, r, ex):
-        dets = decode_detections(
-            [o], [r], ex[:, 0, :],
-            cls_threshold=cfg.NMS_cls_threshold,
-            max_detections=cfg.max_detections,
-            box_reg=cfg.box_reg,
-            scale_imgsize=cfg.regression_scaling_imgsize,
-            scale_wh_only=cfg.regression_scaling_WH_only,
-        )
-        return batched_nms(dets, cfg.NMS_iou_threshold)
-
-    post_fn = jax.jit(post)
-    report["decode_nms"] = timeit(post_fn, obj, regs, exemplars)
-
-    report = {k: round(v, 5) for k, v in report.items()}
+    report = {
+        k: (round(v, 5) if isinstance(v, float) else v)
+        for k, v in report.items()
+    }
     report["batch"] = BATCH
     report["device"] = str(jax.devices()[0])
     print(json.dumps(report, indent=2))

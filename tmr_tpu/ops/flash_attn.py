@@ -171,11 +171,15 @@ def flash_attention_ok() -> bool:
     try:
         with jax.ensure_compile_time_eval():
             rng = np.random.default_rng(0)
-            # production-shaped check: S divisible by the real 512 blocks,
-            # full-width key grid (gw=64) so d_aug lane-pads to 256 exactly
-            # like the ViT-B/H deployments — a config-specific Mosaic
-            # failure must trip HERE, inside the try, not in the model trace
-            B, H, gh, gw, D = 1, 2, 16, 64, 64  # S=1024, d_aug=144->256
+            # PRODUCTION-shaped check: the true 1024-input global-attention
+            # geometry — 64x64 token grid (S=4096, 8 key blocks of 512),
+            # d_aug = 64+64+64 = 192 lane-padded to 256, f32 rel-pos tables
+            # — reduced only in batch/heads (grid/blocks/d are what Mosaic
+            # failures key on). A config-specific failure must trip HERE,
+            # inside the try, not in the model trace. (The 1536 bucket's
+            # 96x96 grid runs the same kernel with more grid steps and the
+            # identical padded depth: 64+96+96 = 256.)
+            B, H, gh, gw, D = 1, 2, 64, 64, 64  # S=4096
             S = gh * gw
             q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
             k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
@@ -188,9 +192,7 @@ def flash_attention_ok() -> bool:
             )
             scale = D**-0.5
             got = jax.jit(
-                lambda *a: flash_decomposed_attention(
-                    *a, (gh, gw), scale, block_q=512, block_k=512
-                )
+                lambda *a: flash_decomposed_attention(*a, (gh, gw), scale)
             )(q, k, v, rh, rw)
             want = jax.jit(
                 lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
