@@ -34,12 +34,18 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 
 import numpy as np
 
 A100_BASELINE_IMG_PER_SEC = 30.0  # documented estimate, see module docstring
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak of one TPU v5e chip
+
+METRIC = (
+    "FSCD-147 eval images/sec/chip (ViT-B 1024, fused match+decode+NMS, "
+    "random weights)"
+)
 
 # env overrides exist so the full script logic can be exercised on CPU at
 # tiny sizes (TMR_BENCH_SIZE=256 TMR_BENCH_BATCH=1 ...); the driver runs the
@@ -49,6 +55,45 @@ import os
 BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
 IMAGE_SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
 CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 20))
+# Overall watchdog. The TPU here sits behind a tunneled transport that has
+# twice been observed to wedge mid-session (remote compiles hang forever, no
+# error). If the whole run exceeds this budget, emit an explicit JSON error
+# line instead of hanging silently past the driver's patience. A daemon
+# timer thread (not SIGALRM) so it fires even while the main thread is
+# blocked inside a native PJRT/gRPC call — exactly the documented wedge.
+ALARM_S = int(os.environ.get("TMR_BENCH_ALARM", 3300))
+
+_T0 = time.time()
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _watchdog_fire() -> None:
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "img/s",
+                "vs_baseline": 0.0,
+                "error": f"watchdog: no result after {ALARM_S}s "
+                "(tunneled TPU backend likely wedged; see PERF.md)",
+            }
+        ),
+        flush=True,
+    )
+    os._exit(0)
+
+
+def _arm_watchdog():
+    if ALARM_S <= 0:
+        return None
+    t = threading.Timer(ALARM_S, _watchdog_fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def forward_tflops_per_image(
@@ -95,6 +140,7 @@ def forward_tflops_per_image(
 
 
 def main() -> None:
+    watchdog = _arm_watchdog()
     import jax
     import jax.numpy as jnp
 
@@ -102,6 +148,7 @@ def main() -> None:
     from tmr_tpu.utils.cache import enable_compilation_cache
 
     enable_compilation_cache()
+    _progress(f"backend init: {jax.devices()}")
 
     cfg = preset(
         "TMR_FSCD147",
@@ -125,6 +172,7 @@ def main() -> None:
     exemplars = jnp.tile(
         jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
     )
+    _progress("params + inputs staged on device")
     fused = predictor._get_fn(17, chain_feedback=True)
 
     def step(p, im, ex, fb):
@@ -134,6 +182,7 @@ def main() -> None:
     fb0 = jnp.zeros((), jnp.float32)
     dets, fb = step(params, image, exemplars, fb0)
     _ = jax.device_get(fb)
+    _progress("fused program compiled + warm")
 
     # round-trip floor: trivial program + scalar fetch
     tiny = jax.jit(lambda x: x + 1.0)
@@ -142,6 +191,7 @@ def main() -> None:
     for _ in range(3):
         _ = jax.device_get(tiny(fb))
     rtt = (time.perf_counter() - t0) / 3
+    _progress(f"rtt floor {rtt * 1000:.1f} ms; starting timed chain x{CHAIN}")
 
     # TMR_BENCH_PROFILE=<dir>: capture an xprof trace of the timed loop
     # (utils/profiling.trace) for per-op analysis in TensorBoard. The timed
@@ -157,6 +207,8 @@ def main() -> None:
         _ = jax.device_get(fb)
         dt = time.perf_counter() - t0
 
+    if watchdog is not None:
+        watchdog.cancel()
     per_batch = max((dt - rtt) / CHAIN, 1e-9)
     img_per_sec = BATCH / per_batch
     tflops = forward_tflops_per_image(IMAGE_SIZE)
@@ -164,8 +216,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "FSCD-147 eval images/sec/chip (ViT-B 1024, fused "
-                "match+decode+NMS, random weights)",
+                "metric": METRIC,
                 "value": round(img_per_sec, 3),
                 "unit": "img/s",
                 "vs_baseline": round(img_per_sec / A100_BASELINE_IMG_PER_SEC, 3),

@@ -143,7 +143,7 @@ def bench_1536() -> dict:
     cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE_HI,
                  compute_dtype=DTYPE, batch_size=1)
     step, params, image, ex = _fused_eval_step(cfg, 17, SIZE_HI)
-    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), N_ITER_LONG,
+    dt = _chain_time(step, N_ITER_LONG,
                      params, image, ex)
     return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
 
@@ -160,7 +160,7 @@ def bench_refine() -> dict:
     step, params, image, ex = _fused_eval_step(
         cfg, 33, SIZE, refiner=refiner, refiner_params=rparams
     )
-    dt = _chain_time(lambda p, i, e, fb: step(p, i, e, fb), N_ITER,
+    dt = _chain_time(step, N_ITER,
                      params, image, ex)
     return {"img_per_sec": round(1.0 / dt, 3), "sec_per_image": round(dt, 4)}
 

@@ -1,8 +1,11 @@
 """Per-component timing breakdown of the flagship inference program.
 
-Times the pipeline stages of the fused FSCD-147 eval program (SAM ViT-B @
-1024, feature upsample, 512-d matcher, decoders, peak decode + NMS) in
-isolation, with the SAME methodology as bench.py (PERF.md Finding 1):
+Times the dominant stages of the fused FSCD-147 eval program in isolation —
+the full program, the SAM ViT-B backbone, one global- and one windowed-
+attention block at real dims, and the matcher x-corr at two capacity
+buckets; the residual full_program - backbone - xcorr attributes the
+head/decode/NMS tail — with the SAME methodology as bench.py (PERF.md
+Finding 1):
 device-staged inputs, iterations chained through a scalar data dependency
 inside each jitted program, one closing fetch, measured RTT floor
 subtracted — `jax.block_until_ready` is advisory over the tunneled

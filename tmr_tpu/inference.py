@@ -138,12 +138,10 @@ class Predictor:
                 dets, out["backbone_feature"],
                 (image.shape[1], image.shape[2]), refiner_params, refine,
             )
+            fb = jnp.sum(dets["scores"]) * 0.0
             if loss_fn is not None:
                 dets = (loss_fn(out, exemplars, *extra), dets)
             if chain_feedback:
-                fb = jnp.sum(
-                    (dets[1] if isinstance(dets, tuple) else dets)["scores"]
-                ) * 0.0
                 return dets, fb
             return dets
 
