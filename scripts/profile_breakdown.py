@@ -100,12 +100,20 @@ def main():
         lambda im, fb: bb_step(bb_params, im, fb), image, rtt=rtt
     )
 
-    # 3. one global vs one windowed transformer block (768-d, real grid)
+    # 3. one global vs one windowed transformer block (768-d, real grid),
+    # plus the A/B windowed variant with the bias folded into QK
+    # (TMR_WIN_ATTN, read at trace time — models/vit.py)
     grid = SIZE // 16
     tokens = jnp.asarray(
         rng.standard_normal((BATCH, grid, grid, 768)), jnp.bfloat16
     )
-    for label, win in (("one_global_block", 0), ("one_windowed_block", 14)):
+    cases = (
+        ("one_global_block", 0, "dense"),
+        ("one_windowed_block", 14, "dense"),
+        ("one_windowed_block_folded", 14, "folded"),
+    )
+    for label, win, win_impl in cases:
+        os.environ["TMR_WIN_ATTN"] = win_impl
         blk = Block(num_heads=12, window_size=win, rel_pos_size=(grid, grid),
                     dtype=jnp.bfloat16)
         bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
@@ -118,8 +126,11 @@ def main():
         report[label] = chained(
             lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
         )
+    os.environ.pop("TMR_WIN_ATTN", None)
 
-    # 4. matcher x-corr at two capacities on the upsampled grid
+    # 4. matcher x-corr on the upsampled grid: every formulation at the
+    # production capacity (TMR_XCORR_IMPL, read at trace time — ops/xcorr.py)
+    # plus the default big-template path at 127
     from tmr_tpu.ops.xcorr import match_templates
 
     up_hw = pred.feature_hw(SIZE)
@@ -127,16 +138,19 @@ def main():
         rng.standard_normal((BATCH, cfg.emb_dim, up_hw, up_hw)), jnp.float32
     )
     ex0 = exemplars[:, 0, :]
-    for cap in (17, 127):
+    for cap, impl in ((17, "conv"), (17, "vmap"), (17, "fft"), (127, "auto")):
+        os.environ["TMR_XCORR_IMPL"] = impl
 
         @jax.jit
         def xc_step(f, e, fb):
             y = match_templates(f + fb, e, capacity=cap)
             return y, jnp.sum(y) * 0.0
 
-        report[f"xcorr_cap{cap}"] = chained(
+        label = f"xcorr_cap{cap}" + ("" if impl == "auto" else f"_{impl}")
+        report[label] = chained(
             lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
         )
+    os.environ.pop("TMR_XCORR_IMPL", None)
 
     report = {
         k: (round(v, 5) if isinstance(v, float) else v)

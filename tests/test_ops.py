@@ -288,3 +288,31 @@ def test_masked_maxpool_and_peaks():
     pooled = masked_maxpool3x3_np(x, k)
     want = (pooled == x) & (x >= 0.25)
     np.testing.assert_array_equal(peaks, want)
+
+
+@pytest.mark.parametrize("impl", ["vmap", "fft"])
+def test_cross_correlation_impl_variants_agree(impl, monkeypatch):
+    """TMR_XCORR_IMPL selects alternative correlation formulations for
+    hardware A/B profiling; every variant must match the default grouped
+    conv on identical inputs (same semantics, different lowering)."""
+    B, C, H, W = 2, 4, 24, 20
+    cap = 9
+    feat = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    sizes = [(5, 7), (9, 3)]
+    templates = np.zeros((B, C, cap, cap), np.float32)
+    for b, (ht, wt) in enumerate(sizes):
+        oy, ox = (cap - ht) // 2, (cap - wt) // 2
+        templates[b, :, oy : oy + ht, ox : ox + wt] = RNG.standard_normal(
+            (C, ht, wt)
+        ).astype(np.float32)
+    thw = jnp.array(sizes, jnp.int32)
+
+    monkeypatch.delenv("TMR_XCORR_IMPL", raising=False)
+    want = np.asarray(
+        ops.cross_correlation(jnp.array(feat), jnp.array(templates), thw)
+    )
+    monkeypatch.setenv("TMR_XCORR_IMPL", impl)
+    got = np.asarray(
+        ops.cross_correlation(jnp.array(feat), jnp.array(templates), thw)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -396,3 +396,31 @@ def test_flash_attention_ok_callable_under_trace():
     traced(jnp.zeros((2,)))
     assert seen == [False]  # CPU backend -> disabled, but no exception/tracer
     flash_attention_ok.cache_clear()
+
+
+def test_windowed_attention_folded_matches_dense(monkeypatch):
+    """TMR_WIN_ATTN=folded routes the windowed blocks' bias through the QK
+    contraction (ops/flash_attn.fold_rel_pos_into_qk); in f32 the algebra is
+    exact, so the Attention module must agree with its default dense path."""
+    from tmr_tpu.models.vit import Attention
+
+    rng = np.random.default_rng(11)
+    b, win, dim, heads = 3, 14, 32, 4
+    x = jnp.asarray(rng.standard_normal((b, win, win, dim)), jnp.float32)
+    attn = Attention(num_heads=heads, rel_pos_size=(win, win))
+    params = attn.init(jax.random.key(0), x)
+    # zero-init rel-pos tables make the bias trivial; randomize them
+    params = jax.tree.map(
+        lambda p: jnp.asarray(
+            np.random.default_rng(3).standard_normal(p.shape) * 0.1, p.dtype
+        ),
+        params,
+    )
+
+    monkeypatch.delenv("TMR_WIN_ATTN", raising=False)
+    want = attn.apply(params, x)
+    monkeypatch.setenv("TMR_WIN_ATTN", "folded")
+    got = attn.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )

@@ -20,6 +20,7 @@ Weight layout intentionally mirrors the reference module tree so the
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -28,6 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tmr_tpu.models.common import LayerNorm2d, MLPBlock
+
+
+def _WIN_ATTN_IMPL() -> str:
+    """Windowed-attention formulation, read at trace time: "dense" (default,
+    separate f32 bias einsums + adds) or "folded" (bias inside the QK
+    contraction). A/B knob for hardware profiling — see Attention below."""
+    return os.environ.get("TMR_WIN_ATTN", "dense")
 
 
 def window_partition(x: jnp.ndarray, window: int):
@@ -235,22 +243,42 @@ class Attention(nn.Module):
             )
             x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         else:
-            attn = jnp.einsum(
-                "bnqc,bnkc->bnqk", q, k, preferred_element_type=jnp.float32
-            ) * scale
-            if self.use_rel_pos:
-                r_q = q.astype(jnp.float32).reshape(
-                    b, self.num_heads, h, w, head_dim
+            if self.use_rel_pos and _WIN_ATTN_IMPL() == "folded":
+                # A/B variant for the windowed blocks (TMR_WIN_ATTN=folded):
+                # the decomposed bias rides inside the QK contraction via the
+                # flash_attn augmentation (q'=[q*scale|q.RH|q.RW],
+                # k'=[k|onehot_row|onehot_col]), so the per-window score
+                # tensor is written once with the bias already in — no
+                # separate bias einsums + broadcast-add passes. Algebraically
+                # exact in f32; in bf16 the bias terms round to bf16 (the
+                # dense path keeps them f32) — kept opt-in until measured on
+                # hardware.
+                from tmr_tpu.ops.flash_attn import fold_rel_pos_into_qk
+
+                q_aug, k_aug = fold_rel_pos_into_qk(
+                    q, k, rh, rw, (h, w), scale
                 )
-                rel_h = jnp.einsum(
-                    "bnhwc,hkc->bnhwk", r_q, rh.astype(jnp.float32)
+                attn = jnp.einsum(
+                    "bnqc,bnkc->bnqk", q_aug, k_aug,
+                    preferred_element_type=jnp.float32,
                 )
-                rel_w = jnp.einsum(
-                    "bnhwc,wkc->bnhwk", r_q, rw.astype(jnp.float32)
-                )
-                attn = attn.reshape(b, self.num_heads, h, w, h, w)
-                attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
-                attn = attn.reshape(b, self.num_heads, h * w, h * w)
+            else:
+                attn = jnp.einsum(
+                    "bnqc,bnkc->bnqk", q, k, preferred_element_type=jnp.float32
+                ) * scale
+                if self.use_rel_pos:
+                    r_q = q.astype(jnp.float32).reshape(
+                        b, self.num_heads, h, w, head_dim
+                    )
+                    rel_h = jnp.einsum(
+                        "bnhwc,hkc->bnhwk", r_q, rh.astype(jnp.float32)
+                    )
+                    rel_w = jnp.einsum(
+                        "bnhwc,wkc->bnhwk", r_q, rw.astype(jnp.float32)
+                    )
+                    attn = attn.reshape(b, self.num_heads, h, w, h, w)
+                    attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
+                    attn = attn.reshape(b, self.num_heads, h * w, h * w)
             attn = jax.nn.softmax(attn, axis=-1).astype(self.dtype)
             x = jnp.einsum(
                 "bnqk,bnkc->bnqc", attn, v,

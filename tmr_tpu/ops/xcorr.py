@@ -23,6 +23,8 @@ fuses into the surrounding jitted model with no host sync.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -176,8 +178,34 @@ def cross_correlation(
     """
     B, C, H, W = feature.shape
     T = template.shape[-1]
-    if T > FFT_CAPACITY_THRESHOLD:
+    # TMR_XCORR_IMPL selects the correlation formulation for A/B profiling on
+    # hardware (read at trace time): "conv" = one grouped conv over B*C,
+    # "vmap" = per-image depthwise conv vmapped over the batch, "fft" = the
+    # correlation-theorem path. Default "auto" = conv below the FFT
+    # threshold, fft above. All are exactness-tested against each other
+    # (tests/test_ops.py).
+    impl = os.environ.get("TMR_XCORR_IMPL", "auto")
+    if impl not in ("auto", "conv", "vmap", "fft"):
+        raise ValueError(
+            f"TMR_XCORR_IMPL={impl!r}: expected auto|conv|vmap|fft"
+        )
+    if impl == "auto":
+        impl = "fft" if T > FFT_CAPACITY_THRESHOLD else "conv"
+    if impl == "fft":
         out = _xcorr_fft(feature, template)
+    elif impl == "vmap":
+        def one(f, t):  # f: (C, H, W), t: (C, T, T)
+            return lax.conv_general_dilated(
+                f[None],
+                t.reshape(C, 1, T, T),
+                window_strides=(1, 1),
+                padding=[(T // 2, T // 2), (T // 2, T // 2)],
+                feature_group_count=C,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=lax.Precision.HIGHEST,
+            )[0]
+
+        out = jax.vmap(one)(feature, template)
     else:
         lhs = feature.reshape(1, B * C, H, W)
         rhs = template.reshape(B * C, 1, T, T)
