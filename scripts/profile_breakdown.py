@@ -111,6 +111,7 @@ def main():
         ("one_global_block", 0, "dense"),
         ("one_windowed_block", 14, "dense"),
         ("one_windowed_block_folded", 14, "folded"),
+        ("one_windowed_block_flash", 14, "flash"),  # no-op fallback off-TPU
     )
     for label, win, win_impl in cases:
         os.environ["TMR_WIN_ATTN"] = win_impl

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Watch the tunneled TPU backend and, the moment it serves again, run the
+# full measurement battery exactly once, strictly serialized (concurrent
+# tunnel clients are the suspected wedge trigger — PERF.md):
+#   1. bench.py            (headline JSON -> $OUT/bench_live.json)
+#   2. profile_breakdown   (stage/variant matrix -> $OUT/profile_live.json)
+#   3. bench_extra         (BASELINE configs -> $OUT/bench_extra_live.json)
+# Probe cadence 10 min; each probe is a fresh short-lived process so a hung
+# probe never blocks the loop.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${TMR_WATCH_OUT:-$REPO}"
+LOG="${TMR_WATCH_LOG:-/tmp/tpu_watch.log}"
+
+log() { echo "[$(date +%H:%M:%S)] $*" >>"$LOG"; }
+
+probe() {
+  timeout 150 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+x = jnp.ones((256, 256), jnp.bfloat16)
+print(jax.device_get(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x)))
+" >>"$LOG" 2>&1
+}
+
+log "watch started (pid $$)"
+while true; do
+  if probe; then
+    log "TPU ALIVE — running measurement battery"
+    cd "$REPO"
+    TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
+      >"$OUT/bench_live.json" 2>>"$LOG"
+    log "bench.py rc=$? -> $OUT/bench_live.json"
+    timeout 2400 python scripts/profile_breakdown.py \
+      >"$OUT/profile_live.json" 2>>"$LOG"
+    log "profile_breakdown rc=$? -> $OUT/profile_live.json"
+    timeout 3600 python scripts/bench_extra.py \
+      >"$OUT/bench_extra_live.json" 2>>"$LOG"
+    log "bench_extra rc=$? -> $OUT/bench_extra_live.json"
+    log "battery done"
+    break
+  fi
+  log "probe failed; sleeping 600s"
+  sleep 600
+done

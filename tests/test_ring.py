@@ -424,3 +424,39 @@ def test_windowed_attention_folded_matches_dense(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
+
+
+def test_flash_windowed_padding_and_segments(monkeypatch):
+    """flash_windowed_attention pads 196-token windows to 256 and masks the
+    pad via a second segment. The Pallas kernel itself needs a TPU, but its
+    module ships mha_reference with identical (q, k, v, ab, segment_ids)
+    semantics — swapping it in validates the fold/pad/segment construction
+    end to end on CPU."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa_mod
+
+    from tmr_tpu.ops import flash_attn
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    def stub(q, k, v, ab=None, segment_ids=None, causal=False, sm_scale=1.0,
+             block_sizes=None, debug=False):
+        return fa_mod.mha_reference(
+            q, k, v, ab, segment_ids, causal=causal, sm_scale=sm_scale
+        )
+
+    monkeypatch.setattr(fa_mod, "flash_attention", stub)
+
+    rng = np.random.default_rng(7)
+    b, hds, gh, gw, d = 3, 2, 14, 14, 16
+    s = gh * gw
+    mk = lambda: jnp.asarray(rng.standard_normal((b, hds, s, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    rh = jnp.asarray(rng.standard_normal((gh, gh, d)) * 0.2, jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((gw, gw, d)) * 0.2, jnp.float32)
+    scale = d**-0.5
+
+    got = flash_attn.flash_windowed_attention(q, k, v, rh, rw, (gh, gw), scale)
+    want = blockwise_decomposed_attention(q, k, v, rh, rw, (gh, gw), scale)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    assert got.shape == (b, hds, s, d)

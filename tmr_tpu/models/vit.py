@@ -33,9 +33,16 @@ from tmr_tpu.models.common import LayerNorm2d, MLPBlock
 
 def _WIN_ATTN_IMPL() -> str:
     """Windowed-attention formulation, read at trace time: "dense" (default,
-    separate f32 bias einsums + adds) or "folded" (bias inside the QK
-    contraction). A/B knob for hardware profiling — see Attention below."""
+    separate f32 bias einsums + adds), "folded" (bias inside the QK
+    contraction), or "flash" (Pallas kernel over 256-padded windows,
+    bf16/TPU only). A/B knob for hardware profiling — see Attention below."""
     return os.environ.get("TMR_WIN_ATTN", "dense")
+
+
+def _flash_window_available(gh: int, gw: int, head_dim: int) -> bool:
+    from tmr_tpu.ops.flash_attn import flash_window_ok
+
+    return flash_window_ok(gh, gw, head_dim)
 
 
 def window_partition(x: jnp.ndarray, window: int):
@@ -241,6 +248,20 @@ class Attention(nn.Module):
                 rw if self.use_rel_pos else None,
                 (h, w), scale,
             )
+            x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
+        elif (
+            self.use_rel_pos
+            and _WIN_ATTN_IMPL() == "flash"
+            and self.dtype == jnp.bfloat16
+            and _flash_window_available(h, w, head_dim)
+        ):
+            # A/B variant (TMR_WIN_ATTN=flash): the stock Pallas kernel over
+            # 256-padded windows with a pad segment — zero per-window score
+            # materialization. bf16-only (the kernel's compute dtype); gated
+            # by a one-time compiled self-check with fallback to dense.
+            from tmr_tpu.ops.flash_attn import flash_windowed_attention
+
+            x = flash_windowed_attention(q, k, v, rh, rw, (h, w), scale)
             x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         else:
             if self.use_rel_pos and _WIN_ATTN_IMPL() == "folded":

@@ -146,16 +146,73 @@ def flash_decomposed_attention(
     return out[..., :D].astype(q.dtype)
 
 
-@functools.lru_cache(maxsize=1)
-def flash_attention_ok() -> bool:
-    """One-time self-check of the compiled flash path on this backend.
+def flash_windowed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    window_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Stock Pallas flash kernel over 196-token attention windows.
 
-    Compares the Pallas kernel (with folded rel-pos) against the exact XLA
-    blockwise path on a small bf16 case; any exception (Mosaic lowering,
-    unsupported backend) or disagreement beyond bf16 tolerance disables the
-    flash path for the process. TMR_NO_FLASH_ATTN=1 force-disables.
+    The ViT's windowed blocks attend within 14x14=196-token windows — below
+    the kernel's 128 block granularity and not a power-of-two multiple. The
+    windows are therefore zero-padded to the next 128 multiple (256) and the
+    pad tokens put in a SECOND segment: the kernel's segment mask keeps real
+    queries attending to exactly the 196 real keys, pad rows attend only to
+    pad (zero V -> zero output) and are sliced off. Rel-pos bias rides
+    inside QK via fold_rel_pos_into_qk (d_aug = 64+14+14 = 92 -> 128 lanes).
 
-    The first call happens while TRACING the model (Attention.__call__ only
+    q/k/v: (B', H, S, D) with B' = B * n_windows, S = win_h * win_w.
+    Returns (B', H, S, D). Numerics: online-softmax flash over the same
+    masked score matrix the dense path materializes.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        SegmentIds,
+        flash_attention,
+    )
+
+    B, H, S, D = q.shape
+    gh, gw = window_hw
+    d_aug = D + (gh + gw if rh is not None else 0)
+    pad_to = _lane_pad(d_aug)
+    q_aug, k_aug = fold_rel_pos_into_qk(
+        q, k, rh, rw, window_hw, scale, pad_to=pad_to
+    )
+    s_pad = _lane_pad(S)
+    ps = s_pad - S
+    widths = ((0, 0), (0, 0), (0, ps), (0, 0))
+    q_aug = jnp.pad(q_aug, widths)
+    k_aug = jnp.pad(k_aug, widths)
+    v_pad = jnp.pad(v, ((0, 0), (0, 0), (0, ps), (0, pad_to - D)))
+    seg = jnp.concatenate(
+        [jnp.zeros((B, S), jnp.int32), jnp.ones((B, ps), jnp.int32)], axis=-1
+    )
+    bq = _block_for(s_pad, 256)
+    bk = _block_for(s_pad, 256)
+    sizes = BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+    out = flash_attention(
+        q_aug, k_aug, v_pad, segment_ids=SegmentIds(q=seg, kv=seg),
+        causal=False, sm_scale=1.0, block_sizes=sizes,
+    )
+    return out[..., :S, :D].astype(q.dtype)
+
+
+def _self_check(attn_fn, B: int, H: int, gh: int, gw: int, D: int) -> bool:
+    """Shared compiled self-check: run ``attn_fn`` (a flash-path callable
+    with the (q, k, v, rh, rw, grid_hw, scale) signature) against the exact
+    XLA blockwise path on bf16 inputs at the given geometry. Any exception
+    (Mosaic lowering, unsupported backend) or disagreement beyond bf16
+    tolerance -> False. TMR_NO_FLASH_ATTN=1 force-disables.
+
+    Callers invoke this while TRACING the model (Attention.__call__ only
     ever runs under jit), so the whole check runs under
     ``jax.ensure_compile_time_eval()`` — concrete values, real compiled
     executions, no leakage into the ambient trace.
@@ -171,15 +228,6 @@ def flash_attention_ok() -> bool:
     try:
         with jax.ensure_compile_time_eval():
             rng = np.random.default_rng(0)
-            # PRODUCTION-shaped check: the true 1024-input global-attention
-            # geometry — 64x64 token grid (S=4096, 8 key blocks of 512),
-            # d_aug = 64+64+64 = 192 lane-padded to 256, f32 rel-pos tables
-            # — reduced only in batch/heads (grid/blocks/d are what Mosaic
-            # failures key on). A config-specific failure must trip HERE,
-            # inside the try, not in the model trace. (The 1536 bucket's
-            # 96x96 grid runs the same kernel with more grid steps and the
-            # identical padded depth: 64+96+96 = 256.)
-            B, H, gh, gw, D = 1, 2, 64, 64, 64  # S=4096
             S = gh * gw
             q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
             k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
@@ -191,9 +239,9 @@ def flash_attention_ok() -> bool:
                 rng.standard_normal((gw, gw, D)) * 0.2, jnp.float32
             )
             scale = D**-0.5
-            got = jax.jit(
-                lambda *a: flash_decomposed_attention(*a, (gh, gw), scale)
-            )(q, k, v, rh, rw)
+            got = jax.jit(lambda *a: attn_fn(*a, (gh, gw), scale))(
+                q, k, v, rh, rw
+            )
             want = jax.jit(
                 lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
             )(q, k, v, rh, rw)
@@ -204,3 +252,27 @@ def flash_attention_ok() -> bool:
             return bool(err / scale_ref < 0.05)
     except Exception:
         return False
+
+
+@functools.lru_cache(maxsize=None)
+def flash_window_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """Per-geometry compiled self-check of the windowed flash path — the
+    caller passes the ACTUAL window grid and head dim it is about to run
+    (14x14/64 in production; any other geometry gets its own checked entry,
+    so an unvalidated shape can never bypass the fallback-to-dense gate)."""
+    return _self_check(flash_windowed_attention, 2, 2, gh, gw, head_dim)
+
+
+@functools.lru_cache(maxsize=1)
+def flash_attention_ok() -> bool:
+    """One-time self-check of the global-attention flash path.
+
+    PRODUCTION-shaped: the true 1024-input global-attention geometry — 64x64
+    token grid (S=4096, 8 key blocks of 512), d_aug = 64+64+64 = 192
+    lane-padded to 256, f32 rel-pos tables — reduced only in batch/heads
+    (grid/blocks/d are what Mosaic failures key on). A config-specific
+    failure must trip inside the check, not in the model trace. (The 1536
+    bucket's 96x96 grid runs the same kernel with more grid steps and the
+    identical padded depth: 64+96+96 = 256.)
+    """
+    return _self_check(flash_decomposed_attention, 1, 2, 64, 64, 64)
