@@ -460,3 +460,35 @@ def test_flash_windowed_padding_and_segments(monkeypatch):
         np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
     )
     assert got.shape == (b, hds, s, d)
+
+
+def test_windowed_attention_folded_grads_match_dense(monkeypatch):
+    """Training differentiates through whatever attention formulation is
+    active; the folded QK path must carry the same gradients as dense."""
+    from tmr_tpu.models.vit import Attention
+
+    rng = np.random.default_rng(13)
+    b, win, dim, heads = 2, 7, 16, 2
+    x = jnp.asarray(rng.standard_normal((b, win, win, dim)), jnp.float32)
+    attn = Attention(num_heads=heads, rel_pos_size=(win, win))
+    params = attn.init(jax.random.key(0), x)
+    params = jax.tree.map(
+        lambda p: jnp.asarray(
+            np.random.default_rng(5).standard_normal(p.shape) * 0.1, p.dtype
+        ),
+        params,
+    )
+
+    def loss(p, x):
+        return jnp.sum(attn.apply(p, x) ** 2)
+
+    monkeypatch.delenv("TMR_WIN_ATTN", raising=False)
+    want_g = jax.grad(loss)(params, x)
+    monkeypatch.setenv("TMR_WIN_ATTN", "folded")
+    got_g = jax.grad(loss)(params, x)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        got_g, want_g,
+    )
