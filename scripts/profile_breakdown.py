@@ -153,6 +153,34 @@ def main():
         )
     os.environ.pop("TMR_XCORR_IMPL", None)
 
+    # 5. decode + NMS tail in isolation (objectness/regressions -> boxes),
+    # via the Predictor's own _decode/_refine_nms so config flags (box_reg,
+    # regression scaling, max_detections) stay the production ones. The
+    # greedy-NMS fixpoint's iteration count is data-dependent (suppression-
+    # chain depth), so the synthetic boxes are exemplar-sized (~10 grid
+    # cells wide): neighbors overlap heavily and the chains run deep, like
+    # clustered production detections — tiny boxes would let the while_loop
+    # converge immediately and flatter the tail.
+    obj = jnp.asarray(
+        rng.standard_normal((BATCH, up_hw, up_hw)), jnp.float32
+    )
+    reg = jnp.abs(jnp.asarray(
+        rng.standard_normal((BATCH, up_hw, up_hw, 4)), jnp.float32
+    ))
+
+    @jax.jit
+    def tail_step(o, r, e, fb):
+        out = {"objectness": [o + fb], "regressions": [r]}
+        dets = pred._decode(out, e)
+        dets = pred._refine_nms(
+            dets, None, (SIZE, SIZE), None, False
+        )
+        return dets, jnp.sum(dets["scores"]) * 0.0
+
+    report[f"decode_nms_tail_n{cfg.max_detections}"] = chained(
+        tail_step, obj, reg, ex0, rtt=rtt
+    )
+
     report = {
         k: (round(v, 5) if isinstance(v, float) else v)
         for k, v in report.items()
