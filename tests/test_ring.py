@@ -492,3 +492,62 @@ def test_windowed_attention_folded_grads_match_dense(monkeypatch):
         ),
         got_g, want_g,
     )
+
+
+def test_flash_self_check_harness_including_grads(monkeypatch):
+    """_self_check gates the flash paths on TPU (forward AND backward since
+    the train step differentiates through them). Off-TPU it must refuse;
+    with the backend gate and kernel stubbed it must pass end to end,
+    proving the harness itself (jit compare + grad compare) is sound."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa_mod
+
+    from tmr_tpu.ops import flash_attn
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("gate legitimately runs the real kernel on TPU")
+    monkeypatch.delenv("TMR_NO_FLASH_ATTN", raising=False)
+
+    # real backend (cpu): the gate refuses outright
+    assert flash_attn._self_check(
+        flash_attn.flash_windowed_attention, 1, 1, 7, 7, 8
+    ) is False
+
+    def stub(q, k, v, ab=None, segment_ids=None, causal=False, sm_scale=1.0,
+             block_sizes=None, debug=False):
+        return fa_mod.mha_reference(
+            q, k, v, ab, segment_ids, causal=causal, sm_scale=sm_scale
+        )
+
+    monkeypatch.setattr(fa_mod, "flash_attention", stub)
+    monkeypatch.setattr(flash_attn.jax, "default_backend", lambda: "tpu")
+    assert flash_attn._self_check(
+        flash_attn.flash_windowed_attention, 1, 1, 7, 7, 8
+    ) is True
+    # a broken kernel must be caught, not crash the trace
+    monkeypatch.setattr(
+        fa_mod, "flash_attention",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("mosaic")),
+    )
+    assert flash_attn._self_check(
+        flash_attn.flash_windowed_attention, 1, 1, 7, 7, 8
+    ) is False
+
+
+def test_flash_self_check_rejects_nan(monkeypatch):
+    """A Mosaic miscompile classically surfaces as NaN output; the gate must
+    reject it (comparisons are phrased so NaN fails, never passes)."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa_mod
+
+    from tmr_tpu.ops import flash_attn
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("gate legitimately runs the real kernel on TPU")
+    monkeypatch.delenv("TMR_NO_FLASH_ATTN", raising=False)
+    monkeypatch.setattr(flash_attn.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        fa_mod, "flash_attention",
+        lambda q, *a, **k: jnp.full_like(q, jnp.nan),
+    )
+    assert flash_attn._self_check(
+        flash_attn.flash_windowed_attention, 1, 1, 7, 7, 8
+    ) is False

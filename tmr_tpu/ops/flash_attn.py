@@ -249,7 +249,35 @@ def _self_check(attn_fn, B: int, H: int, gh: int, gw: int, D: int) -> bool:
                 np.asarray(got, np.float32) - np.asarray(want, np.float32)
             ).max()
             scale_ref = np.abs(np.asarray(want, np.float32)).max() + 1e-6
-            return bool(err / scale_ref < 0.05)
+            # NOTE: comparisons are phrased as ``not (diff < tol)`` so a NaN
+            # (classic Mosaic-miscompile symptom) REJECTS — ``diff >= tol``
+            # would let NaN through, since both comparisons are False on NaN
+            if not (err / scale_ref < 0.05):
+                return False
+
+            # the TRAIN step differentiates through whichever path is
+            # active, and a backward-pass Mosaic failure would otherwise
+            # surface unguarded inside the train trace — so the gate also
+            # compiles and compares gradients w.r.t. q/k/v
+            def loss_of(fn):
+                return lambda *a: jnp.sum(
+                    fn(*a, rh, rw, (gh, gw), scale).astype(jnp.float32) ** 2
+                )
+
+            g_got = jax.jit(jax.grad(loss_of(attn_fn), argnums=(0, 1, 2)))(
+                q, k, v
+            )
+            g_want = jax.jit(
+                jax.grad(
+                    loss_of(blockwise_decomposed_attention), argnums=(0, 1, 2)
+                )
+            )(q, k, v)
+            for a, b in zip(g_got, g_want):
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                if not (np.abs(a - b).max() / (np.abs(b).max() + 1e-6) < 0.05):
+                    return False
+            return True
     except Exception:
         return False
 
