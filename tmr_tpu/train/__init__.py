@@ -7,5 +7,5 @@ from tmr_tpu.train.state import (  # noqa: F401
     TrainState,
     create_train_state,
     make_optimizer,
-    train_step,
+    make_train_step,
 )

@@ -174,6 +174,3 @@ def make_train_step(model, cfg) -> Callable:
 
     return train_step
 
-
-def train_step(model, cfg):  # pragma: no cover - thin alias
-    return make_train_step(model, cfg)
