@@ -157,6 +157,18 @@ def main() -> None:
         compute_dtype="bfloat16",
         batch_size=BATCH,
     )
+
+    # measured formulation selection at the production shapes (TPU only;
+    # TMR_AUTOTUNE=0/false/no/off disables, explicitly set knobs are
+    # respected) — the winners are exported via env before the full
+    # program is traced
+    tune = {}
+    if os.environ.get("TMR_AUTOTUNE", "1").lower() not in (
+        "0", "false", "no", "off"
+    ):
+        from tmr_tpu.utils.autotune import autotune
+
+        tune = autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)
     # the PRODUCTION fused program via the Predictor's chain_feedback hook —
     # the benchmark compiles the same pipeline eval runs, no copy
     from tmr_tpu.inference import Predictor
@@ -225,6 +237,7 @@ def main() -> None:
                 "ms_per_batch": round(per_batch * 1000, 2),
                 "batch": BATCH,
                 "rtt_floor_ms": round(rtt * 1000, 1),
+                "autotuned": {k: v["picked"] for k, v in tune.items()},
             }
         )
     )

@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -34,26 +33,17 @@ CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 10))
 
 
 def _rtt() -> float:
-    tiny = jax.jit(lambda x: x + 1.0)
-    z = jnp.zeros((), jnp.float32)
-    _ = jax.device_get(tiny(z))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _ = jax.device_get(tiny(z))
-    return (time.perf_counter() - t0) / 3
+    from tmr_tpu.utils.profiling import measure_rtt_floor
+
+    return measure_rtt_floor()
 
 
 def chained(fn, *args, rtt: float = 0.0) -> float:
-    """fn(*args, fb) -> (out, fb'): chained sec/iter with the RTT removed."""
-    fb = jnp.zeros((), jnp.float32)
-    out, fb = fn(*args, fb)
-    fb = fb * 0.0
-    _ = jax.device_get(fb)
-    t0 = time.perf_counter()
-    for _ in range(CHAIN):
-        out, fb = fn(*args, fb)
-    _ = jax.device_get(fb)
-    return max((time.perf_counter() - t0 - rtt) / CHAIN, 1e-9)
+    """fn(*args, fb) -> (out, fb'): chained sec/iter with the RTT removed
+    (the shared utils/profiling.py harness at this script's CHAIN count)."""
+    from tmr_tpu.utils.profiling import chained_seconds_per_iter
+
+    return chained_seconds_per_iter(fn, *args, iters=CHAIN, rtt=rtt)
 
 
 def main():

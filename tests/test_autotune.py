@@ -1,0 +1,110 @@
+"""Measured formulation selection (tmr_tpu/utils/autotune.py)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmr_tpu.config import preset
+from tmr_tpu.utils import autotune as at
+
+KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN")
+
+
+@pytest.fixture
+def clean_knobs(monkeypatch):
+    """No knobs set on entry; anything autotune exports is popped on exit."""
+    for k in KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    yield
+    for k in KNOBS:
+        os.environ.pop(k, None)
+
+
+def _cfg():
+    return preset("TMR_FSCD147", backbone="sam_vit_b", image_size=256,
+                  batch_size=1)
+
+
+def test_autotune_noop_off_tpu(clean_knobs):
+    if jax.default_backend() == "tpu":
+        pytest.skip("selection legitimately runs on TPU")
+    assert at.autotune(_cfg(), 256, 1) == {}
+    assert not any(k in os.environ for k in KNOBS)
+
+
+def test_autotune_picks_min_and_exports_env(clean_knobs, monkeypatch):
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.03, "vmap": 0.05, "fft": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl",
+        lambda *a, **k: {"dense": 0.02, "folded": 0.01, "flash": 0.03},
+    )
+    report = at.autotune(_cfg(), 1024, 4)
+    # the xcorr winner exports through the SMALL-scoped knob only: the
+    # 127/191 buckets must keep their FFT auto path
+    assert report["TMR_XCORR_IMPL_SMALL"]["picked"] == "fft"
+    assert report["TMR_WIN_ATTN"]["picked"] == "folded"
+    assert os.environ["TMR_XCORR_IMPL_SMALL"] == "fft"
+    assert "TMR_XCORR_IMPL" not in os.environ
+    assert os.environ["TMR_WIN_ATTN"] == "folded"
+
+
+def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
+    monkeypatch.setenv("TMR_XCORR_IMPL", "conv")
+    monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    called = []
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl", lambda *a, **k: called.append("x") or {}
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl", lambda *a, **k: called.append("w") or {}
+    )
+    assert at.autotune(_cfg(), 1024, 4) == {}
+    assert called == []
+    assert os.environ["TMR_XCORR_IMPL"] == "conv"
+
+
+def test_small_scope_keeps_fft_for_big_buckets(clean_knobs, monkeypatch):
+    """TMR_XCORR_IMPL_SMALL must not reroute a >threshold capacity: the
+    127/191 buckets stay on the FFT path regardless of the tuned winner."""
+    from tmr_tpu.ops import xcorr
+
+    monkeypatch.setenv("TMR_XCORR_IMPL_SMALL", "vmap")
+    B, C, H, W, cap = 1, 2, 16, 16, 67
+    assert cap > xcorr.FFT_CAPACITY_THRESHOLD
+    feat = jnp.asarray(
+        np.random.default_rng(0).standard_normal((B, C, H, W)), jnp.float32
+    )
+    tmpl = jnp.zeros((B, C, cap, cap), jnp.float32)
+    tmpl = tmpl.at[:, :, cap // 2, cap // 2].set(1.0)
+    thw = jnp.array([[1, 1]], jnp.int32)
+    got = xcorr.cross_correlation(feat, tmpl, thw)
+    # identity template: out == feat up to FFT rounding. The conv paths at
+    # Precision.HIGHEST reproduce it exactly (diff == 0); nonzero rounding
+    # proves the FFT path ran despite the small-scope knob.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(feat), atol=1e-4)
+    assert abs(np.asarray(got) - np.asarray(feat)).max() > 0
+
+
+def test_microbenchmarks_run_and_time_all_variants(clean_knobs):
+    """The pick_* functions themselves must run every variant end to end
+    (tiny shapes; CPU is fine for exercising the machinery)."""
+    tx = at.pick_xcorr_impl(1, 8, 16, 5, rtt=0.0)
+    assert set(tx) == set(at.XCORR_VARIANTS)
+    assert all(v > 0 for v in tx.values())
+    # windowed block: flash falls back unavailable off-TPU but must not
+    # crash the sweep; dense/folded always time
+    tw = at.pick_win_attn_impl(1, 14, 16, 2, rtt=0.0)
+    assert {"dense", "folded"} <= set(tw)
+    assert all(v > 0 for v in tw.values())
+    assert "TMR_XCORR_IMPL" not in os.environ  # knobs restored
+    assert "TMR_WIN_ATTN" not in os.environ

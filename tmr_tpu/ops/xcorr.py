@@ -185,12 +185,20 @@ def cross_correlation(
     # threshold, fft above. All are exactness-tested against each other
     # (tests/test_ops.py).
     impl = os.environ.get("TMR_XCORR_IMPL", "auto")
-    if impl not in ("auto", "conv", "vmap", "fft"):
-        raise ValueError(
-            f"TMR_XCORR_IMPL={impl!r}: expected auto|conv|vmap|fft"
-        )
+    # TMR_XCORR_IMPL_SMALL: the autotuner's measured winner for SMALL
+    # buckets only (utils/autotune.py) — scoped below the threshold so a
+    # capacity-17 winner can never drag the 127/191 buckets off the FFT
+    # path (a direct conv there is O(H^2 T^2 C), documented above).
+    small = os.environ.get("TMR_XCORR_IMPL_SMALL", "conv")
+    for name, val in (
+        ("TMR_XCORR_IMPL", impl), ("TMR_XCORR_IMPL_SMALL", small)
+    ):
+        if val not in ("auto", "conv", "vmap", "fft"):
+            raise ValueError(f"{name}={val!r}: expected auto|conv|vmap|fft")
     if impl == "auto":
-        impl = "fft" if T > FFT_CAPACITY_THRESHOLD else "conv"
+        impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
+    if impl == "auto":  # "auto" as the small-bucket value = the conv default
+        impl = "conv"
     if impl == "fft":
         out = _xcorr_fft(feature, template)
     elif impl == "vmap":

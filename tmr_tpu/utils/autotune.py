@@ -1,0 +1,173 @@
+"""Measured kernel-formulation selection (cuDNN-autotune philosophy, TPU-
+style): some ops have several semantically identical lowerings whose relative
+speed depends on the hardware/compiler pair — the matcher correlation
+(ops/xcorr.py: grouped conv / vmap'd depthwise conv / FFT) and the ViT
+windowed attention (models/vit.py: dense / folded-QK / Pallas flash).
+Rather than hardcoding a winner, ``autotune(cfg, ...)`` microbenchmarks each
+variant ON DEVICE at the production shapes derived from the config and
+exports the winners via the env knobs the modules read at trace time:
+
+- ``TMR_XCORR_IMPL_SMALL`` — the small-bucket correlation winner. Scoped:
+  ops/xcorr.py consults it only below FFT_CAPACITY_THRESHOLD, so the
+  capacity-17 winner can never drag the 127/191 buckets off the FFT path.
+- ``TMR_WIN_ATTN`` — the windowed-attention formulation.
+
+The microbenchmarks are small isolated programs (one correlation, one
+transformer block) timed with the bench.py methodology via the shared
+helpers in utils/profiling.py (device-staged inputs, scalar-chained
+iterations, one closing fetch, RTT floor subtracted). Explicitly set env
+knobs are respected and never overridden. Off-TPU the defaults stand
+(XLA:CPU relative speeds do not transfer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
+
+XCORR_VARIANTS = ("conv", "vmap", "fft")
+WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
+
+
+def pick_xcorr_impl(
+    batch: int, emb_dim: int, hw: int, capacity: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time every correlation lowering at the production matcher shape.
+    Returns {variant: sec/iter}; caller picks min."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmr_tpu.ops.xcorr import match_templates
+
+    rng = np.random.default_rng(0)
+    feat = jnp.asarray(
+        rng.standard_normal((batch, emb_dim, hw, hw)), jnp.float32
+    )
+    ex = jnp.tile(jnp.asarray([[0.45, 0.45, 0.53, 0.55]], jnp.float32),
+                  (batch, 1))
+    rtt = measure_rtt_floor() if rtt is None else rtt
+    times: Dict[str, float] = {}
+    prev = os.environ.get("TMR_XCORR_IMPL")
+    try:
+        for impl in XCORR_VARIANTS:
+            os.environ["TMR_XCORR_IMPL"] = impl
+
+            @jax.jit
+            def step(f, e, fb):
+                y = match_templates(f + fb, e, capacity=capacity)
+                return y, jnp.sum(y) * 0.0
+
+            try:
+                times[impl] = chained_seconds_per_iter(step, feat, ex, rtt=rtt)
+            except Exception as e:  # failed variant = not chosen, but say so
+                log(f"autotune: xcorr[{impl}] failed: {type(e).__name__}: {e}")
+    finally:
+        _restore(prev, "TMR_XCORR_IMPL")
+    return times
+
+
+def pick_win_attn_impl(
+    batch: int, grid: int, embed_dim: int, num_heads: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time one windowed transformer block (window 14, bf16 — the deployment
+    dtype) per attention formulation. Returns {variant: sec/iter}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmr_tpu.models.vit import Block
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.standard_normal((batch, grid, grid, embed_dim)), jnp.bfloat16
+    )
+    rtt = measure_rtt_floor() if rtt is None else rtt
+    times: Dict[str, float] = {}
+    prev = os.environ.get("TMR_WIN_ATTN")
+    try:
+        for impl in WIN_ATTN_VARIANTS:
+            os.environ["TMR_WIN_ATTN"] = impl
+            blk = Block(num_heads=num_heads, window_size=14,
+                        rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
+            params = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
+
+            @jax.jit
+            def step(p, x, fb):
+                y = blk.apply({"params": p}, x + fb.astype(x.dtype))
+                return y, jnp.sum(y).astype(jnp.float32) * 0.0
+
+            try:
+                times[impl] = chained_seconds_per_iter(
+                    step, params, tokens, rtt=rtt
+                )
+            except Exception as e:
+                log(f"autotune: win_attn[{impl}] failed: "
+                    f"{type(e).__name__}: {e}")
+    finally:
+        _restore(prev, "TMR_WIN_ATTN")
+    return times
+
+
+def _restore(prev: Optional[str], name: str) -> None:
+    if prev is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = prev
+
+
+def autotune(
+    cfg, image_size: int, batch: int,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, object]:
+    """Measure the variant sets at the production shapes of ``cfg`` and
+    EXPORT the winners via their env knobs (os.environ, read by the modules
+    at trace time) so every program compiled afterwards in this process uses
+    them.
+
+    Knobs the user already set explicitly are left untouched. Off-TPU this
+    is a no-op (returns {}). Returns {knob: {"picked": ..., "times": ...}}.
+    """
+    import jax
+
+    from tmr_tpu.models.vit import VIT_CONFIGS
+
+    if jax.default_backend() != "tpu":
+        return {}
+    vit_kind = {"sam": "vit_h", "sam_vit_h": "vit_h", "sam_vit_b": "vit_b"}.get(
+        cfg.backbone
+    )
+    report: Dict[str, object] = {}
+    rtt = measure_rtt_floor()
+    grid = image_size // 16
+    up_hw = 2 * grid if cfg.feature_upsample else grid
+
+    if "TMR_XCORR_IMPL" not in os.environ \
+            and "TMR_XCORR_IMPL_SMALL" not in os.environ:
+        # capacity 17 = the typical FSCD exemplar bucket; the winner is
+        # exported through the SMALL-scoped knob (see module docstring)
+        times = pick_xcorr_impl(batch, cfg.emb_dim, up_hw, 17, rtt=rtt,
+                                log=log)
+        if times:
+            best = min(times, key=times.get)
+            os.environ["TMR_XCORR_IMPL_SMALL"] = best
+            report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
+            log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
+
+    if "TMR_WIN_ATTN" not in os.environ and vit_kind is not None:
+        vc = VIT_CONFIGS[vit_kind]
+        times = pick_win_attn_impl(
+            batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
+        )
+        if times:
+            best = min(times, key=times.get)
+            os.environ["TMR_WIN_ATTN"] = best
+            report["TMR_WIN_ATTN"] = {"picked": best, "times": times}
+            log(f"autotune: TMR_WIN_ATTN={best} {times}")
+    return report

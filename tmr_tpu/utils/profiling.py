@@ -84,6 +84,49 @@ def step_annotation(name: str, step: int) -> Iterator[None]:
         yield
 
 
+# ---------------------------------------------------- device microbenchmark
+def measure_rtt_floor(samples: int = 3) -> float:
+    """Dispatch + scalar-fetch round-trip floor of the current backend.
+
+    On tunneled/remote devices this floor is tens of ms and must be
+    subtracted from chained timings (PERF.md Finding 1); the canonical copy
+    used by bench.py, scripts/profile_breakdown.py and utils/autotune.py.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros((), jnp.float32)
+    _ = jax.device_get(tiny(z))
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        _ = jax.device_get(tiny(z))
+    return (time.perf_counter() - t0) / samples
+
+
+def chained_seconds_per_iter(step, *args, iters: int = 5, rtt: float = 0.0):
+    """Steady-state sec/iter of ``step(*args, fb) -> (out, fb')``.
+
+    The trailing scalar feedback forces back-to-back device execution
+    (``jax.block_until_ready`` is advisory on some remote transports);
+    timing closes with ONE scalar fetch and subtracts the measured
+    round-trip floor. First call (compile + warmup) happens outside the
+    timed window.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fb = jnp.zeros((), jnp.float32)
+    out, fb = step(*args, fb)
+    fb = fb * 0.0
+    _ = jax.device_get(fb)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out, fb = step(*args, fb)
+    _ = jax.device_get(fb)
+    return max((time.perf_counter() - t0 - rtt) / iters, 1e-9)
+
+
 # ------------------------------------------------------------------ timing
 class PhaseTimer:
     """Host-side wall-clock accounting by phase name.
