@@ -49,27 +49,16 @@ N_ITER_LONG = 2 if TINY else 8  # 1536/train keep the longer average
 
 def _chain_time(step, n, *args):
     """Chained timing: step(*args, fb) -> (out, fb'); returns sec/iter.
-    bench.py rules: warm/zero the feedback BEFORE the timed window, close
-    with one scalar fetch, subtract the measured round-trip floor."""
-    import jax
-    import jax.numpy as jnp
+    The shared utils/profiling.py harness (warm/zero the feedback before
+    the timed window, one closing scalar fetch, RTT floor subtracted)."""
+    from tmr_tpu.utils.profiling import (
+        chained_seconds_per_iter,
+        measure_rtt_floor,
+    )
 
-    fb = jnp.zeros((), jnp.float32)
-    out, fb = step(*args, fb)
-    fb = fb * 0.0
-    _ = jax.device_get(fb)
-    tiny = jax.jit(lambda x: x + 1.0)
-    _ = jax.device_get(tiny(fb))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _ = jax.device_get(tiny(fb))
-    rtt = (time.perf_counter() - t0) / 3
-
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out, fb = step(*args, fb)
-    _ = jax.device_get(fb)
-    return max((time.perf_counter() - t0 - rtt) / n, 1e-9)
+    return chained_seconds_per_iter(
+        step, *args, iters=n, rtt=measure_rtt_floor()
+    )
 
 
 def bench_demo() -> dict:
