@@ -1,0 +1,55 @@
+"""The driver's benchmark entry (bench.py) — one JSON line, correct keys.
+
+Runs the real script in a subprocess at tiny CPU shapes. The subprocess env
+drops PALLAS_AXON_POOL_IPS so the axon sitecustomize never dials the TPU
+relay (PERF.md: a wedged tunnel would hang any process that does).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_env(**extra):
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TMR_BENCH_SIZE="256",
+        TMR_BENCH_BATCH="1",
+        TMR_BENCH_CHAIN="2",
+        **extra,
+    )
+    return env
+
+
+def test_bench_prints_one_json_line_with_required_keys():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(), capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mfu",
+                "ms_per_batch", "autotuned"):
+        assert key in rec, key
+    assert rec["unit"] == "img/s"
+    assert rec["value"] > 0
+    # stage progress goes to stderr, never stdout
+    assert "[bench +" in out.stderr
+
+
+def test_bench_watchdog_emits_error_line():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(TMR_BENCH_ALARM="5"),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0.0
+    assert "watchdog" in rec["error"]
