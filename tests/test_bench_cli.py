@@ -43,10 +43,15 @@ def test_bench_prints_one_json_line_with_required_keys():
     assert "[bench +" in out.stderr
 
 
-def test_bench_watchdog_emits_error_line():
+def test_bench_watchdog_emits_error_line(tmp_path):
+    # a 1s alarm beats even a fully cache-warm run (interpreter + jax init
+    # alone exceed it); a cold per-test compilation cache double-insures
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        env=_bench_env(TMR_BENCH_ALARM="5"),
+        env=_bench_env(
+            TMR_BENCH_ALARM="1",
+            TMR_COMPILATION_CACHE=str(tmp_path / "xla-cache"),
+        ),
         capture_output=True, text=True, timeout=300,
     )
     assert out.returncode == 0

@@ -402,64 +402,89 @@ class SamViT(nn.Module):
     # memory, the standard lever for bigger batches / longer token grids
     remat: bool = False
 
-    @nn.compact
-    def __call__(
-        self, x: jnp.ndarray, return_interm: bool = False
-    ) -> jnp.ndarray:
-        """``return_interm=True`` additionally returns the per-block token
-        embeddings (B, h, w, embed_dim) — the reference's ``forward_interm``
-        (sam.py:97-113), used by SAM-HQ-style consumers."""
+    def setup(self):
+        # setup-style (not @nn.compact) so ``embed``/``neck`` are callable
+        # via apply(method=...) by the pipeline-parallel path
+        # (parallel/pipeline.py) — ONE definition of the pre/post stages for
+        # both the dense and the pipelined forward. Explicit ``name=`` keeps
+        # the param tree identical to the original compact layout (the
+        # convert.py / golden-test contract).
         grid = self.pretrain_img_size // self.patch_size
-        x = nn.Conv(
+        self._grid = grid
+        self._patch = nn.Conv(
             self.embed_dim,
             (self.patch_size, self.patch_size),
             strides=(self.patch_size, self.patch_size),
             padding="VALID",
             dtype=self.dtype,
             name="patch_embed",
-        )(x)
-        h, w = x.shape[1], x.shape[2]
-
-        pos_embed = self.param(
+        )
+        self._pos_embed = self.param(
             "pos_embed", nn.initializers.zeros, (1, grid, grid, self.embed_dim)
         )
-        if (h, w) != (grid, grid):
-            # bilinear re-interpolation for the 1536 bucket (sam.py:72-76)
-            pos_embed = jax.image.resize(
-                pos_embed, (1, h, w, self.embed_dim), method="bilinear",
-                antialias=False,
-            )
-        x = x + pos_embed.astype(x.dtype)
-
-        interm = []
         block_cls = nn.remat(Block) if self.remat else Block
-        for i in range(self.depth):
-            win = 0 if i in self.global_attn_indexes else self.window_size
-            x = block_cls(
+        self._blocks = [
+            block_cls(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
-                window_size=win,
+                window_size=(
+                    0 if i in self.global_attn_indexes else self.window_size
+                ),
                 rel_pos_size=(grid, grid),
                 dtype=self.dtype,
                 seq_mesh=self.seq_mesh,
                 batch_axis=self.batch_axis,
                 name=f"blocks_{i}",
-            )(x)
+            )
+            for i in range(self.depth)
+        ]
+        self._neck_0 = nn.Conv(
+            self.out_chans, (1, 1), use_bias=False, dtype=self.dtype,
+            name="neck_0",
+        )
+        self._neck_1 = LayerNorm2d(name="neck_1")
+        self._neck_2 = nn.Conv(
+            self.out_chans, (3, 3), padding=1, use_bias=False,
+            dtype=self.dtype, name="neck_2",
+        )
+        self._neck_3 = LayerNorm2d(name="neck_3")
+
+    def embed(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Patch embed + (interpolated) absolute pos embed -> (B, h, w, D)
+        tokens. The pos embed bilinearly re-interpolates for non-native
+        grids — the 1536 bucket (sam.py:72-76)."""
+        x = self._patch(x)
+        h, w = x.shape[1], x.shape[2]
+        pos_embed = self._pos_embed
+        if (h, w) != (self._grid, self._grid):
+            pos_embed = jax.image.resize(
+                pos_embed, (1, h, w, self.embed_dim), method="bilinear",
+                antialias=False,
+            )
+        return x + pos_embed.astype(x.dtype)
+
+    def neck(self, x: jnp.ndarray) -> jnp.ndarray:
+        """1x1 conv -> LN2d -> 3x3 conv -> LN2d (sam_ViT.py:88-104)."""
+        x = self._neck_0(x)
+        x = self._neck_1(x.astype(jnp.float32))
+        x = self._neck_2(x.astype(self.dtype))
+        return self._neck_3(x.astype(jnp.float32))
+
+    def __call__(
+        self, x: jnp.ndarray, return_interm: bool = False
+    ) -> jnp.ndarray:
+        """``return_interm=True`` additionally returns the per-block token
+        embeddings (B, h, w, embed_dim) — the reference's ``forward_interm``
+        (sam.py:97-113), used by SAM-HQ-style consumers."""
+        x = self.embed(x)
+        interm = []
+        for i, blk in enumerate(self._blocks):
+            x = blk(x)
             # the reference's forward_interm (sam.py:97-113) collects only the
             # global-attention blocks' embeddings, not every block
-            if return_interm and win == 0:
+            if return_interm and i in self.global_attn_indexes:
                 interm.append(x)
-
-        # neck: 1x1 conv -> LN2d -> 3x3 conv -> LN2d (sam_ViT.py:88-104)
-        x = nn.Conv(
-            self.out_chans, (1, 1), use_bias=False, dtype=self.dtype, name="neck_0"
-        )(x)
-        x = LayerNorm2d(name="neck_1")(x.astype(jnp.float32))
-        x = nn.Conv(
-            self.out_chans, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
-            name="neck_2",
-        )(x.astype(self.dtype))
-        x = LayerNorm2d(name="neck_3")(x.astype(jnp.float32))
+        x = self.neck(x)
         if return_interm:
             return x, interm
         return x

@@ -12,14 +12,22 @@ The TPU replacement for BOTH of the reference's distribution mechanisms
   fixed-size stat tuples.
 
 Mesh axes: ('data', 'model') — plus an optional 'seq' axis for
-sequence/context parallelism. 'model' tensor-parallelism shards the ViT
-attention/MLP feature dims; 'seq' runs the global-attention blocks as ring
-attention over token-row bands (parallel/ring.py). Neither is required for
-reference parity (the reference has no TP/SP) but both are first-class here
-for scaling ViT-H and long token grids beyond one chip.
+sequence/context parallelism and a 'pipe' axis for pipeline parallelism.
+'model' tensor-parallelism shards the ViT attention/MLP feature dims; 'seq'
+runs the global-attention blocks as ring attention over token-row bands
+(parallel/ring.py); 'pipe' streams microbatches through stage-sharded
+encoder blocks with a GPipe schedule (parallel/pipeline.py). None are
+required for reference parity (the reference has only DDP) but all are
+first-class here for scaling ViT-H and long token grids beyond one chip.
 """
 
 from tmr_tpu.parallel.mesh import make_mesh  # noqa: F401
+from tmr_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_vit_apply,
+    stack_stage_params,
+    stage_sharding,
+    stage_split,
+)
 from tmr_tpu.parallel.ring import (  # noqa: F401
     dense_attention,
     make_ring_attention_fn,

@@ -1,0 +1,216 @@
+"""Pipeline parallelism (GPipe) for the SAM ViT encoder.
+
+The reference scales only by data parallelism (Lightning DDP); this module
+adds the remaining classic axis: partition the encoder's transformer blocks
+into pipeline stages sharded over a 'pipe' mesh axis, stream microbatches
+through the stages, and rotate activations stage-to-stage with
+``lax.ppermute`` over ICI neighbor links.
+
+The SAM ViTs are unusually pipeline-friendly: their global-attention
+indexes (sam_ViT.py / vit.py VIT_CONFIGS — vit_b (2,5,8,11) of depth 12,
+vit_h (7,15,23,31) of depth 32) sit at the END of equal-size block groups,
+so every stage has the identical structure "d-1 windowed blocks + 1 global
+block". Identical structure means identical parameter PyTrees, so all
+stages stack into one tree with a leading stage axis, that axis shards over
+'pipe', and ONE traced stage computation serves every device — the
+homogeneity SPMD pipelining needs (no per-stage branches).
+
+Schedule: plain GPipe under ``lax.scan`` (differentiable — the backward
+pipeline is XLA-derived, bubbles and all): M microbatches over P stages run
+M + P - 1 ticks; stage 0 injects microbatch t, stage P-1 records microbatch
+t-(P-1), everyone ppermutes its activation forward each tick. Outputs are
+zero everywhere except the last stage and are combined with one closing
+``psum`` (replicated result — the simple, correct v1; a reduce-scatter
+variant can shard it later).
+
+Scope note: this pipelines the ENCODER FORWARD/BACKWARD (the FLOPs/memory
+dominant part — the detector head is a few convs). It composes under jit
+with data parallelism on the batch dim outside the island. Full
+pp-optimizer integration (sharding optimizer state by stage) is not wired
+into the Trainer; ``__graft_entry__.dryrun_multichip`` demonstrates the
+compiled pp path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def stage_split(depth: int, global_attn_indexes: Sequence[int]) -> Tuple[int, int]:
+    """(n_stages, blocks_per_stage) — validates the homogeneity invariant:
+    every stage must be 'd-1 windowed + 1 global' so stage params stack."""
+    n = len(global_attn_indexes)
+    if n == 0 or depth % n:
+        raise ValueError(
+            f"depth {depth} not divisible into {n} stages (one per global "
+            "block)"
+        )
+    d = depth // n
+    expected = tuple((s + 1) * d - 1 for s in range(n))
+    got = tuple(sorted(int(i) for i in global_attn_indexes))
+    if got != expected:
+        raise ValueError(
+            f"global_attn_indexes {got} do not close equal-size stages "
+            f"{expected}; heterogeneous stages cannot be pipelined"
+        )
+    return n, d
+
+
+def stack_stage_params(params: dict, depth: int,
+                       global_attn_indexes: Sequence[int]) -> dict:
+    """SamViT 'blocks_i' params -> one stage-major tree with a leading
+    stage axis: out['b{j}'] has shape (P, ...) stacking block s*d+j over
+    stages s. Inverse layout of vit.py's flat naming; shapes agree across
+    stages by the stage_split invariant."""
+    n, d = stage_split(depth, global_attn_indexes)
+    stages = [
+        {f"b{j}": params[f"blocks_{s * d + j}"] for j in range(d)}
+        for s in range(n)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def _stage_blocks(vit):
+    """One stage's Block modules: d-1 windowed + 1 global (static configs,
+    same for every stage). rel_pos_size is the PRETRAIN grid — parameter
+    shapes are fixed there and get_rel_pos interpolates to the runtime grid,
+    exactly as SamViT.__call__ builds its blocks."""
+    from tmr_tpu.models.vit import Block
+
+    _, d = stage_split(vit.depth, vit.global_attn_indexes)
+    grid = vit.pretrain_img_size // vit.patch_size
+    blocks = []
+    for j in range(d):
+        blocks.append(
+            Block(
+                num_heads=vit.num_heads,
+                mlp_ratio=vit.mlp_ratio,
+                window_size=0 if j == d - 1 else vit.window_size,
+                rel_pos_size=(grid, grid),
+                dtype=vit.dtype,
+            )
+        )
+    return blocks
+
+
+def pipeline_blocks_apply(
+    vit,
+    stacked: dict,
+    x: jnp.ndarray,
+    mesh,
+    axis: str = "pipe",
+    microbatches: int = 2,
+) -> jnp.ndarray:
+    """Run the ViT's transformer blocks as a GPipe pipeline over ``axis``.
+
+    vit: the SamViT module (for static block configs); stacked: the
+    stage-major params of stack_stage_params, leading axis sharded over
+    ``axis``; x: (B, h, w, C) tokens AFTER patch/pos embed. Returns the
+    (B, h, w, C) tokens the dense block stack would produce (same floats up
+    to fp reordering).
+    """
+    n_stage, _ = stage_split(vit.depth, vit.global_attn_indexes)
+    if mesh.shape[axis] != n_stage:
+        # a mismatch would silently drop stages: shard_map splits the stage
+        # axis across devices and each device keeps only its slice's [0]
+        raise ValueError(
+            f"'{axis}' mesh axis is {mesh.shape[axis]} devices but the "
+            f"model splits into {n_stage} stages; they must match"
+        )
+    b = x.shape[0]
+    if b % microbatches:
+        raise ValueError(f"batch {b} not divisible into {microbatches} "
+                         "microbatches")
+    blocks = _stage_blocks(vit)
+
+    def stage_fn(stage_params, h):
+        for j, blk in enumerate(blocks):
+            h = blk.apply({"params": stage_params[f"b{j}"]}, h)
+        return h
+
+    mb = b // microbatches
+    x_mb = x.reshape((microbatches, mb) + x.shape[1:])
+
+    def island(stacked_local, x_all):
+        sid = lax.axis_index(axis)
+        params = jax.tree.map(lambda a: a[0], stacked_local)
+        buf = jnp.zeros_like(x_all[0])
+        out = jnp.zeros_like(x_all)
+
+        def tick(carry, t):
+            buf, out = carry
+            inject = x_all[jnp.clip(t, 0, microbatches - 1)]
+            h_in = jnp.where(sid == 0, inject, buf)
+            y = stage_fn(params, h_in)
+            oidx = t - (n_stage - 1)
+            record = (sid == n_stage - 1) & (oidx >= 0)
+            out = out.at[jnp.clip(oidx, 0, microbatches - 1)].add(
+                jnp.where(record, y, jnp.zeros_like(y))
+            )
+            perm = [(j, (j + 1) % n_stage) for j in range(n_stage)]
+            buf = lax.ppermute(y, axis, perm)
+            return (buf, out), None
+
+        (buf, out), _ = lax.scan(
+            tick, (buf, out), jnp.arange(microbatches + n_stage - 1)
+        )
+        # outputs were recorded on the last stage only; combine + replicate
+        return lax.psum(out, axis)
+
+    island_sharded = jax.shard_map(
+        island,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = island_sharded(stacked, x_mb)
+    return out.reshape((b,) + x.shape[1:])
+
+
+def pipeline_vit_apply(
+    vit,
+    params: dict,
+    image: jnp.ndarray,
+    mesh,
+    axis: str = "pipe",
+    microbatches: int = 2,
+) -> jnp.ndarray:
+    """Full pipelined encoder forward: replicated patch/pos embed, the
+    block pipeline island, replicated neck. Numerically equivalent to
+    ``vit.apply`` (tests/test_pipeline.py pins it, forward and grads).
+
+    The pre/post stages run through SamViT's OWN ``embed``/``neck`` methods
+    (``apply(method=...)``) — one definition for the dense and pipelined
+    forward, so they cannot drift. The blocks come flat ('blocks_0' present,
+    stacked here) or pre-stacked under 'stages' (the stage-sharded
+    deployment layout, see stage_sharding).
+    """
+    if "blocks_0" in params:
+        stacked = stack_stage_params(
+            params, vit.depth, vit.global_attn_indexes
+        )
+    else:
+        stacked = params["stages"]
+
+    x = vit.apply({"params": params}, image, method="embed")
+    x = pipeline_blocks_apply(
+        vit, stacked, x, mesh, axis=axis, microbatches=microbatches
+    )
+    return vit.apply({"params": params}, x, method="neck")
+
+
+def stage_sharding(stacked: dict, mesh, axis: str = "pipe"):
+    """NamedShardings placing each stage's params on its pipe device (the
+    leading stage axis sharded over ``axis``, everything else replicated)."""
+    def spec(leaf):
+        return NamedSharding(
+            mesh, P(axis, *([None] * (leaf.ndim - 1)))
+        )
+
+    return jax.tree.map(spec, stacked)
