@@ -48,6 +48,11 @@ def config_parser(argv=None):
     p.add_argument("--lr_drop", action="store_true")
     p.add_argument("--lr", default=1e-4, type=float)
     p.add_argument("--lr_backbone", default=1e-5, type=float)
+    p.add_argument(
+        "--grad_accum_steps", default=1, type=int,
+        help="accumulate gradients over k micro-steps before one optimizer "
+        "update (one chip reaches the reference's 4-GPU effective batch)",
+    )
 
     # eval / vis
     p.add_argument("--eval", action="store_true")

@@ -308,3 +308,38 @@ def test_validate_tp_divisibility():
         validate_tp(mesh, 7, 2)
     # tp=1 never constrains
     validate_tp(make_mesh((4, 1)), 7, 13)
+
+
+def test_sharded_train_step_with_grad_accumulation():
+    """state_sharding must traverse the optax.MultiSteps-wrapped optimizer
+    state (acc_grads carry param shapes; counters are scalars) so
+    --grad_accum_steps composes with the mesh."""
+    import dataclasses
+
+    cfg, model = _model_cfg()
+    cfg = dataclasses.replace(cfg, grad_accum_steps=2)
+    batch = _batch()
+    state = create_train_state(
+        model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
+        steps_per_epoch=10,
+    )
+    step = make_train_step(model, cfg)
+    mesh = make_mesh((4, 2))
+    with mesh:
+        sh_state = state.replace(params=shard_params(state.params, mesh))
+        sh_batch = shard_batch(batch, mesh)
+        sharded = jax.jit(
+            step, out_shardings=(state_sharding(sh_state, mesh), None)
+        )
+        s1, l1 = sharded(sh_state, sh_batch)
+        s2, l2 = sharded(s1, sh_batch)
+        jax.block_until_ready(s2.params)
+    # micro-step 1 leaves params untouched; micro-step 2 applies the update
+    p0 = jax.tree_util.tree_leaves(state.params)
+    p1 = jax.tree_util.tree_leaves(s1.params)
+    p2 = jax.tree_util.tree_leaves(s2.params)
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(p0, p1))
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(p1, p2))
+    assert np.isfinite(float(l2["loss"]))

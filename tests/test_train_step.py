@@ -16,12 +16,12 @@ TINY_VIT = dict(
 )
 
 
-def _setup(lr_backbone=0.0):
+def _setup(lr_backbone=0.0, **cfg_overrides):
     cfg = Config(
         backbone="sam_vit_b", emb_dim=16, fusion=True, feature_upsample=False,
         positive_threshold=0.5, negative_threshold=0.5,
         lr=1e-3, lr_backbone=lr_backbone, lr_drop=True, max_epochs=10,
-        compute_dtype="float32",
+        compute_dtype="float32", **cfg_overrides,
     )
     model = MatchingNet(
         backbone=SamViT(**TINY_VIT), emb_dim=cfg.emb_dim, fusion=True,
@@ -159,3 +159,29 @@ def test_nonfinite_loss_skips_update():
         new_state.params, new_state2.params,
     )
     assert not all(jax.tree_util.tree_leaves(leaves_eq))
+
+
+def test_grad_accumulation_updates_every_k_steps():
+    """--grad_accum_steps k (optax.MultiSteps): params stay bit-identical
+    for k-1 micro-steps, then one combined update applies; the mean of the
+    k accumulated gradients drives it (single-chip route to the reference's
+    4-GPU effective batch)."""
+    state, step, batch = _setup(grad_accum_steps=2)
+
+    p0 = jax.tree_util.tree_leaves(state.params)
+    state1, losses1 = step(state, batch)
+    p1 = jax.tree_util.tree_leaves(state1.params)
+    # micro-step 1 of 2: gradients accumulated, NO parameter update
+    for a, b in zip(p0, p1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(float(losses1["loss"]))
+
+    state2, losses2 = step(state1, batch)
+    p2 = jax.tree_util.tree_leaves(state2.params)
+    # micro-step 2 of 2: the combined update fires on the head group
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(p1, p2)
+    )
+    assert changed
+    assert all(np.isfinite(np.asarray(l)).all() for l in p2)

@@ -43,6 +43,10 @@ class Config:
     lr_drop: bool = False
     lr: float = 1e-4
     lr_backbone: float = 1e-5
+    # TPU extension: accumulate gradients over k micro-steps before one
+    # optimizer update (optax.MultiSteps) — a single chip reaches the
+    # reference's 4-GPU effective batch (4 x bs4) with grad_accum_steps=4
+    grad_accum_steps: int = 1
 
     # eval / viz (reference main.py:48-51)
     eval: bool = False

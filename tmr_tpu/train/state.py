@@ -56,10 +56,15 @@ def param_labels(params: Any, frozen_backbone: bool) -> Any:
 
 
 def make_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
+    accum = cfg.grad_accum_steps
+    # the piecewise schedule advances once per OPTIMIZER UPDATE — under
+    # MultiSteps that is once per k micro-steps, so the 60% milestone must
+    # be expressed in updates, not in data steps
+    updates_per_epoch = max(steps_per_epoch // max(accum, 1), 1)
     if cfg.lr_drop:
-        milestone = int(cfg.max_epochs * 0.6) * steps_per_epoch
+        milestone = int(cfg.max_epochs * 0.6) * updates_per_epoch
     else:
-        milestone = (cfg.max_epochs + 1) * steps_per_epoch
+        milestone = (cfg.max_epochs + 1) * updates_per_epoch
 
     def sched(base):
         return optax.piecewise_constant_schedule(base, {milestone: 0.1})
@@ -71,12 +76,18 @@ def make_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
                                 weight_decay=cfg.weight_decay),
         "frozen": optax.set_to_zero(),
     }
-    return optax.chain(
+    tx = optax.chain(
         optax.clip_by_global_norm(cfg.clip_max_norm),
         optax.multi_transform(
             transforms, lambda p: param_labels(p, frozen_backbone)
         ),
     )
+    if accum > 1:
+        # mean-accumulate k micro-step gradients, apply ONE update every k
+        # steps (params are bit-identical in between) — one chip reaches the
+        # reference's DDP effective batch without the memory of a big batch
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    return tx
 
 
 def create_train_state(
