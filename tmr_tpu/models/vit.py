@@ -240,7 +240,9 @@ class Attention(nn.Module):
                     flash_supported,
                 )
 
-                if flash_supported(h * w) and flash_attention_ok():
+                if flash_supported(h * w) and flash_attention_ok(
+                    h, w, head_dim
+                ):
                     attn_fn = flash_decomposed_attention
             x = attn_fn(
                 q, k, v,

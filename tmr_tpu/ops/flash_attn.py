@@ -291,16 +291,17 @@ def flash_window_ok(gh: int, gw: int, head_dim: int) -> bool:
     return _self_check(flash_windowed_attention, 2, 2, gh, gw, head_dim)
 
 
-@functools.lru_cache(maxsize=1)
-def flash_attention_ok() -> bool:
-    """One-time self-check of the global-attention flash path.
+@functools.lru_cache(maxsize=None)
+def flash_attention_ok(
+    gh: int = 64, gw: int = 64, head_dim: int = 64
+) -> bool:
+    """Per-geometry compiled self-check of the global-attention flash path.
 
-    PRODUCTION-shaped: the true 1024-input global-attention geometry — 64x64
-    token grid (S=4096, 8 key blocks of 512), d_aug = 64+64+64 = 192
-    lane-padded to 256, f32 rel-pos tables — reduced only in batch/heads
-    (grid/blocks/d are what Mosaic failures key on). A config-specific
-    failure must trip inside the check, not in the model trace. (The 1536
-    bucket's 96x96 grid runs the same kernel with more grid steps and the
-    identical padded depth: 64+96+96 = 256.)
-    """
-    return _self_check(flash_decomposed_attention, 1, 2, 64, 64, 64)
+    Callers pass the ACTUAL token grid and head dim about to run — vit_b @
+    1024 is (64, 64, 64) (S=4096, 8 key blocks of 512, d_aug 192 lane-padded
+    to 256), vit_h differs in head_dim (80), the 1536 bucket in grid (96x96)
+    — and each geometry gets its own checked cache entry, reduced only in
+    batch/heads (grid/blocks/d are what Mosaic failures key on). A
+    config-specific failure must trip inside the check, not in the model
+    trace."""
+    return _self_check(flash_decomposed_attention, 1, 2, gh, gw, head_dim)
