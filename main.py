@@ -32,6 +32,12 @@ def config_parser(argv=None):
     p.add_argument("--datapath", type=str, default="/home/")
     p.add_argument("--dataset", type=str, default="RPINE")
     p.add_argument("--batch_size", default=1, type=int)
+    p.add_argument(
+        "--eval_batch_size", default=1, type=int,
+        help="batch size for val/test (reference pins 1; >1 is the TPU "
+        "throughput mode, per-image outputs unchanged; forced to 1 when "
+        "--num_exemplars > 1)",
+    )
     p.add_argument("--num_workers", default=8, type=int)
     p.add_argument("--num_exemplars", default=1, type=int)
     p.add_argument("--image_size", default=1024, type=int)

@@ -295,3 +295,29 @@ def test_trainer_multi_exemplar_eval_branch(tmp_path):
     content = open(csv_path).read()
     assert "val/AP" in content and "val/loss_ce" in content
     assert np.isfinite(trainer.ckpt.meta["best_value"] or 0.0)
+
+
+def test_eval_batch_size_matches_bs1_metrics(tmp_path):
+    """--eval_batch_size > 1 (TPU throughput mode) must reproduce the bs=1
+    reference protocol's AP/MAE/RMSE exactly: detections are per-image and
+    the loader only groups same-size images."""
+    import dataclasses
+
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _write_fixture(root, n_train=4, n_val=4)
+
+    results = {}
+    for bs in (1, 2):
+        logdir = str(tmp_path / f"logs_bs{bs}")
+        trainer = _make_trainer(root, logdir)
+        trainer.cfg = dataclasses.replace(
+            trainer.cfg, eval_batch_size=bs, max_epochs=1, logpath=logdir
+        )
+        trainer.fit()
+        results[bs] = trainer.test()
+
+    for key in ("test/AP", "test/AP50", "test/MAE", "test/RMSE"):
+        assert np.isclose(results[1][key], results[2][key], atol=1e-6), (
+            key, results[1][key], results[2][key]
+        )

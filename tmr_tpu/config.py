@@ -28,6 +28,11 @@ class Config:
     datapath: str = "/home/"
     dataset: str = "RPINE"
     batch_size: int = 1
+    # TPU extension: eval/test batch size (reference pins 1,
+    # datamodules.py:27,47,50 — kept as the parity default). >1 batches
+    # same-size-bucket images through the fused eval program; per-image
+    # outputs and metrics are unchanged, logged losses become batch means.
+    eval_batch_size: int = 1
     num_workers: int = 8
     num_exemplars: int = 1
     image_size: int = 1024

@@ -229,7 +229,7 @@ class Attention(nn.Module):
             # global-attention blocks (4096+ tokens): never materialize the
             # S x S scores or the (B, H, h, w, h, w) bias. On TPU in bf16,
             # the Pallas flash kernel runs the rel-pos bias folded into the
-            # QK contraction (ops/flash_attn.py) behind a one-time compiled
+            # QK contraction (ops/flash_attn.py) behind a per-geometry compiled
             # self-check; everywhere else (and for exact-f32 parity) the XLA
             # blockwise path.
             attn_fn = blockwise_decomposed_attention
@@ -260,7 +260,7 @@ class Attention(nn.Module):
             # A/B variant (TMR_WIN_ATTN=flash): the stock Pallas kernel over
             # 256-padded windows with a pad segment — zero per-window score
             # materialization. bf16-only (the kernel's compute dtype); gated
-            # by a one-time compiled self-check with fallback to dense.
+            # by a per-geometry compiled self-check with fallback to dense.
             from tmr_tpu.ops.flash_attn import flash_windowed_attention
 
             x = flash_windowed_attention(q, k, v, rh, rw, (h, w), scale)

@@ -21,7 +21,7 @@ the plain path, a few extra ms at v5e peak, in exchange for ZERO S x S HBM
 traffic inside jax.experimental.pallas's TPU flash kernel (VMEM-resident
 tiles, online softmax).
 
-Used by models/vit.py on the TPU bf16 path behind a one-time compiled
+Used by models/vit.py on the TPU bf16 path behind a per-geometry compiled
 self-check (the pallas_nms pattern); every other configuration takes the
 exact XLA blockwise path.
 """

@@ -31,7 +31,12 @@ from tmr_tpu.train.state import (
     make_train_step,
 )
 from tmr_tpu.utils.checkpoint import CheckpointManager
-from tmr_tpu.utils.profiling import PhaseTimer, step_annotation, trace
+from tmr_tpu.utils.profiling import (
+    PhaseTimer,
+    log_warning,
+    step_annotation,
+    trace,
+)
 from tmr_tpu.utils.metrics import (
     coco_style_annotation_generator,
     del_img_log_path,
@@ -123,17 +128,28 @@ class Trainer:
             max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
             num_workers=cfg.num_workers, drop_last=True,
         )
-        # reference forces batch_size=1 for val/test (datamodules.py:27,47,50)
+        # reference forces batch_size=1 for val/test (datamodules.py:27,47,50);
+        # --eval_batch_size > 1 is the opt-in TPU throughput mode — the
+        # loader already groups images by size bucket and the eval step /
+        # per-image JSON collector unbatch natively. Multi-exemplar eval
+        # stays at 1 (its meta plumbing is per-image).
+        eval_bs = cfg.eval_batch_size if cfg.num_exemplars == 1 else 1
+        if eval_bs != cfg.eval_batch_size:
+            log_warning(
+                f"--eval_batch_size {cfg.eval_batch_size} forced to 1: "
+                "multi-exemplar eval is per-image (num_exemplars="
+                f"{cfg.num_exemplars})"
+            )
         val_split = "val" if cfg.dataset == "FSCD147" else "test"
         val = DataLoader(
             build_dataset(cfg, val_split),
-            batch_size=1, shuffle=False, seed=cfg.seed,
+            batch_size=eval_bs, shuffle=False, seed=cfg.seed,
             max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
             num_workers=cfg.num_workers,
         )
         test = DataLoader(
             build_dataset(cfg, "test"),
-            batch_size=1, shuffle=False, seed=cfg.seed,
+            batch_size=eval_bs, shuffle=False, seed=cfg.seed,
             max_gt=cfg.max_gt_boxes, max_exemplars=cfg.num_exemplars,
             num_workers=cfg.num_workers,
         )
@@ -297,41 +313,69 @@ class Trainer:
             self.wandb.finish()
 
     # ----------------------------------------------------------------- eval
+    @staticmethod
+    def _split_per_image(batch: dict):
+        """Ragged tail batch -> B=1 sub-batches. Each size bucket's leftover
+        has its own batch dim; compiling the whole eval program once per
+        leftover shape would cost a full XLA compile for a batch used once
+        per epoch — B=1 is one stable extra shape instead."""
+        b = batch["image"].shape[0]
+        for i in range(b):
+            yield {
+                k: (v[i : i + 1] if k != "meta" else [v[i]])
+                for k, v in batch.items()
+            }
+
     def eval_epoch(self, loader, stage: str, params) -> Dict[str, float]:
         cfg = self.cfg
         self.predictor.params = params
         sums = None  # device-scalar pytree, fetched once per epoch
         n = 0
-        for batch in loader:
-            if cfg.num_exemplars > 1:
-                # one fused program: per-exemplar losses SUMMED (reference
-                # trainer.py:102-104,121) + union detections
-                losses, dets = self.predictor.predict_multi_exemplar(
-                    batch["image"], batch["meta"][0]["orig_exemplars"]
-                    / np.array(batch["meta"][0]["img_size"].tolist() * 2,
-                               np.float32),
-                    loss_fn=self._loss_fn(),
-                    loss_args=(jnp.asarray(batch["gt_boxes"]),
-                               jnp.asarray(batch["gt_valid"])),
-                )
+        for full_batch in loader:
+            b = full_batch["image"].shape[0]
+            if cfg.num_exemplars == 1 and b not in (1, cfg.eval_batch_size):
+                sub_batches = self._split_per_image(full_batch)
             else:
-                # fused: losses + detections from one forward
-                cap = self.predictor.pick_capacity(
-                    batch["exemplars"], int(batch["image"].shape[1])
+                sub_batches = [full_batch]
+            for batch in sub_batches:
+                losses, dets = self._eval_batch(batch)
+                sums = losses if sums is None else self._acc_fn(sums, losses)
+                n += 1
+                image_info_collector(
+                    cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
                 )
-                losses, dets = self._get_eval_step(cap)(
-                    params, self.predictor.refiner_params,
-                    jnp.asarray(batch["image"]),
-                    jnp.asarray(batch["exemplars"]),
-                    jnp.asarray(batch["gt_boxes"]),
-                    jnp.asarray(batch["gt_valid"]),
-                )
-            sums = losses if sums is None else self._acc_fn(sums, losses)
-            n += 1
-            image_info_collector(
-                cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
-            )
+        return self._finish_eval(stage, sums, n)
 
+    def _eval_batch(self, batch: dict):
+        cfg = self.cfg
+        params = self.predictor.params
+        if cfg.num_exemplars > 1:
+            # one fused program: per-exemplar losses SUMMED (reference
+            # trainer.py:102-104,121) + union detections
+            losses, dets = self.predictor.predict_multi_exemplar(
+                batch["image"], batch["meta"][0]["orig_exemplars"]
+                / np.array(batch["meta"][0]["img_size"].tolist() * 2,
+                           np.float32),
+                loss_fn=self._loss_fn(),
+                loss_args=(jnp.asarray(batch["gt_boxes"]),
+                           jnp.asarray(batch["gt_valid"])),
+            )
+        else:
+            # fused: losses + detections from one forward
+            cap = self.predictor.pick_capacity(
+                batch["exemplars"], int(batch["image"].shape[1])
+            )
+            losses, dets = self._get_eval_step(cap)(
+                params, self.predictor.refiner_params,
+                jnp.asarray(batch["image"]),
+                jnp.asarray(batch["exemplars"]),
+                jnp.asarray(batch["gt_boxes"]),
+                jnp.asarray(batch["gt_valid"]),
+            )
+        return losses, dets
+
+    def _finish_eval(self, stage: str, sums, n: int) -> Dict[str, float]:
+        cfg = self.cfg
         sums_host = (
             {} if sums is None
             else {k: float(v) for k, v in jax.device_get(sums).items()}
