@@ -38,6 +38,17 @@ while true; do
     timeout 3600 python scripts/bench_extra.py \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra rc=$? -> $OUT/bench_extra_live.json"
+    # informational: does local (terminal-side-off) compilation work? If so,
+    # future rounds can avoid the compile-over-tunnel wedge class entirely.
+    if PALLAS_AXON_REMOTE_COMPILE=0 timeout 300 python -u -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((512, 512), jnp.bfloat16)
+print(jax.device_get(jax.jit(lambda a: (a @ (a + 2.0)).astype(jnp.float32).sum())(x)))
+" >>"$LOG" 2>&1; then
+      log "REMOTE_COMPILE=0 probe: OK (local compile works)"
+    else
+      log "REMOTE_COMPILE=0 probe: failed"
+    fi
     log "battery done"
     break
   fi
