@@ -137,3 +137,39 @@ def test_converted_params_run_and_respect_weights():
         torch_sd["model.encoder.backbone.backbone.blocks.0.attn.proj.weight"]
         .numpy().T,
     )
+
+
+def test_convert_cli_roundtrip(tmp_path):
+    """python -m tmr_tpu.utils.convert: .ckpt in, loadable orbax out, layout
+    auto-sniffed (the migration entry point for reference users)."""
+    import torch
+
+    import orbax.checkpoint as ocp
+
+    from tmr_tpu.utils import convert as cv
+
+    ckpt = tmp_path / "best_model.ckpt"
+    torch.save(
+        {"state_dict": _tiny_reference_state_dict(np.random.default_rng(0))},
+        ckpt,
+    )
+    out = tmp_path / "orbax"
+    cv.main(["--ckpt", str(ckpt), "--out", str(out)])
+
+    restored = ocp.StandardCheckpointer().restore(str(out))
+    want = cv.convert_matching_net(
+        {k: v.numpy() for k, v in _tiny_reference_state_dict(
+            np.random.default_rng(0)).items()}
+    )
+    from flax import traverse_util
+
+    got_flat = {
+        "/".join(k): v
+        for k, v in traverse_util.flatten_dict(restored["params"]).items()
+    }
+    want_flat = {
+        "/".join(k): v for k, v in traverse_util.flatten_dict(want).items()
+    }
+    assert set(got_flat) == set(want_flat)
+    for k in want_flat:
+        np.testing.assert_array_equal(got_flat[k], want_flat[k])

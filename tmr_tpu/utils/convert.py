@@ -312,3 +312,79 @@ def convert_matching_net(sd: Dict[str, np.ndarray], backbone: str = "sam") -> di
                 "bias": _np(sd[f"{head}.head.0.bias"]),
             }}
     return p
+
+
+def main(argv=None):
+    """CLI: ``python -m tmr_tpu.utils.convert --ckpt in.pth --out dir
+    [--kind auto|sam_vit|matching_net|refiner|resnet50]``.
+
+    Converts a reference checkpoint into an orbax directory loadable by the
+    Trainer/Predictor (``{"params": ...}`` tree). ``auto`` sniffs the
+    layout: ``image_encoder.*`` -> SAM encoder .pth, ``model.*`` ->
+    Lightning matching_net .ckpt (backbone family sniffed from the
+    encoder keys), ``layer1.*`` -> torchvision resnet50.
+    """
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--ckpt", required=True, help="input .pth/.ckpt")
+    p.add_argument("--out", required=True, help="output orbax directory")
+    p.add_argument(
+        "--kind", default="auto",
+        choices=("auto", "sam_vit", "matching_net", "refiner", "resnet50"),
+    )
+    p.add_argument("--backbone", default="sam",
+                   help="matching_net backbone name (for key remaps)")
+    args = p.parse_args(argv)
+
+    sd = load_torch_state_dict(args.ckpt)
+    kind = args.kind
+    if kind == "auto":
+        if any(k.startswith("image_encoder.") for k in sd):
+            kind = "sam_vit"
+        elif any(k.startswith("model.") for k in sd):
+            kind = "matching_net"
+        elif any(k.startswith("layer1.") for k in sd):
+            kind = "resnet50"
+        else:
+            raise SystemExit(
+                f"cannot sniff checkpoint layout from keys like "
+                f"{sorted(sd)[:3]}; pass --kind explicitly"
+            )
+    backbone = args.backbone
+    if kind == "matching_net":
+        # sniff the backbone family from the encoder keys so resnet
+        # checkpoints don't hit the SAM key remap with a raw KeyError
+        enc = "model.encoder.backbone.backbone."
+        if enc + "patch_embed.proj.weight" in sd:
+            backbone = "sam"
+        elif any(k.startswith(enc + "layer1.") for k in sd):
+            backbone = "resnet50"
+        elif not any(k.startswith(enc) for k in sd):
+            raise SystemExit(
+                f"matching_net checkpoint has no {enc}* keys; pass --kind "
+                "explicitly"
+            )
+    params = {
+        "sam_vit": lambda: convert_sam_vit(sd),
+        "matching_net": lambda: convert_matching_net(sd, backbone=backbone),
+        "refiner": lambda: convert_sam_refiner(sd),
+        "resnet50": lambda: convert_resnet50(sd),
+    }[kind]()
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(args.out)
+    ckptr = ocp.StandardCheckpointer()  # async under the hood
+    ckptr.save(path, {"params": params}, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    import jax
+
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(f"[INFO] {kind}: {n / 1e6:.1f}M params -> {path}")
+
+
+if __name__ == "__main__":
+    main()
