@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import Mesh
 
 from tmr_tpu.models.vit import SamViT
 from tmr_tpu.parallel.mesh import make_mesh
@@ -151,6 +152,24 @@ def test_pipeline_non_native_grid_interpolates_rel_pos():
     mesh = make_mesh((2,), axis_names=("pipe",), devices=jax.devices()[:2])
     got = jax.jit(
         lambda p, v: pipeline_vit_apply(vit, p, v, mesh, microbatches=2)
+    )(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipeline_composes_with_data_parallelism():
+    """pp x dp in one ('pipe','data') mesh: each device pair pipelines its
+    batch shard; output matches dense and keeps the data sharding."""
+    vit, params, x = _model_and_params(seed=7)  # batch 4
+    want = vit.apply({"params": params}, x)
+    mesh = Mesh(
+        np.array(jax.devices()[:4]).reshape(2, 2), ("pipe", "data")
+    )
+    got = jax.jit(
+        lambda p, v: pipeline_vit_apply(
+            vit, p, v, mesh, microbatches=2, data_axis="data"
+        )
     )(params, x)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4

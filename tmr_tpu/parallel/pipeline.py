@@ -105,6 +105,7 @@ def pipeline_blocks_apply(
     mesh,
     axis: str = "pipe",
     microbatches: int = 2,
+    data_axis: str = None,
 ) -> jnp.ndarray:
     """Run the ViT's transformer blocks as a GPipe pipeline over ``axis``.
 
@@ -113,6 +114,11 @@ def pipeline_blocks_apply(
     ``axis``; x: (B, h, w, C) tokens AFTER patch/pos embed. Returns the
     (B, h, w, C) tokens the dense block stack would produce (same floats up
     to fp reordering).
+
+    ``data_axis`` composes pp x dp in one mesh: each microbatch's batch dim
+    additionally shards over that axis (every (pipe, data) device pair
+    pipelines its own batch shard; the closing psum runs over 'pipe' only,
+    so the output keeps the data sharding).
     """
     n_stage, _ = stage_split(vit.depth, vit.global_attn_indexes)
     if mesh.shape[axis] != n_stage:
@@ -134,6 +140,11 @@ def pipeline_blocks_apply(
         return h
 
     mb = b // microbatches
+    if data_axis is not None and mb % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by '{data_axis}' axis "
+            f"size {mesh.shape[data_axis]}"
+        )
     x_mb = x.reshape((microbatches, mb) + x.shape[1:])
 
     def island(stacked_local, x_all):
@@ -162,11 +173,12 @@ def pipeline_blocks_apply(
         # outputs were recorded on the last stage only; combine + replicate
         return lax.psum(out, axis)
 
+    x_spec = P(None, data_axis) if data_axis is not None else P()
     island_sharded = jax.shard_map(
         island,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     out = island_sharded(stacked, x_mb)
@@ -180,6 +192,7 @@ def pipeline_vit_apply(
     mesh,
     axis: str = "pipe",
     microbatches: int = 2,
+    data_axis: str = None,
 ) -> jnp.ndarray:
     """Full pipelined encoder forward: replicated patch/pos embed, the
     block pipeline island, replicated neck. Numerically equivalent to
@@ -200,7 +213,8 @@ def pipeline_vit_apply(
 
     x = vit.apply({"params": params}, image, method="embed")
     x = pipeline_blocks_apply(
-        vit, stacked, x, mesh, axis=axis, microbatches=microbatches
+        vit, stacked, x, mesh, axis=axis, microbatches=microbatches,
+        data_axis=data_axis,
     )
     return vit.apply({"params": params}, x, method="neck")
 
