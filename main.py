@@ -113,6 +113,10 @@ def config_parser(argv=None):
     p.add_argument("--remat_backbone", action="store_true",
                    help="gradient-checkpoint the ViT blocks (activation "
                         "memory ~1/depth for one extra forward)")
+    p.add_argument("--autotune", action="store_true",
+                   help="microbenchmark kernel formulations (x-corr "
+                        "lowering, windowed attention) on this device at "
+                        "the run's shapes and use the winners (TPU only)")
 
     args = p.parse_args(argv)
     return args
@@ -154,6 +158,20 @@ def main(argv=None):
             mesh = make_mesh((args.mesh_data, args.mesh_model, args.mesh_seq))
         else:
             mesh = make_mesh((args.mesh_data, args.mesh_model))
+
+    if args.autotune:
+        from tmr_tpu.utils.autotune import autotune
+        from tmr_tpu.utils.profiling import log_info
+
+        # tune at the PER-DEVICE shape the run will actually compile: the
+        # eval batch under --eval (mirrors the loop's num_exemplars forcing),
+        # else the per-device train batch after data-parallel sharding
+        if cfg.eval:
+            tune_batch = cfg.eval_batch_size if cfg.num_exemplars == 1 else 1
+        else:
+            dp = mesh.shape.get("data", 1) if mesh is not None else 1
+            tune_batch = max(cfg.batch_size // max(dp, 1), 1)
+        autotune(cfg, cfg.image_size, tune_batch, log=log_info)
 
     trainer = Trainer(cfg, mesh=mesh)
     if cfg.eval:
