@@ -49,6 +49,16 @@ print(jax.device_get(jax.jit(lambda a: (a @ (a + 2.0)).astype(jnp.float32).sum()
     else
       log "REMOTE_COMPILE=0 probe: failed"
     fi
+    # land the measurements in the repo so they survive the session even if
+    # nobody is around to collect them (the driver commits leftovers, but an
+    # explicit commit records provenance)
+    cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
+    cp "$OUT/profile_live.json" "$REPO/PROFILE_LIVE.json" 2>/dev/null
+    cp "$OUT/bench_extra_live.json" "$REPO/BENCH_EXTRA_LIVE.json" 2>/dev/null
+    (cd "$REPO" && git add BENCH_LIVE.json PROFILE_LIVE.json \
+        BENCH_EXTRA_LIVE.json 2>>"$LOG" \
+      && git commit -q -m "bench: live TPU measurement battery (tpu_watch)" \
+        2>>"$LOG") || log "git commit of live results failed"
     log "battery done"
     break
   fi
