@@ -18,54 +18,17 @@ TINY_VIT = dict(
 
 
 def _write_fixture(root, n_train=4, n_val=2):
-    """Images with 2 bright square 'objects' on dark background."""
-    from PIL import Image
+    """Images with 2 bright square 'objects' on dark background (the
+    package's own quickstart fixture generator)."""
+    from tmr_tpu.data.synthetic import write_synthetic_fscd147
 
-    os.makedirs(f"{root}/annotations", exist_ok=True)
-    os.makedirs(f"{root}/images_384_VarV2", exist_ok=True)
-    rng = np.random.default_rng(0)
-    names = [f"im{i}.jpg" for i in range(n_train + n_val)]
-    annos, instances = {}, []
-    aid = 1
-    for i, n in enumerate(names):
-        arr = (rng.uniform(0, 40, (64, 64, 3))).astype(np.uint8)
-        boxes = []
-        for (cx, cy) in [(16, 16), (44, 40)]:
-            arr[cy - 5 : cy + 5, cx - 5 : cx + 5] = 220
-            boxes.append([cx - 5, cy - 5, 10, 10])
-        Image.fromarray(arr).save(f"{root}/images_384_VarV2/{n}")
-        annos[n] = {
-            "box_examples_coordinates": [
-                [[x, y], [x, y + h], [x + w, y + h], [x + w, y]]
-                for (x, y, w, h) in boxes  # both objects -> K=2 exemplars
-            ]
-        }
-        for b in boxes:
-            instances.append(
-                {"id": aid, "image_id": i, "bbox": b}
-            )
-            aid += 1
-    json.dump(annos, open(f"{root}/annotations/annotation_FSC147_384.json", "w"))
-    json.dump(
-        {
-            "train": names[:n_train],
-            "val": names[n_train:],
-            "test": names[n_train:],
-        },
-        open(f"{root}/annotations/Train_Test_Val_FSC_147.json", "w"),
-    )
-    inst = {
-        "images": [{"id": i, "file_name": n} for i, n in enumerate(names)],
-        "annotations": instances,
-    }
-    for split in ("train", "val", "test"):
-        json.dump(inst, open(f"{root}/annotations/instances_{split}.json", "w"))
+    write_synthetic_fscd147(root, n_train=n_train, n_val=n_val)
 
 
-def _make_trainer(root, logdir, resume=False):
+def _make_trainer(root, logdir, resume=False, **overrides):
     from tmr_tpu.train.loop import Trainer
 
-    cfg = Config(
+    kw = dict(
         dataset="FSCD147", datapath=root, logpath=logdir,
         backbone="sam_vit_b", emb_dim=16, fusion=True,
         feature_upsample=False, image_size=64,
@@ -76,6 +39,8 @@ def _make_trainer(root, logdir, resume=False):
         compute_dtype="float32", max_detections=64,
         template_buckets=(9,), resume=resume,
     )
+    kw.update(overrides)
+    cfg = Config(**kw)
     trainer = Trainer(cfg)
     tiny = MatchingNet(
         backbone=SamViT(**TINY_VIT), emb_dim=cfg.emb_dim, fusion=True,
@@ -320,4 +285,33 @@ def test_eval_batch_size_matches_bs1_metrics(tmp_path):
     for key in ("test/AP", "test/AP50", "test/MAE", "test/RMSE"):
         assert np.isclose(results[1][key], results[2][key], atol=1e-6), (
             key, results[1][key], results[2][key]
+        )
+
+
+def test_eval_mode_restore_matches_live_metrics(tmp_path):
+    """--eval (fresh process, cfg.eval=True: checkpoint restore + eval-mode
+    datasets) must reproduce the live end-of-training test metrics. Guards
+    the restore path end to end — a stale/corrupt best checkpoint or an
+    eval-only pipeline divergence shows up as a metric gap. Objects are
+    >= 25 px so the reference's small-object 1536 escalation (which
+    legitimately changes eval-mode resolution) stays out of the comparison."""
+    import dataclasses
+
+    from tmr_tpu.data.synthetic import write_synthetic_fscd147
+
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    write_synthetic_fscd147(root, n_train=4, n_val=2, square=26)
+
+    trainer = _make_trainer(root, logdir, max_epochs=4)
+    trainer.fit()
+    _, _, test_loader = trainer._loaders()
+    live = trainer.eval_epoch(test_loader, "test", trainer.state.params)
+
+    ev = _make_trainer(root, logdir, eval=True)
+    restored = ev.test()
+    for key in ("test/AP", "test/AP50", "test/MAE", "test/RMSE"):
+        assert np.isclose(live[key], restored[key], atol=1e-6), (
+            key, live[key], restored[key]
         )
