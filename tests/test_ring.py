@@ -551,3 +551,13 @@ def test_flash_self_check_rejects_nan(monkeypatch):
     assert flash_attn._self_check(
         flash_attn.flash_windowed_attention, 1, 1, 7, 7, 8
     ) is False
+
+
+def test_flash_supported_production_lengths():
+    """Block constraints hold at both production buckets (4096 = 64x64,
+    9216 = 96x96 has the 2^10 factor) and fail at the window length."""
+    from tmr_tpu.ops.flash_attn import flash_supported
+
+    assert flash_supported(4096)
+    assert flash_supported(9216)
+    assert not flash_supported(196)  # windows go through the padded path

@@ -315,3 +315,21 @@ def test_eval_mode_restore_matches_live_metrics(tmp_path):
         assert np.isclose(live[key], restored[key], atol=1e-6), (
             key, live[key], restored[key]
         )
+
+
+def test_split_per_image_unbatches_everything():
+    """Ragged eval tails split into exact B=1 sub-batches (arrays sliced,
+    meta list itemized) — the no-recompile path for leftover size buckets."""
+    from tmr_tpu.train.loop import Trainer
+
+    batch = {
+        "image": np.arange(3 * 4).reshape(3, 2, 2, 1).astype(np.float32),
+        "exemplars": np.arange(3 * 4).reshape(3, 1, 4).astype(np.float32),
+        "meta": [{"img_id": i} for i in range(3)],
+    }
+    subs = list(Trainer._split_per_image(batch))
+    assert len(subs) == 3
+    for i, sub in enumerate(subs):
+        assert sub["image"].shape == (1, 2, 2, 1)
+        np.testing.assert_array_equal(sub["image"][0], batch["image"][i])
+        assert sub["meta"] == [{"img_id": i}]
