@@ -5,7 +5,6 @@ import json
 import os
 
 import numpy as np
-import pytest
 
 from tmr_tpu.config import Config
 from tmr_tpu.data import DataLoader, build_dataset, collate

@@ -18,7 +18,6 @@ from types import SimpleNamespace
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from oracles import giou_loss_np, nms_np, roi_align_np

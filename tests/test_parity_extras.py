@@ -2,7 +2,6 @@
 GTBasedRandomCrop), encoder registry (models/encoders.py), worker payload
 packaging (Package_Modules.zip), refiner save_masks."""
 
-import os
 import sys
 import zipfile
 

@@ -9,7 +9,6 @@ import os
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from tmr_tpu.utils.profiling import (
     PhaseTimer,

@@ -1,7 +1,6 @@
 """End-to-end slice: fit a tiny model on a synthetic FSCD-147 fixture,
 validate (AP/MAE pipeline), checkpoint best/last, resume, and test-eval."""
 
-import json
 import os
 
 import numpy as np

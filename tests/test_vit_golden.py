@@ -10,7 +10,6 @@ import sys
 import types
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

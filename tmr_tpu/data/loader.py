@@ -16,7 +16,7 @@ reference's val/test batch_size=1 behavior when batch_size=1.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

@@ -15,7 +15,7 @@ host-selected static buckets, each compiled once and cached.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -24,12 +24,11 @@ mechanically.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from tmr_tpu.models.common import LayerNorm2d
 

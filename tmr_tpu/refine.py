@@ -27,7 +27,6 @@ The exemplar-scaled variant (box_refine.py:64-188 ``forward_refine``) is
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional, Tuple
 
 import flax.linen as nn

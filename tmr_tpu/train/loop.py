@@ -24,7 +24,6 @@ import numpy as np
 from tmr_tpu.data import DataLoader, build_dataset
 from tmr_tpu.inference import Predictor, detections_to_numpy
 from tmr_tpu.models import build_model
-from tmr_tpu.ops.postprocess import batched_nms, decode_detections
 from tmr_tpu.train.state import (
     compute_losses,
     create_train_state,

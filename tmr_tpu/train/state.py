@@ -17,7 +17,6 @@ sharding its inputs over a mesh (see tmr_tpu/parallel), not from a wrapper.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax
