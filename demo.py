@@ -80,11 +80,25 @@ class DemoEngine:
     def load_checkpoint(self, path: str):
         """Restore model params from an orbax checkpoint directory — either a
         full TrainState saved by the CheckpointManager or a bare params tree.
-        The strict=False spirit of demo.py:154-155: only model params are
+        A training logpath's ``checkpoints/`` parent (containing
+        ckpt_meta.json) resolves to its best version automatically, so
+        ``--ckpt <logpath>/checkpoints`` works like the reference demo's
+        --ckpt best_model.ckpt (demo.py:154-155); only model params are
         read, optimizer state (if present) is ignored."""
+        import json
+
         import orbax.checkpoint as ocp
 
-        tree = ocp.StandardCheckpointer().restore(os.path.abspath(path))
+        path = os.path.abspath(path)
+        meta_path = os.path.join(path, "ckpt_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            v = meta.get("best_version", -1)
+            path = os.path.join(
+                path, f"best_model-v{v}" if v >= 0 else "last"
+            )
+        tree = ocp.StandardCheckpointer().restore(path)
         self.predictor.params = tree.get("params", tree)
 
     def infer(self, image_rgb: np.ndarray, exemplars_px, refine: bool = False):

@@ -321,3 +321,30 @@ def test_multi_exemplar_losses_with_box_reg_ablated():
     )
     assert np.isfinite(float(losses["loss_ce"]))
     assert np.isfinite(np.asarray(dets["boxes"]).sum())
+
+
+def test_load_checkpoint_resolves_manager_directory(tmp_path):
+    """Pointing --ckpt at a training checkpoints/ dir (with ckpt_meta.json)
+    resolves to its best version automatically."""
+    import json
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    e1 = demo_mod.DemoEngine(small_cfg())
+    e1.init_params(seed=5)
+    root = tmp_path / "checkpoints"
+    root.mkdir()
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(str(root / "best_model-v1"), {"params": e1.predictor.params},
+              force=True)
+    ckpt.wait_until_finished()
+    json.dump({"best_value": 1.0, "best_version": 1, "last_epoch": 3},
+              open(root / "ckpt_meta.json", "w"))
+
+    e2 = demo_mod.DemoEngine(small_cfg())
+    e2.load_checkpoint(str(root))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+        e1.predictor.params, e2.predictor.params,
+    )
