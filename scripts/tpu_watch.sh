@@ -32,6 +32,11 @@ while true; do
     TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
       >"$OUT/bench_live.json" 2>>"$LOG"
     log "bench.py rc=$? -> $OUT/bench_live.json"
+    # the headline lands immediately — a very late recovery still records it
+    cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
+    (cd "$REPO" && git add BENCH_LIVE.json 2>>"$LOG" \
+      && git commit -q -m "bench: live TPU headline (tpu_watch)" 2>>"$LOG") \
+      || log "headline commit failed"
     timeout 2400 python scripts/profile_breakdown.py \
       >"$OUT/profile_live.json" 2>>"$LOG"
     log "profile_breakdown rc=$? -> $OUT/profile_live.json"
