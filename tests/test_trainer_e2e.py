@@ -332,3 +332,18 @@ def test_split_per_image_unbatches_everything():
         assert sub["image"].shape == (1, 2, 2, 1)
         np.testing.assert_array_equal(sub["image"][0], batch["image"][i])
         assert sub["meta"] == [{"img_id": i}]
+
+
+def test_eval_batch_size_forced_to_one_for_multi_exemplar(tmp_path, capsys):
+    """num_exemplars > 1 forces eval loaders to bs=1 with an explicit
+    warning (the multi-exemplar meta plumbing is per-image)."""
+    from tmr_tpu.data.synthetic import write_synthetic_fscd147
+
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    write_synthetic_fscd147(root, n_train=2, n_val=2)
+    tr = _make_trainer(root, str(tmp_path / "logs"),
+                       num_exemplars=2, eval_batch_size=4)
+    _, val, test = tr._loaders()
+    assert val.batch_size == 1 and test.batch_size == 1
+    assert "forced to 1" in capsys.readouterr().err
