@@ -103,21 +103,30 @@ def main():
         ("one_windowed_block_folded", 14, "folded"),
         ("one_windowed_block_flash", 14, "flash"),  # no-op fallback off-TPU
     )
-    for label, win, win_impl in cases:
-        os.environ["TMR_WIN_ATTN"] = win_impl
-        blk = Block(num_heads=12, window_size=win, rel_pos_size=(grid, grid),
-                    dtype=jnp.bfloat16)
-        bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
+    # restore the user's knob afterwards (autotune._restore pattern): the
+    # full-program timing in section 1 honoured it, and later sections /
+    # the rest of the process must keep seeing it
+    prev_win = os.environ.get("TMR_WIN_ATTN")
+    try:
+        for label, win, win_impl in cases:
+            os.environ["TMR_WIN_ATTN"] = win_impl
+            blk = Block(num_heads=12, window_size=win,
+                        rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
+            bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
 
-        @jax.jit
-        def blk_step(p, x, fb):
-            y = blk.apply({"params": p}, x + fb.astype(x.dtype))
-            return y, jnp.sum(y).astype(jnp.float32) * 0.0
+            @jax.jit
+            def blk_step(p, x, fb):
+                y = blk.apply({"params": p}, x + fb.astype(x.dtype))
+                return y, jnp.sum(y).astype(jnp.float32) * 0.0
 
-        report[label] = chained(
-            lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
-        )
-    os.environ.pop("TMR_WIN_ATTN", None)
+            report[label] = chained(
+                lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
+            )
+    finally:
+        if prev_win is None:
+            os.environ.pop("TMR_WIN_ATTN", None)
+        else:
+            os.environ["TMR_WIN_ATTN"] = prev_win
 
     # 4. matcher x-corr on the upsampled grid: every formulation at the
     # production capacity (TMR_XCORR_IMPL, read at trace time — ops/xcorr.py)
@@ -129,19 +138,27 @@ def main():
         rng.standard_normal((BATCH, cfg.emb_dim, up_hw, up_hw)), jnp.float32
     )
     ex0 = exemplars[:, 0, :]
-    for cap, impl in ((17, "conv"), (17, "vmap"), (17, "fft"), (127, "auto")):
-        os.environ["TMR_XCORR_IMPL"] = impl
+    prev_xc = os.environ.get("TMR_XCORR_IMPL")
+    try:
+        for cap, impl in (
+            (17, "conv"), (17, "vmap"), (17, "fft"), (127, "auto")
+        ):
+            os.environ["TMR_XCORR_IMPL"] = impl
 
-        @jax.jit
-        def xc_step(f, e, fb):
-            y = match_templates(f + fb, e, capacity=cap)
-            return y, jnp.sum(y) * 0.0
+            @jax.jit
+            def xc_step(f, e, fb):
+                y = match_templates(f + fb, e, capacity=cap)
+                return y, jnp.sum(y) * 0.0
 
-        label = f"xcorr_cap{cap}" + ("" if impl == "auto" else f"_{impl}")
-        report[label] = chained(
-            lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
-        )
-    os.environ.pop("TMR_XCORR_IMPL", None)
+            label = f"xcorr_cap{cap}" + ("" if impl == "auto" else f"_{impl}")
+            report[label] = chained(
+                lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
+            )
+    finally:
+        if prev_xc is None:
+            os.environ.pop("TMR_XCORR_IMPL", None)
+        else:
+            os.environ["TMR_XCORR_IMPL"] = prev_xc
 
     # 5. decode + NMS tail in isolation (objectness/regressions -> boxes),
     # via the Predictor's own _decode/_refine_nms so config flags (box_reg,

@@ -32,11 +32,15 @@ while true; do
     TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
       >"$OUT/bench_live.json" 2>>"$LOG"
     log "bench.py rc=$? -> $OUT/bench_live.json"
-    # the headline lands immediately — a very late recovery still records it
-    cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
-    (cd "$REPO" && git add BENCH_LIVE.json 2>>"$LOG" \
-      && git commit -q -m "bench: live TPU headline (tpu_watch)" 2>>"$LOG") \
-      || log "headline commit failed"
+    # the headline lands immediately — a very late recovery still records
+    # it. Copy only a REAL measurement into the repo (an error record as
+    # BENCH_LIVE.json would read as a headline), and never git-commit from
+    # this background loop: that races a developer's concurrent index use —
+    # the session driver commits results it finds.
+    if grep -q '"value"' "$OUT/bench_live.json" 2>/dev/null \
+        && ! grep -q '"error"' "$OUT/bench_live.json" 2>/dev/null; then
+      cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
+    fi
     timeout 2400 python scripts/profile_breakdown.py \
       >"$OUT/profile_live.json" 2>>"$LOG"
     log "profile_breakdown rc=$? -> $OUT/profile_live.json"
@@ -54,16 +58,12 @@ print(jax.device_get(jax.jit(lambda a: (a @ (a + 2.0)).astype(jnp.float32).sum()
     else
       log "REMOTE_COMPILE=0 probe: failed"
     fi
-    # land the measurements in the repo so they survive the session even if
-    # nobody is around to collect them (the driver commits leftovers, but an
-    # explicit commit records provenance)
-    cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
+    # land the measurements in the repo working tree so they survive the
+    # session even if nobody is around to collect them; committing is the
+    # session driver's job (git from a background loop races the index).
+    # bench_live.json was already copied above, right after it was written.
     cp "$OUT/profile_live.json" "$REPO/PROFILE_LIVE.json" 2>/dev/null
     cp "$OUT/bench_extra_live.json" "$REPO/BENCH_EXTRA_LIVE.json" 2>/dev/null
-    (cd "$REPO" && git add BENCH_LIVE.json PROFILE_LIVE.json \
-        BENCH_EXTRA_LIVE.json 2>>"$LOG" \
-      && git commit -q -m "bench: live TPU measurement battery (tpu_watch)" \
-        2>>"$LOG") || log "git commit of live results failed"
     log "battery done"
     break
   fi

@@ -54,7 +54,27 @@ def test_bench_watchdog_emits_error_line(tmp_path):
         ),
         capture_output=True, text=True, timeout=300,
     )
-    assert out.returncode == 0
+    # non-zero exit so a driver keying on status sees the wedge as a failure
+    assert out.returncode == 2
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["value"] == 0.0
     assert "watchdog" in rec["error"]
+
+
+def test_bench_fast_failure_emits_error_line():
+    # round 3's actual failure mode: a fast exception (jax.devices()
+    # RuntimeError) long before the watchdog — must still yield the one
+    # contractual JSON line, not a raw traceback (BENCH_r03.json regression)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(TMR_BENCH_SELFTEST_FAIL="1"),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 1
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["value"] == 0.0
+    assert "selftest" in rec["error"]
+    for key in ("metric", "value", "unit", "vs_baseline", "error"):
+        assert key in rec, key

@@ -79,8 +79,10 @@ def test_sharded_train_step_matches_single_device(mesh_shape):
     ref_state, ref_losses = jax.jit(step)(state, batch)
     ref_loss = float(ref_losses["loss"])
 
+    # set_mesh, like the Trainer/dryrun: mesh-aware ops (the matcher's
+    # data-axis shard_map island) must also hold the equivalence
     mesh = make_mesh(mesh_shape)
-    with mesh:
+    with jax.sharding.set_mesh(mesh):
         sh_state = state.replace(params=shard_params(state.params, mesh))
         sh_batch = shard_batch(batch, mesh)
         sharded = jax.jit(
@@ -94,6 +96,45 @@ def test_sharded_train_step_matches_single_device(mesh_shape):
     a = np.asarray(ref_state.params["backbone"]["blocks_0"]["attn"]["qkv"]["kernel"])
     b = np.asarray(new_state.params["backbone"]["blocks_0"]["attn"]["qkv"]["kernel"])
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+
+
+def test_xcorr_data_shard_map_engages_and_matches():
+    """Under set_mesh with a divisible batch, the matcher runs as a per-
+    device shard_map island (no group-merge reshape for the partitioner —
+    the MULTICHIP_r03 'involuntary full rematerialization' fix) and must
+    match the global formulation exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tmr_tpu.ops import xcorr
+
+    rng = np.random.default_rng(0)
+    feat = jnp.asarray(rng.standard_normal((4, 8, 24, 24)), jnp.float32)
+    ex = jnp.asarray(np.tile([[0.2, 0.3, 0.55, 0.6]], (4, 1)), jnp.float32)
+    fn = lambda f, e: xcorr.match_templates(f, e, capacity=9)
+    ref = jax.jit(fn)(feat, ex)
+
+    mesh = make_mesh((4, 2))
+    calls = []
+    orig = xcorr._data_shard_map
+
+    def spy(inner, mesh_):
+        calls.append(mesh_)
+        return orig(inner, mesh_)
+
+    xcorr._data_shard_map = spy
+    try:
+        with jax.sharding.set_mesh(mesh):
+            out = jax.jit(fn)(
+                jax.device_put(feat, NamedSharding(mesh, P("data"))),
+                jax.device_put(ex, NamedSharding(mesh, P("data"))),
+            )
+            out = jax.device_get(out)
+    finally:
+        xcorr._data_shard_map = orig
+    assert calls, "shard_map island did not engage under the mesh"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
 
 
 # ------------------------------------------------------------- mapreduce

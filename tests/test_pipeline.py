@@ -174,3 +174,15 @@ def test_pipeline_composes_with_data_parallelism():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
     )
+
+
+def test_pipeline_refuses_seq_mesh_vit():
+    # _stage_blocks rebuilds Blocks without forwarding seq_mesh/batch_axis;
+    # silently dropping a ring/sequence-parallel config is worse than
+    # refusing (advisor r3)
+    mesh = make_mesh((2,), ("pipe",), devices=jax.devices()[:2])
+    vit = SamViT(**TINY, seq_mesh=mesh)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params = SamViT(**TINY).init(jax.random.key(0), x)["params"]
+    with pytest.raises(ValueError, match="seq_mesh"):
+        pipeline_vit_apply(vit, params, x, mesh, microbatches=2)

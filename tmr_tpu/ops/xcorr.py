@@ -156,6 +156,32 @@ def _xcorr_fft(feature: jnp.ndarray, template: jnp.ndarray) -> jnp.ndarray:
     return corr[:, :, ys][:, :, :, xs]
 
 
+def _data_shard_map(fn, mesh):
+    """Wrap the correlation compute in a per-device island over 'data'.
+
+    The matcher is embarrassingly data-parallel — per-IMAGE kernels — but its
+    group-merge reshape (B, C, T, T) -> (B*C, 1, T, T) (and the reversed-
+    kernel transpose conv in the backward pass) folds the batch dim into
+    channels, a transition XLA's spmd partitioner cannot shard efficiently:
+    MULTICHIP_r03 carried two "[SPMD] Involuntary full rematerialization"
+    warnings on exactly these ops. shard_map over 'data' makes each device
+    run the conv on its local images with local shapes — the partitioner
+    never sees the merge, and the model/seq axes simply replicate the tiny
+    per-image kernels. Requires tracing under ``jax.sharding.set_mesh`` (the
+    Trainer and dryrun do; a bare ``with mesh:`` is invisible here) and
+    'data' dividing the batch; otherwise the caller falls back to the global
+    formulation.
+    """
+    P = jax.sharding.PartitionSpec
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )
+
+
 def cross_correlation(
     feature: jnp.ndarray,
     template: jnp.ndarray,
@@ -199,33 +225,48 @@ def cross_correlation(
         impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
     if impl == "auto":  # "auto" as the small-bucket value = the conv default
         impl = "conv"
-    if impl == "fft":
-        out = _xcorr_fft(feature, template)
-    elif impl == "vmap":
-        def one(f, t):  # f: (C, H, W), t: (C, T, T)
-            return lax.conv_general_dilated(
-                f[None],
-                t.reshape(C, 1, T, T),
-                window_strides=(1, 1),
-                padding=[(T // 2, T // 2), (T // 2, T // 2)],
-                feature_group_count=C,
-                dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                precision=lax.Precision.HIGHEST,
-            )[0]
+    def _compute(f, t):
+        # local-shape island: b == B globally, or B/n_data under shard_map
+        b = f.shape[0]
+        if impl == "fft":
+            return _xcorr_fft(f, t)
+        if impl == "vmap":
+            def one(fi, ti):  # fi: (C, H, W), ti: (C, T, T)
+                return lax.conv_general_dilated(
+                    fi[None],
+                    ti.reshape(C, 1, T, T),
+                    window_strides=(1, 1),
+                    padding=[(T // 2, T // 2), (T // 2, T // 2)],
+                    feature_group_count=C,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    precision=lax.Precision.HIGHEST,
+                )[0]
 
-        out = jax.vmap(one)(feature, template)
-    else:
-        lhs = feature.reshape(1, B * C, H, W)
-        rhs = template.reshape(B * C, 1, T, T)
-        out = lax.conv_general_dilated(
+            return jax.vmap(one)(f, t)
+        lhs = f.reshape(1, b * C, H, W)
+        rhs = t.reshape(b * C, 1, T, T)
+        return lax.conv_general_dilated(
             lhs,
             rhs,
             window_strides=(1, 1),
             padding=[(T // 2, T // 2), (T // 2, T // 2)],
-            feature_group_count=B * C,
+            feature_group_count=b * C,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             precision=lax.Precision.HIGHEST,
-        ).reshape(B, C, H, W)
+        ).reshape(b, C, H, W)
+
+    am = jax.sharding.get_abstract_mesh()
+    if (
+        impl != "fft"  # the FFT path has no group-merge; partitions cleanly
+        and am is not None
+        and not am.empty
+        and "data" in am.axis_names
+        and am.shape["data"] > 1
+        and B % am.shape["data"] == 0
+    ):
+        out = _data_shard_map(_compute, am)(feature, template)
+    else:
+        out = _compute(feature, template)
 
     ht = template_hw[:, 0]
     wt = template_hw[:, 1]

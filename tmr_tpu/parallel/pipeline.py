@@ -82,6 +82,15 @@ def _stage_blocks(vit):
     exactly as SamViT.__call__ builds its blocks."""
     from tmr_tpu.models.vit import Block
 
+    if getattr(vit, "seq_mesh", None) is not None:
+        # the rebuilt Blocks below don't forward seq_mesh/batch_axis, so a
+        # ring/sequence-parallel SamViT would silently run dense attention
+        # inside the pipeline island — refuse instead of dropping the config
+        raise ValueError(
+            "pipeline parallelism does not compose with vit.seq_mesh "
+            "(sequence-parallel attention); build the SamViT without "
+            "seq_mesh to pipeline it"
+        )
     _, d = stage_split(vit.depth, vit.global_attn_indexes)
     grid = vit.pretrain_img_size // vit.patch_size
     blocks = []

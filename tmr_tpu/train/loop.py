@@ -117,6 +117,12 @@ class Trainer:
         # device-side loss accumulator: one tiny jitted add per step instead
         # of a host float() sync (which would stall the prefetch pipeline)
         self._acc_fn = jax.jit(lambda s, l: jax.tree.map(jnp.add, s, l))
+        # weighted variant for eval: batches of different sizes (ragged tail
+        # split to B=1 next to full eval_batch_size batches) must contribute
+        # per-image, not per-batch, to the epoch mean
+        self._scale_fn = jax.jit(
+            lambda l, w: jax.tree.map(lambda x: x * w, l)
+        )
 
     # ------------------------------------------------------------ plumbing
     def _loaders(self):
@@ -172,11 +178,20 @@ class Trainer:
             self.state = self.state.replace(
                 params=shard_params(self.state.params, self.mesh)
             )
-            self._train_step = jax.jit(
+            jitted = jax.jit(
                 step,
                 out_shardings=(state_sharding(self.state, self.mesh), None),
                 donate_argnums=0,
             )
+
+            def step_under_mesh(state, batch, _jit=jitted, _mesh=self.mesh):
+                # set_mesh (not a bare `with mesh:`) so mesh-aware ops see it
+                # through get_abstract_mesh at trace time — the matcher's
+                # data-axis shard_map island (ops/xcorr.py) depends on this
+                with jax.sharding.set_mesh(_mesh):
+                    return _jit(state, batch)
+
+            self._train_step = step_under_mesh
         else:
             self._train_step = jax.jit(step, donate_argnums=0)
 
@@ -338,8 +353,14 @@ class Trainer:
                 sub_batches = [full_batch]
             for batch in sub_batches:
                 losses, dets = self._eval_batch(batch)
-                sums = losses if sums is None else self._acc_fn(sums, losses)
-                n += 1
+                # weight each batch's mean losses by its size so the epoch
+                # loss stays a per-image mean under eval_batch_size>1 (a
+                # ragged-tail B=1 image must not weigh as much as a full
+                # batch); still device-side, no host sync per step
+                bsz = int(batch["image"].shape[0])
+                scaled = self._scale_fn(losses, jnp.float32(bsz))
+                sums = scaled if sums is None else self._acc_fn(sums, scaled)
+                n += bsz
                 image_info_collector(
                     cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
                 )
