@@ -103,9 +103,11 @@ def main():
         ("one_windowed_block_folded", 14, "folded"),
         ("one_windowed_block_flash", 14, "flash"),  # no-op fallback off-TPU
     )
-    # restore the user's knob afterwards (autotune._restore pattern): the
+    # restore the user's knob afterwards (autotune's _restore): the
     # full-program timing in section 1 honoured it, and later sections /
     # the rest of the process must keep seeing it
+    from tmr_tpu.utils.autotune import _restore
+
     prev_win = os.environ.get("TMR_WIN_ATTN")
     try:
         for label, win, win_impl in cases:
@@ -123,10 +125,7 @@ def main():
                 lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
             )
     finally:
-        if prev_win is None:
-            os.environ.pop("TMR_WIN_ATTN", None)
-        else:
-            os.environ["TMR_WIN_ATTN"] = prev_win
+        _restore(prev_win, "TMR_WIN_ATTN")
 
     # 4. matcher x-corr on the upsampled grid: every formulation at the
     # production capacity (TMR_XCORR_IMPL, read at trace time — ops/xcorr.py)
@@ -155,10 +154,7 @@ def main():
                 lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
             )
     finally:
-        if prev_xc is None:
-            os.environ.pop("TMR_XCORR_IMPL", None)
-        else:
-            os.environ["TMR_XCORR_IMPL"] = prev_xc
+        _restore(prev_xc, "TMR_XCORR_IMPL")
 
     # 5. decode + NMS tail in isolation (objectness/regressions -> boxes),
     # via the Predictor's own _decode/_refine_nms so config flags (box_reg,

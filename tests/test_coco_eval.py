@@ -248,3 +248,87 @@ def test_cross_check_beyond_max_dets_and_ties():
         got = COCOEvalLite(gts, preds, max_dets=md).run().stats
         want = oracle_cocoeval.evaluate(gts, preds, max_dets=md)
         np.testing.assert_allclose(got, want, atol=1e-9, err_msg=str(md))
+
+
+# ---- adversarial hand-derived cases (VERDICT r3: tie scores, >maxDets) ----
+# pycocotools is unavailable in this image; these expected values are derived
+# BY HAND from the published COCOeval algorithm (cocoeval.py: per-image
+# mergesort + maxDet truncation, global stable mergesort across images in
+# img-id order, greedy matching, right-to-left precision envelope, 101-point
+# searchsorted sampling), giving a derivation independent of both the
+# implementation and the brute-force oracle.
+
+
+def test_tie_scores_resolve_in_image_id_order():
+    """Two dets with IDENTICAL scores in different images: pycocotools
+    concatenates per-image det lists in img-id order and sorts with a STABLE
+    mergesort, so the earlier image's det ranks first.
+
+    FP in img 1, TP in img 2 (1 GT): sequence FP,TP -> pr=[0, 1/2],
+    rc=[0, 1]; envelope [1/2, 1/2]; every recall threshold samples 1/2.
+    Mirrored (TP in img 1): sequence TP,FP -> pr=[1, 1/2], rc=[1, 1];
+    envelope keeps pr[0]=1 and searchsorted hits index 0 for every
+    threshold -> AP50 = 1. The asymmetry pins the stable-order semantics.
+    """
+    gts = {2: [_gt(0, 0, 10, 10)]}
+    preds = {1: [_pred(500, 500, 10, 10, 0.9)], 2: [_pred(0, 0, 10, 10, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    assert np.isclose(ev.stats[1], 0.5, atol=1e-9)
+
+    gts = {1: [_gt(0, 0, 10, 10)]}
+    preds = {1: [_pred(0, 0, 10, 10, 0.9)], 2: [_pred(500, 500, 10, 10, 0.9)]}
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    assert np.isclose(ev.stats[1], 1.0, atol=1e-9)
+
+
+def test_beyond_1100_dets_truncation_cuts_low_scored_tp():
+    """>1100 detections in one image at the reference's maxDets=(900,1000,
+    1100) (log_utils.py:193): per-image truncation keeps the top-1100 by
+    score. A TP scored BELOW 1150 FPs is cut -> AP == 0; the same TP scored
+    ABOVE them ranks first -> envelope pr[0]=1 at rc[0]=1 -> AP == 1."""
+    fps = [
+        _pred(500 + 11 * (i % 97), 500 + 11 * (i // 97), 10, 10,
+              0.9 - i * 1e-6)
+        for i in range(1150)
+    ]
+    gts = {1: [_gt(0, 0, 10, 10)]}
+
+    ev = COCOEvalLite(
+        gts, {1: fps + [_pred(0, 0, 10, 10, 0.1)]},
+    ).run()
+    assert ev.stats[0] == 0.0 and ev.stats[1] == 0.0
+
+    ev = COCOEvalLite(
+        gts, {1: fps + [_pred(0, 0, 10, 10, 0.95)]},
+    ).run()
+    assert np.isclose(ev.stats[1], 1.0, atol=1e-9)
+
+
+def test_multi_image_envelope_hand_derived():
+    """3 images, 6 GTs, global det order TP FP TP TP FP TP (all matches at
+    IoU 1, so every IoU threshold agrees).
+
+    cumTP = 1,1,2,3,3,4; cumFP = 0,1,1,1,2,2
+    rc = 1/6,1/6,2/6,3/6,3/6,4/6; pr = 1, 1/2, 2/3, 3/4, 3/5, 4/6
+    right-to-left envelope: 1, 3/4, 3/4, 3/4, 4/6, 4/6
+    searchsorted over the 101 recall points:
+      thresholds 0.00-0.16 (17) -> idx 0 -> 1
+      thresholds 0.17-0.50 (34) -> idx 2 or 3 -> 3/4
+      thresholds 0.51-0.66 (16) -> idx 5 -> 2/3
+      thresholds 0.67-1.00 (34) -> past the end -> 0
+    AP = (17*1 + 34*0.75 + 16*(2/3)) / 101 = 53.1666../101 = 0.526402..
+    """
+    gts = {
+        1: [_gt(0, 0, 10, 10), _gt(100, 0, 10, 10)],
+        2: [_gt(0, 0, 10, 10), _gt(100, 0, 10, 10)],
+        3: [_gt(0, 0, 10, 10), _gt(100, 0, 10, 10)],
+    }
+    preds = {
+        1: [_pred(0, 0, 10, 10, 0.95), _pred(500, 500, 10, 10, 0.90)],
+        2: [_pred(0, 0, 10, 10, 0.85), _pred(500, 500, 10, 10, 0.75)],
+        3: [_pred(0, 0, 10, 10, 0.80), _pred(100, 0, 10, 10, 0.70)],
+    }
+    ev = COCOEvalLite(gts, preds, max_dets=(10, 20, 30)).run()
+    want = (17 * 1.0 + 34 * 0.75 + 16 * (2.0 / 3.0)) / 101
+    assert np.isclose(ev.stats[1], want, atol=1e-9)  # AP50
+    assert np.isclose(ev.stats[0], want, atol=1e-9)  # identical at all thrs

@@ -229,3 +229,59 @@ def test_remat_blocks_preserve_values_and_grads():
         ),
         g1, g2,
     )
+
+
+def test_vit_matches_reference_real_width_1024():
+    """Production-width golden run (VERDICT r3 #6): 768-dim/12-head blocks —
+    one windowed (window 14 -> the 64-grid pads to 70, the live padding
+    path) and one global — at the REAL 1024 input (64x64 = 4096 tokens,
+    native 127x64 / 27x64 rel-pos tables). Depth is cut to 2 so the torch
+    oracle stays minutes-scale on CPU; widths, head count, window size, and
+    grid are exactly vit_b's (sam_ViT.py vit_b config), so the converter and
+    the rel-pos/window paths are golden-proven at production shapes, not
+    just the 32-dim TINY config above.
+    """
+    import torch
+
+    ref_vit = _load_ref_vit()
+    torch.manual_seed(7)
+    cfg = dict(
+        img_size=1024, patch_size=16, embed_dim=768, depth=2, num_heads=12,
+        global_attn_indexes=(1,), window_size=14, out_chans=256,
+    )
+    ref = ref_vit.ImageEncoderViT(
+        depth=cfg["depth"], embed_dim=cfg["embed_dim"],
+        img_size=cfg["img_size"], mlp_ratio=4,
+        norm_layer=lambda d: torch.nn.LayerNorm(d, eps=1e-6),
+        num_heads=cfg["num_heads"], patch_size=cfg["patch_size"],
+        qkv_bias=True, use_rel_pos=True,
+        global_attn_indexes=cfg["global_attn_indexes"],
+        window_size=cfg["window_size"], out_chans=cfg["out_chans"],
+    )
+    with torch.no_grad():
+        ref.pos_embed.normal_(std=0.02)
+        for blk in ref.blocks:
+            blk.attn.rel_pos_h.normal_(std=0.02)
+            blk.attn.rel_pos_w.normal_(std=0.02)
+    ref.eval()
+
+    mine = SamViT(
+        embed_dim=cfg["embed_dim"], depth=cfg["depth"],
+        num_heads=cfg["num_heads"],
+        global_attn_indexes=cfg["global_attn_indexes"],
+        patch_size=cfg["patch_size"], window_size=cfg["window_size"],
+        out_chans=cfg["out_chans"], pretrain_img_size=cfg["img_size"],
+    )
+    params = convert_sam_vit(
+        {k: v for k, v in ref.state_dict().items()}, prefix=""
+    )
+
+    x = np.random.default_rng(7).standard_normal(
+        (1, 3, 1024, 1024)
+    ).astype(np.float32)
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x)).numpy()  # (1, 256, 64, 64)
+    got = mine.apply({"params": params}, jnp.array(x.transpose(0, 2, 3, 1)))
+    got = np.asarray(got).transpose(0, 3, 1, 2)
+    assert want.shape == got.shape == (1, 256, 64, 64)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -353,10 +353,13 @@ class Trainer:
                 sub_batches = [full_batch]
             for batch in sub_batches:
                 losses, dets = self._eval_batch(batch)
-                # weight each batch's mean losses by its size so the epoch
-                # loss stays a per-image mean under eval_batch_size>1 (a
-                # ragged-tail B=1 image must not weigh as much as a full
-                # batch); still device-side, no host sync per step
+                # weight each batch's losses by its size so a ragged-tail
+                # B=1 image doesn't weigh as much as a full batch. NB this
+                # is batch-size weighting, not exact per-image parity: the
+                # criterion normalizes by the batch's TOTAL positive count
+                # (criterion.py), so batched losses still differ from the
+                # eval_batch_size=1 aggregation — the documented caveat on
+                # --eval_batch_size. Still device-side, no host sync.
                 bsz = int(batch["image"].shape[0])
                 scaled = self._scale_fn(losses, jnp.float32(bsz))
                 sums = scaled if sums is None else self._acc_fn(sums, scaled)
