@@ -103,6 +103,15 @@ def config_parser(argv=None):
     p.add_argument("--mesh_seq", default=1, type=int,
                    help="sequence/context-parallel mesh size: global "
                         "attention blocks run ring attention over this axis")
+    p.add_argument("--mesh_pipe", default=1, type=int,
+                   help="pipeline-parallel stages (GPipe over a 'pipe' "
+                        "axis); must equal the backbone's global-attention "
+                        "block count (4 for vit_b/vit_h). Composes with "
+                        "--mesh_data only; use the same value for --resume/"
+                        "--eval of a pp-trained run (checkpoints store the "
+                        "stage-major layout)")
+    p.add_argument("--pp_microbatches", default=0, type=int,
+                   help="GPipe microbatches (0: one per stage)")
     p.add_argument("--compute_dtype", default="bfloat16", type=str)
     p.add_argument("--max_detections", default=2000, type=int,
                    help="fixed detection-slot capacity of the fused decode/"
@@ -153,7 +162,16 @@ def main(argv=None):
     from tmr_tpu.train.loop import Trainer
 
     mesh = None
-    if args.multi_gpu or args.mesh_model > 1 or args.mesh_seq > 1:
+    if args.mesh_pipe > 1:
+        if args.mesh_model > 1 or args.mesh_seq > 1:
+            raise SystemExit(
+                "--mesh_pipe composes with --mesh_data only (tp/sp inside a "
+                "pipeline mesh is not supported)"
+            )
+        mesh = make_mesh(
+            (args.mesh_data, args.mesh_pipe), axis_names=("data", "pipe")
+        )
+    elif args.multi_gpu or args.mesh_model > 1 or args.mesh_seq > 1:
         if args.mesh_seq > 1:
             mesh = make_mesh((args.mesh_data, args.mesh_model, args.mesh_seq))
         else:

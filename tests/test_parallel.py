@@ -383,3 +383,56 @@ def test_sharded_train_step_with_grad_accumulation():
     assert any(not np.array_equal(np.asarray(a), np.asarray(b))
                for a, b in zip(p1, p2))
     assert np.isfinite(float(l2["loss"]))
+
+
+def test_full_depth_vit_b_compiles_on_mesh():
+    """Depth-12 vit_b at REAL widths (768/12 heads, 4 global blocks) at 512
+    input: the full train step must COMPILE on the dp2 x tp2 x sp2 mesh
+    (VERDICT r3 #8 — depth-dependent sharding/remat issues surface at
+    compile time; execution adds nothing sharding-wise and minutes of CPU).
+    """
+    from tmr_tpu.models.vit import VIT_CONFIGS
+    from tmr_tpu.parallel.sharding import validate_tp
+    from tmr_tpu.train.state import make_train_step
+
+    mesh = make_mesh((2, 2, 2))
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=512, fusion=True,
+        positive_threshold=0.5, negative_threshold=0.5,
+        lr=1e-3, lr_backbone=1e-4, compute_dtype="float32",
+    )
+    vb = VIT_CONFIGS["vit_b"]
+    validate_tp(mesh, vb["embed_dim"], vb["num_heads"])
+    backbone = SamViT(
+        embed_dim=vb["embed_dim"], depth=vb["depth"],
+        num_heads=vb["num_heads"],
+        global_attn_indexes=tuple(vb["global_attn_indexes"]),
+        patch_size=16, window_size=14, out_chans=256,
+        pretrain_img_size=1024, seq_mesh=mesh,
+    )
+    model = MatchingNet(
+        backbone=backbone, emb_dim=512, fusion=True, template_capacity=9
+    )
+    b, s = 2, 512
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.standard_normal((b, s, s, 3)), jnp.float32),
+        "exemplars": jnp.asarray(
+            np.tile([[[0.3, 0.3, 0.45, 0.5]]], (b, 1, 1)), jnp.float32),
+        "gt_boxes": jnp.asarray(
+            np.tile([[[0.3, 0.3, 0.45, 0.5]]], (b, 1, 1)), jnp.float32),
+        "gt_valid": jnp.ones((b, 1), bool),
+    }
+    with jax.sharding.set_mesh(mesh):
+        state = create_train_state(
+            model, cfg, jax.random.key(0), batch["image"],
+            batch["exemplars"], steps_per_epoch=10,
+        )
+        state = state.replace(params=shard_params(state.params, mesh))
+        sb = shard_batch(batch, mesh)
+        step = jax.jit(
+            make_train_step(model, cfg),
+            out_shardings=(state_sharding(state, mesh), None),
+        )
+        compiled = step.lower(state, sb).compile()
+    assert compiled is not None

@@ -186,3 +186,101 @@ def test_pipeline_refuses_seq_mesh_vit():
     params = SamViT(**TINY).init(jax.random.key(0), x)["params"]
     with pytest.raises(ValueError, match="seq_mesh"):
         pipeline_vit_apply(vit, params, x, mesh, microbatches=2)
+
+
+def test_pp_train_step_matches_dense():
+    """The pipeline-parallel train step (stage-sharded params + optimizer
+    moments, GPipe encoder island) must match the dense train step: same
+    loss, same updated params."""
+    from tmr_tpu.config import Config
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.parallel.pipeline import (
+        create_pp_train_state,
+        make_pp_train_step,
+        pp_state_sharding,
+        stack_backbone_params,
+        unstack_backbone_params,
+    )
+    from tmr_tpu.train.state import create_train_state, make_train_step
+
+    cfg = Config(
+        backbone="resnet50", emb_dim=16, fusion=True,
+        positive_threshold=0.5, negative_threshold=0.5,
+        lr=1e-3, lr_backbone=1e-3, compute_dtype="float32",
+    )
+    vit = SamViT(**TINY)
+    model = MatchingNet(backbone=vit, emb_dim=16, fusion=True,
+                        template_capacity=5)
+    rng = np.random.default_rng(0)
+    b = 4
+    batch = {
+        "image": jnp.asarray(rng.standard_normal((b, 32, 32, 3)), jnp.float32),
+        "exemplars": jnp.asarray(
+            np.tile([[[0.3, 0.3, 0.5, 0.55]]], (b, 1, 1)), jnp.float32),
+        "gt_boxes": jnp.asarray(
+            np.tile([[[0.3, 0.3, 0.5, 0.55]]], (b, 1, 1)), jnp.float32),
+        "gt_valid": jnp.ones((b, 1), bool),
+    }
+
+    dense_state = create_train_state(
+        model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
+        steps_per_epoch=10,
+    )
+    dense_new, dense_losses = jax.jit(make_train_step(model, cfg))(
+        dense_state, batch
+    )
+
+    mesh = make_mesh((2, 2), ("data", "pipe"))
+    pp_state = create_pp_train_state(
+        model, cfg, jax.random.key(0), batch["image"], batch["exemplars"],
+        steps_per_epoch=10,
+    )
+    # same init: the stacked tree must be the dense init re-laid-out
+    want = stack_backbone_params(dense_state.params, vit)
+    got_l, want_l = jax.tree.leaves(pp_state.params), jax.tree.leaves(want)
+    for g, w in zip(got_l, want_l):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6)
+
+    with jax.sharding.set_mesh(mesh):
+        sharding = pp_state_sharding(pp_state, mesh)
+        pp_state = jax.device_put(pp_state, sharding)
+        step = jax.jit(
+            make_pp_train_step(model, cfg, mesh, data_axis="data"),
+            out_shardings=(sharding, None),
+        )
+        pp_new, pp_losses = step(pp_state, jax.device_put(
+            batch, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data"))))
+        jax.block_until_ready(pp_new.params)
+
+    assert np.isclose(
+        float(pp_losses["loss"]), float(dense_losses["loss"]), rtol=1e-4
+    )
+    un = unstack_backbone_params(pp_new.params, vit)
+    for path_leaf in (
+        ("backbone", "blocks_0", "attn", "qkv", "kernel"),
+        ("backbone", "blocks_3", "mlp", "lin2", "kernel"),
+        ("input_proj_0", "kernel"),
+    ):
+        a = un
+        d = dense_new.params
+        for k in path_leaf:
+            a, d = a[k], d[k]
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(d), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_pipeline_honors_remat():
+    """--remat_backbone must hold inside the island (same silent-drop class
+    as seq_mesh): remat'd pipelined forward == dense forward."""
+    vit, params, x = _model_and_params(seed=9)
+    rvit = vit.clone(remat=True)
+    want = rvit.apply({"params": params}, x)
+    mesh = make_mesh((2,), axis_names=("pipe",), devices=jax.devices()[:2])
+    got = jax.jit(
+        lambda p, v: pipeline_vit_apply(rvit, p, v, mesh, microbatches=2)
+    )(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )

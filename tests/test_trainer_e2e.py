@@ -347,3 +347,54 @@ def test_eval_batch_size_forced_to_one_for_multi_exemplar(tmp_path, capsys):
     _, val, test = tr._loaders()
     assert val.batch_size == 1 and test.batch_size == 1
     assert "forced to 1" in capsys.readouterr().err
+
+
+def test_pp_trainer_fit_and_eval(tmp_path):
+    """Pipeline-parallel Trainer wiring (--mesh_pipe): fit on a ('data',
+    'pipe') mesh with stage-sharded params + optimizer moments, validate
+    (eval consumes the dense layout via unstack), checkpoint, and test-eval
+    from the restored pp state. Convergence smoke: train loss decreases."""
+    import csv
+
+    import jax
+    from tmr_tpu.parallel.mesh import make_mesh
+    from tmr_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    _write_fixture(root)
+
+    mesh = make_mesh((1, 2), ("data", "pipe"), devices=jax.devices()[:2])
+    cfg = Config(
+        dataset="FSCD147", datapath=root, logpath=logdir,
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        feature_upsample=False, image_size=64,
+        positive_threshold=0.5, negative_threshold=0.5,
+        NMS_cls_threshold=0.3, NMS_iou_threshold=0.5,
+        lr=2e-3, lr_backbone=1e-3, max_epochs=2, AP_term=1,
+        batch_size=2, num_workers=0, max_gt_boxes=8,
+        compute_dtype="float32", max_detections=64,
+        template_buckets=(9,), mesh_pipe=2,
+    )
+    trainer = Trainer(cfg, mesh=mesh)
+    # 4 blocks -> 2 homogeneous stages (1 windowed + 1 global each)
+    tiny = MatchingNet(
+        backbone=SamViT(**dict(TINY_VIT, depth=4, global_attn_indexes=(1, 3))),
+        emb_dim=cfg.emb_dim, fusion=True, template_capacity=9,
+    )
+    trainer.model = tiny
+    trainer.predictor = Predictor(cfg, model=tiny)
+    trainer.fit()
+
+    # stage-major layout actually trained and was checkpointed
+    assert "stages" in trainer.state.params["backbone"]
+    rows = list(
+        csv.DictReader(open(os.path.join(logdir, "metrics.csv")))
+    )
+    losses = [float(r["train/loss"]) for r in rows]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(float(rows[-1]["val/MAE"]))
+
+    metrics = trainer.test()
+    assert np.isfinite(metrics["test/MAE"])

@@ -114,6 +114,11 @@ class Config:
     remat_backbone: bool = False
     # mesh axes: (data, model). Products must equal device count.
     mesh_shape: Tuple[int, int] = (1, 1)
+    # pipeline parallelism (--mesh_pipe): GPipe stages over a 'pipe' axis.
+    # Must equal the backbone's stage count (= #global-attention blocks:
+    # 4 for vit_b/vit_h). pp_microbatches 0 -> one per stage.
+    mesh_pipe: int = 1
+    pp_microbatches: int = 0
     max_gt_boxes: int = 800  # padding capacity for GT boxes per image
 
     @property

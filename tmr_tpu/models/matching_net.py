@@ -72,10 +72,25 @@ class MatchingNet(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, image: jnp.ndarray, exemplars: jnp.ndarray) -> dict:
+    def __call__(
+        self,
+        image: jnp.ndarray,
+        exemplars: jnp.ndarray,
+        features: jnp.ndarray = None,
+    ) -> dict:
         """image: (B, S, S, 3) NHWC; exemplars: (B, K, 4) normalized xyxy
-        (the matcher uses exemplar 0, like template_matching.py:85)."""
-        f = self.backbone(image)
+        (the matcher uses exemplar 0, like template_matching.py:85).
+
+        ``features``: optional precomputed backbone output (B, h, w, C) —
+        the encoder stage is skipped and the detector head runs on it. Used
+        by the pipeline-parallel train step (the encoder runs as a GPipe
+        island outside this module, parallel/pipeline.py) and mirrors the
+        reference's precomputed-feature MapReduce flow (mapper.py saves
+        encoder features; extract_feature.py reloads them)."""
+        if features is not None:
+            f = features
+        else:
+            f = self.backbone(image)
         feats: Sequence[jnp.ndarray] = f if isinstance(f, (list, tuple)) else [f]
         # pre-upsample encoder output: what the reference's separate
         # ``temp_sam(image)`` pass recomputes for the box refiner

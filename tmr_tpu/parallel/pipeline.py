@@ -25,10 +25,12 @@ variant can shard it later).
 
 Scope note: this pipelines the ENCODER FORWARD/BACKWARD (the FLOPs/memory
 dominant part — the detector head is a few convs). It composes under jit
-with data parallelism on the batch dim outside the island. Full
-pp-optimizer integration (sharding optimizer state by stage) is not wired
-into the Trainer; ``__graft_entry__.dryrun_multichip`` demonstrates the
-compiled pp path.
+with data parallelism on the batch dim outside the island. Trainer wiring
+(``--mesh_pipe``): ``create_pp_train_state``/``make_pp_train_step`` hold
+params and AdamW moments in the stage-major layout sharded over 'pipe'
+(``pp_state_sharding``), the detector head runs densely on the island's
+output, and eval/checkpoint interop converts layouts via
+``unstack_backbone_params``. TP/SP inside a pipe mesh is not composed.
 """
 
 from __future__ import annotations
@@ -93,10 +95,16 @@ def _stage_blocks(vit):
         )
     _, d = stage_split(vit.depth, vit.global_attn_indexes)
     grid = vit.pretrain_img_size // vit.patch_size
+    # honour --remat_backbone inside the island too (same silent-drop class
+    # as the seq_mesh refusal above): the pipeline is chosen exactly for
+    # big-model training, where dropping remat means depth x activation mem
+    from flax import linen as nn
+
+    block_cls = nn.remat(Block) if getattr(vit, "remat", False) else Block
     blocks = []
     for j in range(d):
         blocks.append(
-            Block(
+            block_cls(
                 num_heads=vit.num_heads,
                 mlp_ratio=vit.mlp_ratio,
                 window_size=0 if j == d - 1 else vit.window_size,
@@ -226,6 +234,113 @@ def pipeline_vit_apply(
         data_axis=data_axis,
     )
     return vit.apply({"params": params}, x, method="neck")
+
+
+# --------------------------------------------------------- Trainer wiring
+def stack_backbone_params(params: dict, vit) -> dict:
+    """MatchingNet param tree -> pipeline layout: the backbone's flat
+    'blocks_i' subtrees become one stage-major 'stages' tree (leading stage
+    axis, shardable over 'pipe'); embed/neck/head params are untouched."""
+    bb = dict(params["backbone"])
+    stacked = stack_stage_params(bb, vit.depth, vit.global_attn_indexes)
+    out = {k: v for k, v in bb.items() if not k.startswith("blocks_")}
+    out["stages"] = stacked
+    return {**params, "backbone": out}
+
+
+def unstack_backbone_params(params: dict, vit) -> dict:
+    """Inverse of stack_backbone_params: pipeline layout -> the dense flat
+    'blocks_i' layout every non-pipelined consumer (Predictor, converter,
+    export) expects. Used when a pp-trained state feeds eval/checkpoint
+    interop."""
+    if "stages" not in params.get("backbone", {}):
+        return params
+    n, d = stage_split(vit.depth, vit.global_attn_indexes)
+    bb = {k: v for k, v in params["backbone"].items() if k != "stages"}
+    stages = params["backbone"]["stages"]
+    for s in range(n):
+        for j in range(d):
+            bb[f"blocks_{s * d + j}"] = jax.tree.map(
+                lambda a, _s=s: a[_s], stages[f"b{j}"]
+            )
+    return {**params, "backbone": bb}
+
+
+def pp_state_sharding(state, mesh, axis: str = "pipe"):
+    """Sharding tree for a pipeline-layout TrainState: every leaf under a
+    'stages' subtree shards its leading (stage) axis over ``axis`` — params
+    AND their AdamW moments, which mirror the param dict nesting — and
+    everything else replicates. Megatron-style TP inside a pp mesh is not
+    composed here (v1): the pp mesh carries ('data', 'pipe') only."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def assign(path, leaf):
+        names = tuple(
+            k.key for k in path if isinstance(k, jax.tree_util.DictKey)
+        )
+        if "stages" in names and getattr(leaf, "ndim", 0) >= 1:
+            return NamedSharding(
+                mesh, PartitionSpec(axis, *([None] * (leaf.ndim - 1)))
+            )
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree_util.tree_map_with_path(assign, state)
+
+
+def create_pp_train_state(
+    model, cfg, rng, sample_image, sample_exemplars, steps_per_epoch: int = 1000
+):
+    """create_train_state in the pipeline layout: init the dense model, stack
+    the backbone blocks stage-major, then build the optimizer ON the stacked
+    tree — AdamW moments come out stage-major too, so one sharding rule
+    (pp_state_sharding) places params and optimizer state consistently."""
+    from tmr_tpu.train.state import TrainState, make_optimizer
+
+    params = jax.jit(model.init)(rng, sample_image, sample_exemplars)["params"]
+    params = stack_backbone_params(params, model.backbone)
+    tx = make_optimizer(cfg, steps_per_epoch)
+    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def make_pp_train_step(
+    model, cfg, mesh, microbatches: int = 0, data_axis: str = None
+):
+    """Pipeline-parallel train step: the encoder forward/backward runs as the
+    GPipe island over 'pipe' (optionally x data parallel over 'data'), the
+    detector head + loss + optimizer share make_train_step's logic via its
+    forward_fn hook. Expects a state from create_pp_train_state.
+
+    microbatches 0 -> auto: the most microbatches <= the stage count that
+    still divide the batch (and keep each microbatch divisible by the 'data'
+    axis) — the standard GPipe bubble/memory point, degrading gracefully for
+    small batches instead of failing the divisibility checks.
+    """
+    from tmr_tpu.train.state import make_train_step
+
+    n_stage, _ = stage_split(
+        model.backbone.depth, model.backbone.global_attn_indexes
+    )
+    nd = mesh.shape.get(data_axis, 1) if data_axis is not None else 1
+
+    def pick_microbatches(b: int) -> int:
+        if microbatches > 0:
+            return microbatches
+        for m in range(min(n_stage, b), 0, -1):
+            if b % m == 0 and (b // m) % nd == 0:
+                return m
+        return 1
+
+    def forward(params, image, exemplars):
+        feat = pipeline_vit_apply(
+            model.backbone, params["backbone"], image, mesh,
+            microbatches=pick_microbatches(int(image.shape[0])),
+            data_axis=data_axis,
+        )
+        return model.apply(
+            {"params": params}, image, exemplars, features=feat
+        )
+
+    return make_train_step(model, cfg, forward_fn=forward)
 
 
 def stage_sharding(stacked: dict, mesh, axis: str = "pipe"):

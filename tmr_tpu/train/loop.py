@@ -161,6 +161,8 @@ class Trainer:
         return train, val, test
 
     def _init_state(self, sample_batch, steps_per_epoch: int):
+        if self.mesh is not None and "pipe" in self.mesh.shape:
+            return self._init_state_pp(sample_batch, steps_per_epoch)
         self.state = create_train_state(
             self.model, self.cfg, jax.random.key(self.cfg.seed),
             jnp.asarray(sample_batch["image"]),
@@ -178,22 +180,61 @@ class Trainer:
             self.state = self.state.replace(
                 params=shard_params(self.state.params, self.mesh)
             )
-            jitted = jax.jit(
-                step,
-                out_shardings=(state_sharding(self.state, self.mesh), None),
-                donate_argnums=0,
+            self._train_step = self._jit_step_under_mesh(
+                step, state_sharding(self.state, self.mesh)
             )
-
-            def step_under_mesh(state, batch, _jit=jitted, _mesh=self.mesh):
-                # set_mesh (not a bare `with mesh:`) so mesh-aware ops see it
-                # through get_abstract_mesh at trace time — the matcher's
-                # data-axis shard_map island (ops/xcorr.py) depends on this
-                with jax.sharding.set_mesh(_mesh):
-                    return _jit(state, batch)
-
-            self._train_step = step_under_mesh
         else:
             self._train_step = jax.jit(step, donate_argnums=0)
+
+    def _jit_step_under_mesh(self, step, sharding):
+        """jit with sharded output state + tracing under set_mesh — NOT a
+        bare ``with mesh:``, which mesh-aware ops can't see: the matcher's
+        data-axis shard_map island (ops/xcorr.py) discovers the mesh through
+        get_abstract_mesh at trace time."""
+        jitted = jax.jit(step, out_shardings=(sharding, None),
+                         donate_argnums=0)
+
+        def step_under_mesh(state, batch, _jit=jitted, _mesh=self.mesh):
+            with jax.sharding.set_mesh(_mesh):
+                return _jit(state, batch)
+
+        return step_under_mesh
+
+    def _init_state_pp(self, sample_batch, steps_per_epoch: int):
+        """Pipeline-parallel training (--mesh_pipe): stage-sharded params AND
+        optimizer moments over 'pipe', GPipe encoder island in the step (the
+        reference has nothing comparable — its only training parallelism is
+        DDP). Eval/checkpoint interop converts to the dense layout via
+        unstack_backbone_params (see eval_epoch)."""
+        from tmr_tpu.parallel.pipeline import (
+            create_pp_train_state,
+            make_pp_train_step,
+            pp_state_sharding,
+        )
+
+        self.state = create_pp_train_state(
+            self.model, self.cfg, jax.random.key(self.cfg.seed),
+            jnp.asarray(sample_batch["image"]),
+            jnp.asarray(sample_batch["exemplars"]),
+            steps_per_epoch=steps_per_epoch,
+        )
+        sharding = pp_state_sharding(self.state, self.mesh)
+        self.state = jax.device_put(self.state, sharding)
+        data_axis = "data" if self.mesh.shape.get("data", 1) > 1 else None
+        step = make_pp_train_step(
+            self.model, self.cfg, self.mesh,
+            microbatches=self.cfg.pp_microbatches, data_axis=data_axis,
+        )
+        self._train_step = self._jit_step_under_mesh(step, sharding)
+
+    def _eval_params(self, params):
+        """Params as the dense layout every eval consumer expects — a no-op
+        unless training runs pipeline-parallel (stacked 'stages' layout)."""
+        if self.mesh is not None and "pipe" in self.mesh.shape:
+            from tmr_tpu.parallel.pipeline import unstack_backbone_params
+
+            return unstack_backbone_params(params, self.model.backbone)
+        return params
 
     def _to_device(self, batch: dict) -> dict:
         arrays = {k: v for k, v in batch.items() if k != "meta"}
@@ -342,7 +383,7 @@ class Trainer:
 
     def eval_epoch(self, loader, stage: str, params) -> Dict[str, float]:
         cfg = self.cfg
-        self.predictor.params = params
+        self.predictor.params = self._eval_params(params)
         sums = None  # device-scalar pytree, fetched once per epoch
         n = 0
         for full_batch in loader:

@@ -142,16 +142,23 @@ def compute_losses(
     )
 
 
-def make_train_step(model, cfg) -> Callable:
+def make_train_step(model, cfg, forward_fn: Callable = None) -> Callable:
     """Build the jittable train step. Static config is closed over; the
     returned fn is (state, batch) -> (state, metrics) and is safe to wrap in
-    jax.jit with sharded inputs."""
+    jax.jit with sharded inputs.
+
+    ``forward_fn(params, image, exemplars) -> model_out`` overrides the
+    default ``model.apply`` forward — the pipeline-parallel step
+    (parallel/pipeline.make_pp_train_step) routes the encoder through its
+    GPipe island this way while sharing all the loss/containment logic."""
+
+    if forward_fn is None:
+        def forward_fn(params, image, exemplars):
+            return model.apply({"params": params}, image, exemplars)
 
     def train_step(state: TrainState, batch: dict):
         def loss_fn(params):
-            out = model.apply(
-                {"params": params}, batch["image"], batch["exemplars"]
-            )
+            out = forward_fn(params, batch["image"], batch["exemplars"])
             losses = compute_losses(
                 out,
                 batch,
