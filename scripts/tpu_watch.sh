@@ -29,7 +29,8 @@ while true; do
   if probe; then
     log "TPU ALIVE — running measurement battery"
     cd "$REPO"
-    TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
+    TMR_BENCH_CKPT= TMR_AUTOTUNE_EXPORT="$OUT/autotune.env" \
+      TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
       >"$OUT/bench_live.json" 2>>"$LOG"
     log "bench.py rc=$? -> $OUT/bench_live.json"
     # the headline lands immediately — a very late recovery still records
@@ -44,6 +45,25 @@ while true; do
     timeout 2400 python scripts/profile_breakdown.py \
       >"$OUT/profile_live.json" 2>>"$LOG"
     log "profile_breakdown rc=$? -> $OUT/profile_live.json"
+    # trained-weights headline: quickstart-train the bench model, then
+    # re-bench with the restored ckpt (bench.py auto-detects bench_ckpt/)
+    if timeout 1800 python scripts/make_bench_ckpt.py --epochs 2 \
+        --out "$OUT/bench_ckpt" >>"$LOG" 2>&1; then
+      # reuse the headline run's autotune winners (same shapes) instead of
+      # re-sweeping over the wedge-prone tunnel
+      [ -f "$OUT/autotune.env" ] && { set -a; . "$OUT/autotune.env"; set +a; }
+      TMR_BENCH_CKPT="$OUT/bench_ckpt/params" \
+        TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
+        >"$OUT/bench_ckpt_live.json" 2>>"$LOG"
+      log "bench.py (ckpt) rc=$? -> $OUT/bench_ckpt_live.json"
+      if grep -q '"value"' "$OUT/bench_ckpt_live.json" 2>/dev/null \
+          && ! grep -q '"error"' "$OUT/bench_ckpt_live.json" 2>/dev/null; then
+        cp "$OUT/bench_ckpt_live.json" "$REPO/BENCH_CKPT_LIVE.json" \
+          2>/dev/null
+      fi
+    else
+      log "make_bench_ckpt failed (trained-weights bench skipped)"
+    fi
     timeout 3600 python scripts/bench_extra.py \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra rc=$? -> $OUT/bench_extra_live.json"

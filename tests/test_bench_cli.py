@@ -78,3 +78,28 @@ def test_bench_fast_failure_emits_error_line():
     assert "selftest" in rec["error"]
     for key in ("metric", "value", "unit", "vs_baseline", "error"):
         assert key in rec, key
+
+
+def test_bench_restores_checkpoint(tmp_path):
+    # plumbing mode: --epochs 0 saves init params in the exact bench model
+    # layout; bench must restore them and say so in the metric line
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "make_bench_ckpt.py"),
+         "--epochs", "0", "--image_size", "64", "--compute_dtype", "float32",
+         "--out", str(tmp_path / "bench_ckpt")],
+        env=_bench_env(), capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    ckpt = str(tmp_path / "bench_ckpt" / "params")
+    assert os.path.isdir(ckpt)
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(TMR_BENCH_CKPT=ckpt),
+        capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "restored ckpt" in rec["metric"]
+    assert rec["value"] > 0
+    assert "params restored" in out.stderr
