@@ -56,10 +56,10 @@ CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 20))
 _WEIGHTS = "random weights"  # flipped by the ckpt-restore branch in _run
 
 
-def _metric(weights: str = None) -> str:
+def _metric() -> str:
     return (
         f"FSCD-147 eval images/sec/chip (ViT-B {IMAGE_SIZE}, fused "
-        f"match+decode+NMS, {weights or _WEIGHTS})"
+        f"match+decode+NMS, {_WEIGHTS})"
     )
 # Overall watchdog. The TPU here sits behind a tunneled transport that has
 # twice been observed to wedge mid-session (remote compiles hang forever, no

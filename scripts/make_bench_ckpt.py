@@ -2,8 +2,10 @@
 
 Trains the bench's exact model (SAM ViT-B backbone, 512-d matcher, fusion —
 bench.py's preset) on the synthetic quickstart fixture (data/synthetic.py)
-and saves a PARAMS-ONLY orbax checkpoint that bench.py auto-restores (env
-``TMR_BENCH_CKPT``, or the default ``<repo>/bench_ckpt/params``). This
+and saves a PARAMS-ONLY orbax checkpoint; point bench.py at it explicitly
+via ``TMR_BENCH_CKPT=<out>/params`` (there is deliberately NO default-path
+auto-detect — the random-weights headline must stay a random-weights
+measurement). This
 closes the "random weights" asterisk on the bench metric: the measured
 program then runs checkpoint-restored, post-training activations.
 

@@ -29,6 +29,7 @@ while true; do
   if probe; then
     log "TPU ALIVE — running measurement battery"
     cd "$REPO"
+    rm -f "$OUT/autotune.env"  # never reuse winners from an older session
     TMR_BENCH_CKPT= TMR_AUTOTUNE_EXPORT="$OUT/autotune.env" \
       TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
       >"$OUT/bench_live.json" 2>>"$LOG"
@@ -46,13 +47,15 @@ while true; do
       >"$OUT/profile_live.json" 2>>"$LOG"
     log "profile_breakdown rc=$? -> $OUT/profile_live.json"
     # trained-weights headline: quickstart-train the bench model, then
-    # re-bench with the restored ckpt (bench.py auto-detects bench_ckpt/)
+    # re-bench with TMR_BENCH_CKPT pointing at it (restore is explicit-only)
     if timeout 1800 python scripts/make_bench_ckpt.py --epochs 2 \
         --out "$OUT/bench_ckpt" >>"$LOG" 2>&1; then
       # reuse the headline run's autotune winners (same shapes) instead of
-      # re-sweeping over the wedge-prone tunnel
-      [ -f "$OUT/autotune.env" ] && { set -a; . "$OUT/autotune.env"; set +a; }
-      TMR_BENCH_CKPT="$OUT/bench_ckpt/params" \
+      # re-sweeping over the wedge-prone tunnel — scoped to THIS command
+      # only via `env`, so bench_extra below still measures defaults
+      tuned=""
+      [ -f "$OUT/autotune.env" ] && tuned="$(grep -v '^#' "$OUT/autotune.env")"
+      env $tuned TMR_BENCH_CKPT="$OUT/bench_ckpt/params" \
         TMR_BENCH_ALARM=3000 timeout 3300 python bench.py \
         >"$OUT/bench_ckpt_live.json" 2>>"$LOG"
       log "bench.py (ckpt) rc=$? -> $OUT/bench_ckpt_live.json"
