@@ -35,7 +35,6 @@ from __future__ import annotations
 import json
 import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
@@ -61,13 +60,13 @@ def _metric() -> str:
         f"FSCD-147 eval images/sec/chip (ViT-B {IMAGE_SIZE}, fused "
         f"match+decode+NMS, {_WEIGHTS})"
     )
-# Overall watchdog. The TPU here sits behind a tunneled transport that has
-# twice been observed to wedge mid-session (remote compiles hang forever, no
-# error). If the whole run exceeds this budget, emit an explicit JSON error
-# line instead of hanging silently past the driver's patience. A daemon
-# timer thread (not SIGALRM) so it fires even while the main thread is
-# blocked inside a native PJRT/gRPC call — exactly the documented wedge.
-ALARM_S = int(os.environ.get("TMR_BENCH_ALARM", 3300))
+# The overall watchdog + error funnel live in the SHARED guard
+# (tmr_tpu/utils/bench_guard.py, also used by scripts/bench_extra.py):
+# a daemon timer bounds tunnel wedges (TMR_BENCH_ALARM, rc 2), and every
+# exception funnels to the one contractual JSON error line (rc 1) — round
+# 3's record (BENCH_r03.json) was a raw traceback because a fast
+# jax.devices() RuntimeError escaped main while only the hang path was
+# guarded.
 
 _T0 = time.time()
 
@@ -77,12 +76,7 @@ def _progress(msg: str) -> None:
 
 
 def _emit_error(msg: str) -> None:
-    """The contract with the driver: ONE JSON line on stdout, no matter what.
-
-    Round 3's record (BENCH_r03.json) was a raw traceback because a fast
-    ``jax.devices()`` RuntimeError escaped ``main`` — only the hang path was
-    guarded. Every failure mode now funnels here.
-    """
+    """The contract with the driver: ONE JSON line on stdout, no matter what."""
     print(
         json.dumps(
             {
@@ -95,25 +89,6 @@ def _emit_error(msg: str) -> None:
         ),
         flush=True,
     )
-
-
-def _watchdog_fire() -> None:
-    _emit_error(
-        f"watchdog: no result after {ALARM_S}s "
-        "(tunneled TPU backend likely wedged; see PERF.md)"
-    )
-    # non-zero so drivers keying on exit status see the wedge as a failure;
-    # consumers parsing the JSON still get the error field either way
-    os._exit(2)
-
-
-def _arm_watchdog():
-    if ALARM_S <= 0:
-        return None
-    t = threading.Timer(ALARM_S, _watchdog_fire)
-    t.daemon = True
-    t.start()
-    return t
 
 
 def forward_tflops_per_image(
@@ -200,7 +175,7 @@ def _wait_for_backend() -> str | None:
     return last
 
 
-def _run(watchdog) -> None:
+def _run(cancel_watchdog) -> None:
     if os.environ.get("TMR_BENCH_SELFTEST_FAIL"):
         raise RuntimeError("selftest: forced fast failure")
     err = _wait_for_backend()
@@ -309,8 +284,7 @@ def _run(watchdog) -> None:
         _ = jax.device_get(fb)
         dt = time.perf_counter() - t0
 
-    if watchdog is not None:
-        watchdog.cancel()
+    cancel_watchdog()  # before the success print: no success-then-watchdog
     per_batch = max((dt - rtt) / CHAIN, 1e-9)
     img_per_sec = BATCH / per_batch
     tflops = forward_tflops_per_image(IMAGE_SIZE)
@@ -334,17 +308,9 @@ def _run(watchdog) -> None:
 
 
 def main() -> int:
-    watchdog = _arm_watchdog()
-    try:
-        _run(watchdog)
-        return 0
-    except BaseException as e:  # noqa: BLE001 — the JSON line IS the contract
-        if watchdog is not None:
-            watchdog.cancel()
-        if isinstance(e, KeyboardInterrupt):
-            raise
-        _emit_error(f"{type(e).__name__}: {e}")
-        return 1
+    from tmr_tpu.utils.bench_guard import run_guarded
+
+    return run_guarded(_run, _emit_error)
 
 
 if __name__ == "__main__":

@@ -293,7 +293,7 @@ ALL = {
 }
 
 
-def main(argv=None) -> int:
+def _run(cancel_watchdog, argv=None) -> int:
     from tmr_tpu.utils.cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -314,8 +314,23 @@ def main(argv=None) -> int:
         results[name]["wall_s"] = round(time.perf_counter() - t0, 1)
         print(f"[bench_extra] {name}: {results[name]}", file=sys.stderr,
               flush=True)
+    cancel_watchdog()  # before the success print: no success-then-watchdog
     print(json.dumps(results))
     return 0
+
+
+def main(argv=None) -> int:
+    """Per-config failures are recorded inline by _run; the SHARED guard
+    (tmr_tpu/utils/bench_guard.py, same one bench.py runs under) covers
+    everything OUTSIDE those try blocks — backend init (round 3's bench.py
+    died exactly there), argparse, cache setup — plus the tunnel-wedge
+    watchdog: the output is ALWAYS one JSON line."""
+    from tmr_tpu.utils.bench_guard import run_guarded
+
+    return run_guarded(
+        lambda cancel: _run(cancel, argv),
+        lambda msg: print(json.dumps({"error": msg}), flush=True),
+    )
 
 
 if __name__ == "__main__":

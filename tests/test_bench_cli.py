@@ -103,3 +103,50 @@ def test_bench_restores_checkpoint(tmp_path):
     assert "restored ckpt" in rec["metric"]
     assert rec["value"] > 0
     assert "params restored" in out.stderr
+
+
+def test_bench_extra_emits_json_on_failure_and_success(tmp_path):
+    """bench_extra.py shares bench.py's contract: ONE JSON line no matter
+    what (round 3 died at unguarded backend init; per-config errors were
+    already inline but everything outside them wasn't)."""
+    script = os.path.join(REPO, "scripts", "bench_extra.py")
+    # success path at tiny shapes, single cheapest config
+    out = subprocess.run(
+        [sys.executable, script, "--only", "demo"],
+        env=_bench_env(TMR_BENCH_TINY="1"),
+        capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "demo" in rec and "device" in rec
+
+    # an unknown --only name is caught by the per-config guard: still one
+    # JSON line, error recorded inline, rc 0
+    out = subprocess.run(
+        [sys.executable, script, "--only", "nonsense"],
+        env=_bench_env(), capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" in rec["nonsense"]
+
+    # fast-fail OUTSIDE the per-config guards (round 3's bench.py death
+    # mode): backend init fails -> one error-JSON line, rc 1
+    out = subprocess.run(
+        [sys.executable, script, "--only", "demo"],
+        env={**_bench_env(), "JAX_PLATFORMS": "bogus"},
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 1
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" in rec
+
+    # watchdog path
+    out = subprocess.run(
+        [sys.executable, script, "--only", "demo"],
+        env=_bench_env(TMR_BENCH_TINY="1", TMR_BENCH_ALARM="1"),
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 2
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "watchdog" in rec["error"]
