@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# The paper recipe under PIPELINE parallelism (beyond the reference, which
+# has only DDP): the ViT's blocks run as 4 GPipe stages over a 'pipe' mesh
+# axis — params AND AdamW moments stage-sharded — composed with data
+# parallelism over the remaining chips (--mesh_data -1 fills them). 4
+# stages because vit_b/vit_h both carry 4 global-attention blocks (one per
+# stage). Use the same --mesh_pipe for --resume/--eval of this run:
+# checkpoints store the stage-major layout.
+python main.py \
+  --project_name "Few-Shot Pattern Detection" \
+  --datapath /data/fscd-147 \
+  --logpath ./outputs/FSCD147_pp \
+  --modeltype matching_net \
+  --template_type roi_align \
+  --dataset FSCD147 \
+  --num_workers 4 \
+  --max_epochs 200 \
+  --batch_size 4 \
+  --num_exemplars 1 \
+  --backbone sam \
+  --encoder original \
+  --emb_dim 512 \
+  --decoder_num_layer 1 \
+  --decoder_kernel_size 3 \
+  --feature_upsample \
+  --positive_threshold 0.5 \
+  --negative_threshold 0.5 \
+  --NMS_cls_threshold 0.1 \
+  --NMS_iou_threshold 0.5 \
+  --fusion \
+  --lr 1e-4 \
+  --lr_backbone 0 \
+  --lr_drop \
+  --nowandb \
+  --device tpu \
+  --mesh_data -1 \
+  --mesh_pipe 4 \
+  "$@"
