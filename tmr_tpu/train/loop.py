@@ -429,13 +429,34 @@ class Trainer:
             cap = self.predictor.pick_capacity(
                 batch["exemplars"], int(batch["image"].shape[1])
             )
-            losses, dets = self._get_eval_step(cap)(
-                params, self.predictor.refiner_params,
-                jnp.asarray(batch["image"]),
-                jnp.asarray(batch["exemplars"]),
-                jnp.asarray(batch["gt_boxes"]),
-                jnp.asarray(batch["gt_valid"]),
-            )
+            fn = self._get_eval_step(cap)
+            keys = ("image", "exemplars", "gt_boxes", "gt_valid")
+            mesh = self.mesh
+            if (
+                mesh is not None
+                and mesh.shape.get("data", 1) > 1
+                and batch["image"].shape[0] % mesh.shape["data"] == 0
+            ):
+                # data-sharded eval: with --eval_batch_size a multiple of
+                # the 'data' axis, the fused eval program runs each image
+                # shard on its own devices (the reference's DDP eval
+                # spreads ranks the same way; per-image JSON collection
+                # and the rank-0 merge are already shard-order agnostic).
+                # shard_batch device_puts host arrays straight to their
+                # sharding — one transfer, same helper _to_device uses.
+                from tmr_tpu.parallel.sharding import shard_batch
+
+                sharded = shard_batch({k: batch[k] for k in keys}, mesh)
+                with jax.sharding.set_mesh(mesh):
+                    losses, dets = fn(
+                        params, self.predictor.refiner_params,
+                        *(sharded[k] for k in keys),
+                    )
+            else:
+                losses, dets = fn(
+                    params, self.predictor.refiner_params,
+                    *(jnp.asarray(batch[k]) for k in keys),
+                )
         return losses, dets
 
     def _finish_eval(self, stage: str, sums, n: int) -> Dict[str, float]:
