@@ -182,10 +182,14 @@ def main(argv=None):
         from tmr_tpu.utils.profiling import log_info
 
         # tune at the PER-DEVICE shape the run will actually compile: the
-        # eval batch under --eval (mirrors the loop's num_exemplars forcing),
+        # eval batch under --eval (mirrors the loop's num_exemplars forcing
+        # AND its data-sharded eval split when the 'data' axis divides it),
         # else the per-device train batch after data-parallel sharding
         if cfg.eval:
             tune_batch = cfg.eval_batch_size if cfg.num_exemplars == 1 else 1
+            dp = mesh.shape.get("data", 1) if mesh is not None else 1
+            if dp > 1 and tune_batch % dp == 0:
+                tune_batch //= dp
         else:
             dp = mesh.shape.get("data", 1) if mesh is not None else 1
             tune_batch = max(cfg.batch_size // max(dp, 1), 1)

@@ -14,10 +14,15 @@ KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN")
 
 
 @pytest.fixture
-def clean_knobs(monkeypatch):
-    """No knobs set on entry; anything autotune exports is popped on exit."""
+def clean_knobs(monkeypatch, tmp_path):
+    """No knobs set on entry; anything autotune exports is popped on exit.
+    The persistent winner cache is redirected to a per-test file so tests
+    never read/pollute ~/.cache/tmr_tpu/autotune.json (a prior test's
+    winners would otherwise short-circuit later measurements)."""
     for k in KNOBS:
         monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TMR_AUTOTUNE_CACHE", str(tmp_path / "autotune.json"))
+    monkeypatch.delenv("TMR_AUTOTUNE_FORCE", raising=False)
     yield
     for k in KNOBS:
         os.environ.pop(k, None)
@@ -108,3 +113,71 @@ def test_microbenchmarks_run_and_time_all_variants(clean_knobs):
     assert all(v > 0 for v in tw.values())
     assert "TMR_XCORR_IMPL" not in os.environ  # knobs restored
     assert "TMR_WIN_ATTN" not in os.environ
+
+
+def test_autotune_cache_persists_winners_across_processes(
+    clean_knobs, monkeypatch
+):
+    """Measured once -> cached; the next autotune at the same key exports
+    the winners WITHOUT re-measuring (the 'measured winners become the
+    defaults' mechanism); TMR_AUTOTUNE_FORCE re-measures."""
+    calls = []
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: calls.append("x") or {"conv": 0.03, "fft": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl",
+        lambda *a, **k: calls.append("w") or {"dense": 0.02, "folded": 0.01},
+    )
+    r1 = at.autotune(_cfg(), 1024, 4)
+    assert calls == ["x", "w"]
+    assert r1["TMR_WIN_ATTN"]["picked"] == "folded"
+
+    # fresh process simulation: knobs cleared, cache file remains
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    r2 = at.autotune(_cfg(), 1024, 4)
+    assert calls == ["x", "w"], "cached hit must not re-measure"
+    assert r2["TMR_XCORR_IMPL_SMALL"] == {"picked": "fft", "cached": True}
+    assert r2["TMR_WIN_ATTN"] == {"picked": "folded", "cached": True}
+    assert os.environ["TMR_XCORR_IMPL_SMALL"] == "fft"
+    assert os.environ["TMR_WIN_ATTN"] == "folded"
+
+    # a different shape key measures fresh
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    at.autotune(_cfg(), 1536, 1)
+    assert calls == ["x", "w", "x", "w"]
+
+    # force bypasses the cache
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setenv("TMR_AUTOTUNE_FORCE", "1")
+    at.autotune(_cfg(), 1024, 4)
+    assert calls == ["x", "w", "x", "w", "x", "w"]
+
+
+def test_autotune_cached_hit_respects_explicit_knobs(
+    clean_knobs, monkeypatch
+):
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl", lambda *a, **k: {"conv": 0.03, "fft": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl", lambda *a, **k: {"dense": 0.02,
+                                                  "folded": 0.01}
+    )
+    at.autotune(_cfg(), 1024, 4)
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    # user pins the attention knob: the cached hit must not override it
+    monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    r = at.autotune(_cfg(), 1024, 4)
+    assert "TMR_WIN_ATTN" not in r
+    assert os.environ["TMR_WIN_ATTN"] == "dense"
+    assert r["TMR_XCORR_IMPL_SMALL"]["cached"] is True

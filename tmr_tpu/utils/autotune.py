@@ -122,6 +122,52 @@ def _restore(prev: Optional[str], name: str) -> None:
         os.environ[name] = prev
 
 
+CACHE_PATH = os.path.join(
+    os.path.expanduser("~"), ".cache", "tmr_tpu", "autotune.json"
+)
+
+
+def _cache_load() -> Dict[str, dict]:
+    import json
+
+    path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    # best-effort all the way down: a foreign/hand-edited file must degrade
+    # to "no cache", not crash the launch
+    if not isinstance(obj, dict):
+        return {}
+    return {
+        k: v for k, v in obj.items()
+        if isinstance(v, dict)
+        and all(isinstance(x, str) for x in list(v) + list(v.values()))
+    }
+
+
+def _cache_store(key: str, report: Dict[str, object]) -> None:
+    import json
+
+    path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        cache = _cache_load()
+        # merge: a partial report (one knob pinned by the user this run)
+        # must not wipe the sibling knob's previously cached winner
+        cache[key] = {
+            **cache.get(key, {}),
+            **{k: v["picked"] for k, v in report.items()},
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)  # atomic: concurrent readers see old or new
+    except OSError:
+        pass  # caching is best-effort; the measured winners still export
+
+
 def autotune(
     cfg, image_size: int, batch: int,
     log: Callable[[str], None] = lambda s: None,
@@ -131,8 +177,15 @@ def autotune(
     at trace time) so every program compiled afterwards in this process uses
     them.
 
+    Winners persist in ``~/.cache/tmr_tpu/autotune.json`` keyed by (device
+    kind, shapes): measured once on hardware, they become the default for
+    every later process on the machine with no re-sweep — the "measured
+    winners become the defaults" mechanism. ``TMR_AUTOTUNE_FORCE=1``
+    re-measures; ``TMR_AUTOTUNE_CACHE`` relocates the file.
+
     Knobs the user already set explicitly are left untouched. Off-TPU this
-    is a no-op (returns {}). Returns {knob: {"picked": ..., "times": ...}}.
+    is a no-op (returns {}). Returns {knob: {"picked": ..., "times": ...}}
+    (cached hits carry {"picked": ..., "cached": True} instead of times).
     """
     import jax
 
@@ -144,12 +197,43 @@ def autotune(
         cfg.backbone
     )
     report: Dict[str, object] = {}
-    rtt = measure_rtt_floor()
     grid = image_size // 16
     up_hw = 2 * grid if cfg.feature_upsample else grid
 
-    if "TMR_XCORR_IMPL" not in os.environ \
-            and "TMR_XCORR_IMPL_SMALL" not in os.environ:
+    # up_hw (not image_size alone) keys the cache: the xcorr sweep shape
+    # depends on feature_upsample, and a winner measured at the wrong map
+    # size must never be silently reused
+    key = "|".join(
+        str(p) for p in (
+            jax.devices()[0].device_kind, image_size, up_hw, batch,
+            cfg.emb_dim, vit_kind,
+        )
+    )
+    force = os.environ.get("TMR_AUTOTUNE_FORCE", "") not in ("", "0")
+    cached = {} if force else _cache_load().get(key, {})
+
+    want_xcorr = (
+        "TMR_XCORR_IMPL" not in os.environ
+        and "TMR_XCORR_IMPL_SMALL" not in os.environ
+    )
+    want_attn = "TMR_WIN_ATTN" not in os.environ and vit_kind is not None
+    wanted = set()
+    if want_xcorr:
+        wanted.add("TMR_XCORR_IMPL_SMALL")
+    if want_attn:
+        wanted.add("TMR_WIN_ATTN")
+    if cached and wanted <= set(cached):
+        # cached winners cover every wanted knob: export without measuring.
+        # (A partial entry — e.g. one sweep failed when it was written —
+        # falls through to a fresh measurement instead of pinning forever.)
+        for knob in sorted(wanted):
+            os.environ[knob] = cached[knob]
+            report[knob] = {"picked": cached[knob], "cached": True}
+            log(f"autotune: {knob}={cached[knob]} (cached, {key})")
+        return report
+
+    rtt = measure_rtt_floor()
+    if want_xcorr:
         # capacity 17 = the typical FSCD exemplar bucket; the winner is
         # exported through the SMALL-scoped knob (see module docstring)
         times = pick_xcorr_impl(batch, cfg.emb_dim, up_hw, 17, rtt=rtt,
@@ -160,7 +244,7 @@ def autotune(
             report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
             log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
 
-    if "TMR_WIN_ATTN" not in os.environ and vit_kind is not None:
+    if want_attn:
         vc = VIT_CONFIGS[vit_kind]
         times = pick_win_attn_impl(
             batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
@@ -170,4 +254,6 @@ def autotune(
             os.environ["TMR_WIN_ATTN"] = best
             report["TMR_WIN_ATTN"] = {"picked": best, "times": times}
             log(f"autotune: TMR_WIN_ATTN={best} {times}")
+    if report:
+        _cache_store(key, report)
     return report
