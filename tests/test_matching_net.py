@@ -122,3 +122,37 @@ def test_backbone_flag_validation():
                               remat_backbone=True))
     bb = build_backbone(Config(backbone="sam_vit_b", remat_backbone=True))
     assert bb.remat is True
+
+
+def test_vit_h_production_config_abstract_forward():
+    """Full ViT-H (1280-d, 32 blocks, global attention at 7/15/23/31) under
+    the production RPINE/--refine_box configuration at 1024: abstract
+    evaluation (eval_shape — zero FLOPs) instantiates the real module tree
+    and type-checks the whole forward, catching any wiring/shape error in
+    the one backbone no tiny-config test builds (sam_ViT.py vit_h:
+    1280/32/16, sam.py:20-30)."""
+    import jax
+
+    from tmr_tpu.config import preset
+    from tmr_tpu.models import build_model
+
+    cfg = preset("TMR_RPINE", backbone="sam", image_size=1024,
+                 compute_dtype="bfloat16")
+    model = build_model(cfg).clone(template_capacity=17)
+    image = jax.ShapeDtypeStruct((1, 1024, 1024, 3), jnp.float32)
+    ex = jax.ShapeDtypeStruct((1, 1, 4), jnp.float32)
+    params = jax.eval_shape(model.init, jax.random.key(0), image, ex)[
+        "params"
+    ]
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+    )
+    assert n_params > 630e6, f"vit_h detector should be ~656M, got {n_params}"
+    out = jax.eval_shape(
+        lambda p, i, e: model.apply({"params": p}, i, e), params, image, ex
+    )
+    # 2x upsampled 64-grid -> 128 maps, reference matching_net.py:50-51
+    assert out["objectness"][0].shape == (1, 128, 128)
+    assert out["regressions"][0].shape == (1, 128, 128, 4)
+    assert out["feature"].shape == (1, 128, 128, 256)
+    assert out["backbone_feature"].shape == (1, 64, 64, 256)

@@ -140,10 +140,17 @@ def _cache_load() -> Dict[str, dict]:
     # to "no cache", not crash the launch
     if not isinstance(obj, dict):
         return {}
+    valid = {
+        "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
+        "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
+    }
     return {
         k: v for k, v in obj.items()
         if isinstance(v, dict)
-        and all(isinstance(x, str) for x in list(v) + list(v.values()))
+        and all(
+            isinstance(kk, str) and vv in valid.get(kk, ())
+            for kk, vv in v.items()
+        )
     }
 
 
@@ -222,6 +229,8 @@ def autotune(
         wanted.add("TMR_XCORR_IMPL_SMALL")
     if want_attn:
         wanted.add("TMR_WIN_ATTN")
+    if not wanted:
+        return report  # everything pinned: skip even the rtt round trip
     if cached and wanted <= set(cached):
         # cached winners cover every wanted knob: export without measuring.
         # (A partial entry — e.g. one sweep failed when it was written —
