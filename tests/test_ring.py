@@ -561,3 +561,27 @@ def test_flash_supported_production_lengths():
     assert flash_supported(4096)
     assert flash_supported(9216)
     assert not flash_supported(196)  # windows go through the padded path
+
+
+def test_ring_at_1536_bucket_scale():
+    """The 1536 small-object bucket is the reference's longest sequence
+    (96x96 = 9216 tokens, sam.py:72-76 pos-embed re-interpolation); ring
+    attention must hold exactly there — per-device KV slabs of 9216/8
+    tokens, online-softmax accumulation over 8 ppermute hops. Small head
+    count keeps the dense oracle affordable on CPU."""
+    b, h, s, d = 1, 2, 96 * 96, 16
+    rng = np.random.default_rng(42)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    want = dense_attention(q, k, v)
+
+    mesh = seq_mesh(8)
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq"),
+        mesh=mesh, in_specs=(SEQ_SPEC,) * 3,
+        out_specs=SEQ_SPEC, check_vma=False,
+    )
+    got = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )

@@ -144,14 +144,21 @@ def _cache_load() -> Dict[str, dict]:
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
     }
-    return {
-        k: v for k, v in obj.items()
-        if isinstance(v, dict)
-        and all(
-            isinstance(kk, str) and vv in valid.get(kk, ())
-            for kk, vv in v.items()
-        )
-    }
+    # per-knob filtering: one invalid/unknown winner drops only itself —
+    # the valid sibling survives (and all-or-nothing would let the next
+    # _cache_store rewrite erase it from disk permanently)
+    out: Dict[str, dict] = {}
+    for k, v in obj.items():
+        if not isinstance(v, dict):
+            continue
+        kept = {
+            kk: vv for kk, vv in v.items()
+            if isinstance(kk, str) and isinstance(vv, str)
+            and vv in valid.get(kk, ())
+        }
+        if kept:
+            out[k] = kept
+    return out
 
 
 def _cache_store(key: str, report: Dict[str, object]) -> None:
