@@ -9,6 +9,8 @@ import importlib.util
 import sys
 import types
 
+import pytest
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -229,9 +231,6 @@ def test_remat_blocks_preserve_values_and_grads():
         ),
         g1, g2,
     )
-
-
-import pytest
 
 
 @pytest.mark.parametrize(

@@ -386,6 +386,33 @@ class Trainer:
         self.predictor.params = self._eval_params(params)
         sums = None  # device-scalar pytree, fetched once per epoch
         n = 0
+        # one-batch software pipeline: batch k's detections are fetched only
+        # AFTER batch k+1's H2D upload and compute have been dispatched
+        # (both async), so the host->device transfer — the dominant cost on
+        # slow links — overlaps the previous batch's compute instead of
+        # serializing with its result fetch
+        # (bsz, meta, losses, dets) awaiting collection — only size + meta
+        # from the host batch, so batch k's image/gt arrays release before
+        # batch k+1 materializes (one resident host batch, not two)
+        pending = None
+
+        def collect(p):
+            nonlocal sums, n
+            bsz, meta, losses, dets = p
+            # weight each batch's losses by its size so a ragged-tail B=1
+            # image doesn't weigh as much as a full batch. NB this is
+            # batch-size weighting, not exact per-image parity: the
+            # criterion normalizes by the batch's TOTAL positive count
+            # (criterion.py), so batched losses still differ from the
+            # eval_batch_size=1 aggregation — the documented caveat on
+            # --eval_batch_size. Still device-side, no host sync.
+            scaled = self._scale_fn(losses, jnp.float32(bsz))
+            sums = scaled if sums is None else self._acc_fn(sums, scaled)
+            n += bsz
+            image_info_collector(
+                cfg.logpath, stage, meta, detections_to_numpy(dets)
+            )
+
         for full_batch in loader:
             b = full_batch["image"].shape[0]
             if cfg.num_exemplars == 1 and b not in (1, cfg.eval_batch_size):
@@ -393,21 +420,14 @@ class Trainer:
             else:
                 sub_batches = [full_batch]
             for batch in sub_batches:
-                losses, dets = self._eval_batch(batch)
-                # weight each batch's losses by its size so a ragged-tail
-                # B=1 image doesn't weigh as much as a full batch. NB this
-                # is batch-size weighting, not exact per-image parity: the
-                # criterion normalizes by the batch's TOTAL positive count
-                # (criterion.py), so batched losses still differ from the
-                # eval_batch_size=1 aggregation — the documented caveat on
-                # --eval_batch_size. Still device-side, no host sync.
-                bsz = int(batch["image"].shape[0])
-                scaled = self._scale_fn(losses, jnp.float32(bsz))
-                sums = scaled if sums is None else self._acc_fn(sums, scaled)
-                n += bsz
-                image_info_collector(
-                    cfg.logpath, stage, batch["meta"], detections_to_numpy(dets)
+                losses, dets = self._eval_batch(batch)  # async dispatch
+                if pending is not None:
+                    collect(pending)
+                pending = (
+                    int(batch["image"].shape[0]), batch["meta"], losses, dets
                 )
+        if pending is not None:
+            collect(pending)
         return self._finish_eval(stage, sums, n)
 
     def _eval_batch(self, batch: dict):
