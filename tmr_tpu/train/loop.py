@@ -392,8 +392,9 @@ class Trainer:
         # slow links — overlaps the previous batch's compute instead of
         # serializing with its result fetch
         # (bsz, meta, losses, dets) awaiting collection — only size + meta
-        # from the host batch, so batch k's image/gt arrays release before
-        # batch k+1 materializes (one resident host batch, not two)
+        # from the host batch, so `pending` itself doesn't pin batch k's
+        # image/gt arrays across the overlap (loop locals still hold the
+        # current batch, so peak residency is the loader's usual window)
         pending = None
 
         def collect(p):

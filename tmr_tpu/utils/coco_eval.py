@@ -10,7 +10,8 @@ Matches pycocotools semantics for iscrowd=0 data:
 - IoU on xywh boxes, union = a1 + a2 - inter;
 - detections sorted by score (stable), truncated to maxDet;
 - per threshold, each det greedily takes the best still-unmatched GT with
-  IoU >= threshold (ties keep the earlier GT);
+  IoU >= threshold (the scan's strict `<` update hands equal-IoU ties to
+  the LAST qualifying GT, like cocoeval.py);
 - GTs outside the area range are ignore: matches to them don't count either
   way, unmatched dets outside the range are ignored too;
 - precision made monotonically non-increasing, sampled at 101 recall points;
@@ -108,24 +109,41 @@ class COCOEvalLite:
         dtm = np.zeros((T, D), np.int64)  # 1 + matched gt index, 0 = none
         gtm = np.zeros((T, G), np.int64)
         dt_ig = np.zeros((T, D), bool)
-        for ti, t in enumerate(self.iou_thrs):
+        # Greedy matching, vectorized over (thresholds x gts) with one loop
+        # over detections (the det loop is inherently sequential — each
+        # match consumes a gt). Replicates cocoeval.py's scan EXACTLY:
+        # candidates need iou >= min(t, 1-1e-10); the running `iou < best:
+        # continue` update means equal IoUs hand the match to the LAST
+        # qualifying gt; gts are sorted non-ignored-first and the scan
+        # breaks on entering the ignored section with a real match in hand,
+        # so ignored gts are a fallback tier, not competitors.
+        if D and G:
+            t_eff = np.minimum(self.iou_thrs, 1.0 - 1e-10)  # (T,)
+            ig_row = gt_ig[None, :]  # (1, G)
+            any_ig = bool(gt_ig.any())
             for d in range(D):
-                best = min(t, 1.0 - 1e-10)
-                m = -1
-                for g in range(G):
-                    if gtm[ti, g] > 0:
-                        continue
-                    if m > -1 and not gt_ig[m] and gt_ig[g]:
-                        break  # only ignored gts remain; keep current match
-                    if ious[d, g] < best:
-                        continue
-                    best = ious[d, g]
-                    m = g
-                if m == -1:
-                    continue
-                dtm[ti, d] = m + 1
-                gtm[ti, m] = d + 1
-                dt_ig[ti, d] = gt_ig[m]
+                cand = np.broadcast_to(ious[d][None, :], (T, G))
+                avail = gtm == 0
+                # tier A: non-ignored unmatched gts
+                a = np.where(avail & ~ig_row, cand, -1.0)
+                a_max = a.max(axis=1)
+                a_m = G - 1 - np.argmax(a[:, ::-1], axis=1)  # last-tie-wins
+                use_a = a_max >= t_eff
+                if any_ig:
+                    # tier B: ignored unmatched gts (only when A found none)
+                    b = np.where(avail & ig_row, cand, -1.0)
+                    b_max = b.max(axis=1)
+                    b_m = G - 1 - np.argmax(b[:, ::-1], axis=1)
+                    use_b = ~use_a & (b_max >= t_eff)
+                    m = np.where(use_a, a_m, np.where(use_b, b_m, -1))
+                else:
+                    m = np.where(use_a, a_m, -1)
+                rows = np.nonzero(m >= 0)[0]
+                if rows.size:
+                    mg = m[rows]
+                    dtm[rows, d] = mg + 1
+                    gtm[rows, mg] = d + 1
+                    dt_ig[rows, d] = gt_ig[mg]
         # unmatched dets outside the area range are ignored
         d_area = d_boxes[:, 2] * d_boxes[:, 3]
         out_rng = (d_area < lo) | (d_area > hi)
@@ -186,14 +204,12 @@ class COCOEvalLite:
                     pr = tp / (fp + tp + np.spacing(1))
                     recall[ti, 0, ai, mi] = rc[-1] if nd else 0.0
                     q = np.zeros(R)
-                    pr = pr.tolist()
-                    for i in range(nd - 1, 0, -1):
-                        if pr[i] > pr[i - 1]:
-                            pr[i - 1] = pr[i]
+                    # right-to-left monotone envelope (the cocoeval.py
+                    # backward loop) == reversed cumulative maximum
+                    pr = np.maximum.accumulate(pr[::-1])[::-1]
                     inds = np.searchsorted(rc, self.rec_thrs, side="left")
-                    for ri, pi in enumerate(inds):
-                        if pi < nd:
-                            q[ri] = pr[pi]
+                    ok = inds < nd
+                    q[ok] = pr[inds[ok]]
                     precision[ti, :, 0, ai, mi] = q
 
         self.precision = precision
