@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Throughput variant of the FSCD-147 eval (beyond the reference, which
+# forces eval batch 1): --eval_batch_size batches size-bucketed eval
+# images through the fused program, --mesh_data spreads each batch over
+# the local chips (the loop shards whenever the batch divides the axis;
+# ragged tails fall back per image), and --autotune picks the measured
+# kernel formulations, cached per (device, shape) after the first run.
+# Metrics match the batch-1 protocol (per-image JSON collection is batch-
+# order agnostic; the documented caveat is the logged eval LOSS only).
+python main.py \
+  --project_name "Few-Shot Pattern Detection" \
+  --datapath /data/fscd-147 \
+  --logpath ./outputs/FSCD147 \
+  --modeltype matching_net \
+  --template_type roi_align \
+  --dataset FSCD147 \
+  --num_workers 4 \
+  --batch_size 1 \
+  --eval_batch_size 8 \
+  --num_exemplars 1 \
+  --backbone sam \
+  --encoder original \
+  --emb_dim 512 \
+  --decoder_num_layer 1 \
+  --decoder_kernel_size 3 \
+  --feature_upsample \
+  --positive_threshold 0.5 \
+  --negative_threshold 0.5 \
+  --NMS_cls_threshold 0.25 \
+  --NMS_iou_threshold 0.5 \
+  --fusion \
+  --nowandb \
+  --device tpu \
+  --mesh_data -1 \
+  --multi_gpu \
+  --autotune \
+  --eval \
+  "$@"
