@@ -52,17 +52,88 @@ def export_model(
     return output
 
 
+def export_detector_artifact(
+    model_type: str = "vit_b",
+    tmr_checkpoint: str | None = None,
+    output: str = "exported/tmr_detector.stablehlo",
+    image_size: int = 1024,
+    compute_dtype: str = "bfloat16",
+    template_capacity: int = 17,
+    cls_threshold: float = 0.25,
+    iou_threshold: float = 0.5,
+    max_detections: int = 2000,
+):
+    """Whole-detector artifact (beyond the reference's encoder-only export):
+    one StableHLO file running encoder -> match -> heads -> decode -> NMS,
+    (image, exemplars) -> (boxes, scores, valid). ``tmr_checkpoint`` is an
+    orbax params checkpoint (a Trainer best/last dir's params, or
+    scripts/make_bench_ckpt.py output); without one the artifact carries
+    random weights like the reference's weightless export."""
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.utils.export import export_detector, save_exported
+
+    backbone = {"vit_b": "sam_vit_b", "vit_h": "sam_vit_h"}[model_type]
+    cfg = preset(
+        "TMR_FSCD147", backbone=backbone, image_size=image_size,
+        compute_dtype=compute_dtype, NMS_cls_threshold=cls_threshold,
+        NMS_iou_threshold=iou_threshold, max_detections=max_detections,
+    )
+    predictor = Predictor(cfg)
+    predictor.init_params(seed=0, image_size=image_size)
+    if tmr_checkpoint:
+        import orbax.checkpoint as ocp
+
+        predictor.params = ocp.StandardCheckpointer().restore(
+            os.path.abspath(tmr_checkpoint), target=predictor.params
+        )
+    print(
+        "weights: "
+        + (f"restored from {tmr_checkpoint}" if tmr_checkpoint
+           else "fresh random init")
+    )
+    data = export_detector(
+        predictor, template_capacity, image_size=image_size
+    )
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    save_exported(data, output)
+    print(f"wrote {output} ({len(data) / 1e6:.1f} MB, batch 1, "
+          f"inputs (1, {image_size}, {image_size}, 3) f32 + (1, 1, 4) f32)")
+    return output
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model_type", default="vit_b", choices=["vit_b", "vit_h"])
     p.add_argument("--checkpoint", default=None,
                    help="SAM-HQ .pth with image_encoder.* keys")
-    p.add_argument("--output", default="exported/sam_vit_b_encoder.stablehlo")
+    p.add_argument("--output", default=None)
     p.add_argument("--image_size", default=1024, type=int)
     p.add_argument("--compute_dtype", default="bfloat16")
+    p.add_argument("--detector", action="store_true",
+                   help="export the WHOLE detector (encoder+match+decode+"
+                        "NMS) instead of the encoder alone")
+    p.add_argument("--tmr_checkpoint", default=None,
+                   help="orbax params dir for --detector weights")
+    p.add_argument("--template_capacity", default=17, type=int)
     args = p.parse_args(argv)
-    export_model(args.model_type, args.checkpoint, args.output,
-                 args.image_size, args.compute_dtype)
+    if args.detector:
+        if args.checkpoint:
+            p.error(
+                "--checkpoint (SAM-HQ .pth) applies to the encoder export "
+                "only; --detector takes --tmr_checkpoint (orbax params dir)"
+            )
+        export_detector_artifact(
+            args.model_type, args.tmr_checkpoint,
+            args.output or "exported/tmr_detector.stablehlo",
+            args.image_size, args.compute_dtype, args.template_capacity,
+        )
+    else:
+        export_model(
+            args.model_type, args.checkpoint,
+            args.output or "exported/sam_vit_b_encoder.stablehlo",
+            args.image_size, args.compute_dtype,
+        )
 
 
 if __name__ == "__main__":

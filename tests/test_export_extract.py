@@ -142,3 +142,62 @@ def test_verdict_thresholds():
     assert verdict(0.0120).startswith("HARD")
     assert verdict(0.0140).startswith("EASY")
     assert verdict(0.0133) == "MEDIUM"
+
+
+def test_export_detector_roundtrip_matches_eager(tmp_path):
+    """Whole-detector artifact (beyond the reference's encoder-only
+    export): the serialized (image, exemplars) -> (boxes, scores, valid)
+    program — the Predictor's OWN fused pipeline, config flags included —
+    must reproduce the live Predictor bit-for-bit after a disk round
+    trip."""
+    import jax
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.utils.export import (
+        export_detector,
+        load_exported_detector,
+        save_exported,
+    )
+
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        feature_upsample=False, image_size=32,
+        NMS_cls_threshold=0.3, NMS_iou_threshold=0.5, max_detections=16,
+        template_buckets=(5,), compute_dtype="float32",
+        positive_threshold=0.5, negative_threshold=0.5,
+    )
+    model = MatchingNet(
+        backbone=SamViT(**TINY), emb_dim=16, fusion=True,
+        template_capacity=5,
+    )
+    predictor = Predictor(cfg, model=model)
+    rng = np.random.default_rng(5)
+    image = jnp.asarray(rng.standard_normal((1, 32, 32, 3)), jnp.float32)
+    ex = jnp.asarray([[[0.3, 0.3, 0.55, 0.6]]], jnp.float32)
+    predictor.params = jax.jit(model.init)(
+        jax.random.key(0), image, ex
+    )["params"]
+
+    data = export_detector(
+        predictor, capacity=5, image_size=32, platforms=("cpu",)
+    )
+    path = str(tmp_path / "detector.stablehlo")
+    save_exported(data, path)
+    call = load_exported_detector(path)
+    boxes, scores, valid = call(image, ex)
+
+    # oracle: the live Predictor's own program
+    dets = predictor._get_fn(5)(
+        predictor.params, predictor.refiner_params, image, ex
+    )
+    assert np.asarray(valid).dtype == np.bool_
+    assert np.asarray(valid).shape == np.asarray(dets["valid"]).shape
+    np.testing.assert_array_equal(np.asarray(valid), np.asarray(dets["valid"]))
+    np.testing.assert_allclose(
+        np.asarray(boxes), np.asarray(dets["boxes"]), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(scores), np.asarray(dets["scores"]), rtol=1e-6, atol=1e-7
+    )

@@ -57,6 +57,52 @@ def export_encoder(
     return exported.serialize()
 
 
+def export_detector(
+    predictor,
+    capacity: int,
+    image_size: int = 1024,
+    platforms: Sequence[str] = DEFAULT_PLATFORMS,
+    batch: int = 1,
+    n_exemplars: int = 1,
+) -> bytes:
+    """Whole-detector artifact: (image, exemplars) -> (boxes, scores, valid).
+
+    Beyond the reference (which exports only the encoder): the COMPLETE
+    fused eval program — encoder, matcher, heads, peak decode, [refine],
+    NMS — as one self-contained StableHLO file, so a serving host detects
+    patterns with no model code at all. The program is the Predictor's OWN
+    pipeline (inference.py `_get_fn` — "exactly one copy"), so every config
+    flag the eval path honours (thresholds, box_reg, regression scaling,
+    refine_box) is honoured identically in the artifact; params are baked
+    in as constants.
+
+    The batch axis is STATIC (default 1, the serving shape): the matcher's
+    grouped correlation bakes ``batch*channels`` into the convolution's
+    ``feature_group_count``, which XLA requires to be a compile-time
+    constant — a symbolic batch cannot flow through it. Export one artifact
+    per batch size needed (the encoder-only export keeps its symbolic
+    batch).
+    """
+    fn = predictor._get_fn(capacity)
+    params = predictor.params
+    refiner_params = predictor.refiner_params
+
+    def serve(image, exemplars):
+        dets = fn(params, refiner_params, image, exemplars)
+        return dets["boxes"], dets["scores"], dets["valid"]
+
+    specs = (
+        jax.ShapeDtypeStruct(
+            (batch, image_size, image_size, 3), jnp.float32
+        ),
+        jax.ShapeDtypeStruct((batch, n_exemplars, 4), jnp.float32),
+    )
+    exported = jax_export.export(jax.jit(serve), platforms=list(platforms))(
+        *specs
+    )
+    return exported.serialize()
+
+
 def save_exported(data: bytes, path: str) -> None:
     with open(path, "wb") as f:
         f.write(data)
@@ -143,3 +189,8 @@ def load_exported_decoder(path: str) -> Callable:
         return exported.call(*args)
 
     return call
+
+
+#: export_detector artifacts load the same way: a positional-args callable
+#: (image, exemplars) -> (boxes, scores, valid)
+load_exported_detector = load_exported_decoder
