@@ -59,9 +59,8 @@ def export_detector_artifact(
     image_size: int = 1024,
     compute_dtype: str = "bfloat16",
     template_capacity: int = 17,
-    cls_threshold: float = 0.25,
-    iou_threshold: float = 0.5,
-    max_detections: int = 2000,
+    n_exemplars: int = 1,
+    **preset_overrides,
 ):
     """Whole-detector artifact (beyond the reference's encoder-only export):
     one StableHLO file running encoder -> match -> heads -> decode -> NMS,
@@ -74,10 +73,11 @@ def export_detector_artifact(
     from tmr_tpu.utils.export import export_detector, save_exported
 
     backbone = {"vit_b": "sam_vit_b", "vit_h": "sam_vit_h"}[model_type]
+    # thresholds/caps come from the preset (single source of truth);
+    # programmatic callers may override via **preset_overrides
     cfg = preset(
         "TMR_FSCD147", backbone=backbone, image_size=image_size,
-        compute_dtype=compute_dtype, NMS_cls_threshold=cls_threshold,
-        NMS_iou_threshold=iou_threshold, max_detections=max_detections,
+        compute_dtype=compute_dtype, **preset_overrides,
     )
     predictor = Predictor(cfg)
     predictor.init_params(seed=0, image_size=image_size)
@@ -93,12 +93,18 @@ def export_detector_artifact(
            else "fresh random init")
     )
     data = export_detector(
-        predictor, template_capacity, image_size=image_size
+        predictor, template_capacity, image_size=image_size,
+        n_exemplars=n_exemplars,
     )
     os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
     save_exported(data, output)
+    if n_exemplars == 1:
+        sig = f"(1, {image_size}, {image_size}, 3) f32 + (1, 1, 4) f32"
+    else:
+        sig = (f"(1, {image_size}, {image_size}, 3) f32 + "
+               f"({n_exemplars}, 4) f32 + k_real () int32")
     print(f"wrote {output} ({len(data) / 1e6:.1f} MB, batch 1, "
-          f"inputs (1, {image_size}, {image_size}, 3) f32 + (1, 1, 4) f32)")
+          f"inputs {sig}, capacity {template_capacity})")
     return output
 
 
@@ -115,7 +121,13 @@ def main(argv=None):
                         "NMS) instead of the encoder alone")
     p.add_argument("--tmr_checkpoint", default=None,
                    help="orbax params dir for --detector weights")
-    p.add_argument("--template_capacity", default=17, type=int)
+    p.add_argument("--template_capacity", default=17, type=int,
+                   help="STATIC template bucket baked into the detector "
+                        "artifact; export one artifact per bucket and "
+                        "route by exemplar span when serving")
+    p.add_argument("--n_exemplars", default=1, type=int,
+                   help="static exemplar-slot count of the detector "
+                        "artifact's (1, K, 4) input")
     args = p.parse_args(argv)
     if args.detector:
         if args.checkpoint:
@@ -127,6 +139,7 @@ def main(argv=None):
             args.model_type, args.tmr_checkpoint,
             args.output or "exported/tmr_detector.stablehlo",
             args.image_size, args.compute_dtype, args.template_capacity,
+            args.n_exemplars,
         )
     else:
         export_model(

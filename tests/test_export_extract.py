@@ -201,3 +201,62 @@ def test_export_detector_roundtrip_matches_eager(tmp_path):
     np.testing.assert_allclose(
         np.asarray(scores), np.asarray(dets["scores"]), rtol=1e-6, atol=1e-7
     )
+
+
+def test_export_detector_multi_exemplar_matches_live(tmp_path):
+    """n_exemplars > 1 exports the fused multi-exemplar program (union NMS,
+    k_real masking): round-tripped artifact == live
+    predict_multi_exemplar on the same 2-of-3-slot input."""
+    import jax
+
+    from tmr_tpu.config import Config
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.utils.export import (
+        export_detector,
+        load_exported_detector,
+        save_exported,
+    )
+
+    cfg = Config(
+        backbone="sam_vit_b", emb_dim=16, fusion=True,
+        feature_upsample=False, image_size=32,
+        NMS_cls_threshold=0.3, NMS_iou_threshold=0.5, max_detections=16,
+        template_buckets=(5,), compute_dtype="float32",
+        positive_threshold=0.5, negative_threshold=0.5, num_exemplars=3,
+    )
+    model = MatchingNet(
+        backbone=SamViT(**TINY), emb_dim=16, fusion=True,
+        template_capacity=5,
+    )
+    predictor = Predictor(cfg, model=model)
+    rng = np.random.default_rng(6)
+    image = jnp.asarray(rng.standard_normal((1, 32, 32, 3)), jnp.float32)
+    ex2 = np.asarray(
+        [[0.3, 0.3, 0.55, 0.6], [0.1, 0.15, 0.4, 0.35]], np.float32
+    )
+    predictor.params = jax.jit(model.init)(
+        jax.random.key(0), image, jnp.asarray(ex2[None, :1])
+    )["params"]
+
+    # n_exemplars must equal the K bucket live inference picks for the
+    # serving k (K_BUCKETS) — same program, slot-exact comparison
+    data = export_detector(
+        predictor, capacity=5, image_size=32, platforms=("cpu",),
+        n_exemplars=2,
+    )
+    path = str(tmp_path / "det_multi.stablehlo")
+    save_exported(data, path)
+    call = load_exported_detector(path)
+    boxes, scores, valid = call(
+        image, jnp.asarray(ex2), jnp.asarray(2, jnp.int32)
+    )
+
+    live = predictor.predict_multi_exemplar(image, ex2)
+    np.testing.assert_array_equal(np.asarray(valid), np.asarray(live["valid"]))
+    np.testing.assert_allclose(
+        np.asarray(boxes), np.asarray(live["boxes"]), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(scores), np.asarray(live["scores"]), rtol=1e-6, atol=1e-7
+    )

@@ -1,0 +1,77 @@
+"""Direct unit coverage for small leaf modules (bench_guard, COCOIndex)."""
+
+import json
+
+import pytest
+
+from tmr_tpu.data.coco_index import COCOIndex
+from tmr_tpu.utils.bench_guard import run_guarded
+
+
+def test_run_guarded_success_and_cancel(monkeypatch):
+    monkeypatch.setenv("TMR_BENCH_ALARM", "3300")
+    seen = []
+
+    def run(cancel):
+        cancel()  # contract: callable before the success print
+        seen.append("ran")
+        return 0
+
+    rc = run_guarded(run, lambda msg: seen.append(("err", msg)))
+    assert rc == 0 and seen == ["ran"]
+
+
+def test_run_guarded_funnels_exceptions(monkeypatch):
+    monkeypatch.setenv("TMR_BENCH_ALARM", "0")  # no watchdog thread
+    errs = []
+    rc = run_guarded(
+        lambda cancel: (_ for _ in ()).throw(RuntimeError("boom")),
+        errs.append,
+    )
+    assert rc == 1
+    assert "RuntimeError: boom" in errs[0]
+
+    # SystemExit funnels too (an in-library sys.exit must still yield the
+    # contractual JSON record, not an empty stdout)
+    errs = []
+    rc = run_guarded(
+        lambda cancel: (_ for _ in ()).throw(SystemExit(3)), errs.append
+    )
+    assert rc == 1 and "SystemExit" in errs[0]
+
+
+def test_run_guarded_malformed_alarm_env(monkeypatch):
+    monkeypatch.setenv("TMR_BENCH_ALARM", "")  # int() would raise
+    rc = run_guarded(lambda cancel: 0, lambda msg: None)
+    assert rc == 0
+
+
+def test_run_guarded_keyboardinterrupt_reraises(monkeypatch):
+    monkeypatch.setenv("TMR_BENCH_ALARM", "0")
+    with pytest.raises(KeyboardInterrupt):
+        run_guarded(
+            lambda cancel: (_ for _ in ()).throw(KeyboardInterrupt()),
+            lambda msg: None,
+        )
+
+
+def test_coco_index_read_paths(tmp_path):
+    data = {
+        "images": [{"id": 7, "file_name": "a.jpg"},
+                   {"id": 9, "file_name": "b.jpg"}],
+        "annotations": [
+            {"id": 1, "image_id": 7, "bbox": [0, 0, 5, 5]},
+            {"id": 2, "image_id": 7, "bbox": [1, 1, 3, 3]},
+            {"id": 3, "image_id": 9, "bbox": [2, 2, 4, 4]},
+        ],
+    }
+    p = tmp_path / "inst.json"
+    p.write_text(json.dumps(data))
+    idx = COCOIndex(str(p))
+    assert sorted(idx.get_img_ids()) == [7, 9]
+    assert idx.imgs[9]["file_name"] == "b.jpg"
+    ids = idx.get_ann_ids([7])
+    assert sorted(ids) == [1, 2]
+    anns = idx.load_anns(ids)
+    assert [a["id"] for a in anns] == sorted(ids)
+    assert idx.get_ann_ids([9, 7]) and len(idx.get_ann_ids([9, 7])) == 3

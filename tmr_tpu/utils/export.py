@@ -82,21 +82,56 @@ def export_detector(
     constant — a symbolic batch cannot flow through it. Export one artifact
     per batch size needed (the encoder-only export keeps its symbolic
     batch).
+
+    The template ``capacity`` is likewise STATIC — the live Predictor picks
+    a capacity bucket per exemplar size (inference.py ``pick_capacity``),
+    so the artifact matches live inference only for exemplars that fit
+    ``capacity``; larger ones degrade to a coarser template (the in-jit
+    clamp). Export one artifact per bucket to cover the full range, and
+    route by exemplar span on the serving host.
+
+    ``n_exemplars == 1`` exports the single-exemplar program:
+    (image (b,S,S,3), exemplars (b,1,4)) -> dets. ``n_exemplars > 1``
+    exports the fused MULTI-exemplar program (per-exemplar decode, one NMS
+    over the union — trainer.py:75-121 semantics): (image (1,S,S,3),
+    exemplars (K,4), k_real () int32) -> dets, k_real masking unused
+    padded rows; batch is fixed at 1 there like live inference. For
+    slot-exact parity with ``predict_multi_exemplar``, pick ``n_exemplars``
+    from ``Predictor.K_BUCKETS`` (live inference rounds k up to a bucket).
     """
-    fn = predictor._get_fn(capacity)
     params = predictor.params
     refiner_params = predictor.refiner_params
 
-    def serve(image, exemplars):
-        dets = fn(params, refiner_params, image, exemplars)
-        return dets["boxes"], dets["scores"], dets["valid"]
+    if n_exemplars == 1:
+        fn = predictor._get_fn(capacity)
 
-    specs = (
-        jax.ShapeDtypeStruct(
-            (batch, image_size, image_size, 3), jnp.float32
-        ),
-        jax.ShapeDtypeStruct((batch, n_exemplars, 4), jnp.float32),
-    )
+        def serve(image, exemplars):
+            dets = fn(params, refiner_params, image, exemplars)
+            return dets["boxes"], dets["scores"], dets["valid"]
+
+        specs = (
+            jax.ShapeDtypeStruct(
+                (batch, image_size, image_size, 3), jnp.float32
+            ),
+            jax.ShapeDtypeStruct((batch, 1, 4), jnp.float32),
+        )
+    else:
+        if batch != 1:
+            raise ValueError(
+                "the multi-exemplar program is per-image (batch 1), like "
+                "live predict_multi_exemplar"
+            )
+        mfn = predictor._get_multi_fn(capacity, n_exemplars)
+
+        def serve(image, exemplars, k_real):
+            dets = mfn(params, refiner_params, image, exemplars, k_real)
+            return dets["boxes"], dets["scores"], dets["valid"]
+
+        specs = (
+            jax.ShapeDtypeStruct((1, image_size, image_size, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n_exemplars, 4), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
     exported = jax_export.export(jax.jit(serve), platforms=list(platforms))(
         *specs
     )
@@ -192,5 +227,7 @@ def load_exported_decoder(path: str) -> Callable:
 
 
 #: export_detector artifacts load the same way: a positional-args callable
-#: (image, exemplars) -> (boxes, scores, valid)
+#: returning (boxes, scores, valid) — called (image, exemplars) for
+#: single-exemplar artifacts, (image, exemplars, k_real) for multi
+#: (see export_detector's docstring for the exact input specs)
 load_exported_detector = load_exported_decoder
