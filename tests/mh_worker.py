@@ -1,0 +1,117 @@
+"""Worker for the 2-process multi-host smoke test (test_multihost.py).
+
+Run as: python mh_worker.py <coordinator> <num_processes> <process_id>.
+Each process contributes 4 virtual CPU devices (8 global); collectives
+cross the process boundary over jax.distributed's Gloo transport — the
+DCN stand-in this image allows. Prints MH_OK <loss> <stats_sum> on
+success; any divergence raises.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmr_tpu.parallel.mesh import initialize_multihost  # noqa: E402
+
+coordinator, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+initialize_multihost(coordinator, n_proc, pid)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tmr_tpu.config import Config  # noqa: E402
+from tmr_tpu.models.matching_net import MatchingNet  # noqa: E402
+from tmr_tpu.models.vit import SamViT  # noqa: E402
+from tmr_tpu.parallel.mapreduce import allreduce_stats  # noqa: E402
+from tmr_tpu.parallel.mesh import make_mesh  # noqa: E402
+from tmr_tpu.train.state import (  # noqa: E402
+    create_train_state,
+    make_train_step,
+)
+
+assert jax.process_count() == n_proc, jax.process_count()
+assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
+
+mesh = make_mesh((4 * n_proc, 1))  # ('data', 'model') over BOTH processes
+
+cfg = Config(
+    backbone="sam_vit_b", emb_dim=16, fusion=True,
+    positive_threshold=0.5, negative_threshold=0.5,
+    lr=1e-3, lr_backbone=1e-4, compute_dtype="float32",
+)
+tiny = dict(embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
+            patch_size=8, window_size=3, out_chans=16, pretrain_img_size=64)
+model = MatchingNet(backbone=SamViT(**tiny), emb_dim=16, fusion=True,
+                    template_capacity=9)
+
+# identical data on every process (same seed); each contributes its local
+# shard of the GLOBAL batch of 8 via make_array_from_process_local_data
+rng = np.random.default_rng(0)
+g_batch = {
+    "image": rng.standard_normal((8, 64, 64, 3)).astype(np.float32),
+    "exemplars": np.tile([[[0.3, 0.3, 0.45, 0.5]]], (8, 1, 1)).astype(
+        np.float32
+    ),
+    "gt_boxes": np.tile([[[0.3, 0.3, 0.45, 0.5]]], (8, 1, 1)).astype(
+        np.float32
+    ),
+    "gt_valid": np.ones((8, 1), bool),
+}
+data_sh = NamedSharding(mesh, P("data"))
+repl_sh = NamedSharding(mesh, P())
+batch = {
+    k: jax.make_array_from_process_local_data(
+        data_sh, v[pid * 4:(pid + 1) * 4]
+    )
+    for k, v in g_batch.items()
+}
+
+with jax.sharding.set_mesh(mesh):
+    state = create_train_state(
+        model, cfg, jax.random.key(0),
+        jnp.asarray(g_batch["image"][:1]),
+        jnp.asarray(g_batch["exemplars"][:1]),
+        steps_per_epoch=10,
+    )
+    state = state.replace(
+        params=jax.device_put(state.params, repl_sh)
+    )
+    step = jax.jit(make_train_step(model, cfg))
+    state, losses = step(state, batch)
+    jax.block_until_ready(state.params)
+loss = float(losses["loss"])  # replicated scalar, same on every process
+assert np.isfinite(loss), loss
+
+# the MapReduce shuffle replacement crossing the process boundary:
+# per-device stat partials psum'd over 'data' (parallel/mapreduce.py)
+stats = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")),
+    np.full((4, 4, 5), float(pid + 1), np.float32),
+)
+reduce = jax.jit(jax.shard_map(
+    lambda t: allreduce_stats(t, "data"), mesh=mesh,
+    in_specs=P("data"), out_specs=P("data"), check_vma=False,
+))
+total = reduce(stats)
+# 4 rows of 1.0 (proc 0) + 4 rows of 2.0 (proc 1), psum'd everywhere
+want = 4.0 * 1 + 4.0 * 2
+local = np.asarray(
+    [s.data for s in total.addressable_shards][0]
+)
+np.testing.assert_allclose(local[0, 0], np.full(5, want))
+
+# the eval rendezvous barrier (train/loop.py:_finish_eval multihost path)
+from jax.experimental import multihost_utils  # noqa: E402
+
+multihost_utils.sync_global_devices("mh_smoke")
+print(f"MH_OK {loss:.6f} {float(local[0, 0, 0]):.1f}", flush=True)
