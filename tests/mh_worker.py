@@ -110,8 +110,52 @@ local = np.asarray(
 )
 np.testing.assert_allclose(local[0, 0], np.full(5, want))
 
-# the eval rendezvous barrier (train/loop.py:_finish_eval multihost path)
+# the full eval rendezvous (train/loop.py:_finish_eval): every process
+# writes per-image JSONs for ITS images into the shared logpath, barrier,
+# process 0 merges them into COCO gts/preds files, barrier, then EVERY
+# process computes the metrics from the merged files (the reference's
+# filesystem-as-IPC protocol, trainer.py:181-199) — results must agree.
 from jax.experimental import multihost_utils  # noqa: E402
 
-multihost_utils.sync_global_devices("mh_smoke")
-print(f"MH_OK {loss:.6f} {float(local[0, 0, 0]):.1f}", flush=True)
+from tmr_tpu.utils.metrics import (  # noqa: E402
+    coco_style_annotation_generator,
+    get_ap_scores,
+    get_mae_rmse,
+    image_info_collector,
+)
+
+logpath = sys.argv[4]
+meta = [
+    {
+        "img_name": f"im{pid}.jpg", "img_url": f"im{pid}.jpg",
+        "img_id": pid + 1, "img_size": (64, 64),
+        "orig_boxes": np.asarray(
+            [[8.0, 8.0, 24.0, 24.0], [40.0, 40.0, 56.0, 56.0]]
+        ),
+        "orig_exemplars": np.asarray([[8.0, 8.0, 24.0, 24.0]]),
+    }
+]
+dets = [
+    {
+        # each process predicts ITS image's first GT box exactly
+        "boxes": np.asarray([[8 / 64, 8 / 64, 24 / 64, 24 / 64]]),
+        "scores": np.asarray([0.9]),
+        "refs": np.asarray([[16 / 64, 16 / 64]]),
+    }
+]
+image_info_collector(logpath, "test", meta, dets)
+multihost_utils.sync_global_devices("mh_eval_pre_merge")
+if jax.process_index() == 0:
+    coco_style_annotation_generator(logpath, "test")
+multihost_utils.sync_global_devices("mh_eval_post_merge")
+mae, rmse = get_mae_rmse(logpath, "test")
+ap, ap50, ap75 = get_ap_scores(logpath, "test")
+# 2 images x 2 GTs, 1 exact-hit pred each: MAE = 1, AP50 = 101-pt half
+# recall with perfect precision = (51/101) * 100
+assert abs(mae - 1.0) < 1e-9, mae
+assert abs(ap50 - 100 * 51 / 101) < 1e-6, ap50
+
+print(
+    f"MH_OK {loss:.6f} {float(local[0, 0, 0]):.1f} {mae:.3f} {ap50:.3f}",
+    flush=True,
+)

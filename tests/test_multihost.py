@@ -5,10 +5,12 @@ spawns TWO OS processes that rendezvous through
 ``parallel/mesh.initialize_multihost`` (jax.distributed + Gloo — the DCN
 transport stand-in available on CPU) and run, across the process boundary:
 the data-parallel train step on a global mesh (4 local devices each, 8
-global), the MapReduce shuffle-replacement ``allreduce_stats`` psum, and
-the eval-rendezvous barrier. The reference's multi-node story is Hadoop
-job submission + Lightning DDP; this is its TPU-native equivalent
-actually crossing processes.
+global), the MapReduce shuffle-replacement ``allreduce_stats`` psum, and the FULL
+eval rendezvous — per-process per-image JSONs, barrier, process-0 COCO
+merge, barrier, every process computing identical metrics from the merged
+files (the reference's filesystem-as-IPC protocol, trainer.py:181-199).
+The reference's multi-node story is Hadoop job submission + Lightning
+DDP; this is its TPU-native equivalent actually crossing processes.
 """
 
 import os
@@ -30,7 +32,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_train_step_and_stats_psum():
+def test_two_process_train_step_and_stats_psum(tmp_path):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {
         k: v for k, v in os.environ.items()
@@ -39,7 +41,8 @@ def test_two_process_train_step_and_stats_psum():
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            [sys.executable, WORKER, coordinator, "2", str(pid),
+             str(tmp_path / "logs")],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
