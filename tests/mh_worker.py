@@ -155,7 +155,76 @@ ap, ap50, ap75 = get_ap_scores(logpath, "test")
 assert abs(mae - 1.0) < 1e-9, mae
 assert abs(ap50 - 100 * 51 / 101) < 1e-6, ap50
 
+# cross-HOST pipeline parallelism and ring attention: a 2-device mesh
+# whose devices live in DIFFERENT processes (one local, one remote), so
+# the GPipe activation rotation and the ring K/V rotation both ppermute
+# across the process boundary over the Gloo transport.
+from tmr_tpu.parallel.pipeline import pipeline_vit_apply  # noqa: E402
+from tmr_tpu.parallel.ring import (  # noqa: E402
+    dense_attention,
+    ring_attention,
+)
+from jax.sharding import Mesh  # noqa: E402
+
+cross = Mesh(
+    np.array([jax.devices()[0], jax.devices()[4]]), ("pipe",)
+)
+assert (
+    cross.devices.flatten()[0].process_index
+    != cross.devices.flatten()[1].process_index
+), "mesh must span both processes"
+
+pvit = SamViT(embed_dim=32, depth=4, num_heads=2, global_attn_indexes=(1, 3),
+              patch_size=8, window_size=3, out_chans=16,
+              pretrain_img_size=32)
+px_host = np.random.default_rng(3).standard_normal((2, 32, 32, 3)).astype(
+    np.float32
+)
+pparams = jax.jit(pvit.init)(jax.random.key(2), jnp.asarray(px_host))[
+    "params"
+]
+want_pp = pvit.apply({"params": pparams}, jnp.asarray(px_host))
+repl_cross = NamedSharding(cross, P())
+px = jax.make_array_from_process_local_data(repl_cross, px_host)
+pparams_c = jax.device_put(pparams, repl_cross)
+got_pp = jax.jit(
+    lambda p, v: pipeline_vit_apply(pvit, p, v, cross, microbatches=2)
+)(pparams_c, px)
+got_local = np.asarray(got_pp.addressable_shards[0].data)
+np.testing.assert_allclose(
+    got_local, np.asarray(want_pp), rtol=2e-4, atol=2e-4
+)
+
+# same cross-process device pair, 'seq' axis for the ring semantics
+ring_mesh = Mesh(cross.devices, ("seq",))
+rng_r = np.random.default_rng(4)
+qkv_host = [
+    rng_r.standard_normal((1, 2, 16, 8)).astype(np.float32)
+    for _ in range(3)
+]
+want_ring = dense_attention(*(jnp.asarray(a) for a in qkv_host))
+seq_spec = P(None, None, "seq", None)
+qkv = [
+    jax.make_array_from_process_local_data(
+        NamedSharding(ring_mesh, seq_spec),
+        a[:, :, (pid * 8):(pid * 8 + 8)],
+    )
+    for a in qkv_host
+]
+ring = jax.jit(jax.shard_map(
+    lambda q, k, v: ring_attention(q, k, v, "seq"), mesh=ring_mesh,
+    in_specs=(seq_spec,) * 3, out_specs=seq_spec, check_vma=False,
+))
+got_ring = ring(*qkv)
+ring_local = np.asarray(got_ring.addressable_shards[0].data)
+np.testing.assert_allclose(
+    ring_local,
+    np.asarray(want_ring)[:, :, (pid * 8):(pid * 8 + 8)],
+    rtol=2e-4, atol=2e-5,
+)
+
 print(
-    f"MH_OK {loss:.6f} {float(local[0, 0, 0]):.1f} {mae:.3f} {ap50:.3f}",
+    f"MH_OK {loss:.6f} {float(local[0, 0, 0]):.1f} {mae:.3f} {ap50:.3f} "
+    "pp+ring-cross-host",
     flush=True,
 )
