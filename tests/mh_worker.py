@@ -1,10 +1,14 @@
 """Worker for the 2-process multi-host smoke test (test_multihost.py).
 
-Run as: python mh_worker.py <coordinator> <num_processes> <process_id>.
-Each process contributes 4 virtual CPU devices (8 global); collectives
-cross the process boundary over jax.distributed's Gloo transport — the
-DCN stand-in this image allows. Prints MH_OK <loss> <stats_sum> on
-success; any divergence raises.
+Run as: python mh_worker.py <coordinator> <num_processes> <process_id>
+<shared_logpath>. Each process contributes 4 virtual CPU devices (8
+global); collectives cross the process boundary over jax.distributed's
+Gloo transport — the DCN stand-in this image allows. On success prints
+one line, identical across processes:
+
+    MH_OK <loss> <stats_sum> <mae> <ap50> pp+ring-cross-host
+
+any divergence or failed assertion raises instead.
 """
 
 import os
