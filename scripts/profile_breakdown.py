@@ -32,6 +32,13 @@ SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
 CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 10))
 
 
+def _progress(msg: str) -> None:
+    """Stage marker on stderr, flushed: cold-cache compiles over the tunnel
+    take tens of minutes end-to-end, and without these lines a slow run is
+    indistinguishable from a wedged one."""
+    print(f"[profile] {msg}", file=sys.stderr, flush=True)
+
+
 def _rtt() -> float:
     from tmr_tpu.utils.profiling import measure_rtt_floor
 
@@ -58,6 +65,7 @@ def main():
         compute_dtype="bfloat16", batch_size=BATCH,
     )
     pred = Predictor(cfg)
+    _progress("init_params (jitted init)")
     pred.init_params(seed=0, image_size=SIZE)
     params = pred.params
     rng = np.random.default_rng(0)
@@ -67,19 +75,24 @@ def main():
     exemplars = jnp.tile(
         jnp.asarray([[[0.45, 0.45, 0.53, 0.55]]], jnp.float32), (BATCH, 1, 1)
     )
+    _progress("measuring rtt floor")
     rtt = _rtt()
     report = {"rtt_floor_ms": round(rtt * 1000, 1)}
 
     # 1. full fused program (the production pipeline via its bench hook)
+    _progress("stage 1: full fused program")
     fused = pred._get_fn(17, chain_feedback=True)
     report["full_program"] = chained(
         lambda im, ex, fb: fused(params, None, im, ex, fb),
         image, exemplars, rtt=rtt,
     )
+    _progress(f"full_program: {report['full_program']*1000:.2f} ms")
 
     # 2. backbone alone (chained through the feature sum)
     bb = pred.model.backbone
     bb_params = params["backbone"]
+
+    _progress("stage 2: backbone alone")
 
     @jax.jit
     def bb_step(p, im, fb):
@@ -89,6 +102,7 @@ def main():
     report["backbone"] = chained(
         lambda im, fb: bb_step(bb_params, im, fb), image, rtt=rtt
     )
+    _progress(f"backbone: {report['backbone']*1000:.2f} ms")
 
     # 3. one global vs one windowed transformer block (768-d, real grid),
     # plus the A/B windowed variant with the bias folded into QK
@@ -111,6 +125,7 @@ def main():
     prev_win = os.environ.get("TMR_WIN_ATTN")
     try:
         for label, win, win_impl in cases:
+            _progress(f"stage 3: {label}")
             os.environ["TMR_WIN_ATTN"] = win_impl
             blk = Block(num_heads=12, window_size=win,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
@@ -124,6 +139,7 @@ def main():
             report[label] = chained(
                 lambda x, fb: blk_step(bp, x, fb), tokens, rtt=rtt
             )
+            _progress(f"{label}: {report[label]*1000:.2f} ms")
     finally:
         _restore(prev_win, "TMR_WIN_ATTN")
 
@@ -138,11 +154,17 @@ def main():
     )
     ex0 = exemplars[:, 0, :]
     prev_xc = os.environ.get("TMR_XCORR_IMPL")
+    prev_pr = os.environ.get("TMR_XCORR_PRECISION")
     try:
-        for cap, impl in (
-            (17, "conv"), (17, "vmap"), (17, "fft"), (127, "auto")
+        for cap, impl, prec in (
+            (17, "conv", "highest"), (17, "conv", "default"),
+            (17, "conv", "bf16"), (17, "vmap", "highest"),
+            (17, "vmap", "bf16"), (17, "fft", "highest"),
+            (127, "auto", "highest"),
         ):
+            _progress(f"stage 4: xcorr cap={cap} impl={impl} prec={prec}")
             os.environ["TMR_XCORR_IMPL"] = impl
+            os.environ["TMR_XCORR_PRECISION"] = prec
 
             @jax.jit
             def xc_step(f, e, fb):
@@ -150,11 +172,15 @@ def main():
                 return y, jnp.sum(y) * 0.0
 
             label = f"xcorr_cap{cap}" + ("" if impl == "auto" else f"_{impl}")
+            if prec != "highest":
+                label += f"_{prec}"
             report[label] = chained(
                 lambda f, e, fb: xc_step(f, e, fb), proj, ex0, rtt=rtt
             )
+            _progress(f"{label}: {report[label]*1000:.2f} ms")
     finally:
         _restore(prev_xc, "TMR_XCORR_IMPL")
+        _restore(prev_pr, "TMR_XCORR_PRECISION")
 
     # 5. decode + NMS tail in isolation (objectness/regressions -> boxes),
     # via the Predictor's own _decode/_refine_nms so config flags (box_reg,
@@ -170,6 +196,8 @@ def main():
     reg = jnp.abs(jnp.asarray(
         rng.standard_normal((BATCH, up_hw, up_hw, 4)), jnp.float32
     ))
+
+    _progress("stage 5: decode+NMS tail")
 
     @jax.jit
     def tail_step(o, r, e, fb):

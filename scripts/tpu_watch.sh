@@ -43,7 +43,10 @@ while true; do
         && ! grep -q '"error"' "$OUT/bench_live.json" 2>/dev/null; then
       cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
     fi
-    timeout 2400 python scripts/profile_breakdown.py \
+    # 2400 was not enough cold-cache: a 30-min run on 2026-07-31 was killed
+    # mid-compile with zero stages done (the persistent cache makes reruns
+    # cumulative, but budget for the worst case)
+    timeout 5400 python scripts/profile_breakdown.py \
       >"$OUT/profile_live.json" 2>>"$LOG"
     log "profile_breakdown rc=$? -> $OUT/profile_live.json"
     # trained-weights headline: quickstart-train the bench model, then

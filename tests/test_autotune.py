@@ -10,7 +10,8 @@ import pytest
 from tmr_tpu.config import preset
 from tmr_tpu.utils import autotune as at
 
-KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN")
+KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN",
+         "TMR_XCORR_PRECISION")
 
 
 @pytest.fixture
@@ -64,6 +65,7 @@ def test_autotune_picks_min_and_exports_env(clean_knobs, monkeypatch):
 def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setenv("TMR_XCORR_IMPL", "conv")
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    monkeypatch.setenv("TMR_XCORR_PRECISION", "highest")
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
     called = []
@@ -72,6 +74,9 @@ def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     )
     monkeypatch.setattr(
         at, "pick_win_attn_impl", lambda *a, **k: called.append("w") or {}
+    )
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision", lambda *a, **k: called.append("p") or {}
     )
     assert at.autotune(_cfg(), 1024, 4) == {}
     assert called == []
@@ -113,6 +118,56 @@ def test_microbenchmarks_run_and_time_all_variants(clean_knobs):
     assert all(v > 0 for v in tw.values())
     assert "TMR_XCORR_IMPL" not in os.environ  # knobs restored
     assert "TMR_WIN_ATTN" not in os.environ
+
+
+def test_autotune_precision_stage_flips_only_on_decisive_win(
+    clean_knobs, monkeypatch
+):
+    """The TMR_XCORR_PRECISION sweep runs on the winning small-bucket impl
+    and only leaves the reference-parity 'highest' when a variant wins by
+    >10% (changed numerics need a decisive speedup); an fft winner skips
+    the sweep entirely (the FFT path is f32 regardless)."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
+    )
+    monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    swept = []
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: swept.append(1) or {
+            "highest": 0.010, "default": 0.0095, "bf16": 0.0092
+        },
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    # best (bf16, 8% faster) is under the 10% bar -> parity precision stays
+    assert swept and r["TMR_XCORR_PRECISION"]["picked"] == "highest"
+    assert os.environ["TMR_XCORR_PRECISION"] == "highest"
+
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setenv("TMR_AUTOTUNE_FORCE", "1")
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: {"highest": 0.010, "default": 0.004, "bf16": 0.006},
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "default"
+    assert os.environ["TMR_XCORR_PRECISION"] == "default"
+
+    # fft winner: no sweep, cache records the f32 no-op
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.03, "vmap": 0.05, "fft": 0.01},
+    )
+    boom = lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept"))
+    monkeypatch.setattr(at, "pick_xcorr_precision", boom)
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "highest"
 
 
 def test_autotune_cache_persists_winners_across_processes(

@@ -318,6 +318,51 @@ def test_cross_correlation_impl_variants_agree(impl, monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("impl", ["conv", "vmap"])
+@pytest.mark.parametrize("prec", ["default", "bf16"])
+def test_cross_correlation_precision_variants_close(impl, prec, monkeypatch):
+    """TMR_XCORR_PRECISION relaxes the conv paths' MXU precision for
+    hardware A/B profiling (ops/xcorr.py; the reference correlation is true
+    f32, template_matching.py:23-41). 'default' is numerically identical on
+    CPU and only changes the TPU pass count; 'bf16' rounds the operands, so
+    it must stay within bf16 input-rounding distance of the f32 result and
+    must preserve the output dtype."""
+    B, C, H, W = 2, 4, 24, 20
+    cap = 9
+    feat = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    sizes = [(5, 7), (9, 3)]
+    templates = np.zeros((B, C, cap, cap), np.float32)
+    for b, (ht, wt) in enumerate(sizes):
+        oy, ox = (cap - ht) // 2, (cap - wt) // 2
+        templates[b, :, oy : oy + ht, ox : ox + wt] = RNG.standard_normal(
+            (C, ht, wt)
+        ).astype(np.float32)
+    thw = jnp.array(sizes, jnp.int32)
+
+    monkeypatch.setenv("TMR_XCORR_IMPL", impl)
+    monkeypatch.delenv("TMR_XCORR_PRECISION", raising=False)
+    want = ops.cross_correlation(jnp.array(feat), jnp.array(templates), thw)
+    monkeypatch.setenv("TMR_XCORR_PRECISION", prec)
+    got = ops.cross_correlation(jnp.array(feat), jnp.array(templates), thw)
+    assert got.dtype == want.dtype == jnp.float32
+    # 'default' is bit-identical on CPU but a single bf16 MXU pass on TPU,
+    # so both relaxed values get bf16-rounding tolerance there
+    if prec == "bf16" or jax.default_backend() == "tpu":
+        tol = dict(rtol=3e-2, atol=3e-2)
+    else:
+        tol = dict(rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **tol)
+
+
+def test_cross_correlation_precision_invalid_raises(monkeypatch):
+    monkeypatch.setenv("TMR_XCORR_PRECISION", "fp8")
+    with pytest.raises(ValueError, match="TMR_XCORR_PRECISION"):
+        ops.cross_correlation(
+            jnp.zeros((1, 2, 8, 8)), jnp.zeros((1, 2, 3, 3)),
+            jnp.array([[3, 3]], jnp.int32),
+        )
+
+
 # ---- hand-derived RoIAlign cases (VERDICT r3 weak #7) ----------------------
 # torchvision.ops.roi_align is absent in this image, and roi_align_np is a
 # builder-written port — so these expected values are computed BY HAND from

@@ -211,6 +211,25 @@ def cross_correlation(
     # threshold, fft above. All are exactness-tested against each other
     # (tests/test_ops.py).
     impl = os.environ.get("TMR_XCORR_IMPL", "auto")
+    # TMR_XCORR_PRECISION selects the conv/vmap paths' MXU precision (read
+    # at trace time, A/B-measurable like the impl knobs): "highest" = the
+    # parity default (f32 via multi-pass bf16 emulation on TPU — 3-6 MXU
+    # passes per conv); "default" = single-pass; "bf16" = cast the operands
+    # to bfloat16 and accumulate in f32 (one MXU pass, f32 result). The
+    # reference's torch conv2d is true f32 (template_matching.py:23-41), so
+    # "highest" stays the default until hardware measurement justifies the
+    # flip; scores feed ranking/thresholding, where bf16 input rounding
+    # (~1e-2 rel) is far below the NMS/threshold decision scale. The FFT
+    # path is f32 either way.
+    prec_name = os.environ.get("TMR_XCORR_PRECISION", "highest")
+    if prec_name not in ("highest", "default", "bf16"):
+        raise ValueError(
+            f"TMR_XCORR_PRECISION={prec_name!r}: expected highest|default|bf16"
+        )
+    conv_prec = (
+        lax.Precision.HIGHEST if prec_name == "highest"
+        else lax.Precision.DEFAULT
+    )
     # TMR_XCORR_IMPL_SMALL: the autotuner's measured winner for SMALL
     # buckets only (utils/autotune.py) — scoped below the threshold so a
     # capacity-17 winner can never drag the 127/191 buckets off the FFT
@@ -230,6 +249,14 @@ def cross_correlation(
         b = f.shape[0]
         if impl == "fft":
             return _xcorr_fft(f, t)
+        in_dtype = f.dtype
+        if prec_name == "bf16":
+            f = f.astype(jnp.bfloat16)
+            t = t.astype(jnp.bfloat16)
+        # keep the f32 MXU accumulator in the result (the codebase's bf16-
+        # matmul convention, e.g. models/vit.py): without this the conv
+        # output would round to bf16 before the upcast below
+        acc = jnp.float32 if prec_name == "bf16" else None
         if impl == "vmap":
             def one(fi, ti):  # fi: (C, H, W), ti: (C, T, T)
                 return lax.conv_general_dilated(
@@ -239,10 +266,11 @@ def cross_correlation(
                     padding=[(T // 2, T // 2), (T // 2, T // 2)],
                     feature_group_count=C,
                     dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                    precision=lax.Precision.HIGHEST,
+                    precision=conv_prec,
+                    preferred_element_type=acc,
                 )[0]
 
-            return jax.vmap(one)(f, t)
+            return jax.vmap(one)(f, t).astype(in_dtype)
         lhs = f.reshape(1, b * C, H, W)
         rhs = t.reshape(b * C, 1, T, T)
         return lax.conv_general_dilated(
@@ -252,8 +280,9 @@ def cross_correlation(
             padding=[(T // 2, T // 2), (T // 2, T // 2)],
             feature_group_count=b * C,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            precision=lax.Precision.HIGHEST,
-        ).reshape(b, C, H, W)
+            precision=conv_prec,
+            preferred_element_type=acc,
+        ).reshape(b, C, H, W).astype(in_dtype)
 
     am = jax.sharding.get_abstract_mesh()
     if (

@@ -29,15 +29,18 @@ from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
 XCORR_VARIANTS = ("conv", "vmap", "fft")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
+XCORR_PRECISIONS = ("highest", "default", "bf16")
 
 
-def pick_xcorr_impl(
-    batch: int, emb_dim: int, hw: int, capacity: int,
-    rtt: Optional[float] = None,
-    log: Callable[[str], None] = lambda s: None,
+def _sweep_xcorr_env(
+    env_var: str, variants, batch: int, emb_dim: int, hw: int, capacity: int,
+    rtt: Optional[float], log: Callable[[str], None],
+    skip=(),
 ) -> Dict[str, float]:
-    """Time every correlation lowering at the production matcher shape.
-    Returns {variant: sec/iter}; caller picks min."""
+    """Shared microbenchmark harness for the trace-time xcorr knobs: pin
+    ``env_var`` to each variant, jit one correlation at the production
+    matcher shape, time it chained. One harness for both sweeps so the step
+    function / staging / failure handling can never diverge between them."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -52,10 +55,12 @@ def pick_xcorr_impl(
                   (batch, 1))
     rtt = measure_rtt_floor() if rtt is None else rtt
     times: Dict[str, float] = {}
-    prev = os.environ.get("TMR_XCORR_IMPL")
+    prev = os.environ.get(env_var)
     try:
-        for impl in XCORR_VARIANTS:
-            os.environ["TMR_XCORR_IMPL"] = impl
+        for variant in variants:
+            if variant in skip:
+                continue
+            os.environ[env_var] = variant
 
             @jax.jit
             def step(f, e, fb):
@@ -63,11 +68,51 @@ def pick_xcorr_impl(
                 return y, jnp.sum(y) * 0.0
 
             try:
-                times[impl] = chained_seconds_per_iter(step, feat, ex, rtt=rtt)
+                times[variant] = chained_seconds_per_iter(
+                    step, feat, ex, rtt=rtt
+                )
             except Exception as e:  # failed variant = not chosen, but say so
-                log(f"autotune: xcorr[{impl}] failed: {type(e).__name__}: {e}")
+                log(f"autotune: {env_var}[{variant}] failed: "
+                    f"{type(e).__name__}: {e}")
     finally:
-        _restore(prev, "TMR_XCORR_IMPL")
+        _restore(prev, env_var)
+    return times
+
+
+def pick_xcorr_impl(
+    batch: int, emb_dim: int, hw: int, capacity: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time every correlation lowering at the production matcher shape.
+    Returns {variant: sec/iter}; caller picks min."""
+    return _sweep_xcorr_env(
+        "TMR_XCORR_IMPL", XCORR_VARIANTS, batch, emb_dim, hw, capacity,
+        rtt, log,
+    )
+
+
+def pick_xcorr_precision(
+    batch: int, emb_dim: int, hw: int, capacity: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+    seed_highest: Optional[float] = None,
+) -> Dict[str, float]:
+    """Time the small-bucket correlation at each TMR_XCORR_PRECISION value
+    under the CURRENTLY exported impl knobs (run after the impl sweep so the
+    precision is measured on the winning formulation). "highest" is f32 via
+    multi-pass bf16 emulation on the MXU (ops/xcorr.py) — on TPU the other
+    two can win big; semantics differ only by f32/bf16 rounding.
+    ``seed_highest`` injects the impl sweep's timing of the winner (the
+    identical program at the default "highest" precision) instead of
+    re-measuring it. Returns {precision: sec/iter}; caller picks min."""
+    times = _sweep_xcorr_env(
+        "TMR_XCORR_PRECISION", XCORR_PRECISIONS, batch, emb_dim, hw,
+        capacity, rtt, log,
+        skip=("highest",) if seed_highest is not None else (),
+    )
+    if seed_highest is not None:
+        times["highest"] = seed_highest
     return times
 
 
@@ -143,6 +188,7 @@ def _cache_load() -> Dict[str, dict]:
     valid = {
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
+        "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
     }
     # per-knob filtering: one invalid/unknown winner drops only itself —
     # the valid sibling survives (and all-or-nothing would let the next
@@ -231,11 +277,14 @@ def autotune(
         and "TMR_XCORR_IMPL_SMALL" not in os.environ
     )
     want_attn = "TMR_WIN_ATTN" not in os.environ and vit_kind is not None
+    want_prec = "TMR_XCORR_PRECISION" not in os.environ
     wanted = set()
     if want_xcorr:
         wanted.add("TMR_XCORR_IMPL_SMALL")
     if want_attn:
         wanted.add("TMR_WIN_ATTN")
+    if want_prec:
+        wanted.add("TMR_XCORR_PRECISION")
     if not wanted:
         return report  # everything pinned: skip even the rtt round trip
     if cached and wanted <= set(cached):
@@ -259,6 +308,55 @@ def autotune(
             os.environ["TMR_XCORR_IMPL_SMALL"] = best
             report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
             log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
+
+    if want_prec:
+        # sweep AFTER the impl pick so precision is measured on the winning
+        # small-bucket formulation. Resolve the active small-bucket impl
+        # exactly the way ops/xcorr.py dispatches it: explicit
+        # TMR_XCORR_IMPL, else the SMALL knob (just exported above or
+        # user-pinned), else the conv default.
+        active = os.environ.get("TMR_XCORR_IMPL", "auto")
+        if active == "auto":
+            active = os.environ.get("TMR_XCORR_IMPL_SMALL", "conv")
+        if active == "auto":
+            active = "conv"
+        if active == "fft":
+            # the FFT path is f32 regardless; record the no-op so the cache
+            # entry is complete and later runs skip the sweep
+            report["TMR_XCORR_PRECISION"] = {"picked": "highest",
+                                             "times": {}}
+            os.environ["TMR_XCORR_PRECISION"] = "highest"
+        else:
+            # the impl sweep already timed this exact program at "highest"
+            # (the knob was unset during it): reuse that number instead of
+            # paying a third compile+timing round over the tunnel
+            seed = None
+            xc = report.get("TMR_XCORR_IMPL_SMALL")
+            if xc and xc.get("times", {}).get(active) is not None:
+                seed = xc["times"][active]
+            times = pick_xcorr_precision(
+                batch, cfg.emb_dim, up_hw, 17, rtt=rtt, log=log,
+                seed_highest=seed,
+            )
+            base = times.get("highest")
+            if times and base is not None:
+                best = min(times, key=times.get)
+                if times[best] > 0.9 * base:
+                    # <10% win: keep the reference-parity f32 precision —
+                    # only a decisive speedup justifies changed numerics
+                    best = "highest"
+                os.environ["TMR_XCORR_PRECISION"] = best
+                report["TMR_XCORR_PRECISION"] = {"picked": best,
+                                                 "times": times}
+                log(f"autotune: TMR_XCORR_PRECISION={best} {times}")
+            elif times:
+                # no parity baseline measured -> no justified flip: stay on
+                # the f32 default rather than export unverified numerics
+                os.environ["TMR_XCORR_PRECISION"] = "highest"
+                report["TMR_XCORR_PRECISION"] = {"picked": "highest",
+                                                 "times": times}
+                log("autotune: TMR_XCORR_PRECISION=highest "
+                    f"(no 'highest' baseline in {times})")
 
     if want_attn:
         vc = VIT_CONFIGS[vit_kind]
