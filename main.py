@@ -193,7 +193,10 @@ def main(argv=None):
         else:
             dp = mesh.shape.get("data", 1) if mesh is not None else 1
             tune_batch = max(cfg.batch_size // max(dp, 1), 1)
-        autotune(cfg, cfg.image_size, tune_batch, log=log_info)
+        # precision relaxation is justified for inference score ranking
+        # only — training must not inherit bf16-rounded matcher gradients
+        autotune(cfg, cfg.image_size, tune_batch, log=log_info,
+                 tune_precision=bool(cfg.eval))
 
     trainer = Trainer(cfg, mesh=mesh)
     if cfg.eval:

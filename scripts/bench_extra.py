@@ -125,6 +125,30 @@ def _fused_eval_step(cfg, capacity, image_size, refiner=None,
     return step, pred.params, image, ex
 
 
+def bench_batch_sweep() -> dict:
+    """Throughput vs batch size for the headline config (ViT-B @ 1024,
+    fused eval). bench.py's headline batch (4) was an engineering guess;
+    this measures img/s at 1, 2, 8 and 16 so the throughput-optimal batch
+    is a recorded number, not a default. Skips a batch on OOM/compile
+    failure rather than dying (16 at 1024^2 can exceed a v5e's 16 GB)."""
+    from tmr_tpu.config import preset
+
+    out = {}
+    for batch in ((1, 2) if TINY else (1, 2, 8, 16)):
+        cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE,
+                     compute_dtype=DTYPE, batch_size=batch)
+        try:
+            step, params, image, ex = _fused_eval_step(cfg, 17, SIZE)
+            dt = _chain_time(step, N_ITER, params, image, ex)
+            out[f"batch{batch}"] = {
+                "img_per_sec": round(batch / dt, 3),
+                "ms_per_batch": round(dt * 1000, 2),
+            }
+        except Exception as e:
+            out[f"batch{batch}"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def bench_1536() -> dict:
     """The small-object escalation bucket (eval protocol: batch 1)."""
     from tmr_tpu.config import preset
@@ -286,6 +310,7 @@ def bench_stream() -> dict:
 
 ALL = {
     "demo": bench_demo,
+    "batch_sweep": bench_batch_sweep,
     "1536": bench_1536,
     "refine": bench_refine,
     "train": bench_train,

@@ -170,6 +170,73 @@ def test_autotune_precision_stage_flips_only_on_decisive_win(
     assert r["TMR_XCORR_PRECISION"]["picked"] == "highest"
 
 
+def test_autotune_tune_precision_false_skips_sweep(clean_knobs, monkeypatch):
+    """Training runs (main.py passes tune_precision=False) must not export
+    relaxed matcher numerics: the precision sweep never runs and the knob
+    is never set."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
+    )
+    monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    boom = lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept"))
+    monkeypatch.setattr(at, "pick_xcorr_precision", boom)
+    r = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert "TMR_XCORR_PRECISION" not in r
+    assert "TMR_XCORR_PRECISION" not in os.environ
+
+
+def test_autotune_cached_precision_is_impl_specific(clean_knobs, monkeypatch):
+    """A cached relaxed-precision winner was measured under one impl; a
+    later run with a DIFFERENT pinned impl must re-measure instead of
+    inheriting numerics whose decisive-win evidence does not transfer."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
+    )
+    monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: {"highest": 0.010, "default": 0.004, "bf16": 0.006},
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "default"  # won on conv
+
+    # same shapes, but the user pins a different impl: the cached 'default'
+    # winner (measured on conv) must NOT be exported for vmap
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setenv("TMR_XCORR_IMPL_SMALL", "vmap")
+    swept = []
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: swept.append(1) or {
+            "highest": 0.010, "default": 0.0099, "bf16": 0.0098
+        },
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    assert swept, "must re-measure under the newly pinned impl"
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "highest"  # <10% on vmap
+    assert os.environ["TMR_XCORR_PRECISION"] == "highest"
+
+    # with the SAME impl as measured, the cached winner exports directly
+    # (attention pinned: its sweep returned {} above so it was never cached)
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    boom = lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept"))
+    monkeypatch.setattr(at, "pick_xcorr_precision", boom)
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl", boom
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_IMPL_SMALL"] == {"picked": "conv", "cached": True}
+
+
 def test_autotune_cache_persists_winners_across_processes(
     clean_knobs, monkeypatch
 ):

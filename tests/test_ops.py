@@ -290,7 +290,7 @@ def test_masked_maxpool_and_peaks():
     np.testing.assert_array_equal(peaks, want)
 
 
-@pytest.mark.parametrize("impl", ["vmap", "fft"])
+@pytest.mark.parametrize("impl", ["vmap", "fft", "convnhwc"])
 def test_cross_correlation_impl_variants_agree(impl, monkeypatch):
     """TMR_XCORR_IMPL selects alternative correlation formulations for
     hardware A/B profiling; every variant must match the default grouped

@@ -238,8 +238,10 @@ def cross_correlation(
     for name, val in (
         ("TMR_XCORR_IMPL", impl), ("TMR_XCORR_IMPL_SMALL", small)
     ):
-        if val not in ("auto", "conv", "vmap", "fft"):
-            raise ValueError(f"{name}={val!r}: expected auto|conv|vmap|fft")
+        if val not in ("auto", "conv", "vmap", "fft", "convnhwc"):
+            raise ValueError(
+                f"{name}={val!r}: expected auto|conv|vmap|fft|convnhwc"
+            )
     if impl == "auto":
         impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
     if impl == "auto":  # "auto" as the small-bucket value = the conv default
@@ -257,6 +259,25 @@ def cross_correlation(
         # matmul convention, e.g. models/vit.py): without this the conv
         # output would round to bf16 before the upcast below
         acc = jnp.float32 if prec_name == "bf16" else None
+        if impl == "convnhwc":
+            # same grouped conv in the TPU-native activation layout: XLA:TPU
+            # canonicalizes NCHW convs by inserting layout transposes, so
+            # expressing the op as NHWC/HWIO directly lets the compiler skip
+            # them (the surrounding model is NHWC anyway; the matcher's NCHW
+            # is inherited from the reference's torch layout). Semantics
+            # identical to "conv" — A/B-measured, never assumed.
+            lhs = f.reshape(1, b * C, H, W).transpose(0, 2, 3, 1)
+            rhs = t.reshape(b * C, 1, T, T).transpose(2, 3, 1, 0)
+            return lax.conv_general_dilated(
+                lhs,
+                rhs,
+                window_strides=(1, 1),
+                padding=[(T // 2, T // 2), (T // 2, T // 2)],
+                feature_group_count=b * C,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=conv_prec,
+                preferred_element_type=acc,
+            ).transpose(0, 3, 1, 2).reshape(b, C, H, W).astype(in_dtype)
         if impl == "vmap":
             def one(fi, ti):  # fi: (C, H, W), ti: (C, T, T)
                 return lax.conv_general_dilated(

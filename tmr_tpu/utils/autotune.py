@@ -27,7 +27,7 @@ from typing import Callable, Dict, Optional
 
 from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
-XCORR_VARIANTS = ("conv", "vmap", "fft")
+XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 
@@ -160,6 +160,21 @@ def pick_win_attn_impl(
     return times
 
 
+def _active_small_impl(cached: Dict[str, str]) -> str:
+    """The impl the small-bucket correlation will actually dispatch to,
+    resolved the way ops/xcorr.py does: explicit TMR_XCORR_IMPL, else the
+    SMALL knob (env now, or the cached winner about to be exported), else
+    the conv default."""
+    active = os.environ.get("TMR_XCORR_IMPL", "auto")
+    if active == "auto":
+        active = os.environ.get(
+            "TMR_XCORR_IMPL_SMALL", cached.get("TMR_XCORR_IMPL_SMALL", "conv")
+        )
+    if active == "auto":
+        active = "conv"
+    return active
+
+
 def _restore(prev: Optional[str], name: str) -> None:
     if prev is None:
         os.environ.pop(name, None)
@@ -189,6 +204,9 @@ def _cache_load() -> Dict[str, dict]:
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
         "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
+        # metadata, not an env knob: which impl the precision winner was
+        # measured under (its decisive-win evidence is impl-specific)
+        "_precision_impl": set(XCORR_VARIANTS),
     }
     # per-knob filtering: one invalid/unknown winner drops only itself —
     # the valid sibling survives (and all-or-nothing would let the next
@@ -207,7 +225,9 @@ def _cache_load() -> Dict[str, dict]:
     return out
 
 
-def _cache_store(key: str, report: Dict[str, object]) -> None:
+def _cache_store(
+    key: str, report: Dict[str, object], extra: Optional[Dict[str, str]] = None
+) -> None:
     import json
 
     path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
@@ -219,6 +239,7 @@ def _cache_store(key: str, report: Dict[str, object]) -> None:
         cache[key] = {
             **cache.get(key, {}),
             **{k: v["picked"] for k, v in report.items()},
+            **(extra or {}),
         }
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -231,6 +252,7 @@ def _cache_store(key: str, report: Dict[str, object]) -> None:
 def autotune(
     cfg, image_size: int, batch: int,
     log: Callable[[str], None] = lambda s: None,
+    tune_precision: bool = True,
 ) -> Dict[str, object]:
     """Measure the variant sets at the production shapes of ``cfg`` and
     EXPORT the winners via their env knobs (os.environ, read by the modules
@@ -246,6 +268,11 @@ def autotune(
     Knobs the user already set explicitly are left untouched. Off-TPU this
     is a no-op (returns {}). Returns {knob: {"picked": ..., "times": ...}}
     (cached hits carry {"picked": ..., "cached": True} instead of times).
+
+    ``tune_precision=False`` skips the TMR_XCORR_PRECISION sweep entirely:
+    the decisive-win policy justifies relaxed numerics for inference score
+    ranking only — training runs (main.py) must not inherit bf16-rounded
+    matcher GRADIENTS from an eval-shape microbenchmark.
     """
     import jax
 
@@ -277,7 +304,7 @@ def autotune(
         and "TMR_XCORR_IMPL_SMALL" not in os.environ
     )
     want_attn = "TMR_WIN_ATTN" not in os.environ and vit_kind is not None
-    want_prec = "TMR_XCORR_PRECISION" not in os.environ
+    want_prec = tune_precision and "TMR_XCORR_PRECISION" not in os.environ
     wanted = set()
     if want_xcorr:
         wanted.add("TMR_XCORR_IMPL_SMALL")
@@ -287,6 +314,16 @@ def autotune(
         wanted.add("TMR_XCORR_PRECISION")
     if not wanted:
         return report  # everything pinned: skip even the rtt round trip
+    if (
+        cached.get("TMR_XCORR_PRECISION", "highest") != "highest"
+        and cached.get("_precision_impl") != _active_small_impl(cached)
+    ):
+        # the relaxed-precision winner was measured on a different impl
+        # (user pinned another one since): its decisive-win evidence does
+        # not transfer — fall through and re-measure rather than export
+        # unverified numerics
+        cached = {k: v for k, v in cached.items()
+                  if k != "TMR_XCORR_PRECISION"}
     if cached and wanted <= set(cached):
         # cached winners cover every wanted knob: export without measuring.
         # (A partial entry — e.g. one sweep failed when it was written —
@@ -315,11 +352,7 @@ def autotune(
         # exactly the way ops/xcorr.py dispatches it: explicit
         # TMR_XCORR_IMPL, else the SMALL knob (just exported above or
         # user-pinned), else the conv default.
-        active = os.environ.get("TMR_XCORR_IMPL", "auto")
-        if active == "auto":
-            active = os.environ.get("TMR_XCORR_IMPL_SMALL", "conv")
-        if active == "auto":
-            active = "conv"
+        active = _active_small_impl({})
         if active == "fft":
             # the FFT path is f32 regardless; record the no-op so the cache
             # entry is complete and later runs skip the sweep
@@ -369,5 +402,8 @@ def autotune(
             report["TMR_WIN_ATTN"] = {"picked": best, "times": times}
             log(f"autotune: TMR_WIN_ATTN={best} {times}")
     if report:
-        _cache_store(key, report)
+        extra = {}
+        if "TMR_XCORR_PRECISION" in report:
+            extra["_precision_impl"] = _active_small_impl({})
+        _cache_store(key, report, extra)
     return report
