@@ -76,19 +76,44 @@ def _progress(msg: str) -> None:
 
 
 def _emit_error(msg: str) -> None:
-    """The contract with the driver: ONE JSON line on stdout, no matter what."""
-    print(
-        json.dumps(
-            {
-                "metric": _metric(),
-                "value": 0.0,
-                "unit": "img/s",
-                "vs_baseline": 0.0,
-                "error": msg,
-            }
-        ),
-        flush=True,
-    )
+    """The contract with the driver: ONE JSON line on stdout, no matter what.
+
+    An outage record additionally carries the last COMMITTED live
+    measurement (BENCH_LIVE.json, captured by scripts/tpu_watch.sh when the
+    tunnel last served) under ``last_committed_live`` with its commit date —
+    clearly-labeled provenance, so a round-end wedge doesn't erase the
+    round's actual measured number from the driver's artifact."""
+    rec = {
+        "metric": _metric(),
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_LIVE.json")) as f:
+            live = json.load(f)
+        if isinstance(live, dict) and "error" not in live and live.get("value"):
+            date = subprocess.run(
+                ["git", "-C", here, "log", "-1", "--format=%cI", "--",
+                 "BENCH_LIVE.json"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+            dirty = subprocess.run(
+                ["git", "-C", here, "status", "--porcelain", "--",
+                 "BENCH_LIVE.json"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+            if dirty or not date:
+                # file differs from (or was never in) git: real measurement,
+                # but the commit date would misattribute it — say so instead
+                rec["last_live_uncommitted"] = live
+            else:
+                rec["last_committed_live"] = {**live, "committed_at": date}
+    except Exception:
+        pass  # the error record itself must never fail to print
+    print(json.dumps(rec), flush=True)
 
 
 def forward_tflops_per_image(

@@ -78,6 +78,16 @@ def test_bench_fast_failure_emits_error_line():
     assert "selftest" in rec["error"]
     for key in ("metric", "value", "unit", "vs_baseline", "error"):
         assert key in rec, key
+    # an outage record carries the last committed live measurement (with
+    # provenance) so a round-end wedge doesn't erase the round's number —
+    # asserted only when the repo actually has a real BENCH_LIVE.json
+    live_path = os.path.join(REPO, "BENCH_LIVE.json")
+    if os.path.exists(live_path):
+        with open(live_path) as f:
+            live = json.load(f)
+        if "error" not in live and live.get("value"):
+            assert rec["last_committed_live"]["value"] == live["value"]
+            assert "committed_at" in rec["last_committed_live"]
 
 
 def test_bench_restores_checkpoint(tmp_path):

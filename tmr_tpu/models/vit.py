@@ -32,11 +32,20 @@ from tmr_tpu.models.common import LayerNorm2d, MLPBlock
 
 
 def _WIN_ATTN_IMPL() -> str:
-    """Windowed-attention formulation, read at trace time: "dense" (default,
-    separate f32 bias einsums + adds), "folded" (bias inside the QK
-    contraction), or "flash" (Pallas kernel over 256-padded windows,
-    bf16/TPU only). A/B knob for hardware profiling — see Attention below."""
-    return os.environ.get("TMR_WIN_ATTN", "dense")
+    """Windowed-attention formulation, read at trace time: "dense" (separate
+    f32 bias einsums + adds), "folded" (bias inside the QK contraction), or
+    "flash" (Pallas kernel over 256-padded windows, bf16/TPU only). A/B knob
+    for hardware profiling — see Attention below.
+
+    Default: "flash" on TPU, "dense" elsewhere. Measured, not assumed: the
+    on-device autotune sweep picked flash at the production ViT-B/1024
+    shapes on TPU v5 lite (BENCH_LIVE.json, 2026-07-31, the repo's first
+    driver-grade measurement) — the VERDICT r3 "measured winners become the
+    defaults" mandate. Safe as a default: the flash path runs behind a
+    per-geometry compiled self-check with dense fallback (Attention below),
+    and the bf16/geometry gates mean non-TPU or f32 traces never take it."""
+    dflt = "flash" if jax.default_backend() == "tpu" else "dense"
+    return os.environ.get("TMR_WIN_ATTN", dflt)
 
 
 def _flash_window_available(gh: int, gw: int, head_dim: int) -> bool:

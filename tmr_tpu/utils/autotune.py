@@ -164,14 +164,18 @@ def _active_small_impl(cached: Dict[str, str]) -> str:
     """The impl the small-bucket correlation will actually dispatch to,
     resolved the way ops/xcorr.py does: explicit TMR_XCORR_IMPL, else the
     SMALL knob (env now, or the cached winner about to be exported), else
-    the conv default."""
+    the backend-dependent default (ops/xcorr.py small_impl_default — the
+    single source of truth, so this mirror can never drift from dispatch)."""
+    from tmr_tpu.ops.xcorr import small_impl_default
+
     active = os.environ.get("TMR_XCORR_IMPL", "auto")
     if active == "auto":
         active = os.environ.get(
-            "TMR_XCORR_IMPL_SMALL", cached.get("TMR_XCORR_IMPL_SMALL", "conv")
+            "TMR_XCORR_IMPL_SMALL",
+            cached.get("TMR_XCORR_IMPL_SMALL", small_impl_default()),
         )
     if active == "auto":
-        active = "conv"
+        active = small_impl_default()
     return active
 
 
