@@ -79,3 +79,46 @@ def test_stderr_protocol_format(capsys):
     assert "[INFO] hello" in err
     assert "[WARNING] careful" in err
     assert "[PROGRESS] 3/10" in err
+
+
+def test_xprof_top_ops_extracts_dominant_op(tmp_path):
+    """scripts/xprof_top_ops.py parses a jax.profiler trace without
+    TensorBoard and ranks ops by device time — on the CPU test backend the
+    op events land on the host plane (the tool's documented fallback), and
+    a repeated 512x512 matmul must dominate the table."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    gen = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import jax.numpy as jnp, numpy as np;"
+        "x = jnp.asarray(np.random.default_rng(0).standard_normal((512,512)),"
+        " jnp.float32);"
+        "f = jax.jit(lambda a: jnp.tanh(a @ a).sum()); f(x);"
+        "import jax.profiler;"
+        "ctx = jax.profiler.trace(r'%s');"
+        "ctx.__enter__();"
+        "[f(x).block_until_ready() for _ in range(5)];"
+        "ctx.__exit__(None, None, None)" % str(tmp_path / "trace")
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    r = subprocess.run([sys.executable, "-c", gen], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "xprof_top_ops.py"),
+         str(tmp_path / "trace"), "5"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["total_ms"] > 0
+    assert rec["top_ops"], rec
+    names = " ".join(op["name"] for op in rec["top_ops"])
+    assert "dot" in names, names
+    assert abs(sum(o["pct"] for o in rec["top_ops"]) ) <= 100.5

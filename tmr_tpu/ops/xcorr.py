@@ -256,8 +256,8 @@ def cross_correlation(
             )
     if impl == "auto":
         impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
-    if impl == "auto":  # "auto" as the small-bucket value = the conv default
-        impl = "conv"
+    if impl == "auto":  # "auto" as the small-bucket value = backend default
+        impl = small_impl_default()
     def _compute(f, t):
         # local-shape island: b == B globally, or B/n_data under shard_map
         b = f.shape[0]
