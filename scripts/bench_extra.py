@@ -128,24 +128,41 @@ def _fused_eval_step(cfg, capacity, image_size, refiner=None,
 def bench_batch_sweep() -> dict:
     """Throughput vs batch size for the headline config (ViT-B @ 1024,
     fused eval). bench.py's headline batch (4) was an engineering guess;
-    this measures img/s at 1, 2, 8 and 16 so the throughput-optimal batch
-    is a recorded number, not a default. Skips a batch on OOM/compile
-    failure rather than dying (16 at 1024^2 can exceed a v5e's 16 GB)."""
+    this measures img/s at 1, 2, 4, 8 and 16 so the throughput-optimal
+    batch is a recorded number, not a default. Skips a batch on OOM/compile
+    failure rather than dying (16 at 1024^2 can exceed a v5e's 16 GB).
+
+    On TPU the winner is persisted into the autotune winner cache as
+    TMR_BENCH_BATCH keyed by (device kind, image size): the next bench.py
+    on this machine defaults its headline batch to the measured optimum —
+    the same "measured winners become the defaults" mechanism as the
+    formulation knobs (explicit TMR_BENCH_BATCH always wins)."""
+    import jax
+
     from tmr_tpu.config import preset
+    from tmr_tpu.utils.autotune import _cache_store, bench_batch_cache_key
 
     out = {}
-    for batch in ((1, 2) if TINY else (1, 2, 8, 16)):
+    best = (None, -1.0)
+    for batch in ((1, 2) if TINY else (1, 2, 4, 8, 16)):
         cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE,
                      compute_dtype=DTYPE, batch_size=batch)
         try:
             step, params, image, ex = _fused_eval_step(cfg, 17, SIZE)
             dt = _chain_time(step, N_ITER, params, image, ex)
+            ips = batch / dt
             out[f"batch{batch}"] = {
-                "img_per_sec": round(batch / dt, 3),
+                "img_per_sec": round(ips, 3),
                 "ms_per_batch": round(dt * 1000, 2),
             }
+            if ips > best[1]:
+                best = (batch, ips)
         except Exception as e:
             out[f"batch{batch}"] = {"error": f"{type(e).__name__}: {e}"}
+    if best[0] is not None and jax.default_backend() == "tpu":
+        key = bench_batch_cache_key(jax.devices()[0].device_kind, SIZE)
+        _cache_store(key, {"TMR_BENCH_BATCH": {"picked": str(best[0])}})
+        out["cached_default"] = best[0]
     return out
 
 

@@ -48,29 +48,6 @@ while true; do
     # K=V tokens without spaces, which is what bench.py writes)
     tuned=""
     [ -f "$OUT/autotune.env" ] && tuned="$(grep -v '^#' "$OUT/autotune.env")"
-    # xprof capture: a SHORT traced bench (chain 3, winners reused from the
-    # headline's sweep) so trace overhead never pollutes the headline, then
-    # the op-level table the r3 verdict asked for. The raw trace stays in
-    # $OUT; only the extracted table is copied into the repo. Trace dir is
-    # cleared first and extraction is gated on a fresh successful traced
-    # bench — a stale trace must never be republished as live data.
-    rm -rf "$OUT/xprof"
-    env $tuned TMR_BENCH_CHAIN=3 TMR_BENCH_PROFILE="$OUT/xprof" \
-      TMR_BENCH_ALARM=2100 timeout 2400 python bench.py \
-      >"$OUT/bench_traced.json" 2>>"$LOG"
-    log "bench.py (traced, chain 3) rc=$? -> $OUT/bench_traced.json"
-    if grep -q '"value"' "$OUT/bench_traced.json" 2>/dev/null \
-        && ! grep -q '"error"' "$OUT/bench_traced.json" 2>/dev/null; then
-      python scripts/xprof_top_ops.py "$OUT/xprof" 15 \
-        >"$OUT/xprof_top_ops.json" 2>>"$LOG"
-      log "xprof_top_ops rc=$? -> $OUT/xprof_top_ops.json"
-      if ! grep -q '"error"' "$OUT/xprof_top_ops.json" 2>/dev/null; then
-        cp "$OUT/xprof_top_ops.json" "$REPO/XPROF_TOP_OPS_LIVE.json" \
-          2>/dev/null
-      fi
-    else
-      log "traced bench failed; skipping xprof extraction"
-    fi
     # 2400 was not enough cold-cache: a 30-min run on 2026-07-31 was killed
     # mid-compile with zero stages done (the persistent cache makes reruns
     # cumulative, but budget for the worst case)
@@ -100,6 +77,32 @@ while true; do
     timeout 3600 python scripts/bench_extra.py \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra rc=$? -> $OUT/bench_extra_live.json"
+    # traced bench runs LAST: jax.profiler over the axon transport is
+    # untested and a profiler-triggered wedge must not cost the rest of
+    # the battery.
+    # xprof capture: a SHORT traced bench (chain 3, winners reused from the
+    # headline's sweep) so trace overhead never pollutes the headline, then
+    # the op-level table the r3 verdict asked for. The raw trace stays in
+    # $OUT; only the extracted table is copied into the repo. Trace dir is
+    # cleared first and extraction is gated on a fresh successful traced
+    # bench — a stale trace must never be republished as live data.
+    rm -rf "$OUT/xprof"
+    env $tuned TMR_BENCH_CHAIN=3 TMR_BENCH_PROFILE="$OUT/xprof" \
+      TMR_BENCH_ALARM=2100 timeout 2400 python bench.py \
+      >"$OUT/bench_traced.json" 2>>"$LOG"
+    log "bench.py (traced, chain 3) rc=$? -> $OUT/bench_traced.json"
+    if grep -q '"value"' "$OUT/bench_traced.json" 2>/dev/null \
+        && ! grep -q '"error"' "$OUT/bench_traced.json" 2>/dev/null; then
+      python scripts/xprof_top_ops.py "$OUT/xprof" 15 \
+        >"$OUT/xprof_top_ops.json" 2>>"$LOG"
+      log "xprof_top_ops rc=$? -> $OUT/xprof_top_ops.json"
+      if ! grep -q '"error"' "$OUT/xprof_top_ops.json" 2>/dev/null; then
+        cp "$OUT/xprof_top_ops.json" "$REPO/XPROF_TOP_OPS_LIVE.json" \
+          2>/dev/null
+      fi
+    else
+      log "traced bench failed; skipping xprof extraction"
+    fi
     # informational: does local (terminal-side-off) compilation work? If so,
     # future rounds can avoid the compile-over-tunnel wedge class entirely.
     if PALLAS_AXON_REMOTE_COMPILE=0 timeout 300 python -u -c "

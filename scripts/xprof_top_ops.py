@@ -13,11 +13,13 @@ Prints ONE JSON line:
     {"device_plane": ..., "total_ms": ..., "top_ops": [
         {"name": ..., "count": ..., "total_ms": ..., "pct": ...}, ...]}
 
-Notes on semantics: durations are summed per metadata name over all lines
-of the busiest device plane, so concurrently-overlapping events (rare on a
-single TPU core's XLA Ops line) would double-count; percentages are of the
-plane's summed event time, not wall clock. Good enough to rank where the
-program's device time goes — the use this table serves.
+Notes on semantics: durations are aggregated per metadata name over the
+busiest SINGLE line of the chosen plane — device planes carry both an
+"XLA Modules" line (one event spanning each whole program execution) and an
+"XLA Ops" line (per-op events); summing lines would double-count and rank
+the module event first. Percentages are of that line's summed event time,
+not wall clock. Good enough to rank where the program's device time goes —
+the use this table serves.
 """
 
 from __future__ import annotations
@@ -70,18 +72,18 @@ def top_ops(trace_dir: str, n: int = 10) -> dict:
             if have_device_events and not plane.name.startswith("/device:"):
                 continue
             meta = {k: v.name for k, v in plane.event_metadata.items()}
-            agg = defaultdict(lambda: [0, 0.0])  # name -> [count, ps]
             for line in plane.lines:
+                agg = defaultdict(lambda: [0, 0.0])  # name -> [count, ps]
                 for ev in line.events:
                     name = meta.get(ev.metadata_id, str(ev.metadata_id))
                     a = agg[name]
                     a[0] += 1
                     a[1] += ev.duration_ps
-            total = sum(v[1] for v in agg.values())
-            if total > best_total:
-                best_total = total
-                best_plane = plane.name
-                best_events = agg
+                total = sum(v[1] for v in agg.values())
+                if total > best_total:
+                    best_total = total
+                    best_plane = f"{plane.name} [{line.name}]"
+                    best_events = agg
     if best_events is None or best_total <= 0:
         raise ValueError("no event-bearing plane in trace")
     ranked = sorted(best_events.items(), key=lambda kv: -kv[1][1])[:n]

@@ -337,3 +337,25 @@ def test_measured_tpu_defaults(monkeypatch):
     thw = jnp.array([[1, 1]], jnp.int32)
     got = xcorr_mod.cross_correlation(feat, tmpl, thw)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(feat))
+
+
+def test_cache_accepts_measured_batch_winner(clean_knobs):
+    """bench_extra's batch sweep persists TMR_BENCH_BATCH as a digit string
+    (bench.py defaults its headline batch to it); non-numeric or
+    non-positive values must be dropped by the cache validator."""
+    at._cache_store("v5e|bench_batch|1024", {
+        "TMR_BENCH_BATCH": {"picked": "8"},
+    })
+    assert at._cache_load()["v5e|bench_batch|1024"]["TMR_BENCH_BATCH"] == "8"
+
+    import json
+    path = os.environ["TMR_AUTOTUNE_CACHE"]
+    with open(path) as f:
+        obj = json.load(f)
+    obj["v5e|bench_batch|1024"]["TMR_BENCH_BATCH"] = "abc"
+    obj["other"] = {"TMR_BENCH_BATCH": "0"}
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    loaded = at._cache_load()
+    assert "TMR_BENCH_BATCH" not in loaded.get("v5e|bench_batch|1024", {})
+    assert "other" not in loaded

@@ -186,6 +186,13 @@ def _restore(prev: Optional[str], name: str) -> None:
         os.environ[name] = prev
 
 
+def bench_batch_cache_key(device_kind: str, image_size: int) -> str:
+    """Cache key for the measured throughput-optimal headline batch —
+    written by scripts/bench_extra.py's batch sweep, read by bench.py; one
+    definition so writer and reader can never drift."""
+    return f"{device_kind}|bench_batch|{image_size}"
+
+
 CACHE_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "tmr_tpu", "autotune.json"
 )
@@ -212,6 +219,9 @@ def _cache_load() -> Dict[str, dict]:
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
     }
+    # measured throughput-optimal eval batch (bench_extra's batch sweep);
+    # value is a positive int rendered as a string
+    digit_keys = {"TMR_BENCH_BATCH"}
     # per-knob filtering: one invalid/unknown winner drops only itself —
     # the valid sibling survives (and all-or-nothing would let the next
     # _cache_store rewrite erase it from disk permanently)
@@ -222,7 +232,11 @@ def _cache_load() -> Dict[str, dict]:
         kept = {
             kk: vv for kk, vv in v.items()
             if isinstance(kk, str) and isinstance(vv, str)
-            and vv in valid.get(kk, ())
+            and (
+                vv in valid.get(kk, ())
+                or (kk in digit_keys and vv.isascii() and vv.isdigit()
+                    and int(vv) > 0)
+            )
         }
         if kept:
             out[k] = kept
