@@ -253,15 +253,15 @@ def _run(cancel_watchdog) -> None:
         # identical shapes) can source them and skip the sweep — halves the
         # tunnel exposure per battery
         export = os.environ.get("TMR_AUTOTUNE_EXPORT")
-        if export and tune:
+        if export:
             with open(export, "w") as f:
                 for k, v in tune.items():
                     f.write(f"{k}={v['picked']}\n")
-                # pin THIS run's batch too: bench_extra's sweep may rewrite
-                # the cached TMR_BENCH_BATCH winner mid-battery, and a
-                # follow-up bench sourcing this file must measure the same
-                # program the headline did (not a different batch whose
-                # formulation winners were never measured)
+                # pin THIS run's batch too — even when the sweep exported
+                # nothing (knobs pinned, TMR_AUTOTUNE=0, failed sweeps):
+                # bench_extra may rewrite the cached TMR_BENCH_BATCH winner
+                # mid-battery, and a follow-up bench sourcing this file must
+                # measure the same program the headline did
                 f.write(f"TMR_BENCH_BATCH={BATCH}\n")
     # the PRODUCTION fused program via the Predictor's chain_feedback hook —
     # the benchmark compiles the same pipeline eval runs, no copy

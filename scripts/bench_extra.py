@@ -196,7 +196,24 @@ def bench_refine() -> dict:
 
 
 def bench_train() -> dict:
-    """Config #5's inner loop: one training step, ViT-B @ 1024, batch 4."""
+    """Config #5's inner loop: one training step, ViT-B @ 1024, batch 4.
+
+    TMR_XCORR_PRECISION is pinned to the parity default for this config:
+    autotune's relaxed-precision winners are inference-only policy
+    (utils/autotune.py tune_precision), so the training benchmark must
+    measure the same f32 matcher gradients production training runs."""
+    prev_prec = os.environ.get("TMR_XCORR_PRECISION")
+    os.environ["TMR_XCORR_PRECISION"] = "highest"
+    try:
+        return _bench_train_inner()
+    finally:
+        if prev_prec is None:
+            os.environ.pop("TMR_XCORR_PRECISION", None)
+        else:
+            os.environ["TMR_XCORR_PRECISION"] = prev_prec
+
+
+def _bench_train_inner() -> dict:
     import jax
     import jax.numpy as jnp
 

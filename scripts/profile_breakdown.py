@@ -112,21 +112,25 @@ def main():
         rng.standard_normal((BATCH, grid, grid, 768)), jnp.bfloat16
     )
     cases = (
-        ("one_global_block", 0, "dense"),
-        ("one_windowed_block", 14, "dense"),
-        ("one_windowed_block_folded", 14, "folded"),
-        ("one_windowed_block_flash", 14, "flash"),  # no-op fallback off-TPU
+        # (label, window, knob, value): global blocks read TMR_GLOBAL_ATTN,
+        # windowed blocks TMR_WIN_ATTN (both trace-time)
+        ("one_global_block_blockwise", 0, "TMR_GLOBAL_ATTN", "blockwise"),
+        ("one_global_block_flash", 0, "TMR_GLOBAL_ATTN", "flash"),
+        ("one_windowed_block", 14, "TMR_WIN_ATTN", "dense"),
+        ("one_windowed_block_folded", 14, "TMR_WIN_ATTN", "folded"),
+        ("one_windowed_block_flash", 14, "TMR_WIN_ATTN", "flash"),
     )
-    # restore the user's knob afterwards (autotune's _restore): the
-    # full-program timing in section 1 honoured it, and later sections /
-    # the rest of the process must keep seeing it
+    # restore the user's knobs afterwards (autotune's _restore): the
+    # full-program timing in section 1 honoured them, and later sections /
+    # the rest of the process must keep seeing them
     from tmr_tpu.utils.autotune import _restore
 
     prev_win = os.environ.get("TMR_WIN_ATTN")
+    prev_glob = os.environ.get("TMR_GLOBAL_ATTN")
     try:
-        for label, win, win_impl in cases:
+        for label, win, knob, win_impl in cases:
             _progress(f"stage 3: {label}")
-            os.environ["TMR_WIN_ATTN"] = win_impl
+            os.environ[knob] = win_impl
             blk = Block(num_heads=12, window_size=win,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
             bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
@@ -142,6 +146,7 @@ def main():
             _progress(f"{label}: {report[label]*1000:.2f} ms")
     finally:
         _restore(prev_win, "TMR_WIN_ATTN")
+        _restore(prev_glob, "TMR_GLOBAL_ATTN")
 
     # 4. matcher x-corr on the upsampled grid: every formulation at the
     # production capacity (TMR_XCORR_IMPL, read at trace time — ops/xcorr.py)

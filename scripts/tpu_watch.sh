@@ -74,7 +74,11 @@ while true; do
     else
       log "make_bench_ckpt failed (trained-weights bench skipped)"
     fi
-    timeout 3600 python scripts/bench_extra.py \
+    # bench_extra runs under the headline's winners too: its batch sweep
+    # persists the default headline batch, which must be measured on the
+    # same formulations the headline actually runs (bench_train re-pins
+    # the parity precision internally)
+    env $tuned timeout 3600 python scripts/bench_extra.py \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra rc=$? -> $OUT/bench_extra_live.json"
     # traced bench runs LAST: jax.profiler over the axon transport is

@@ -13,13 +13,15 @@ Prints ONE JSON line:
     {"device_plane": ..., "total_ms": ..., "top_ops": [
         {"name": ..., "count": ..., "total_ms": ..., "pct": ...}, ...]}
 
-Notes on semantics: durations are aggregated per metadata name over the
-busiest SINGLE line of the chosen plane — device planes carry both an
-"XLA Modules" line (one event spanning each whole program execution) and an
-"XLA Ops" line (per-op events); summing lines would double-count and rank
-the module event first. Percentages are of that line's summed event time,
-not wall clock. Good enough to rank where the program's device time goes —
-the use this table serves.
+Notes on semantics: durations are aggregated per metadata name over ONE
+line of the chosen plane. Device planes carry both an "XLA Modules" line
+(one event spanning each whole program execution) and an "XLA Ops" line
+(per-op events); the module span always covers the ops plus gaps, so
+neither a plane-wide sum nor a busiest-line max yields an op ranking — a
+line literally named "XLA Ops" is preferred, module-named lines are
+excluded from the busiest-line fallback. Percentages are of the chosen
+line's summed event time, not wall clock. Good enough to rank where the
+program's device time goes — the use this table serves.
 """
 
 from __future__ import annotations
@@ -67,12 +69,16 @@ def top_ops(trace_dir: str, n: int = 10) -> dict:
     best_plane = None
     best_events = None
     best_total = -1.0
+    best_is_ops_line = False
     for xs in spaces:
         for plane in xs.planes:
             if have_device_events and not plane.name.startswith("/device:"):
                 continue
             meta = {k: v.name for k, v in plane.event_metadata.items()}
             for line in plane.lines:
+                is_ops = line.name == "XLA Ops"
+                if "module" in line.name.lower():
+                    continue  # whole-program spans, not ops
                 agg = defaultdict(lambda: [0, 0.0])  # name -> [count, ps]
                 for ev in line.events:
                     name = meta.get(ev.metadata_id, str(ev.metadata_id))
@@ -80,10 +86,15 @@ def top_ops(trace_dir: str, n: int = 10) -> dict:
                     a[0] += 1
                     a[1] += ev.duration_ps
                 total = sum(v[1] for v in agg.values())
-                if total > best_total:
+                better = (
+                    (is_ops and not best_is_ops_line)
+                    or (is_ops == best_is_ops_line and total > best_total)
+                )
+                if better and total > 0:
                     best_total = total
                     best_plane = f"{plane.name} [{line.name}]"
                     best_events = agg
+                    best_is_ops_line = is_ops
     if best_events is None or best_total <= 0:
         raise ValueError("no event-bearing plane in trace")
     ranked = sorted(best_events.items(), key=lambda kv: -kv[1][1])[:n]

@@ -11,7 +11,7 @@ from tmr_tpu.config import preset
 from tmr_tpu.utils import autotune as at
 
 KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN",
-         "TMR_XCORR_PRECISION")
+         "TMR_XCORR_PRECISION", "TMR_GLOBAL_ATTN")
 
 
 @pytest.fixture
@@ -52,20 +52,27 @@ def test_autotune_picks_min_and_exports_env(clean_knobs, monkeypatch):
         at, "pick_win_attn_impl",
         lambda *a, **k: {"dense": 0.02, "folded": 0.01, "flash": 0.03},
     )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: {"blockwise": 0.03, "flash": 0.02},
+    )
     report = at.autotune(_cfg(), 1024, 4)
     # the xcorr winner exports through the SMALL-scoped knob only: the
     # 127/191 buckets must keep their FFT auto path
     assert report["TMR_XCORR_IMPL_SMALL"]["picked"] == "fft"
     assert report["TMR_WIN_ATTN"]["picked"] == "folded"
+    assert report["TMR_GLOBAL_ATTN"]["picked"] == "flash"
     assert os.environ["TMR_XCORR_IMPL_SMALL"] == "fft"
     assert "TMR_XCORR_IMPL" not in os.environ
     assert os.environ["TMR_WIN_ATTN"] == "folded"
+    assert os.environ["TMR_GLOBAL_ATTN"] == "flash"
 
 
 def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setenv("TMR_XCORR_IMPL", "conv")
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")
     monkeypatch.setenv("TMR_XCORR_PRECISION", "highest")
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
     called = []
@@ -77,6 +84,9 @@ def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     )
     monkeypatch.setattr(
         at, "pick_xcorr_precision", lambda *a, **k: called.append("p") or {}
+    )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl", lambda *a, **k: called.append("g") or {}
     )
     assert at.autotune(_cfg(), 1024, 4) == {}
     assert called == []
@@ -134,6 +144,7 @@ def test_autotune_precision_stage_flips_only_on_decisive_win(
         lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
     )
     monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(at, "pick_global_attn_impl", lambda *a, **k: {})
     swept = []
     monkeypatch.setattr(
         at, "pick_xcorr_precision",
@@ -181,6 +192,7 @@ def test_autotune_tune_precision_false_skips_sweep(clean_knobs, monkeypatch):
         lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
     )
     monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(at, "pick_global_attn_impl", lambda *a, **k: {})
     boom = lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept"))
     monkeypatch.setattr(at, "pick_xcorr_precision", boom)
     r = at.autotune(_cfg(), 1024, 4, tune_precision=False)
@@ -199,6 +211,7 @@ def test_autotune_cached_precision_is_impl_specific(clean_knobs, monkeypatch):
         lambda *a, **k: {"conv": 0.01, "vmap": 0.05, "fft": 0.03},
     )
     monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(at, "pick_global_attn_impl", lambda *a, **k: {})
     monkeypatch.setattr(
         at, "pick_xcorr_precision",
         lambda *a, **k: {"highest": 0.010, "default": 0.004, "bf16": 0.006},
@@ -228,6 +241,7 @@ def test_autotune_cached_precision_is_impl_specific(clean_knobs, monkeypatch):
     for k in KNOBS:
         os.environ.pop(k, None)
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
     boom = lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept"))
     monkeypatch.setattr(at, "pick_xcorr_precision", boom)
     monkeypatch.setattr(
@@ -254,15 +268,20 @@ def test_autotune_cache_persists_winners_across_processes(
         at, "pick_win_attn_impl",
         lambda *a, **k: calls.append("w") or {"dense": 0.02, "folded": 0.01},
     )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: calls.append("g") or {"blockwise": 0.02,
+                                              "flash": 0.01},
+    )
     r1 = at.autotune(_cfg(), 1024, 4)
-    assert calls == ["x", "w"]
+    assert calls == ["x", "w", "g"]
     assert r1["TMR_WIN_ATTN"]["picked"] == "folded"
 
     # fresh process simulation: knobs cleared, cache file remains
     for k in KNOBS:
         os.environ.pop(k, None)
     r2 = at.autotune(_cfg(), 1024, 4)
-    assert calls == ["x", "w"], "cached hit must not re-measure"
+    assert calls == ["x", "w", "g"], "cached hit must not re-measure"
     assert r2["TMR_XCORR_IMPL_SMALL"] == {"picked": "fft", "cached": True}
     assert r2["TMR_WIN_ATTN"] == {"picked": "folded", "cached": True}
     assert os.environ["TMR_XCORR_IMPL_SMALL"] == "fft"
@@ -272,14 +291,14 @@ def test_autotune_cache_persists_winners_across_processes(
     for k in KNOBS:
         os.environ.pop(k, None)
     at.autotune(_cfg(), 1536, 1)
-    assert calls == ["x", "w", "x", "w"]
+    assert calls == ["x", "w", "g", "x", "w", "g"]
 
     # force bypasses the cache
     for k in KNOBS:
         os.environ.pop(k, None)
     monkeypatch.setenv("TMR_AUTOTUNE_FORCE", "1")
     at.autotune(_cfg(), 1024, 4)
-    assert calls == ["x", "w", "x", "w", "x", "w"]
+    assert calls == ["x", "w", "g", "x", "w", "g", "x", "w", "g"]
 
 
 def test_autotune_cached_hit_respects_explicit_knobs(
@@ -293,6 +312,10 @@ def test_autotune_cached_hit_respects_explicit_knobs(
     monkeypatch.setattr(
         at, "pick_win_attn_impl", lambda *a, **k: {"dense": 0.02,
                                                   "folded": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: {"blockwise": 0.02, "flash": 0.01},
     )
     at.autotune(_cfg(), 1024, 4)
     for k in KNOBS:
@@ -359,3 +382,28 @@ def test_cache_accepts_measured_batch_winner(clean_knobs):
     loaded = at._cache_load()
     assert "TMR_BENCH_BATCH" not in loaded.get("v5e|bench_batch|1024", {})
     assert "other" not in loaded
+
+
+def test_global_attn_knob_validates_and_matches(monkeypatch):
+    """TMR_GLOBAL_ATTN forces the global-attention formulation at trace
+    time: invalid values raise, and 'blockwise' matches the auto dispatch
+    off-TPU (where the flash gate falls back to blockwise anyway)."""
+    from tmr_tpu.models.vit import Block
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, 32, 32, 32)),
+        jnp.bfloat16,
+    )
+    blk = Block(num_heads=2, window_size=0, rel_pos_size=(32, 32),
+                dtype=jnp.bfloat16)
+    monkeypatch.delenv("TMR_GLOBAL_ATTN", raising=False)
+    params = jax.jit(blk.init)(jax.random.key(0), tokens)["params"]
+    auto = blk.apply({"params": params}, tokens)
+
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
+    forced = blk.apply({"params": params}, tokens)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(forced))
+
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "spiral")
+    with pytest.raises(ValueError, match="TMR_GLOBAL_ATTN"):
+        blk.apply({"params": params}, tokens)

@@ -240,9 +240,17 @@ class Attention(nn.Module):
             # the Pallas flash kernel runs the rel-pos bias folded into the
             # QK contraction (ops/flash_attn.py) behind a per-geometry compiled
             # self-check; everywhere else (and for exact-f32 parity) the XLA
-            # blockwise path.
+            # blockwise path. TMR_GLOBAL_ATTN (trace-time A/B knob, measured
+            # by the autotune sweep like TMR_WIN_ATTN): "auto" = flash when
+            # available, "blockwise"/"flash" force — "flash" still falls
+            # back when the gates say the kernel can't run this geometry.
+            impl = os.environ.get("TMR_GLOBAL_ATTN", "auto")
+            if impl not in ("auto", "blockwise", "flash"):
+                raise ValueError(
+                    f"TMR_GLOBAL_ATTN={impl!r}: expected auto|blockwise|flash"
+                )
             attn_fn = blockwise_decomposed_attention
-            if self.dtype == jnp.bfloat16:
+            if impl != "blockwise" and self.dtype == jnp.bfloat16:
                 from tmr_tpu.ops.flash_attn import (
                     flash_attention_ok,
                     flash_decomposed_attention,

@@ -29,6 +29,7 @@ from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
 XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
+GLOBAL_ATTN_VARIANTS = ("blockwise", "flash")
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 
 
@@ -116,13 +117,16 @@ def pick_xcorr_precision(
     return times
 
 
-def pick_win_attn_impl(
+def _sweep_block_env(
+    env_var: str, variants, window_size: int,
     batch: int, grid: int, embed_dim: int, num_heads: int,
-    rtt: Optional[float] = None,
-    log: Callable[[str], None] = lambda s: None,
+    rtt: Optional[float], log: Callable[[str], None],
 ) -> Dict[str, float]:
-    """Time one windowed transformer block (window 14, bf16 — the deployment
-    dtype) per attention formulation. Returns {variant: sec/iter}."""
+    """Shared microbenchmark harness for the trace-time transformer-block
+    knobs: pin ``env_var`` to each variant, jit one Block at the production
+    grid (bf16, the deployment dtype), time it chained. One harness for the
+    windowed and global sweeps so staging / step / failure handling can
+    never diverge between them (the _sweep_xcorr_env principle)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -135,11 +139,11 @@ def pick_win_attn_impl(
     )
     rtt = measure_rtt_floor() if rtt is None else rtt
     times: Dict[str, float] = {}
-    prev = os.environ.get("TMR_WIN_ATTN")
+    prev = os.environ.get(env_var)
     try:
-        for impl in WIN_ATTN_VARIANTS:
-            os.environ["TMR_WIN_ATTN"] = impl
-            blk = Block(num_heads=num_heads, window_size=14,
+        for impl in variants:
+            os.environ[env_var] = impl
+            blk = Block(num_heads=num_heads, window_size=window_size,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
             params = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
 
@@ -153,11 +157,41 @@ def pick_win_attn_impl(
                     step, params, tokens, rtt=rtt
                 )
             except Exception as e:
-                log(f"autotune: win_attn[{impl}] failed: "
+                log(f"autotune: {env_var}[{impl}] failed: "
                     f"{type(e).__name__}: {e}")
     finally:
-        _restore(prev, "TMR_WIN_ATTN")
+        _restore(prev, env_var)
     return times
+
+
+def pick_win_attn_impl(
+    batch: int, grid: int, embed_dim: int, num_heads: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time one windowed transformer block (window 14, bf16 — the deployment
+    dtype) per attention formulation. Returns {variant: sec/iter}."""
+    return _sweep_block_env(
+        "TMR_WIN_ATTN", WIN_ATTN_VARIANTS, 14,
+        batch, grid, embed_dim, num_heads, rtt, log,
+    )
+
+
+def pick_global_attn_impl(
+    batch: int, grid: int, embed_dim: int, num_heads: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time one GLOBAL transformer block (window 0, the full grid as keys,
+    bf16) per TMR_GLOBAL_ATTN formulation — the 4 global blocks were the one
+    formulation chosen by static gates instead of measurement. Off-TPU the
+    flash gate falls back to blockwise, so both variants time the same
+    program (harmless; selection only runs on TPU). Returns
+    {variant: sec/iter}."""
+    return _sweep_block_env(
+        "TMR_GLOBAL_ATTN", GLOBAL_ATTN_VARIANTS, 0,
+        batch, grid, embed_dim, num_heads, rtt, log,
+    )
 
 
 def _active_small_impl(cached: Dict[str, str]) -> str:
@@ -214,6 +248,7 @@ def _cache_load() -> Dict[str, dict]:
     valid = {
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
+        "TMR_GLOBAL_ATTN": set(GLOBAL_ATTN_VARIANTS) | {"auto"},
         "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
         # metadata, not an env knob: which impl the precision winner was
         # measured under (its decisive-win evidence is impl-specific)
@@ -322,12 +357,15 @@ def autotune(
         and "TMR_XCORR_IMPL_SMALL" not in os.environ
     )
     want_attn = "TMR_WIN_ATTN" not in os.environ and vit_kind is not None
+    want_glob = "TMR_GLOBAL_ATTN" not in os.environ and vit_kind is not None
     want_prec = tune_precision and "TMR_XCORR_PRECISION" not in os.environ
     wanted = set()
     if want_xcorr:
         wanted.add("TMR_XCORR_IMPL_SMALL")
     if want_attn:
         wanted.add("TMR_WIN_ATTN")
+    if want_glob:
+        wanted.add("TMR_GLOBAL_ATTN")
     if want_prec:
         wanted.add("TMR_XCORR_PRECISION")
     if not wanted:
@@ -419,6 +457,17 @@ def autotune(
             os.environ["TMR_WIN_ATTN"] = best
             report["TMR_WIN_ATTN"] = {"picked": best, "times": times}
             log(f"autotune: TMR_WIN_ATTN={best} {times}")
+
+    if want_glob:
+        vc = VIT_CONFIGS[vit_kind]
+        times = pick_global_attn_impl(
+            batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
+        )
+        if times:
+            best = min(times, key=times.get)
+            os.environ["TMR_GLOBAL_ATTN"] = best
+            report["TMR_GLOBAL_ATTN"] = {"picked": best, "times": times}
+            log(f"autotune: TMR_GLOBAL_ATTN={best} {times}")
     if report:
         extra = {}
         if "TMR_XCORR_PRECISION" in report:
