@@ -229,6 +229,18 @@ def _run(cancel_watchdog) -> None:
             _progress(f"batch {BATCH}: measured winner from the autotune "
                       "cache (bench_extra batch sweep)")
 
+    # pin THIS run's batch for any follow-up bench sourcing the export
+    # file — written OUTSIDE the TMR_AUTOTUNE gate and before the sweep, so
+    # it exists even with autotune disabled, pinned knobs, or failed
+    # sweeps: bench_extra may rewrite the cached TMR_BENCH_BATCH winner
+    # mid-battery, and the traced/ckpt benches must measure the same
+    # program the headline did (a stale export from an older battery is
+    # also overwritten here)
+    export0 = os.environ.get("TMR_AUTOTUNE_EXPORT")
+    if export0:
+        with open(export0, "w") as f:
+            f.write(f"TMR_BENCH_BATCH={BATCH}\n")
+
     cfg = preset(
         "TMR_FSCD147",
         backbone="sam_vit_b",
@@ -254,15 +266,9 @@ def _run(cancel_watchdog) -> None:
         # tunnel exposure per battery
         export = os.environ.get("TMR_AUTOTUNE_EXPORT")
         if export:
-            with open(export, "w") as f:
+            with open(export, "a") as f:  # batch line written above
                 for k, v in tune.items():
                     f.write(f"{k}={v['picked']}\n")
-                # pin THIS run's batch too — even when the sweep exported
-                # nothing (knobs pinned, TMR_AUTOTUNE=0, failed sweeps):
-                # bench_extra may rewrite the cached TMR_BENCH_BATCH winner
-                # mid-battery, and a follow-up bench sourcing this file must
-                # measure the same program the headline did
-                f.write(f"TMR_BENCH_BATCH={BATCH}\n")
     # the PRODUCTION fused program via the Predictor's chain_feedback hook —
     # the benchmark compiles the same pipeline eval runs, no copy
     from tmr_tpu.inference import Predictor

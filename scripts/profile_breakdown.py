@@ -165,6 +165,7 @@ def main():
             (17, "conv", "highest"), (17, "conv", "default"),
             (17, "conv", "bf16"), (17, "vmap", "highest"),
             (17, "vmap", "bf16"), (17, "fft", "highest"),
+            (17, "pallas", "highest"), (17, "convnhwc", "highest"),
             (127, "auto", "highest"),
         ):
             _progress(f"stage 4: xcorr cap={cap} impl={impl} prec={prec}")

@@ -127,3 +127,86 @@ def test_pallas_nms_suppression_chain():
     want = np.asarray(nms_keep_mask(boxes, scores, 0.2))
     np.testing.assert_array_equal(got, want)
     np.testing.assert_array_equal(got, [True, False, True])
+
+
+# ---- pallas depthwise correlation (ops/pallas_xcorr.py) --------------------
+def test_pallas_xcorr_matches_conv_path():
+    """The Pallas correlation kernel (interpret mode on CPU) must equal the
+    HIGHEST-precision grouped-conv lowering on identical inputs, across
+    channel counts that do and don't divide the channel block."""
+    from jax import lax
+
+    from tmr_tpu.ops.pallas_xcorr import xcorr_pallas
+
+    rng = np.random.default_rng(3)
+    for B, C, H, W, T in ((2, 8, 24, 20, 5), (1, 3, 16, 16, 7)):
+        f = jnp.asarray(rng.standard_normal((B, C, H, W)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((B, C, T, T)), jnp.float32)
+        got = np.asarray(xcorr_pallas(f, t, interpret=True))
+        want = np.asarray(
+            lax.conv_general_dilated(
+                f.reshape(1, B * C, H, W),
+                t.reshape(B * C, 1, T, T),
+                window_strides=(1, 1),
+                padding=[(T // 2, T // 2), (T // 2, T // 2)],
+                feature_group_count=B * C,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=lax.Precision.HIGHEST,
+            ).reshape(B, C, H, W)
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_xcorr_dispatch_falls_back_off_tpu(monkeypatch):
+    """TMR_XCORR_IMPL=pallas off-TPU: the self-check refuses (no TPU), the
+    dispatcher silently falls back to the conv path, results exact."""
+    from tmr_tpu.ops import xcorr as xc
+
+    rng = np.random.default_rng(4)
+    B, C, H, W, cap = 2, 4, 20, 20, 9
+    feat = rng.standard_normal((B, C, H, W)).astype(np.float32)
+    tmpl = np.zeros((B, C, cap, cap), np.float32)
+    tmpl[:, :, 2:7, 3:6] = rng.standard_normal((B, C, 5, 3))
+    thw = jnp.array([[5, 3], [5, 3]], jnp.int32)
+
+    monkeypatch.delenv("TMR_XCORR_IMPL", raising=False)
+    want = np.asarray(
+        xc.cross_correlation(jnp.array(feat), jnp.array(tmpl), thw)
+    )
+    monkeypatch.setenv("TMR_XCORR_IMPL", "pallas")
+    got = np.asarray(
+        xc.cross_correlation(jnp.array(feat), jnp.array(tmpl), thw)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_xcorr_ok_gates(monkeypatch):
+    from tmr_tpu.ops import pallas_xcorr as px
+
+    # capacity beyond the unroll cap always refuses, even on TPU
+    assert not px.pallas_xcorr_ok(8, 64, 64, px.MAX_UNROLL_T + 2)
+    # force-disable env wins regardless of backend
+    monkeypatch.setenv("TMR_NO_PALLAS_XCORR", "1")
+    assert not px.pallas_xcorr_ok(8, 64, 64, 17)
+
+
+def test_pallas_xcorr_big_bucket_falls_back_to_fft(monkeypatch):
+    """TMR_XCORR_IMPL=pallas with a >threshold capacity must fall back to
+    the FFT path (a direct conv at T in the 100s is the O(H^2 T^2 C)
+    blowup FFT_CAPACITY_THRESHOLD exists to prevent), not the conv path."""
+    from tmr_tpu.ops import xcorr as xc
+
+    B, C, H, W, cap = 1, 2, 16, 16, 67
+    assert cap > xc.FFT_CAPACITY_THRESHOLD
+    feat = jnp.asarray(
+        np.random.default_rng(0).standard_normal((B, C, H, W)), jnp.float32
+    )
+    tmpl = jnp.zeros((B, C, cap, cap), jnp.float32)
+    tmpl = tmpl.at[:, :, cap // 2, cap // 2].set(1.0)
+    thw = jnp.array([[1, 1]], jnp.int32)
+    monkeypatch.setenv("TMR_XCORR_IMPL", "pallas")
+    got = xc.cross_correlation(feat, tmpl, thw)
+    # identity template through FFT: equal up to FFT rounding, and the
+    # nonzero rounding proves the FFT path ran (a conv would be exact)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(feat), atol=1e-4)
+    assert abs(np.asarray(got) - np.asarray(feat)).max() > 0

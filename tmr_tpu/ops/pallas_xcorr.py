@@ -1,0 +1,160 @@
+"""Depthwise cross-correlation as a Pallas TPU kernel (TMR_XCORR_IMPL=pallas).
+
+Why: the matcher's per-image depthwise correlation (reference
+template_matching.py:23-41) has no channel reduction, so it can't feed the
+MXU's contraction dimension — XLA lowers the ``feature_group_count=B*C``
+grouped conv through generic conv machinery that on TPU pays layout
+transposes and multi-pass f32 emulation at ``Precision.HIGHEST``
+(ops/xcorr.py). The operation itself is just T^2 shifted multiply-adds over
+the (H, W) map per channel — pure VPU work. This kernel expresses exactly
+that: each grid program holds one (CB-channel, padded-H, padded-W) block in
+VMEM and accumulates the T^2 statically-unrolled shifted products in f32.
+
+Numerics: inputs are multiplied after an upcast to f32 and accumulated in
+f32, so with f32 inputs the result matches the HIGHEST-precision conv path
+(true f32 — the VPU does not do bf16-split emulation), and with bf16 inputs
+(TMR_XCORR_PRECISION=bf16) it matches that path's f32-accumulator contract.
+
+Scope: small-capacity buckets only (T <= MAX_UNROLL_T); the unroll count is
+T^2, and capacities above the cap fall back to the conv lowering in the
+dispatcher (the >65 buckets take the FFT path anyway, ops/xcorr.py).
+
+Runs compiled on TPU behind a per-geometry compiled self-check with
+fallback (the flash_attn.py pattern); ``interpret=True`` (automatic
+off-TPU) keeps CPU tests honest.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: largest template capacity the statically-unrolled kernel accepts: the
+#: kernel body is T^2 slice+FMA steps, and past ~33 (1089 steps) Mosaic
+#: compile time grows out of proportion to the op's share of the program.
+MAX_UNROLL_T = 33
+
+#: channels per grid program: VMEM block is CB*(H+T-1)*(W+T-1)*4 bytes for
+#: the padded feature plus the CB*H*W f32 accumulator — 8 keeps the worst
+#: production shape (H=W=192, T=33) near 2.5 MB, well inside VMEM.
+_CB = 8
+
+
+def _xcorr_kernel(fpad_ref, tmpl_ref, out_ref, *, T: int, H: int, W: int):
+    """One (CB, H, W) output block: sum of T^2 shifted products.
+
+    fpad_ref: (1, CB, H+T-1, W+T-1); tmpl_ref: (1, CB, T, T);
+    out_ref: (1, CB, H, W). The T^2 loop is a static Python unroll — every
+    slice has static offsets, so Mosaic sees straight-line vector code.
+    """
+    fpad = fpad_ref[0].astype(jnp.float32)
+    tmpl = tmpl_ref[0].astype(jnp.float32)
+    acc = jnp.zeros(out_ref.shape[1:], jnp.float32)
+    for i in range(T):
+        for j in range(T):
+            acc = acc + fpad[:, i : i + H, j : j + W] * tmpl[:, i, j][
+                :, None, None
+            ]
+    out_ref[0] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret",)
+)
+def _run_xcorr(fpad, tmpl, interpret: bool = False):
+    B, C, HP, WP = fpad.shape
+    T = tmpl.shape[-1]
+    H = HP - (T - 1)
+    W = WP - (T - 1)
+    cb = _CB if C % _CB == 0 else 1
+    kernel = functools.partial(_xcorr_kernel, T=T, H=H, W=W)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, C // cb),
+        in_specs=[
+            pl.BlockSpec((1, cb, HP, WP), lambda b, c: (b, c, 0, 0)),
+            pl.BlockSpec((1, cb, T, T), lambda b, c: (b, c, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, cb, H, W), lambda b, c: (b, c, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, C, H, W), jnp.float32),
+        interpret=interpret,
+    )(fpad, tmpl)
+
+
+def xcorr_pallas(
+    feature: jnp.ndarray,
+    template: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """SAME-padded depthwise correlation, f32 result.
+
+    feature: (B, C, H, W); template: (B, C, T, T), T odd. Semantics equal
+    ops/xcorr.py's grouped-conv path (zero padding T//2 per side, no kernel
+    flip — correlation, not convolution)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T = template.shape[-1]
+    c = T // 2
+    fpad = jnp.pad(
+        feature, ((0, 0), (0, 0), (c, T - 1 - c), (c, T - 1 - c))
+    )
+    return _run_xcorr(fpad, template, interpret=interpret)
+
+
+_OK_CACHE: dict = {}
+
+
+def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
+    """Per-geometry compiled self-check with conv-path cross-check.
+
+    Callers pass the actual (C, H, W, T) about to run. Reduced only in
+    batch/channels (block geometry is what Mosaic failures key on): the
+    check runs B=1 with one channel block. Any exception or disagreement
+    beyond f32 tolerance -> False (dispatcher falls back to the conv
+    lowering). TMR_NO_PALLAS_XCORR=1 force-disables.
+    """
+    if os.environ.get("TMR_NO_PALLAS_XCORR"):
+        return False
+    if T > MAX_UNROLL_T:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    cb = _CB if C % _CB == 0 else 1
+    key = (cb, H, W, T)
+    if key in _OK_CACHE:
+        return _OK_CACHE[key]
+    import numpy as np
+
+    from jax import lax
+
+    try:
+        with jax.ensure_compile_time_eval():
+            rng = np.random.default_rng(0)
+            f = jnp.asarray(
+                rng.standard_normal((1, cb, H, W)), jnp.float32
+            )
+            t = jnp.asarray(
+                rng.standard_normal((1, cb, T, T)), jnp.float32
+            )
+            got = np.asarray(xcorr_pallas(f, t, interpret=False))
+            want = np.asarray(
+                lax.conv_general_dilated(
+                    f.reshape(1, cb, H, W),
+                    t.reshape(cb, 1, T, T),
+                    window_strides=(1, 1),
+                    padding=[(T // 2, T // 2), (T // 2, T // 2)],
+                    feature_group_count=cb,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    precision=lax.Precision.HIGHEST,
+                )
+            )
+            scale = np.abs(want).max() + 1e-6
+            ok = bool(np.abs(got - want).max() / scale < 5e-5)
+    except Exception:
+        ok = False
+    _OK_CACHE[key] = ok
+    return ok

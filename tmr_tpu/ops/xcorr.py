@@ -250,9 +250,9 @@ def cross_correlation(
     for name, val in (
         ("TMR_XCORR_IMPL", impl), ("TMR_XCORR_IMPL_SMALL", small)
     ):
-        if val not in ("auto", "conv", "vmap", "fft", "convnhwc"):
+        if val not in ("auto", "conv", "vmap", "fft", "convnhwc", "pallas"):
             raise ValueError(
-                f"{name}={val!r}: expected auto|conv|vmap|fft|convnhwc"
+                f"{name}={val!r}: expected auto|conv|vmap|fft|convnhwc|pallas"
             )
     if impl == "auto":
         impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
@@ -261,7 +261,8 @@ def cross_correlation(
     def _compute(f, t):
         # local-shape island: b == B globally, or B/n_data under shard_map
         b = f.shape[0]
-        if impl == "fft":
+        use = impl
+        if use == "fft":
             return _xcorr_fft(f, t)
         in_dtype = f.dtype
         if prec_name == "bf16":
@@ -271,7 +272,23 @@ def cross_correlation(
         # matmul convention, e.g. models/vit.py): without this the conv
         # output would round to bf16 before the upcast below
         acc = jnp.float32 if prec_name == "bf16" else None
-        if impl == "convnhwc":
+        if use == "pallas":
+            from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok, xcorr_pallas
+
+            if pallas_xcorr_ok(C, H, W, T):
+                # the kernel upcasts to f32 and accumulates in f32, so it
+                # satisfies every TMR_XCORR_PRECISION contract: with f32
+                # inputs it equals the HIGHEST conv path (the VPU is true
+                # f32), and under the bf16 knob the inputs above already
+                # carry the rounding
+                return xcorr_pallas(f, t).astype(in_dtype)
+            # self-check refused or capacity too big: fall back the way the
+            # auto dispatch would — a direct SAME conv at T in the 100s is
+            # O(H^2 T^2 C) (module docstring), so big buckets go to FFT
+            if T > FFT_CAPACITY_THRESHOLD:
+                return _xcorr_fft(f, t).astype(in_dtype)
+            use = "conv"
+        if use == "convnhwc":
             # same grouped conv in the TPU-native activation layout: XLA:TPU
             # canonicalizes NCHW convs by inserting layout transposes, so
             # expressing the op as NHWC/HWIO directly lets the compiler skip
@@ -290,7 +307,7 @@ def cross_correlation(
                 precision=conv_prec,
                 preferred_element_type=acc,
             ).transpose(0, 3, 1, 2).reshape(b, C, H, W).astype(in_dtype)
-        if impl == "vmap":
+        if use == "vmap":
             def one(fi, ti):  # fi: (C, H, W), ti: (C, T, T)
                 return lax.conv_general_dilated(
                     fi[None],

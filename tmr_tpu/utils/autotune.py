@@ -27,7 +27,7 @@ from typing import Callable, Dict, Optional
 
 from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
-XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft")
+XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft", "pallas")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
 GLOBAL_ATTN_VARIANTS = ("blockwise", "flash")
 XCORR_PRECISIONS = ("highest", "default", "bf16")
@@ -447,27 +447,21 @@ def autotune(
                 log("autotune: TMR_XCORR_PRECISION=highest "
                     f"(no 'highest' baseline in {times})")
 
-    if want_attn:
+    for knob, picker, want in (
+        ("TMR_WIN_ATTN", pick_win_attn_impl, want_attn),
+        ("TMR_GLOBAL_ATTN", pick_global_attn_impl, want_glob),
+    ):
+        if not want:
+            continue
         vc = VIT_CONFIGS[vit_kind]
-        times = pick_win_attn_impl(
+        times = picker(
             batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
         )
         if times:
             best = min(times, key=times.get)
-            os.environ["TMR_WIN_ATTN"] = best
-            report["TMR_WIN_ATTN"] = {"picked": best, "times": times}
-            log(f"autotune: TMR_WIN_ATTN={best} {times}")
-
-    if want_glob:
-        vc = VIT_CONFIGS[vit_kind]
-        times = pick_global_attn_impl(
-            batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
-        )
-        if times:
-            best = min(times, key=times.get)
-            os.environ["TMR_GLOBAL_ATTN"] = best
-            report["TMR_GLOBAL_ATTN"] = {"picked": best, "times": times}
-            log(f"autotune: TMR_GLOBAL_ATTN={best} {times}")
+            os.environ[knob] = best
+            report[knob] = {"picked": best, "times": times}
+            log(f"autotune: {knob}={best} {times}")
     if report:
         extra = {}
         if "TMR_XCORR_PRECISION" in report:
