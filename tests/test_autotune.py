@@ -23,6 +23,7 @@ def clean_knobs(monkeypatch, tmp_path):
     for k in KNOBS:
         monkeypatch.delenv(k, raising=False)
     monkeypatch.setenv("TMR_AUTOTUNE_CACHE", str(tmp_path / "autotune.json"))
+    monkeypatch.setenv("TMR_AUTOTUNE_SEED", str(tmp_path / "no_seed.json"))
     monkeypatch.delenv("TMR_AUTOTUNE_FORCE", raising=False)
     yield
     for k in KNOBS:
@@ -407,3 +408,101 @@ def test_global_attn_knob_validates_and_matches(monkeypatch):
     monkeypatch.setenv("TMR_GLOBAL_ATTN", "spiral")
     with pytest.raises(ValueError, match="TMR_GLOBAL_ATTN"):
         blk.apply({"params": params}, tokens)
+
+
+def test_autotune_seed_file_partial_sweep(clean_knobs, monkeypatch, tmp_path):
+    """A committed seed file (AUTOTUNE_SEED.json) pre-covers knobs for a
+    fresh machine: covered knobs export without measuring, ONLY the
+    unseeded ones sweep, and a local user-cache entry for the same key
+    fully supersedes the seed."""
+    import json
+
+    seed = tmp_path / "seed.json"
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    key = "|".join(str(p) for p in (
+        jax.devices()[0].device_kind, 1024, 128, 4, 512, "vit_b"))
+    seed.write_text(json.dumps({key: {
+        "TMR_XCORR_IMPL_SMALL": "vmap", "TMR_WIN_ATTN": "flash",
+    }}))
+    monkeypatch.setenv("TMR_AUTOTUNE_SEED", str(seed))
+
+    calls = []
+    boom = lambda tag: lambda *a, **k: calls.append(tag) or {}
+    monkeypatch.setattr(at, "pick_xcorr_impl", boom("x"))
+    monkeypatch.setattr(at, "pick_win_attn_impl", boom("w"))
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: calls.append("g") or {"blockwise": 0.02,
+                                              "flash": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: calls.append("p") or {
+            "highest": 0.01, "default": 0.002, "bf16": 0.003},
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    # seeded knobs exported without their sweeps; unseeded ones measured
+    assert "x" not in calls and "w" not in calls
+    assert "g" in calls and "p" in calls
+    assert r["TMR_XCORR_IMPL_SMALL"] == {"picked": "vmap", "cached": True}
+    assert r["TMR_WIN_ATTN"] == {"picked": "flash", "cached": True}
+    assert os.environ["TMR_WIN_ATTN"] == "flash"
+    assert r["TMR_GLOBAL_ATTN"]["picked"] == "flash"
+    # precision measured on the seeded vmap winner, decisive win -> default
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "default"
+
+    # a local user-cache write supersedes the seed for that knob (the
+    # measured run above already materialized the seeded winners into the
+    # user file through its report, so the key is fully local now)
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    at._cache_store(key, {"TMR_XCORR_IMPL_SMALL": {"picked": "conv"}})
+    cached = at._cache_load()[key]
+    assert cached["TMR_XCORR_IMPL_SMALL"] == "conv"
+    assert cached["TMR_WIN_ATTN"] == "flash"
+
+    # and with the user cache absent, the seed alone still serves
+    os.environ["TMR_AUTOTUNE_CACHE"] = str(tmp_path / "fresh_cache.json")
+    assert at._cache_load()[key]["TMR_XCORR_IMPL_SMALL"] == "vmap"
+
+
+def test_cached_precision_dropped_when_impl_sweep_pending(
+    clean_knobs, monkeypatch
+):
+    """Run A (impl pinned) caches a relaxed precision measured on conv.
+    Run B (nothing pinned) will sweep impls fresh — the cached bf16 must
+    NOT be exported ahead of that sweep: it is re-measured on whatever the
+    fresh sweep picks, so relaxed numerics never outlive their pairing."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(at, "pick_win_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(at, "pick_global_attn_impl", lambda *a, **k: {})
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: {"highest": 0.010, "default": 0.004, "bf16": 0.003},
+    )
+    # run A: impl pinned to conv -> precision measured+cached under conv
+    monkeypatch.setenv("TMR_XCORR_IMPL_SMALL", "conv")
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "bf16"
+
+    # run B: unpinned; fresh impl sweep picks pallas. Cached bf16 must be
+    # dropped and re-measured (mock shows <10% this time -> highest)
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.03, "vmap": 0.05, "pallas": 0.01},
+    )
+    reswept = []
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision",
+        lambda *a, **k: reswept.append(1) or {
+            "highest": 0.010, "default": 0.0099, "bf16": 0.0098},
+    )
+    r = at.autotune(_cfg(), 1024, 4)
+    assert r["TMR_XCORR_IMPL_SMALL"]["picked"] == "pallas"
+    assert reswept, "cached precision must not be exported past a fresh sweep"
+    assert r["TMR_XCORR_PRECISION"]["picked"] == "highest"
+    assert os.environ["TMR_XCORR_PRECISION"] == "highest"

@@ -232,10 +232,20 @@ CACHE_PATH = os.path.join(
 )
 
 
-def _cache_load() -> Dict[str, dict]:
+#: winners measured on real hardware, committed with the repo: a fresh
+#: machine/container (e.g. the driver's round-end bench) starts from these
+#: instead of paying the full sweep over the wedge-prone tunnel. The user
+#: cache always takes precedence; entries are validated like the cache.
+SEED_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))),
+    "AUTOTUNE_SEED.json",
+)
+
+
+def _load_validated(path: str) -> Dict[str, dict]:
     import json
 
-    path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
     try:
         with open(path) as f:
             obj = json.load(f)
@@ -245,6 +255,23 @@ def _cache_load() -> Dict[str, dict]:
     # to "no cache", not crash the launch
     if not isinstance(obj, dict):
         return {}
+    return _validate_cache_obj(obj)
+
+
+def _cache_load() -> Dict[str, dict]:
+    path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
+    seed = _load_validated(os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH))
+    user = _load_validated(path)
+    # knob-level merge within each key, user values winning: a partial
+    # user entry (written by a run with some knobs env-pinned) must not
+    # shadow the seed's winners for knobs it never locally measured
+    out = dict(seed)
+    for k, v in user.items():
+        out[k] = {**out.get(k, {}), **v}
+    return out
+
+
+def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
     valid = {
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
@@ -286,7 +313,9 @@ def _cache_store(
     path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        cache = _cache_load()
+        # read-modify-write the USER cache only — merging the seed here
+        # would copy committed seed entries into the user file forever
+        cache = _load_validated(path)
         # merge: a partial report (one knob pinned by the user this run)
         # must not wipe the sibling knob's previously cached winner
         cache[key] = {
@@ -352,46 +381,46 @@ def autotune(
     force = os.environ.get("TMR_AUTOTUNE_FORCE", "") not in ("", "0")
     cached = {} if force else _cache_load().get(key, {})
 
-    want_xcorr = (
+    wanted = set()
+    if (
         "TMR_XCORR_IMPL" not in os.environ
         and "TMR_XCORR_IMPL_SMALL" not in os.environ
-    )
-    want_attn = "TMR_WIN_ATTN" not in os.environ and vit_kind is not None
-    want_glob = "TMR_GLOBAL_ATTN" not in os.environ and vit_kind is not None
-    want_prec = tune_precision and "TMR_XCORR_PRECISION" not in os.environ
-    wanted = set()
-    if want_xcorr:
+    ):
         wanted.add("TMR_XCORR_IMPL_SMALL")
-    if want_attn:
+    if "TMR_WIN_ATTN" not in os.environ and vit_kind is not None:
         wanted.add("TMR_WIN_ATTN")
-    if want_glob:
+    if "TMR_GLOBAL_ATTN" not in os.environ and vit_kind is not None:
         wanted.add("TMR_GLOBAL_ATTN")
-    if want_prec:
+    if tune_precision and "TMR_XCORR_PRECISION" not in os.environ:
         wanted.add("TMR_XCORR_PRECISION")
     if not wanted:
         return report  # everything pinned: skip even the rtt round trip
-    if (
-        cached.get("TMR_XCORR_PRECISION", "highest") != "highest"
-        and cached.get("_precision_impl") != _active_small_impl(cached)
+    if cached.get("TMR_XCORR_PRECISION", "highest") != "highest" and (
+        "TMR_XCORR_IMPL_SMALL" in wanted
+        or cached.get("_precision_impl") != _active_small_impl(cached)
     ):
-        # the relaxed-precision winner was measured on a different impl
-        # (user pinned another one since): its decisive-win evidence does
-        # not transfer — fall through and re-measure rather than export
-        # unverified numerics
+        # a relaxed-precision winner's decisive-win evidence is
+        # impl-specific: drop it when it was measured under a different
+        # impl (user pinned another one since), AND whenever a fresh impl
+        # sweep is about to run — the sweep may pick a different winner,
+        # and exported-early bf16 numerics must never outlive the pairing
+        # they were validated on (re-measured after the fresh pick instead)
         cached = {k: v for k, v in cached.items()
                   if k != "TMR_XCORR_PRECISION"}
-    if cached and wanted <= set(cached):
-        # cached winners cover every wanted knob: export without measuring.
-        # (A partial entry — e.g. one sweep failed when it was written —
-        # falls through to a fresh measurement instead of pinning forever.)
-        for knob in sorted(wanted):
-            os.environ[knob] = cached[knob]
-            report[knob] = {"picked": cached[knob], "cached": True}
-            log(f"autotune: {knob}={cached[knob]} (cached, {key})")
+    # export every cached wanted knob up front; only the remainder is
+    # measured. A seed file (AUTOTUNE_SEED.json) typically covers the big
+    # knobs, so a fresh container sweeps just the unseeded ones instead of
+    # everything — each avoided sweep is tunnel-wedge exposure avoided.
+    for knob in sorted(wanted & set(cached)):
+        os.environ[knob] = cached[knob]
+        report[knob] = {"picked": cached[knob], "cached": True}
+        log(f"autotune: {knob}={cached[knob]} (cached, {key})")
+    wanted -= set(cached)
+    if not wanted:
         return report
 
     rtt = measure_rtt_floor()
-    if want_xcorr:
+    if "TMR_XCORR_IMPL_SMALL" in wanted:
         # capacity 17 = the typical FSCD exemplar bucket; the winner is
         # exported through the SMALL-scoped knob (see module docstring)
         times = pick_xcorr_impl(batch, cfg.emb_dim, up_hw, 17, rtt=rtt,
@@ -402,7 +431,7 @@ def autotune(
             report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
             log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
 
-    if want_prec:
+    if "TMR_XCORR_PRECISION" in wanted:
         # sweep AFTER the impl pick so precision is measured on the winning
         # small-bucket formulation. Resolve the active small-bucket impl
         # exactly the way ops/xcorr.py dispatches it: explicit
@@ -447,11 +476,11 @@ def autotune(
                 log("autotune: TMR_XCORR_PRECISION=highest "
                     f"(no 'highest' baseline in {times})")
 
-    for knob, picker, want in (
-        ("TMR_WIN_ATTN", pick_win_attn_impl, want_attn),
-        ("TMR_GLOBAL_ATTN", pick_global_attn_impl, want_glob),
+    for knob, picker in (
+        ("TMR_WIN_ATTN", pick_win_attn_impl),
+        ("TMR_GLOBAL_ATTN", pick_global_attn_impl),
     ):
-        if not want:
+        if knob not in wanted:
             continue
         vc = VIT_CONFIGS[vit_kind]
         times = picker(
