@@ -66,6 +66,16 @@ def top_ops(trace_dir: str, n: int = 10) -> dict:
         for xs in spaces
         for plane in xs.planes
     )
+    # wrapper/frame events that are nesting spans, not ops — excluded in
+    # the host-plane FALLBACK so the XLA client thread (real op events)
+    # outranks the python main thread (PjitFunction/Execute spans cover the
+    # ops plus dispatch and would win any duration ranking). Device planes
+    # carry none of these.
+    _WRAPPERS = ("$", "PjitFunction", "PjRtCpu", "XlaComputation")
+
+    def _is_wrapper(name: str) -> bool:
+        return name.startswith(_WRAPPERS)
+
     best_plane = None
     best_events = None
     best_total = -1.0
@@ -82,6 +92,8 @@ def top_ops(trace_dir: str, n: int = 10) -> dict:
                 agg = defaultdict(lambda: [0, 0.0])  # name -> [count, ps]
                 for ev in line.events:
                     name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                    if not have_device_events and _is_wrapper(name):
+                        continue
                     a = agg[name]
                     a[0] += 1
                     a[1] += ev.duration_ps
