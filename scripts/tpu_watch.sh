@@ -81,7 +81,7 @@ while true; do
     # persists the default headline batch, which must be measured on the
     # same formulations the headline actually runs (bench_train re-pins
     # the parity precision internally)
-    env $tuned timeout 3600 python scripts/bench_extra.py \
+    env $tuned timeout 5400 python scripts/bench_extra.py \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra rc=$? -> $OUT/bench_extra_live.json"
     # traced bench runs LAST: jax.profiler over the axon transport is
