@@ -116,6 +116,8 @@ def main():
         # windowed blocks TMR_WIN_ATTN (both trace-time)
         ("one_global_block_blockwise", 0, "TMR_GLOBAL_ATTN", "blockwise"),
         ("one_global_block_flash", 0, "TMR_GLOBAL_ATTN", "flash"),
+        ("one_global_block_blockfolded", 0, "TMR_GLOBAL_ATTN", "blockfolded"),
+        ("one_global_block_pallas", 0, "TMR_GLOBAL_ATTN", "pallas"),
         ("one_windowed_block", 14, "TMR_WIN_ATTN", "dense"),
         ("one_windowed_block_folded", 14, "TMR_WIN_ATTN", "folded"),
         ("one_windowed_block_flash", 14, "TMR_WIN_ATTN", "flash"),

@@ -175,8 +175,45 @@ def blockwise_decomposed_attention(
         )
         return ob.astype(work)
 
-    out = jax.lax.map(one_band, (q_blocks, rh_blocks))  # (nb, B, H, rows, gw, D)
-    return jnp.moveaxis(out, 0, 2).reshape(B, H, S, D)
+    out = jax.lax.map(one_band, (q_blocks, rh_blocks))  # (nb, B, H, rows, gw, Dv)
+    # output width comes from v: under the folded-QK variant q/k are
+    # augmented past v's head dim
+    return jnp.moveaxis(out, 0, 2).reshape(B, H, S, v.shape[-1])
+
+
+def blockfolded_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """The blockwise band scan with the bias folded into the QK contraction.
+
+    Same banded schedule as :func:`blockwise_decomposed_attention`, but q/k
+    are first augmented (ops/flash_attn.fold_rel_pos_into_qk: q' carries
+    [q*scale | q.RH | q.RW], k' carries [k | row one-hots | col one-hots]) so
+    each band's (rows*gw, S) f32 score tile arrives from ONE einsum with the
+    bias already inside. The two bias einsums and — the expensive part — the
+    two f32 broadcast-add passes over the score tile disappear; per-band HBM
+    traffic drops by roughly a third at ~2x the (tiny relative to bandwidth)
+    QK FLOPs. Algebraically exact in f32; under bf16 inputs the bias terms
+    round to bf16 before the f32-accumulated matmul, where the blockwise
+    path keeps them f32 — so this is an autotune-selected variant
+    (TMR_GLOBAL_ATTN=blockfolded), never the parity default.
+    """
+    if rh is None:
+        return blockwise_decomposed_attention(q, k, v, None, None, grid_hw, scale)
+    from tmr_tpu.ops.flash_attn import fold_rel_pos_into_qk
+
+    q_aug, k_aug = fold_rel_pos_into_qk(q, k, rh, rw, grid_hw, scale)
+    # v keeps the original head dim: the band einsum takes its output width
+    # from v, so the augmented contraction never widens the result
+    return blockwise_decomposed_attention(
+        q_aug, k_aug, v, None, None, grid_hw, 1.0
+    )
 
 
 class Attention(nn.Module):
@@ -236,21 +273,44 @@ class Attention(nn.Module):
             x = self._ring_attn(q, k, v, rh, rw, (b, h, w, dim), head_dim)
         elif h * w >= 1024:
             # global-attention blocks (4096+ tokens): never materialize the
-            # S x S scores or the (B, H, h, w, h, w) bias. On TPU in bf16,
-            # the Pallas flash kernel runs the rel-pos bias folded into the
-            # QK contraction (ops/flash_attn.py) behind a per-geometry compiled
-            # self-check; everywhere else (and for exact-f32 parity) the XLA
-            # blockwise path. TMR_GLOBAL_ATTN (trace-time A/B knob, measured
-            # by the autotune sweep like TMR_WIN_ATTN): "auto" = flash when
-            # available, "blockwise"/"flash" force — "flash" still falls
-            # back when the gates say the kernel can't run this geometry.
+            # S x S scores or the (B, H, h, w, h, w) bias. TMR_GLOBAL_ATTN
+            # (trace-time A/B knob, measured by the autotune sweep like
+            # TMR_WIN_ATTN) picks the formulation:
+            #   blockwise    exact XLA band scan (the f32-parity default)
+            #   blockfolded  band scan, bias folded into the QK contraction
+            #                (bias rounds to input dtype; ungated)
+            #   flash        stock Pallas flash over the 256-padded folded
+            #                QK (bf16 only; self-check gate -> blockwise)
+            #   pallas       custom decomposed-bias kernel, VMEM-resident
+            #                tiles at native head dim (ops/pallas_attn.py;
+            #                self-check gate -> blockwise)
+            #   auto         flash when its gate passes, else blockwise
             impl = os.environ.get("TMR_GLOBAL_ATTN", "auto")
-            if impl not in ("auto", "blockwise", "flash"):
+            if impl not in (
+                "auto", "blockwise", "flash", "blockfolded", "pallas"
+            ):
                 raise ValueError(
-                    f"TMR_GLOBAL_ATTN={impl!r}: expected auto|blockwise|flash"
+                    f"TMR_GLOBAL_ATTN={impl!r}: expected "
+                    "auto|blockwise|flash|blockfolded|pallas"
                 )
             attn_fn = blockwise_decomposed_attention
-            if impl != "blockwise" and self.dtype == jnp.bfloat16:
+            if impl == "blockfolded":
+                attn_fn = blockfolded_decomposed_attention
+            elif impl == "pallas":
+                # the custom decomposed-bias kernel (ops/pallas_attn.py):
+                # VMEM-resident online-softmax tiles, native head-dim
+                # contraction; self-checked per geometry with fallback
+                from tmr_tpu.ops.pallas_attn import (
+                    pallas_decomposed_attention,
+                    pallas_global_ok,
+                    pallas_supported,
+                )
+
+                if pallas_supported(h * w) and pallas_global_ok(
+                    h, w, head_dim
+                ):
+                    attn_fn = pallas_decomposed_attention
+            elif impl != "blockwise" and self.dtype == jnp.bfloat16:
                 from tmr_tpu.ops.flash_attn import (
                     flash_attention_ok,
                     flash_decomposed_attention,

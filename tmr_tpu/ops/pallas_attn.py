@@ -1,0 +1,256 @@
+"""Custom Pallas TPU kernel: global attention with decomposed rel-pos bias.
+
+The 4 global-attention blocks dominate the flagship program's runtime
+(PROFILE_LIVE: ~55 ms/block of the 394 ms batch-4 budget at 1024, vs ~1 ms
+of pure matmul FLOPs). The XLA blockwise path (models/vit.py) is bandwidth-
+bound: every band's (rows*gw, S) f32 score tile makes ~5 HBM passes
+(write, bias adds, softmax reductions). The stock Pallas flash kernel with
+the bias folded into a 256-lane-padded contraction measured *worse*
+(~68 ms). This kernel keeps scores resident in VMEM:
+
+- grid (B*H, S/BQ, S/BK), k-axis innermost ("arbitrary" semantics), online
+  softmax with running (m, l, acc) f32 scratch — no score tensor ever
+  reaches HBM;
+- the decomposed bias (reference sam_ViT.py:325-361 semantics:
+  bias[q=(y,x), k=(ky,kx)] = (q.RH)[y,ky] + (q.RW)[x,kx]) is applied per
+  tile from the SMALL precomputed projections rel_h_q (B*H, S, gh) and
+  rel_w_q (B*H, S, gw), expanded to the (BQ, BK) tile by two one-hot
+  selector matmuls built from iota — MXU work on (BQ, gh)x(gh, BK), no
+  dynamic lane slicing, no (S, S) bias materialization;
+- qk/av contractions stay at the native head dim (64/80), f32 accumulate.
+
+Exactness: identical math to blockwise_decomposed_attention up to
+input-dtype rounding of the bias projections (bf16 deployment rounds them
+once; the f32 path matches blockwise to float-associativity). Gated like
+every Pallas path here: per-geometry compiled self-check against the exact
+blockwise oracle, fallback on any failure (ops/flash_attn._self_check).
+
+Training: a ``jax.custom_vjp`` whose backward recomputes gradients through
+the exact blockwise formulation — the forward speed is what matters for the
+eval/deploy path, and the backward stays bit-identical to the parity
+implementation (no handwritten flash backward to validate).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, rhq_ref, rwq_ref, out_ref,
+    m_ref, l_ref, acc_ref,
+    *, scale: float, gw: int, bk: int, nk: int, has_bias: bool,
+):
+    """One (batch*head, q-block, k-block) step of online-softmax attention.
+
+    Refs (VMEM blocks): q (1, BQ, D), k/v (1, BK, D), rhq (1, BQ, gh),
+    rwq (1, BQ, gw), out (1, BQ, D); scratch m/l (BQ, 128) f32 running
+    max/denominator (lane-broadcast), acc (BQ, D) f32 running numerator.
+    """
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (BQ, BK)
+
+    if has_bias:
+        # decomposed bias for this tile. k-token j of block ik sits at grid
+        # (ky, kx) = divmod(ik*BK + j, gw); select the matching columns of
+        # the precomputed q-projections with one-hot matmuls (iota-built,
+        # MXU-fed).
+        gh = rhq_ref.shape[-1]
+        k_tok = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        ky = k_tok // gw  # (1, BK)
+        kx = k_tok % gw
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (gh, 1), 0)
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (gw, 1), 0)
+        sel_h = (row_ids == ky).astype(jnp.float32)  # (gh, BK)
+        sel_w = (col_ids == kx).astype(jnp.float32)  # (gw, BK)
+        s += jax.lax.dot_general(
+            rhq_ref[0].astype(jnp.float32), sel_h, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s += jax.lax.dot_general(
+            rwq_ref[0].astype(jnp.float32), sel_w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    m_prev = m_ref[:, :1]  # (BQ, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)  # (BQ, 1)
+    p = jnp.exp(s - m_new)  # (BQ, BK) f32
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        out_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(out_ref.dtype)
+
+
+def _attn_kernel_nobias(
+    q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, gw: int, bk: int, nk: int,
+):
+    """use_rel_pos=False arity: no bias-projection inputs, no selector
+    matmuls — the has_bias=False specialization drops them statically."""
+    _attn_kernel(
+        q_ref, k_ref, v_ref, None, None, out_ref, m_ref, l_ref, acc_ref,
+        scale=scale, gw=gw, bk=bk, nk=nk, has_bias=False,
+    )
+
+
+def _pick_block(s: int, preferred: int = 512) -> Optional[int]:
+    # delegates to the one block-selection rule (flash_attn._block_for) so
+    # the flash and pallas gates can never diverge; kept as a module-level
+    # name so tests can monkeypatch the preferred size
+    from tmr_tpu.ops.flash_attn import _block_for
+
+    return _block_for(s, preferred)
+
+
+def pallas_supported(seq_len: int) -> bool:
+    return _pick_block(seq_len) is not None
+
+
+def pallas_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Drop-in for blockwise_decomposed_attention (q/k/v (B, H, S, D),
+    rh (gh, gh, D) / rw (gw, gw, D) tables or None) running the VMEM-resident
+    kernel above. Differentiable: backward recomputes through the exact
+    blockwise path (module docstring). Off-TPU the kernel runs in the Pallas
+    interpreter (CPU tests); the production gate (pallas_global_ok) already
+    refuses off-TPU backends, so only tests reach that mode."""
+    return _pallas_attn_vjp(q, k, v, rh, rw, grid_hw, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _pallas_attn_vjp(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale)
+
+
+def _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    bq = _pick_block(S)
+    bk = _pick_block(S)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"sequence length {S} has no power-of-two block >= 128; gate "
+            "callers on pallas_supported()"
+        )
+    bh = B * H
+    nq = S // bq
+    nk = S // bk
+    qkv_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+    ]
+    inputs = [q.reshape(bh, S, D), k.reshape(bh, S, D), v.reshape(bh, S, D)]
+    if rh is not None:
+        qf = q.reshape(B, H, gh, gw, D).astype(jnp.float32)
+        inputs.append(jnp.einsum(
+            "bhywd,ykd->bhywk", qf, rh.astype(jnp.float32)
+        ).reshape(bh, S, gh))
+        inputs.append(jnp.einsum(
+            "bhywd,wkd->bhywk", qf, rw.astype(jnp.float32)
+        ).reshape(bh, S, gw))
+        in_specs = qkv_specs + [
+            pl.BlockSpec((1, bq, gh), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, gw), lambda b, iq, ik: (b, iq, 0)),
+        ]
+        kernel = functools.partial(
+            _attn_kernel, scale=scale, gw=gw, bk=bk, nk=nk, has_bias=True
+        )
+    else:
+        in_specs = qkv_specs
+        kernel = functools.partial(
+            _attn_kernel_nobias, scale=scale, gw=gw, bk=bk, nk=nk
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(*inputs)
+    return out.reshape(B, H, S, D)
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_global_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """Per-geometry compiled self-check of this kernel against the exact
+    blockwise oracle (forward AND backward — the backward here IS blockwise,
+    so the grad half guards only the custom_vjp plumbing). Same policy as
+    flash_attention_ok: reduced batch/heads, full grid/blocks/head-dim."""
+    from tmr_tpu.ops.flash_attn import _self_check
+
+    return _self_check(pallas_decomposed_attention, 1, 2, gh, gw, head_dim)
+
+
+def _vjp_fwd(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale), (
+        q, k, v, rh, rw,
+    )
+
+
+def _vjp_bwd(grid_hw, scale, res, g):
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    q, k, v, rh, rw = res
+    if rh is None:
+        _, pull = jax.vjp(
+            lambda a, b, c: blockwise_decomposed_attention(
+                a, b, c, None, None, grid_hw, scale),
+            q, k, v,
+        )
+        dq, dk, dv = pull(g)
+        return dq, dk, dv, None, None
+    _, pull = jax.vjp(
+        lambda a, b, c, d, e: blockwise_decomposed_attention(
+            a, b, c, d, e, grid_hw, scale),
+        q, k, v, rh, rw,
+    )
+    return pull(g)
+
+
+_pallas_attn_vjp.defvjp(_vjp_fwd, _vjp_bwd)
