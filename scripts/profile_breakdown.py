@@ -166,7 +166,8 @@ def main():
         for cap, impl, prec in (
             (17, "conv", "highest"), (17, "conv", "default"),
             (17, "conv", "bf16"), (17, "vmap", "highest"),
-            (17, "vmap", "bf16"), (17, "fft", "highest"),
+            (17, "vmap", "default"), (17, "vmap", "bf16"),
+            (17, "fft", "highest"),
             (17, "pallas", "highest"), (17, "convnhwc", "highest"),
             (127, "auto", "highest"),
         ):
