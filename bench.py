@@ -285,9 +285,19 @@ def _run(cancel_watchdog) -> None:
     if ckpt:
         import orbax.checkpoint as ocp
 
-        predictor.params = ocp.StandardCheckpointer().restore(
+        restored = ocp.StandardCheckpointer().restore(
             os.path.abspath(ckpt), target=predictor.params
         )
+        # orbax returns COMMITTED arrays whose explicit shardings annotate
+        # every param of the lowered program, forcing a recompile into a
+        # measurably slower binary for identical values (PERF.md session 5;
+        # scripts/ckpt_probe.py isolates init vs restored vs round-trip).
+        # A host round-trip re-stages them as ordinary uncommitted arrays
+        # so the measured program is EXACTLY the headline's (single-chip
+        # bench; a sharded multi-host restore would need device_put
+        # shardings instead).
+        predictor.params = jax.device_put(jax.device_get(restored))
+        del restored
         global _WEIGHTS
         _WEIGHTS = "restored ckpt"
         _progress(f"params restored from {ckpt}")

@@ -1,0 +1,94 @@
+#!/usr/bin/env bash
+# Session-5 recovery battery: runs ONCE when the tunnel next serves,
+# strictly serialized (one tunnel client at a time — PERF.md wedge trigger).
+# Ordered by value-per-wedge-risk: cheap cached-vit_b A/B experiments first,
+# cold vit_h/1536 compiles (the stage that wedged the 09:12 battery) LAST.
+#   1. global-attn one-block sweep incl. the new blockfolded/pallas kernels
+#   2. headline bench under the measured global winner (cached elsewhere)
+#   3. headline bench under TMR_WIN_ATTN=dense (one-block says dense beats
+#      the seeded flash pick)
+#   4. trained-ckpt anomaly probe: restored-as-is vs host-roundtripped
+#      params (sdy.sharding annotations are the prime suspect)
+#   5. traced bench + xprof top-ops extraction
+#   6. bench_extra remaining stages (batch_sweep,1536,refine,train,stream)
+# Results land as working-tree files; the session driver commits.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${TMR_WATCH_OUT:-$REPO}"
+LOG="${TMR_WATCH_LOG:-/tmp/tpu_watch2.log}"
+
+log() { echo "[$(date +%H:%M:%S)] $*" >>"$LOG"; }
+
+probe() {
+  timeout 150 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+x = jnp.ones((256, 256), jnp.bfloat16)
+print(jax.device_get(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x)))
+" >>"$LOG" 2>&1
+}
+
+log "watch2 started (pid $$)"
+while true; do
+  if probe; then
+    log "TPU ALIVE — running session-5 experiment battery"
+    cd "$REPO"
+    # 1: one-block global-attention sweep (all four formulations)
+    timeout 2400 python -u -c "
+import json
+from tmr_tpu.utils.autotune import pick_global_attn_impl
+t = pick_global_attn_impl(4, 64, 768, 12, log=lambda s: None)
+print(json.dumps({'one_global_block_sec': t}))
+" >"$OUT/global_attn_sweep.json" 2>>"$LOG"
+    log "global sweep rc=$? -> $OUT/global_attn_sweep.json"
+    # 2: headline with the pallas kernel forced (winner check happens at
+    # analysis time; a gate-refused geometry silently falls back, which the
+    # bench JSON will show as an unchanged number)
+    TMR_GLOBAL_ATTN=pallas TMR_BENCH_ALARM=2700 timeout 3000 \
+      python bench.py >"$OUT/bench_pallas.json" 2>>"$LOG"
+    log "bench (pallas) rc=$? -> $OUT/bench_pallas.json"
+    # 3: headline with dense windowed attention (keep the global winner
+    # from the autotune cache for everything else)
+    TMR_WIN_ATTN=dense TMR_BENCH_ALARM=2700 timeout 3000 \
+      python bench.py >"$OUT/bench_windense.json" 2>>"$LOG"
+    log "bench (win dense) rc=$? -> $OUT/bench_windense.json"
+    # 3b: both winners combined
+    TMR_GLOBAL_ATTN=pallas TMR_WIN_ATTN=dense TMR_BENCH_ALARM=2700 \
+      timeout 3000 python bench.py >"$OUT/bench_combined.json" 2>>"$LOG"
+    log "bench (combined) rc=$? -> $OUT/bench_combined.json"
+    # 4: ckpt anomaly probe (only if the battery's ckpt still exists)
+    if [ -d "$OUT/bench_ckpt/params" ]; then
+      timeout 2400 python -u scripts/ckpt_probe.py \
+        >"$OUT/ckpt_probe.json" 2>>"$LOG"
+      log "ckpt probe rc=$? -> $OUT/ckpt_probe.json"
+    fi
+    # 5: traced bench + xprof top ops (profiling over the tunnel is the
+    # least-proven path; after the A/Bs on purpose)
+    rm -rf "$OUT/xprof"
+    TMR_BENCH_CHAIN=3 TMR_BENCH_PROFILE="$OUT/xprof" \
+      TMR_BENCH_ALARM=2100 timeout 2400 python bench.py \
+      >"$OUT/bench_traced.json" 2>>"$LOG"
+    log "bench (traced) rc=$? -> $OUT/bench_traced.json"
+    if grep -q '"value"' "$OUT/bench_traced.json" 2>/dev/null \
+        && ! grep -q '"error"' "$OUT/bench_traced.json" 2>/dev/null; then
+      python scripts/xprof_top_ops.py "$OUT/xprof" 15 \
+        >"$OUT/xprof_top_ops.json" 2>>"$LOG"
+      log "xprof_top_ops rc=$? -> $OUT/xprof_top_ops.json"
+      if ! grep -q '"error"' "$OUT/xprof_top_ops.json" 2>/dev/null; then
+        cp "$OUT/xprof_top_ops.json" "$REPO/XPROF_TOP_OPS_LIVE.json" \
+          2>/dev/null
+      fi
+    fi
+    # 6: the bench_extra stages the 09:12 wedge consumed (cold vit_h/1536
+    # compiles — the riskiest stage runs when everything else is banked)
+    timeout 5400 python scripts/bench_extra.py \
+      --only batch_sweep,1536,refine,train,stream \
+      >"$OUT/bench_extra_live.json" 2>>"$LOG"
+    log "bench_extra (rest) rc=$? -> $OUT/bench_extra_live.json"
+    log "battery done"
+    break
+  fi
+  log "probe failed; sleeping 600s"
+  sleep 600
+done
