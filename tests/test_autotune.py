@@ -302,6 +302,65 @@ def test_autotune_cache_persists_winners_across_processes(
     assert calls == ["x", "w", "g", "x", "w", "g", "x", "w", "g"]
 
 
+def test_cached_winner_stale_when_variant_set_grows(clean_knobs, monkeypatch):
+    """A cached winner is versioned by the variant set it beat
+    (_variants_<knob>): growing the set (a new kernel) or a stamp-less
+    legacy entry must trigger a re-sweep so new variants get their shot,
+    while correctly stamped siblings stay cached."""
+    calls = []
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: calls.append("x") or {"conv": 0.03, "fft": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl",
+        lambda *a, **k: calls.append("w") or {"dense": 0.02, "folded": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: calls.append("g") or {"blockwise": 0.02,
+                                              "flash": 0.01},
+    )
+    r1 = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert calls == ["x", "w", "g"]
+
+    # cached entries were stamped: a rerun re-measures nothing
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert calls == ["x", "w", "g"]
+
+    # the global-attn variant set grows (new kernel lands): ONLY that knob
+    # re-sweeps; the stamped siblings stay cached
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "GLOBAL_ATTN_VARIANTS",
+        at.GLOBAL_ATTN_VARIANTS + ("newkernel",),
+    )
+    r3 = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert calls == ["x", "w", "g", "g"]
+    assert r3["TMR_XCORR_IMPL_SMALL"].get("cached") is True
+    assert r3["TMR_WIN_ATTN"].get("cached") is True
+    assert "cached" not in r3["TMR_GLOBAL_ATTN"]
+
+    # legacy stamp-less entries (pre-versioning caches/seeds) also re-sweep
+    import json
+    path = os.environ["TMR_AUTOTUNE_CACHE"]
+    j = json.load(open(path))
+    for entry in j.values():
+        for kk in list(entry):
+            if kk.startswith("_variants_"):
+                del entry[kk]
+    json.dump(j, open(path, "w"))
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert calls == ["x", "w", "g", "g", "x", "w", "g"]
+
+
 def test_autotune_cached_hit_respects_explicit_knobs(
     clean_knobs, monkeypatch
 ):
@@ -424,6 +483,12 @@ def test_autotune_seed_file_partial_sweep(clean_knobs, monkeypatch, tmp_path):
         jax.devices()[0].device_kind, 1024, 128, 4, 512, "vit_b"))
     seed.write_text(json.dumps({key: {
         "TMR_XCORR_IMPL_SMALL": "vmap", "TMR_WIN_ATTN": "flash",
+        # seeds carry the variant sets their winners beat (an unstamped
+        # entry is treated as stale — covered by
+        # test_cached_winner_stale_when_variant_set_grows)
+        "_variants_TMR_XCORR_IMPL_SMALL": at._variants_sig(
+            "TMR_XCORR_IMPL_SMALL"),
+        "_variants_TMR_WIN_ATTN": at._variants_sig("TMR_WIN_ATTN"),
     }}))
     monkeypatch.setenv("TMR_AUTOTUNE_SEED", str(seed))
 

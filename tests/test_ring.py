@@ -437,16 +437,18 @@ def test_pallas_decomposed_attention_matches_blockwise():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_pallas_attention_multiblock_seq():
+@pytest.mark.parametrize("gh,gw,D", [(16, 32, 8), (16, 32, 80)])
+def test_pallas_attention_multiblock_seq(gh, gw, D):
     """S=512 at block 256 forces a real multi-k-block online-softmax pass
-    (running max/denominator rescaling across iterations)."""
+    (running max/denominator rescaling across iterations); D=80 is vit_h's
+    head dim — not lane-aligned, exercising the kernel's padded tiles."""
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
     from tmr_tpu.ops import pallas_attn
 
     rng = np.random.default_rng(14)
-    B, H, gh, gw, D = 1, 1, 16, 32, 8  # S=512 -> blocks of 512? force 256
+    B, H = 1, 1
     S = gh * gw
     q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)

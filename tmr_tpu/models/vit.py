@@ -310,6 +310,17 @@ class Attention(nn.Module):
                     h, w, head_dim
                 ):
                     attn_fn = pallas_decomposed_attention
+                else:
+                    # explicit request refused by the gate: an A/B number
+                    # measured now would silently be blockwise — say so
+                    # once, at trace time
+                    import warnings
+
+                    warnings.warn(
+                        "TMR_GLOBAL_ATTN=pallas: self-check gate refused "
+                        f"grid ({h}, {w}, head_dim {head_dim}); running "
+                        "blockwise fallback"
+                    )
             elif impl != "blockwise" and self.dtype == jnp.bfloat16:
                 from tmr_tpu.ops.flash_attn import (
                     flash_attention_ok,
@@ -321,6 +332,24 @@ class Attention(nn.Module):
                     h, w, head_dim
                 ):
                     attn_fn = flash_decomposed_attention
+                elif impl == "flash":
+                    import warnings
+
+                    warnings.warn(
+                        "TMR_GLOBAL_ATTN=flash: gate refused grid "
+                        f"({h}, {w}, head_dim {head_dim}); running "
+                        "blockwise fallback"
+                    )
+            elif impl == "flash":
+                # explicit flash on a non-bf16 model: the kernel is
+                # bf16-only, so the request silently lands on blockwise —
+                # say so or an A/B records blockwise timings labeled flash
+                import warnings
+
+                warnings.warn(
+                    f"TMR_GLOBAL_ATTN=flash needs bf16 (model dtype "
+                    f"{self.dtype}); running blockwise fallback"
+                )
             x = attn_fn(
                 q, k, v,
                 rh if self.use_rel_pos else None,

@@ -271,6 +271,27 @@ def _cache_load() -> Dict[str, dict]:
     return out
 
 
+#: knobs whose cache entries are versioned by the variant tuple the sweep
+#: measured against (recorded as ``_variants_<knob>``): a cached winner
+#: predating the current tuple is stale — a newly added variant (e.g. a
+#: new kernel) must get its chance at the next hardware sweep instead of
+#: being silently locked out by an older pick.
+_VERSIONED_KNOBS = (
+    "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
+    "TMR_XCORR_PRECISION",
+)
+
+
+def _variants_sig(knob: str) -> str:
+    sets = {
+        "TMR_XCORR_IMPL_SMALL": XCORR_VARIANTS,
+        "TMR_WIN_ATTN": WIN_ATTN_VARIANTS,
+        "TMR_GLOBAL_ATTN": GLOBAL_ATTN_VARIANTS,
+        "TMR_XCORR_PRECISION": XCORR_PRECISIONS,
+    }
+    return ",".join(sets[knob])
+
+
 def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
     valid = {
         "TMR_XCORR_IMPL_SMALL": set(XCORR_VARIANTS) | {"auto"},
@@ -298,6 +319,9 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
                 vv in valid.get(kk, ())
                 or (kk in digit_keys and vv.isascii() and vv.isdigit()
                     and int(vv) > 0)
+                # variant-set version stamps: free-form comma-joined
+                # names, compared verbatim against _variants_sig()
+                or kk.startswith("_variants_")
             )
         }
         if kept:
@@ -380,6 +404,15 @@ def autotune(
     )
     force = os.environ.get("TMR_AUTOTUNE_FORCE", "") not in ("", "0")
     cached = {} if force else _cache_load().get(key, {})
+    for knob in _VERSIONED_KNOBS:
+        if knob in cached and cached.get(
+            f"_variants_{knob}"
+        ) != _variants_sig(knob):
+            # the winner predates the current variant set (or carries no
+            # stamp): stale — re-measure so new variants get their shot
+            cached.pop(knob)
+            log(f"autotune: cached {knob} predates the current variant "
+                "set; re-measuring")
 
     wanted = set()
     if (
@@ -495,5 +528,13 @@ def autotune(
         extra = {}
         if "TMR_XCORR_PRECISION" in report:
             extra["_precision_impl"] = _active_small_impl({})
+        for knob in _VERSIONED_KNOBS:
+            # stamp every exported winner — fresh sweeps beat the current
+            # set by construction, and cached hits passed the staleness
+            # check against it; leaving cached knobs unstamped would let a
+            # later seed's fresh stamp vouch for a stale user-cache value
+            # through the knob-level merge in _cache_load
+            if knob in report:
+                extra[f"_variants_{knob}"] = _variants_sig(knob)
         _cache_store(key, report, extra)
     return report
