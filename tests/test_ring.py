@@ -380,6 +380,19 @@ def test_global_attn_env_dispatch_blockfolded(monkeypatch):
     with pytest.raises(ValueError, match="TMR_GLOBAL_ATTN"):
         jax.jit(attn.apply)(params, x)
 
+    # an explicit pallas request whose gate refuses (always true off-TPU)
+    # must WARN about the blockwise fallback — a silent fallback corrupts
+    # A/B measurements by recording blockwise timings under another label
+    import warnings as _warnings
+
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "pallas")
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        got_p = jax.jit(attn.apply)(params, x)
+    assert any("blockwise fallback" in str(r.message) for r in rec)
+    np.testing.assert_allclose(np.asarray(got_p), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
 
 def test_pallas_decomposed_attention_matches_blockwise():
     """The custom VMEM-resident global-attention kernel
