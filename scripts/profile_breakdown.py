@@ -112,27 +112,55 @@ def main():
         rng.standard_normal((BATCH, grid, grid, 768)), jnp.bfloat16
     )
     cases = (
-        # (label, window, knob, value): global blocks read TMR_GLOBAL_ATTN,
-        # windowed blocks TMR_WIN_ATTN (both trace-time)
-        ("one_global_block_blockwise", 0, "TMR_GLOBAL_ATTN", "blockwise"),
-        ("one_global_block_flash", 0, "TMR_GLOBAL_ATTN", "flash"),
-        ("one_global_block_blockfolded", 0, "TMR_GLOBAL_ATTN", "blockfolded"),
-        ("one_global_block_pallas", 0, "TMR_GLOBAL_ATTN", "pallas"),
-        ("one_windowed_block", 14, "TMR_WIN_ATTN", "dense"),
-        ("one_windowed_block_folded", 14, "TMR_WIN_ATTN", "folded"),
-        ("one_windowed_block_flash", 14, "TMR_WIN_ATTN", "flash"),
+        # (label, window, {knob: value}): global blocks read TMR_GLOBAL_ATTN,
+        # windowed blocks TMR_WIN_ATTN (all trace-time); the pallas rows also
+        # sweep the kernel's tile sizes (TMR_PALLAS_ATTN_BQ/BK)
+        ("one_global_block_blockwise", 0, {"TMR_GLOBAL_ATTN": "blockwise"}),
+        ("one_global_block_flash", 0, {"TMR_GLOBAL_ATTN": "flash"}),
+        ("one_global_block_blockfolded", 0,
+         {"TMR_GLOBAL_ATTN": "blockfolded"}),
+        ("one_global_block_pallas", 0, {"TMR_GLOBAL_ATTN": "pallas"}),
+        ("one_global_block_pallas_bq256", 0,
+         {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BQ": "256"}),
+        ("one_global_block_pallas_bk1024", 0,
+         {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BK": "1024"}),
+        ("one_windowed_block", 14, {"TMR_WIN_ATTN": "dense"}),
+        ("one_windowed_block_folded", 14, {"TMR_WIN_ATTN": "folded"}),
+        ("one_windowed_block_flash", 14, {"TMR_WIN_ATTN": "flash"}),
     )
     # restore the user's knobs afterwards (autotune's _restore): the
     # full-program timing in section 1 honoured them, and later sections /
     # the rest of the process must keep seeing them
     from tmr_tpu.utils.autotune import _restore
 
-    prev_win = os.environ.get("TMR_WIN_ATTN")
-    prev_glob = os.environ.get("TMR_GLOBAL_ATTN")
+    prev = {
+        k: os.environ.get(k)
+        for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
+                  "TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK")
+    }
     try:
-        for label, win, knob, win_impl in cases:
+        for label, win, knobs in cases:
+            if "TMR_PALLAS_ATTN_BQ" in knobs or "TMR_PALLAS_ATTN_BK" in knobs:
+                # skip tile rows whose preference clamps back to the default
+                # tile at this S — they would re-measure the plain pallas
+                # row under a label claiming a different tile size
+                from tmr_tpu.ops.flash_attn import _block_for
+
+                s_glob = grid * grid
+                eff = (
+                    _block_for(s_glob,
+                               int(knobs.get("TMR_PALLAS_ATTN_BQ", 512))),
+                    _block_for(s_glob,
+                               int(knobs.get("TMR_PALLAS_ATTN_BK", 512))),
+                )
+                if eff == (_block_for(s_glob, 512), _block_for(s_glob, 512)):
+                    _progress(f"stage 3: {label} skipped (tiles clamp to "
+                              f"the default {eff} at S={s_glob})")
+                    continue
             _progress(f"stage 3: {label}")
-            os.environ[knob] = win_impl
+            for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK"):
+                os.environ.pop(k, None)  # tile overrides are per-case
+            os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
             bp = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
@@ -147,8 +175,8 @@ def main():
             )
             _progress(f"{label}: {report[label]*1000:.2f} ms")
     finally:
-        _restore(prev_win, "TMR_WIN_ATTN")
-        _restore(prev_glob, "TMR_GLOBAL_ATTN")
+        for k, v in prev.items():
+            _restore(v, k)
 
     # 4. matcher x-corr on the upsampled grid: every formulation at the
     # production capacity (TMR_XCORR_IMPL, read at trace time — ops/xcorr.py)

@@ -158,11 +158,30 @@ def _pallas_attn_vjp(q, k, v, rh, rw, grid_hw, scale):
     return _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale)
 
 
+def _env_tile(name: str, default: int) -> int:
+    """Preferred tile size from the env: a power of two >= 128 (the actual
+    tile is still the largest such divisor of S at or below it)."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer tile size")
+    if val < 128 or val & (val - 1):
+        raise ValueError(f"{name}={val}: expected a power of two >= 128")
+    return val
+
+
 def _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
     B, H, S, D = q.shape
     gh, gw = grid_hw
-    bq = _pick_block(S)
-    bk = _pick_block(S)
+    # TMR_PALLAS_ATTN_BQ/BK: preferred tile sizes for on-hardware block
+    # sweeps (still clamped to the largest power-of-two divisor of S)
+    bq = _pick_block(S, _env_tile("TMR_PALLAS_ATTN_BQ", 512))
+    bk = _pick_block(S, _env_tile("TMR_PALLAS_ATTN_BK", 512))
     if bq is None or bk is None:
         raise ValueError(
             f"sequence length {S} has no power-of-two block >= 128; gate "
