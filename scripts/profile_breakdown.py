@@ -127,6 +127,7 @@ def main():
         ("one_windowed_block", 14, {"TMR_WIN_ATTN": "dense"}),
         ("one_windowed_block_folded", 14, {"TMR_WIN_ATTN": "folded"}),
         ("one_windowed_block_flash", 14, {"TMR_WIN_ATTN": "flash"}),
+        ("one_windowed_block_pallas", 14, {"TMR_WIN_ATTN": "pallas"}),
     )
     # restore the user's knobs afterwards (autotune's _restore): the
     # full-program timing in section 1 honoured them, and later sections /

@@ -33,9 +33,10 @@ from tmr_tpu.models.common import LayerNorm2d, MLPBlock
 
 def _WIN_ATTN_IMPL() -> str:
     """Windowed-attention formulation, read at trace time: "dense" (separate
-    f32 bias einsums + adds), "folded" (bias inside the QK contraction), or
-    "flash" (Pallas kernel over 256-padded windows, bf16/TPU only). A/B knob
-    for hardware profiling — see Attention below.
+    f32 bias einsums + adds), "folded" (bias inside the QK contraction),
+    "flash" (stock Pallas kernel over 256-padded folded QK, bf16/TPU only),
+    or "pallas" (the custom decomposed-bias kernel, ops/pallas_attn.py).
+    A/B knob for hardware profiling — see Attention below.
 
     Default: "flash" on TPU, "dense" elsewhere. Measured, not assumed: the
     on-device autotune sweep picked flash at the production ViT-B/1024
@@ -52,6 +53,12 @@ def _flash_window_available(gh: int, gw: int, head_dim: int) -> bool:
     from tmr_tpu.ops.flash_attn import flash_window_ok
 
     return flash_window_ok(gh, gw, head_dim)
+
+
+def _pallas_window_available(gh: int, gw: int, head_dim: int) -> bool:
+    from tmr_tpu.ops.pallas_attn import pallas_window_ok
+
+    return pallas_window_ok(gh, gw, head_dim)
 
 
 def window_partition(x: jnp.ndarray, window: int):
@@ -371,7 +378,35 @@ class Attention(nn.Module):
 
             x = flash_windowed_attention(q, k, v, rh, rw, (h, w), scale)
             x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
+        elif (
+            self.use_rel_pos
+            and _WIN_ATTN_IMPL() == "pallas"
+            and _pallas_window_available(h, w, head_dim)
+        ):
+            # A/B variant (TMR_WIN_ATTN=pallas): the custom decomposed-bias
+            # kernel (ops/pallas_attn.py) on 128-padded window tiles with
+            # in-kernel pad-column masking — native head-dim contraction,
+            # per-tile bias from the small q-projections. Self-check gated
+            # with dense fallback.
+            from tmr_tpu.ops.pallas_attn import pallas_windowed_attention
+
+            x = pallas_windowed_attention(q, k, v, rh, rw, (h, w), scale)
+            x = x.transpose(0, 2, 1, 3).reshape(b, h, w, dim)
         else:
+            if os.environ.get("TMR_WIN_ATTN") in ("flash", "pallas"):
+                # an EXPLICIT kernel request landed here only because its
+                # gate (or dtype precondition) refused — warn, or an A/B
+                # records dense timings under the requested label. The
+                # TPU default ("flash" with no env set) falls back silently
+                # by design.
+                import warnings
+
+                warnings.warn(
+                    f"TMR_WIN_ATTN={os.environ['TMR_WIN_ATTN']}: gate or "
+                    f"dtype refused window grid ({h}, {w}, head_dim "
+                    f"{head_dim}, dtype {self.dtype}); running dense "
+                    "fallback"
+                )
             if self.use_rel_pos and _WIN_ATTN_IMPL() == "folded":
                 # A/B variant for the windowed blocks (TMR_WIN_ATTN=folded):
                 # the decomposed bias rides inside the QK contraction via the

@@ -48,6 +48,7 @@ def _attn_kernel(
     q_ref, k_ref, v_ref, rhq_ref, rwq_ref, out_ref,
     m_ref, l_ref, acc_ref,
     *, scale: float, gw: int, bk: int, nk: int, has_bias: bool,
+    valid_len: Optional[int] = None,
 ):
     """One (batch*head, q-block, k-block) step of online-softmax attention.
 
@@ -92,6 +93,13 @@ def _attn_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    if valid_len is not None:
+        # padded sequence (windowed attention: 196 tokens in a 256 tile):
+        # pad KEY columns must not receive probability mass. Pad QUERY rows
+        # produce garbage output sliced off by the caller.
+        col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < valid_len, s, _NEG_INF)
+
     m_prev = m_ref[:, :1]  # (BQ, 1)
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
@@ -120,6 +128,26 @@ def _attn_kernel_nobias(
         q_ref, k_ref, v_ref, None, None, out_ref, m_ref, l_ref, acc_ref,
         scale=scale, gw=gw, bk=bk, nk=nk, has_bias=False,
     )
+
+
+def _bias_projections(
+    q: jnp.ndarray, rh: jnp.ndarray, rw: jnp.ndarray,
+    grid_hw: Tuple[int, int],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, H, S, D) q + (gh, gh, D)/(gw, gw, D) tables -> the small f32
+    q-projections rel_h_q (B*H, S, gh), rel_w_q (B*H, S, gw) the kernel
+    rebuilds bias tiles from. The f32 cast and layout here are part of the
+    kernel's exactness contract with the blockwise oracle."""
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    qf = q.reshape(B, H, gh, gw, D).astype(jnp.float32)
+    rel_h_q = jnp.einsum(
+        "bhywd,ykd->bhywk", qf, rh.astype(jnp.float32)
+    ).reshape(B * H, S, gh)
+    rel_w_q = jnp.einsum(
+        "bhywd,wkd->bhywk", qf, rw.astype(jnp.float32)
+    ).reshape(B * H, S, gw)
+    return rel_h_q, rel_w_q
 
 
 def _pick_block(s: int, preferred: int = 512) -> Optional[int]:
@@ -197,13 +225,7 @@ def _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
     ]
     inputs = [q.reshape(bh, S, D), k.reshape(bh, S, D), v.reshape(bh, S, D)]
     if rh is not None:
-        qf = q.reshape(B, H, gh, gw, D).astype(jnp.float32)
-        inputs.append(jnp.einsum(
-            "bhywd,ykd->bhywk", qf, rh.astype(jnp.float32)
-        ).reshape(bh, S, gh))
-        inputs.append(jnp.einsum(
-            "bhywd,wkd->bhywk", qf, rw.astype(jnp.float32)
-        ).reshape(bh, S, gw))
+        inputs.extend(_bias_projections(q, rh, rw, grid_hw))
         in_specs = qkv_specs + [
             pl.BlockSpec((1, bq, gh), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, gw), lambda b, iq, ik: (b, iq, 0)),
@@ -233,6 +255,111 @@ def _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
         interpret=jax.default_backend() != "tpu",
     )(*inputs)
     return out.reshape(B, H, S, D)
+
+
+def pallas_windowed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: jnp.ndarray,
+    rw: jnp.ndarray,
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """The same VMEM-resident kernel for WINDOWED attention
+    (TMR_WIN_ATTN=pallas): q/k/v (B*num_windows, H, S, D) with S = the
+    window token count (196 for SAM's 14x14), padded to the next multiple
+    of 128 and masked in-kernel (pad key columns get -inf scores; pad query
+    rows are sliced off here). One (s_pad, s_pad) tile per (window, head)
+    program — no online-softmax chaining needed, the whole window fits.
+    Differentiable via the same recompute-through-blockwise backward as
+    the global kernel."""
+    return _pallas_win_vjp(q, k, v, rh, rw, grid_hw, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _pallas_win_vjp(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale)
+
+
+def _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    s_pad = max(128, -(-S // 128) * 128)
+    pad = s_pad - S
+    qp, kp, vp = (
+        jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0))) for t in (q, k, v)
+    )
+    rel_h_q, rel_w_q = _bias_projections(q, rh, rw, grid_hw)
+    rel_h_q = jnp.pad(rel_h_q, ((0, 0), (0, pad), (0, 0)))
+    rel_w_q = jnp.pad(rel_w_q, ((0, 0), (0, pad), (0, 0)))
+    # pad KEY columns still receive a (partial) bias: ky = k_tok // gw runs
+    # past gh so sel_h contributes nothing, but kx = k_tok % gw wraps back
+    # into the grid and sel_w DOES match — correctness rests entirely on
+    # the valid_len -inf mask applied after the bias add (the kernel masks
+    # before the softmax max). Do not treat the mask as redundant.
+
+    bh = B * H
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, gw=gw, bk=s_pad, nk=1, has_bias=True,
+        valid_len=S,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, 1, 1),
+        in_specs=[
+            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, gh), lambda b, iq, ik: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, gw), lambda b, iq, ik: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((s_pad, 128), jnp.float32),
+            pltpu.VMEM((s_pad, 128), jnp.float32),
+            pltpu.VMEM((s_pad, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(
+        qp.reshape(bh, s_pad, D), kp.reshape(bh, s_pad, D),
+        vp.reshape(bh, s_pad, D), rel_h_q, rel_w_q,
+    )
+    return out[:, :S].reshape(B, H, S, D)
+
+
+def _win_vjp_fwd(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale), (
+        q, k, v, rh, rw,
+    )
+
+
+def _win_vjp_bwd(grid_hw, scale, res, g):
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    q, k, v, rh, rw = res
+    _, pull = jax.vjp(
+        lambda a, b, c, d, e: blockwise_decomposed_attention(
+            a, b, c, d, e, grid_hw, scale),
+        q, k, v, rh, rw,
+    )
+    return pull(g)
+
+
+_pallas_win_vjp.defvjp(_win_vjp_fwd, _win_vjp_bwd)
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_window_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """Per-geometry compiled self-check of the windowed kernel against the
+    exact blockwise oracle at the window grid (14x14 in production)."""
+    from tmr_tpu.ops.flash_attn import _self_check
+
+    return _self_check(pallas_windowed_attention, 2, 2, gh, gw, head_dim)
 
 
 @functools.lru_cache(maxsize=None)

@@ -28,7 +28,7 @@ from typing import Callable, Dict, Optional
 from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
 XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft", "pallas")
-WIN_ATTN_VARIANTS = ("dense", "folded", "flash")
+WIN_ATTN_VARIANTS = ("dense", "folded", "flash", "pallas")
 GLOBAL_ATTN_VARIANTS = ("blockwise", "flash", "blockfolded", "pallas")
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 
