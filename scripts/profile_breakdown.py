@@ -128,6 +128,8 @@ def main():
         ("one_windowed_block_folded", 14, {"TMR_WIN_ATTN": "folded"}),
         ("one_windowed_block_flash", 14, {"TMR_WIN_ATTN": "flash"}),
         ("one_windowed_block_pallas", 14, {"TMR_WIN_ATTN": "pallas"}),
+        ("one_windowed_block_pallas_g8", 14,
+         {"TMR_WIN_ATTN": "pallas", "TMR_PALLAS_WIN_GROUP": "8"}),
     )
     # restore the user's knobs afterwards (autotune's _restore): the
     # full-program timing in section 1 honoured them, and later sections /
@@ -136,11 +138,27 @@ def main():
 
     prev = {
         k: os.environ.get(k)
-        for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
-                  "TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK")
+        for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_PALLAS_ATTN_BQ",
+                  "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
     }
     try:
         for label, win, knobs in cases:
+            if "TMR_PALLAS_WIN_GROUP" in knobs:
+                # skip when the preference clamps to a different effective
+                # group at this batch (same mislabeling hazard as the tile
+                # rows): bh = batch * windows * heads for one block
+                from tmr_tpu.ops.pallas_attn import _win_group
+
+                n_win = ((grid + win - 1) // win) ** 2 if win else 1
+                bh_blk = BATCH * n_win * 12
+                want_g = int(knobs["TMR_PALLAS_WIN_GROUP"])
+                os.environ["TMR_PALLAS_WIN_GROUP"] = str(want_g)
+                eff_g = _win_group(bh_blk)
+                os.environ.pop("TMR_PALLAS_WIN_GROUP", None)
+                if eff_g != want_g:
+                    _progress(f"stage 3: {label} skipped (group clamps to "
+                              f"{eff_g} at bh={bh_blk})")
+                    continue
             if "TMR_PALLAS_ATTN_BQ" in knobs or "TMR_PALLAS_ATTN_BK" in knobs:
                 # skip tile rows whose preference clamps back to the default
                 # tile at this S — they would re-measure the plain pallas
@@ -159,8 +177,9 @@ def main():
                               f"the default {eff} at S={s_glob})")
                     continue
             _progress(f"stage 3: {label}")
-            for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK"):
-                os.environ.pop(k, None)  # tile overrides are per-case
+            for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
+                      "TMR_PALLAS_WIN_GROUP"):
+                os.environ.pop(k, None)  # tile/group overrides are per-case
             os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)

@@ -486,15 +486,19 @@ def test_pallas_attention_multiblock_seq(gh, gw, D):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_pallas_windowed_attention_matches_blockwise():
+@pytest.mark.parametrize("group", [None, "3"])
+def test_pallas_windowed_attention_matches_blockwise(group, monkeypatch):
     """TMR_WIN_ATTN=pallas (ops/pallas_attn.pallas_windowed_attention) vs
     the exact blockwise oracle at the REAL 14x14 window grid (196 tokens
-    padded to a 256 tile with in-kernel masking), values and grads."""
+    padded to a 256 tile with in-kernel masking), values and grads —
+    grouped (TMR_PALLAS_WIN_GROUP=3 -> G=3 at bh=6) and ungrouped."""
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
     from tmr_tpu.ops.pallas_attn import pallas_windowed_attention
 
+    if group is not None:
+        monkeypatch.setenv("TMR_PALLAS_WIN_GROUP", group)
     rng = np.random.default_rng(15)
     B, H, gh, gw, D = 3, 2, 14, 14, 8  # B = batch*windows
     S = gh * gw

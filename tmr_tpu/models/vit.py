@@ -55,10 +55,14 @@ def _flash_window_available(gh: int, gw: int, head_dim: int) -> bool:
     return flash_window_ok(gh, gw, head_dim)
 
 
-def _pallas_window_available(gh: int, gw: int, head_dim: int) -> bool:
-    from tmr_tpu.ops.pallas_attn import pallas_window_ok
+def _pallas_window_available(
+    gh: int, gw: int, head_dim: int, bh: int
+) -> bool:
+    """``bh`` = windows*batch*heads of the ACTUAL trace: the self-check
+    must validate the same effective window group production will run."""
+    from tmr_tpu.ops.pallas_attn import _win_group, pallas_window_ok
 
-    return pallas_window_ok(gh, gw, head_dim)
+    return pallas_window_ok(gh, gw, head_dim, _win_group(bh))
 
 
 def window_partition(x: jnp.ndarray, window: int):
@@ -381,7 +385,7 @@ class Attention(nn.Module):
         elif (
             self.use_rel_pos
             and _WIN_ATTN_IMPL() == "pallas"
-            and _pallas_window_available(h, w, head_dim)
+            and _pallas_window_available(h, w, head_dim, b * self.num_heads)
         ):
             # A/B variant (TMR_WIN_ATTN=pallas): the custom decomposed-bias
             # kernel (ops/pallas_attn.py) on 128-padded window tiles with

@@ -48,7 +48,6 @@ def _attn_kernel(
     q_ref, k_ref, v_ref, rhq_ref, rwq_ref, out_ref,
     m_ref, l_ref, acc_ref,
     *, scale: float, gw: int, bk: int, nk: int, has_bias: bool,
-    valid_len: Optional[int] = None,
 ):
     """One (batch*head, q-block, k-block) step of online-softmax attention.
 
@@ -92,13 +91,6 @@ def _attn_kernel(
             rwq_ref[0].astype(jnp.float32), sel_w, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-
-    if valid_len is not None:
-        # padded sequence (windowed attention: 196 tokens in a 256 tile):
-        # pad KEY columns must not receive probability mass. Pad QUERY rows
-        # produce garbage output sliced off by the caller.
-        col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < valid_len, s, _NEG_INF)
 
     m_prev = m_ref[:, :1]  # (BQ, 1)
     m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -282,6 +274,75 @@ def _pallas_win_vjp(q, k, v, rh, rw, grid_hw, scale):
     return _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale)
 
 
+def _win_kernel(
+    q_ref, k_ref, v_ref, rhq_ref, rwq_ref, out_ref,
+    *, scale: float, gw: int, valid_len: int,
+):
+    """Whole-window attention, one (s_pad, s_pad) score tile per window —
+    nk == 1, so plain in-register softmax (no online rescaling, no
+    scratch). The leading block dim groups G windows per program
+    (TMR_PALLAS_WIN_GROUP) to amortize program dispatch; the loop is a
+    static unroll."""
+    G, s_pad, _ = q_ref.shape
+    gh = rhq_ref.shape[-1]
+    # selector one-hots depend only on the token layout — identical for
+    # every window, built once per program
+    k_tok = jax.lax.broadcasted_iota(jnp.int32, (1, s_pad), 1)
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (gh, 1), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (gw, 1), 0)
+    sel_h = (row_ids == k_tok // gw).astype(jnp.float32)  # (gh, s_pad)
+    sel_w = (col_ids == k_tok % gw).astype(jnp.float32)  # (gw, s_pad)
+    pad_mask = k_tok < valid_len  # (1, s_pad)
+    for g in range(G):
+        s = jax.lax.dot_general(
+            q_ref[g], k_ref[g], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s += jax.lax.dot_general(
+            rhq_ref[g].astype(jnp.float32), sel_h, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # pad KEY columns still receive a partial bias (kx = k_tok % gw
+        # wraps back into the grid, so sel_w matches even past valid_len);
+        # the -inf mask below is what keeps them out of the softmax — do
+        # not treat it as redundant
+        s += jax.lax.dot_general(
+            rwq_ref[g].astype(jnp.float32), sel_w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = jnp.where(pad_mask, s, _NEG_INF)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        out_ref[g] = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[g], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(out_ref.dtype)
+
+
+def _win_group(bh: int) -> int:
+    """Windows per program: the largest divisor of ``bh`` at or below the
+    TMR_PALLAS_WIN_GROUP preference (default 1 — grouping is a measured
+    knob, not an assumed win)."""
+    import os
+
+    raw = os.environ.get("TMR_PALLAS_WIN_GROUP", "1")
+    try:
+        pref = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TMR_PALLAS_WIN_GROUP={raw!r}: expected a positive integer"
+        )
+    if pref < 1:
+        raise ValueError(
+            f"TMR_PALLAS_WIN_GROUP={pref}: expected a positive integer"
+        )
+    g = min(pref, bh)
+    while bh % g:
+        g -= 1
+    return g
+
+
 def _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
     B, H, S, D = q.shape
     gh, gw = grid_hw
@@ -293,36 +354,26 @@ def _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
     rel_h_q, rel_w_q = _bias_projections(q, rh, rw, grid_hw)
     rel_h_q = jnp.pad(rel_h_q, ((0, 0), (0, pad), (0, 0)))
     rel_w_q = jnp.pad(rel_w_q, ((0, 0), (0, pad), (0, 0)))
-    # pad KEY columns still receive a (partial) bias: ky = k_tok // gw runs
-    # past gh so sel_h contributes nothing, but kx = k_tok % gw wraps back
-    # into the grid and sel_w DOES match — correctness rests entirely on
-    # the valid_len -inf mask applied after the bias add (the kernel masks
-    # before the softmax max). Do not treat the mask as redundant.
 
     bh = B * H
+    g = _win_group(bh)
     kernel = functools.partial(
-        _attn_kernel, scale=scale, gw=gw, bk=s_pad, nk=1, has_bias=True,
-        valid_len=S,
+        _win_kernel, scale=scale, gw=gw, valid_len=S
     )
     out = pl.pallas_call(
         kernel,
-        grid=(bh, 1, 1),
+        grid=(bh // g,),
         in_specs=[
-            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad, gh), lambda b, iq, ik: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad, gw), lambda b, iq, ik: (b, 0, 0)),
+            pl.BlockSpec((g, s_pad, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((g, s_pad, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((g, s_pad, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((g, s_pad, gh), lambda b: (b, 0, 0)),
+            pl.BlockSpec((g, s_pad, gw), lambda b: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, s_pad, D), lambda b, iq, ik: (b, 0, 0)),
+        out_specs=pl.BlockSpec((g, s_pad, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((s_pad, 128), jnp.float32),
-            pltpu.VMEM((s_pad, 128), jnp.float32),
-            pltpu.VMEM((s_pad, D), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            dimension_semantics=("parallel",),
         ),
         interpret=jax.default_backend() != "tpu",
     )(
@@ -354,12 +405,22 @@ _pallas_win_vjp.defvjp(_win_vjp_fwd, _win_vjp_bwd)
 
 
 @functools.lru_cache(maxsize=None)
-def pallas_window_ok(gh: int, gw: int, head_dim: int) -> bool:
+def pallas_window_ok(
+    gh: int, gw: int, head_dim: int, group: int = 1
+) -> bool:
     """Per-geometry compiled self-check of the windowed kernel against the
-    exact blockwise oracle at the window grid (14x14 in production)."""
+    exact blockwise oracle at the window grid (14x14 in production).
+
+    ``group`` must be the PRODUCTION effective window group (the caller
+    computes ``_win_group(b*H)``): the check builds B=group, H=1 inputs so
+    its bh == group and ``_win_group`` resolves to exactly that G — a
+    group-specific Mosaic failure or VMEM overflow trips here, inside the
+    gate, not in the model trace. The lru_cache keys on it."""
     from tmr_tpu.ops.flash_attn import _self_check
 
-    return _self_check(pallas_windowed_attention, 2, 2, gh, gw, head_dim)
+    return _self_check(
+        pallas_windowed_attention, group, 1, gh, gw, head_dim
+    )
 
 
 @functools.lru_cache(maxsize=None)
