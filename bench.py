@@ -363,6 +363,17 @@ def _run(cancel_watchdog) -> None:
                 "batch": BATCH,
                 "rtt_floor_ms": round(rtt * 1000, 1),
                 "autotuned": {k: v["picked"] for k, v in tune.items()},
+                # the formulations the measured program actually traced
+                # with (env at trace time) — autotuned reports only sweep
+                # picks, so env-pinned A/B runs need this to be readable
+                "knobs": {
+                    k: os.environ[k]
+                    for k in ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN",
+                              "TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL",
+                              "TMR_XCORR_PRECISION", "TMR_PALLAS_ATTN_BQ",
+                              "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
+                    if k in os.environ
+                },
             }
         )
     )
