@@ -194,9 +194,11 @@ def main(argv=None):
             dp = mesh.shape.get("data", 1) if mesh is not None else 1
             tune_batch = max(cfg.batch_size // max(dp, 1), 1)
         # precision relaxation is justified for inference score ranking
-        # only — training must not inherit bf16-rounded matcher gradients
+        # only — training must not inherit bf16-rounded matcher gradients.
+        # train=True times the block sweeps fwd+bwd (recompute-backward
+        # kernels rank differently) and caches under a separate key.
         autotune(cfg, cfg.image_size, tune_batch, log=log_info,
-                 tune_precision=bool(cfg.eval))
+                 tune_precision=bool(cfg.eval), train=not cfg.eval)
 
     trainer = Trainer(cfg, mesh=mesh)
     if cfg.eval:

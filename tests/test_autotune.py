@@ -302,6 +302,65 @@ def test_autotune_cache_persists_winners_across_processes(
     assert calls == ["x", "w", "g", "x", "w", "g", "x", "w", "g"]
 
 
+def test_train_autotune_uses_separate_key_and_grad_sweep(
+    clean_knobs, monkeypatch
+):
+    """train=True must (a) time the block sweeps with a gradient pass —
+    the Pallas kernels' recompute backward inverts the fwd-only ranking —
+    and (b) cache under a distinct key so eval winners never leak into
+    training and vice versa."""
+    seen_train = []
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fake_xcorr(*a, train=False, **k):
+        seen_train.append(("x", train))
+        return {"conv": 0.03, "fft": 0.01}
+
+    monkeypatch.setattr(at, "pick_xcorr_impl", fake_xcorr)
+
+    def fake_sweep(*a, train=False, **k):
+        seen_train.append(("a", train))
+        return ({"dense": 0.02, "folded": 0.01} if train
+                else {"dense": 0.01, "folded": 0.02})
+
+    monkeypatch.setattr(at, "pick_win_attn_impl", fake_sweep)
+    monkeypatch.setattr(at, "pick_global_attn_impl", fake_sweep)
+
+    r_eval = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert r_eval["TMR_WIN_ATTN"]["picked"] == "dense"
+    assert seen_train == [("x", False), ("a", False), ("a", False)]
+
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    r_train = at.autotune(_cfg(), 1024, 4, tune_precision=False, train=True)
+    # the eval cache entry must NOT satisfy the train run, and every sweep
+    # (xcorr included) must time with gradients
+    assert seen_train[3:] == [("x", True), ("a", True), ("a", True)]
+    assert r_train["TMR_WIN_ATTN"]["picked"] == "folded"
+
+    # both keys now cached independently
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    r2 = at.autotune(_cfg(), 1024, 4, tune_precision=False, train=True)
+    assert r2["TMR_WIN_ATTN"] == {"picked": "folded", "cached": True}
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    r3 = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert r3["TMR_WIN_ATTN"] == {"picked": "dense", "cached": True}
+
+
+def test_block_sweep_train_mode_times_grad(clean_knobs, monkeypatch):
+    """The real harness under train=True must build a differentiable step
+    (value_and_grad through the block) and produce a time for every
+    variant that can differentiate — on CPU every variant falls back to a
+    differentiable path, so all four windowed variants report."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    times = at.pick_win_attn_impl(1, 8, 16, 2, rtt=0.0, train=True)
+    assert set(times) == set(at.WIN_ATTN_VARIANTS)
+    assert all(t > 0 for t in times.values())
+
+
 def test_cached_winner_stale_when_variant_set_grows(clean_knobs, monkeypatch):
     """A cached winner is versioned by the variant set it beat
     (_variants_<knob>): growing the set (a new kernel) or a stamp-less

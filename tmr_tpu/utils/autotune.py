@@ -36,12 +36,15 @@ XCORR_PRECISIONS = ("highest", "default", "bf16")
 def _sweep_xcorr_env(
     env_var: str, variants, batch: int, emb_dim: int, hw: int, capacity: int,
     rtt: Optional[float], log: Callable[[str], None],
-    skip=(),
+    skip=(), train: bool = False,
 ) -> Dict[str, float]:
     """Shared microbenchmark harness for the trace-time xcorr knobs: pin
     ``env_var`` to each variant, jit one correlation at the production
     matcher shape, time it chained. One harness for both sweeps so the step
-    function / staging / failure handling can never diverge between them."""
+    function / staging / failure handling can never diverge between them.
+    ``train=True`` times forward + gradient w.r.t. the feature map (the
+    matcher sits in the training grad path; backward cost ratios differ
+    per lowering, so a fwd-only rank could mis-pick for training)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -63,10 +66,20 @@ def _sweep_xcorr_env(
                 continue
             os.environ[env_var] = variant
 
-            @jax.jit
-            def step(f, e, fb):
-                y = match_templates(f + fb, e, capacity=capacity)
-                return y, jnp.sum(y) * 0.0
+            if train:
+                def loss_fn(f, e):
+                    y = match_templates(f, e, capacity=capacity)
+                    return jnp.sum(y.astype(jnp.float32) ** 2)
+
+                @jax.jit
+                def step(f, e, fb):
+                    l, g = jax.value_and_grad(loss_fn)(f + fb, e)
+                    return g, l * 0.0
+            else:
+                @jax.jit
+                def step(f, e, fb):
+                    y = match_templates(f + fb, e, capacity=capacity)
+                    return y, jnp.sum(y) * 0.0
 
             try:
                 times[variant] = chained_seconds_per_iter(
@@ -84,12 +97,13 @@ def pick_xcorr_impl(
     batch: int, emb_dim: int, hw: int, capacity: int,
     rtt: Optional[float] = None,
     log: Callable[[str], None] = lambda s: None,
+    train: bool = False,
 ) -> Dict[str, float]:
     """Time every correlation lowering at the production matcher shape.
     Returns {variant: sec/iter}; caller picks min."""
     return _sweep_xcorr_env(
         "TMR_XCORR_IMPL", XCORR_VARIANTS, batch, emb_dim, hw, capacity,
-        rtt, log,
+        rtt, log, train=train,
     )
 
 
@@ -121,12 +135,19 @@ def _sweep_block_env(
     env_var: str, variants, window_size: int,
     batch: int, grid: int, embed_dim: int, num_heads: int,
     rtt: Optional[float], log: Callable[[str], None],
+    train: bool = False,
 ) -> Dict[str, float]:
     """Shared microbenchmark harness for the trace-time transformer-block
     knobs: pin ``env_var`` to each variant, jit one Block at the production
     grid (bf16, the deployment dtype), time it chained. One harness for the
     windowed and global sweeps so staging / step / failure handling can
-    never diverge between them (the _sweep_xcorr_env principle)."""
+    never diverge between them (the _sweep_xcorr_env principle).
+
+    ``train=True`` times forward + backward (value_and_grad through the
+    block): the Pallas kernels' backward RECOMPUTES through the blockwise
+    path, so a forward-only sweep would systematically mis-pick them for
+    training runs — the training sweep must measure what a train step pays.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -147,10 +168,22 @@ def _sweep_block_env(
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
             params = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
 
-            @jax.jit
-            def step(p, x, fb):
-                y = blk.apply({"params": p}, x + fb.astype(x.dtype))
-                return y, jnp.sum(y).astype(jnp.float32) * 0.0
+            if train:
+                def loss_fn(p, x, _blk=blk):
+                    y = _blk.apply({"params": p}, x)
+                    return jnp.sum(y.astype(jnp.float32) ** 2)
+
+                @jax.jit
+                def step(p, x, fb):
+                    l, g = jax.value_and_grad(loss_fn)(
+                        p, x + fb.astype(x.dtype)
+                    )
+                    return g, l * 0.0
+            else:
+                @jax.jit
+                def step(p, x, fb):
+                    y = blk.apply({"params": p}, x + fb.astype(x.dtype))
+                    return y, jnp.sum(y).astype(jnp.float32) * 0.0
 
             try:
                 times[impl] = chained_seconds_per_iter(
@@ -168,12 +201,13 @@ def pick_win_attn_impl(
     batch: int, grid: int, embed_dim: int, num_heads: int,
     rtt: Optional[float] = None,
     log: Callable[[str], None] = lambda s: None,
+    train: bool = False,
 ) -> Dict[str, float]:
     """Time one windowed transformer block (window 14, bf16 — the deployment
     dtype) per attention formulation. Returns {variant: sec/iter}."""
     return _sweep_block_env(
         "TMR_WIN_ATTN", WIN_ATTN_VARIANTS, 14,
-        batch, grid, embed_dim, num_heads, rtt, log,
+        batch, grid, embed_dim, num_heads, rtt, log, train=train,
     )
 
 
@@ -181,6 +215,7 @@ def pick_global_attn_impl(
     batch: int, grid: int, embed_dim: int, num_heads: int,
     rtt: Optional[float] = None,
     log: Callable[[str], None] = lambda s: None,
+    train: bool = False,
 ) -> Dict[str, float]:
     """Time one GLOBAL transformer block (window 0, the full grid as keys,
     bf16) per TMR_GLOBAL_ATTN formulation — the 4 global blocks were the one
@@ -190,7 +225,7 @@ def pick_global_attn_impl(
     {variant: sec/iter}."""
     return _sweep_block_env(
         "TMR_GLOBAL_ATTN", GLOBAL_ATTN_VARIANTS, 0,
-        batch, grid, embed_dim, num_heads, rtt, log,
+        batch, grid, embed_dim, num_heads, rtt, log, train=train,
     )
 
 
@@ -359,6 +394,7 @@ def autotune(
     cfg, image_size: int, batch: int,
     log: Callable[[str], None] = lambda s: None,
     tune_precision: bool = True,
+    train: bool = False,
 ) -> Dict[str, object]:
     """Measure the variant sets at the production shapes of ``cfg`` and
     EXPORT the winners via their env knobs (os.environ, read by the modules
@@ -402,6 +438,10 @@ def autotune(
             cfg.emb_dim, vit_kind,
         )
     )
+    if train:
+        # fwd-only winners must never be reused for training (the Pallas
+        # kernels' recompute backward inverts the ranking) and vice versa
+        key += "|train"
     force = os.environ.get("TMR_AUTOTUNE_FORCE", "") not in ("", "0")
     cached = {} if force else _cache_load().get(key, {})
     for knob in _VERSIONED_KNOBS:
@@ -457,7 +497,7 @@ def autotune(
         # capacity 17 = the typical FSCD exemplar bucket; the winner is
         # exported through the SMALL-scoped knob (see module docstring)
         times = pick_xcorr_impl(batch, cfg.emb_dim, up_hw, 17, rtt=rtt,
-                                log=log)
+                                log=log, train=train)
         if times:
             best = min(times, key=times.get)
             os.environ["TMR_XCORR_IMPL_SMALL"] = best
@@ -517,7 +557,8 @@ def autotune(
             continue
         vc = VIT_CONFIGS[vit_kind]
         times = picker(
-            batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log
+            batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log,
+            train=train,
         )
         if times:
             best = min(times, key=times.get)
