@@ -289,7 +289,8 @@ class Attention(nn.Module):
             # TMR_WIN_ATTN) picks the formulation:
             #   blockwise    exact XLA band scan (the f32-parity default)
             #   blockfolded  band scan, bias folded into the QK contraction
-            #                (bias rounds to input dtype; ungated)
+            #                (exact in f32; bf16 is numerics-self-checked
+            #                with blockwise fallback)
             #   flash        stock Pallas flash over the 256-padded folded
             #                QK (bf16 only; self-check gate -> blockwise)
             #   pallas       custom decomposed-bias kernel, VMEM-resident
@@ -306,7 +307,24 @@ class Attention(nn.Module):
                 )
             attn_fn = blockwise_decomposed_attention
             if impl == "blockfolded":
+                # exact in f32; under bf16 the folded bias rounds to bf16,
+                # so the selection is self-check-gated like every other
+                # formulation (PARITY.md contract). The gate is pure XLA
+                # (runs on any backend, Pallas kill-switch exempt).
                 attn_fn = blockfolded_decomposed_attention
+                if self.dtype == jnp.bfloat16:
+                    from tmr_tpu.ops.flash_attn import blockfolded_ok
+
+                    if not blockfolded_ok(h, w, head_dim):
+                        import warnings
+
+                        warnings.warn(
+                            "TMR_GLOBAL_ATTN=blockfolded: bf16 numerics "
+                            f"self-check failed at grid ({h}, {w}, "
+                            f"head_dim {head_dim}); running blockwise "
+                            "fallback"
+                        )
+                        attn_fn = blockwise_decomposed_attention
             elif impl == "pallas":
                 # the custom decomposed-bias kernel (ops/pallas_attn.py):
                 # VMEM-resident online-softmax tiles, native head-dim

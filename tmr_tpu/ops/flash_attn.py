@@ -205,22 +205,30 @@ def flash_windowed_attention(
     return out[..., :S, :D].astype(q.dtype)
 
 
-def _self_check(attn_fn, B: int, H: int, gh: int, gw: int, D: int) -> bool:
+def _self_check(
+    attn_fn, B: int, H: int, gh: int, gw: int, D: int,
+    require_tpu: bool = True,
+) -> bool:
     """Shared compiled self-check: run ``attn_fn`` (a flash-path callable
     with the (q, k, v, rh, rw, grid_hw, scale) signature) against the exact
     XLA blockwise path on bf16 inputs at the given geometry. Any exception
     (Mosaic lowering, unsupported backend) or disagreement beyond bf16
     tolerance -> False. TMR_NO_FLASH_ATTN=1 force-disables.
 
+    ``require_tpu=False`` is for pure-XLA formulations (blockfolded): the
+    comparison runs on any backend and the Pallas kill-switch does not
+    apply — there is no kernel to kill, only numerics to pin.
+
     Callers invoke this while TRACING the model (Attention.__call__ only
     ever runs under jit), so the whole check runs under
     ``jax.ensure_compile_time_eval()`` — concrete values, real compiled
     executions, no leakage into the ambient trace.
     """
-    if os.environ.get("TMR_NO_FLASH_ATTN"):
-        return False
-    if jax.default_backend() != "tpu":
-        return False
+    if require_tpu:
+        if os.environ.get("TMR_NO_FLASH_ATTN"):
+            return False
+        if jax.default_backend() != "tpu":
+            return False
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
@@ -280,6 +288,19 @@ def _self_check(attn_fn, B: int, H: int, gh: int, gw: int, D: int) -> bool:
             return True
     except Exception:
         return False
+
+
+@functools.lru_cache(maxsize=None)
+def blockfolded_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """Per-geometry compiled self-check of the blockfolded formulation
+    under bf16 (the folded bias rounds to bf16; in f32 the fold is
+    algebraically exact and needs no gate). Pure XLA — runs on any backend
+    and ignores the Pallas kill-switch. Keeps the PARITY.md contract:
+    every selectable formulation is pinned to the blockwise oracle."""
+    from tmr_tpu.models.vit import blockfolded_decomposed_attention
+
+    return _self_check(blockfolded_decomposed_attention, 1, 2, gh, gw,
+                       head_dim, require_tpu=False)
 
 
 @functools.lru_cache(maxsize=None)
