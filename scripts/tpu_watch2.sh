@@ -57,11 +57,26 @@ print(json.dumps({'one_global_block_sec': t}))
     TMR_GLOBAL_ATTN=pallas TMR_WIN_ATTN=dense TMR_BENCH_ALARM=2700 \
       timeout 3000 python bench.py >"$OUT/bench_combined.json" 2>>"$LOG"
     log "bench (combined) rc=$? -> $OUT/bench_combined.json"
+    # 3c: the all-custom-kernel configuration (windowed kernel grouped 8)
+    TMR_GLOBAL_ATTN=pallas TMR_WIN_ATTN=pallas TMR_PALLAS_WIN_GROUP=8 \
+      TMR_BENCH_ALARM=2700 timeout 3000 python bench.py \
+      >"$OUT/bench_allpallas.json" 2>>"$LOG"
+    log "bench (all-pallas g8) rc=$? -> $OUT/bench_allpallas.json"
     # 4: ckpt anomaly probe (only if the battery's ckpt still exists)
     if [ -d "$OUT/bench_ckpt/params" ]; then
       timeout 2400 python -u scripts/ckpt_probe.py \
         >"$OUT/ckpt_probe.json" 2>>"$LOG"
       log "ckpt probe rc=$? -> $OUT/ckpt_probe.json"
+    fi
+    # 4b: full per-stage/variant profile — the new kernel + tile/group rows
+    # (one_global_block_pallas, bq256/bk1024, one_windowed_block_pallas/_g8)
+    # have never been measured; most other stages cache-hit by now
+    timeout 5400 python scripts/profile_breakdown.py \
+      >"$OUT/profile_live.json" 2>>"$LOG"
+    log "profile_breakdown rc=$? -> $OUT/profile_live.json"
+    if ! grep -q '"error"' "$OUT/profile_live.json" 2>/dev/null \
+        && grep -q '"full_program"' "$OUT/profile_live.json" 2>/dev/null; then
+      cp "$OUT/profile_live.json" "$REPO/PROFILE_LIVE.json" 2>/dev/null
     fi
     # 5: traced bench + xprof top ops (profiling over the tunnel is the
     # least-proven path; after the A/Bs on purpose)
