@@ -363,6 +363,13 @@ def _run(cancel_watchdog) -> None:
                 "batch": BATCH,
                 "rtt_floor_ms": round(rtt * 1000, 1),
                 "autotuned": {k: v["picked"] for k, v in tune.items()},
+                # per-variant sweep timings (sec/iter) for knobs measured
+                # THIS run — the A/B evidence itself, not just the winner;
+                # cached hits carry no times and are omitted
+                "autotune_times": {
+                    k: {vk: round(vv, 6) for vk, vv in v["times"].items()}
+                    for k, v in tune.items() if v.get("times")
+                },
                 # the formulations the measured program actually traced
                 # with (env at trace time) — autotuned reports only sweep
                 # picks, so env-pinned A/B runs need this to be readable
