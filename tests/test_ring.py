@@ -486,12 +486,13 @@ def test_pallas_attention_multiblock_seq(gh, gw, D):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("group", [None, "3"])
-def test_pallas_windowed_attention_matches_blockwise(group, monkeypatch):
+@pytest.mark.parametrize("group,D", [(None, 8), ("3", 8), (None, 80)])
+def test_pallas_windowed_attention_matches_blockwise(group, D, monkeypatch):
     """TMR_WIN_ATTN=pallas (ops/pallas_attn.pallas_windowed_attention) vs
     the exact blockwise oracle at the REAL 14x14 window grid (196 tokens
     padded to a 256 tile with in-kernel masking), values and grads —
-    grouped (TMR_PALLAS_WIN_GROUP=3 -> G=3 at bh=6) and ungrouped."""
+    grouped (TMR_PALLAS_WIN_GROUP=3 -> G=3 at bh=6) and ungrouped, plus
+    vit_h's non-lane-aligned head_dim 80."""
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
@@ -500,7 +501,7 @@ def test_pallas_windowed_attention_matches_blockwise(group, monkeypatch):
     if group is not None:
         monkeypatch.setenv("TMR_PALLAS_WIN_GROUP", group)
     rng = np.random.default_rng(15)
-    B, H, gh, gw, D = 3, 2, 14, 14, 8  # B = batch*windows
+    B, H, gh, gw = 3, 2, 14, 14  # B = batch*windows
     S = gh * gw
     q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
