@@ -69,6 +69,39 @@ def test_autotune_picks_min_and_exports_env(clean_knobs, monkeypatch):
     assert os.environ["TMR_GLOBAL_ATTN"] == "flash"
 
 
+def test_fallback_annotated_entries_never_win(clean_knobs, monkeypatch):
+    """A gate-refused variant's timing is recorded annotated ("<impl>
+    (fallback)") and must be excluded from winner selection even when it is
+    the fastest row — it measured a DIFFERENT formulation than its label,
+    and exporting it would set an invalid env value (ADVICE r4)."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl",
+        lambda *a, **k: {"conv": 0.01, "pallas" + at.FALLBACK_SUFFIX: 1e-5},
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl",
+        lambda *a, **k: {"dense": 0.02, "pallas (fallback)": 0.001},
+    )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: {"blockwise": 0.03, "flash (fallback)": 0.001},
+    )
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert report["TMR_XCORR_IMPL_SMALL"]["picked"] == "conv"
+    assert os.environ["TMR_XCORR_IMPL_SMALL"] == "conv"
+    assert report["TMR_WIN_ATTN"]["picked"] == "dense"
+    assert report["TMR_GLOBAL_ATTN"]["picked"] == "blockwise"
+    assert os.environ["TMR_WIN_ATTN"] == "dense"
+    assert os.environ["TMR_GLOBAL_ATTN"] == "blockwise"
+    # the annotated evidence is preserved in the report
+    assert "pallas (fallback)" in report["TMR_WIN_ATTN"]["times"]
+    assert "pallas" + at.FALLBACK_SUFFIX in (
+        report["TMR_XCORR_IMPL_SMALL"]["times"]
+    )
+
+
 def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setenv("TMR_XCORR_IMPL", "conv")
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")
@@ -118,9 +151,14 @@ def test_small_scope_keeps_fft_for_big_buckets(clean_knobs, monkeypatch):
 
 def test_microbenchmarks_run_and_time_all_variants(clean_knobs):
     """The pick_* functions themselves must run every variant end to end
-    (tiny shapes; CPU is fine for exercising the machinery)."""
+    (tiny shapes; CPU is fine for exercising the machinery). Off-TPU the
+    pallas xcorr gate refuses, so that row reports ANNOTATED — labeled
+    with what was measured (the conv fallback), like the block sweeps."""
     tx = at.pick_xcorr_impl(1, 8, 16, 5, rtt=0.0)
-    assert set(tx) == set(at.XCORR_VARIANTS)
+    assert {k.replace(at.FALLBACK_SUFFIX, "") for k in tx} == set(
+        at.XCORR_VARIANTS
+    )
+    assert "pallas" + at.FALLBACK_SUFFIX in tx and "pallas" not in tx
     assert all(v > 0 for v in tx.values())
     # windowed block: flash falls back unavailable off-TPU but must not
     # crash the sweep; dense/folded always time
@@ -354,10 +392,17 @@ def test_block_sweep_train_mode_times_grad(clean_knobs, monkeypatch):
     """The real harness under train=True must build a differentiable step
     (value_and_grad through the block) and produce a time for every
     variant that can differentiate — on CPU every variant falls back to a
-    differentiable path, so all four windowed variants report."""
+    differentiable path, so all four windowed variants report. Off-TPU the
+    flash/pallas gates refuse, so those entries come back ANNOTATED
+    ("<impl> (fallback)"): the harness must label what it measured, never
+    record a fallback timing under the requested name (ADVICE r4)."""
     monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
     times = at.pick_win_attn_impl(1, 8, 16, 2, rtt=0.0, train=True)
-    assert set(times) == set(at.WIN_ATTN_VARIANTS)
+    base = {k.replace(at.FALLBACK_SUFFIX, "") for k in times}
+    assert base == set(at.WIN_ATTN_VARIANTS)
+    # CPU: the kernel gates refuse -> their rows must carry the annotation
+    for impl in ("flash", "pallas"):
+        assert impl + at.FALLBACK_SUFFIX in times and impl not in times
     assert all(t > 0 for t in times.values())
 
 

@@ -486,6 +486,32 @@ def test_pallas_attention_multiblock_seq(gh, gw, D):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_pallas_global_gate_keys_on_effective_tiles(monkeypatch):
+    """The gate verdict must be cached per EFFECTIVE (bq, bk) tile config:
+    TMR_PALLAS_ATTN_BQ/BK change the kernel the forward impl traces, so a
+    verdict reached under one tile config must never vouch for another
+    (ADVICE r4 medium). effective_global_tiles is the caller-side
+    resolution — env preference clamped to a power-of-two divisor of S,
+    identical to _pallas_attn_fwd_impl's."""
+    from tmr_tpu.ops import pallas_attn
+
+    monkeypatch.delenv("TMR_PALLAS_ATTN_BQ", raising=False)
+    monkeypatch.delenv("TMR_PALLAS_ATTN_BK", raising=False)
+    assert pallas_attn.effective_global_tiles(4096) == (512, 512)
+    monkeypatch.setenv("TMR_PALLAS_ATTN_BQ", "256")
+    monkeypatch.setenv("TMR_PALLAS_ATTN_BK", "1024")
+    assert pallas_attn.effective_global_tiles(4096) == (256, 1024)
+    # distinct tile configs -> distinct lru_cache entries (fresh keys so
+    # other tests' gate calls can't collide)
+    info0 = pallas_attn.pallas_global_ok.cache_info()
+    pallas_attn.pallas_global_ok(3, 3, 8, 512, 512)
+    pallas_attn.pallas_global_ok(3, 3, 8, 256, 1024)
+    pallas_attn.pallas_global_ok(3, 3, 8, 512, 512)  # hit, not a re-check
+    info1 = pallas_attn.pallas_global_ok.cache_info()
+    assert info1.misses - info0.misses == 2
+    assert info1.hits - info0.hits == 1
+
+
 @pytest.mark.parametrize("group,D", [(None, 8), ("3", 8), (None, 80)])
 def test_pallas_windowed_attention_matches_blockwise(group, D, monkeypatch):
     """TMR_WIN_ATTN=pallas (ops/pallas_attn.pallas_windowed_attention) vs

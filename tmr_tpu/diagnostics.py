@@ -1,0 +1,26 @@
+"""Shared diagnostic warning types (dependency-free — importable from any
+layer: ops, models, utils).
+
+``FormulationFallbackWarning`` is the structural contract between the
+trace-time formulation dispatchers (models/vit.py attention, ops/xcorr.py
+correlation) and the measurement harnesses (utils/autotune.py sweeps,
+scripts/profile_breakdown.py): when an EXPLICITLY requested formulation is
+refused by its gate/dtype precondition and a fallback traces instead, the
+dispatcher warns with this category carrying ``env_var`` — so harnesses can
+detect by category + attribute (not message substrings) that a timing
+recorded under the requested label actually measured the fallback.
+"""
+
+from __future__ import annotations
+
+
+class FormulationFallbackWarning(UserWarning):
+    """An explicitly requested kernel formulation fell back at trace time.
+
+    ``env_var`` names the knob whose request was refused (e.g.
+    "TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_XCORR_IMPL",
+    "TMR_XCORR_IMPL_SMALL")."""
+
+    def __init__(self, env_var: str, message: str):
+        super().__init__(message)
+        self.env_var = env_var

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tmr_tpu.diagnostics import FormulationFallbackWarning  # noqa: F401
 from tmr_tpu.models.common import LayerNorm2d, MLPBlock
 
 
@@ -318,25 +319,28 @@ class Attention(nn.Module):
                     if not blockfolded_ok(h, w, head_dim):
                         import warnings
 
-                        warnings.warn(
+                        warnings.warn(FormulationFallbackWarning(
+                            "TMR_GLOBAL_ATTN",
                             "TMR_GLOBAL_ATTN=blockfolded: bf16 numerics "
                             f"self-check failed at grid ({h}, {w}, "
                             f"head_dim {head_dim}); running blockwise "
                             "fallback"
-                        )
+                        ))
                         attn_fn = blockwise_decomposed_attention
             elif impl == "pallas":
                 # the custom decomposed-bias kernel (ops/pallas_attn.py):
                 # VMEM-resident online-softmax tiles, native head-dim
                 # contraction; self-checked per geometry with fallback
                 from tmr_tpu.ops.pallas_attn import (
+                    effective_global_tiles,
                     pallas_decomposed_attention,
                     pallas_global_ok,
                     pallas_supported,
                 )
 
+                bq, bk = effective_global_tiles(h * w)
                 if pallas_supported(h * w) and pallas_global_ok(
-                    h, w, head_dim
+                    h, w, head_dim, bq, bk
                 ):
                     attn_fn = pallas_decomposed_attention
                 else:
@@ -345,11 +349,12 @@ class Attention(nn.Module):
                     # once, at trace time
                     import warnings
 
-                    warnings.warn(
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_GLOBAL_ATTN",
                         "TMR_GLOBAL_ATTN=pallas: self-check gate refused "
                         f"grid ({h}, {w}, head_dim {head_dim}); running "
                         "blockwise fallback"
-                    )
+                    ))
             elif impl != "blockwise" and self.dtype == jnp.bfloat16:
                 from tmr_tpu.ops.flash_attn import (
                     flash_attention_ok,
@@ -364,21 +369,23 @@ class Attention(nn.Module):
                 elif impl == "flash":
                     import warnings
 
-                    warnings.warn(
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_GLOBAL_ATTN",
                         "TMR_GLOBAL_ATTN=flash: gate refused grid "
                         f"({h}, {w}, head_dim {head_dim}); running "
                         "blockwise fallback"
-                    )
+                    ))
             elif impl == "flash":
                 # explicit flash on a non-bf16 model: the kernel is
                 # bf16-only, so the request silently lands on blockwise —
                 # say so or an A/B records blockwise timings labeled flash
                 import warnings
 
-                warnings.warn(
+                warnings.warn(FormulationFallbackWarning(
+                    "TMR_GLOBAL_ATTN",
                     f"TMR_GLOBAL_ATTN=flash needs bf16 (model dtype "
                     f"{self.dtype}); running blockwise fallback"
-                )
+                ))
             x = attn_fn(
                 q, k, v,
                 rh if self.use_rel_pos else None,
@@ -423,12 +430,13 @@ class Attention(nn.Module):
                 # by design.
                 import warnings
 
-                warnings.warn(
+                warnings.warn(FormulationFallbackWarning(
+                    "TMR_WIN_ATTN",
                     f"TMR_WIN_ATTN={os.environ['TMR_WIN_ATTN']}: gate or "
                     f"dtype refused window grid ({h}, {w}, head_dim "
                     f"{head_dim}, dtype {self.dtype}); running dense "
                     "fallback"
-                )
+                ))
             if self.use_rel_pos and _WIN_ATTN_IMPL() == "folded":
                 # A/B variant for the windowed blocks (TMR_WIN_ATTN=folded):
                 # the decomposed bias rides inside the QK contraction via the

@@ -19,9 +19,10 @@ the bias folded into a 256-lane-padded contraction measured *worse*
   dynamic lane slicing, no (S, S) bias materialization;
 - qk/av contractions stay at the native head dim (64/80), f32 accumulate.
 
-Exactness: identical math to blockwise_decomposed_attention up to
-input-dtype rounding of the bias projections (bf16 deployment rounds them
-once; the f32 path matches blockwise to float-associativity). Gated like
+Exactness: identical math to blockwise_decomposed_attention to
+float-associativity — the bias projections are computed and consumed in
+full f32 regardless of the input dtype (bf16 deployment rounds only the
+qk/av contraction inputs, exactly like the blockwise path). Gated like
 every Pallas path here: per-geometry compiled self-check against the exact
 blockwise oracle, fallback on any failure (ops/flash_attn._self_check).
 
@@ -153,6 +154,21 @@ def _pick_block(s: int, preferred: int = 512) -> Optional[int]:
 
 def pallas_supported(seq_len: int) -> bool:
     return _pick_block(seq_len) is not None
+
+
+def effective_global_tiles(
+    seq_len: int,
+) -> Tuple[Optional[int], Optional[int]]:
+    """The (bq, bk) tile sizes the global kernel will actually trace with:
+    the TMR_PALLAS_ATTN_BQ/BK preferences clamped to the largest
+    power-of-two divisor of ``seq_len`` — the same resolution
+    ``_pallas_attn_fwd_impl`` performs. Callers of ``pallas_global_ok``
+    MUST pass these so the gate verdict is cached under the tile config it
+    actually vouches for."""
+    return (
+        _pick_block(seq_len, _env_tile("TMR_PALLAS_ATTN_BQ", 512)),
+        _pick_block(seq_len, _env_tile("TMR_PALLAS_ATTN_BK", 512)),
+    )
 
 
 def pallas_decomposed_attention(
@@ -424,13 +440,25 @@ def pallas_window_ok(
 
 
 @functools.lru_cache(maxsize=None)
-def pallas_global_ok(gh: int, gw: int, head_dim: int) -> bool:
+def pallas_global_ok(
+    gh: int, gw: int, head_dim: int, bq: int, bk: int
+) -> bool:
     """Per-geometry compiled self-check of this kernel against the exact
     blockwise oracle (forward AND backward — the backward here IS blockwise,
     so the grad half guards only the custom_vjp plumbing). Same policy as
-    flash_attention_ok: reduced batch/heads, full grid/blocks/head-dim."""
+    flash_attention_ok: reduced batch/heads, full grid/blocks/head-dim.
+
+    ``(bq, bk)`` must be the EFFECTIVE tile sizes the kernel will trace
+    with (callers resolve them via ``effective_global_tiles`` — the same
+    env + clamp resolution the forward impl performs). The self-check
+    below reads the same env at trace time, so its compiled program runs
+    exactly those tiles; the lru_cache keys on them so a verdict reached
+    under one tile config is never reused for another (a tile-specific
+    Mosaic lowering failure or VMEM overflow must trip here, inside the
+    gate — mirroring pallas_window_ok's ``group`` parameter)."""
     from tmr_tpu.ops.flash_attn import _self_check
 
+    del bq, bk  # cache key only; the env the caller resolved from is live
     return _self_check(pallas_decomposed_attention, 1, 2, gh, gw, head_dim)
 
 

@@ -254,8 +254,16 @@ def cross_correlation(
             raise ValueError(
                 f"{name}={val!r}: expected auto|conv|vmap|fft|convnhwc|pallas"
             )
+    # remember which knob supplied the resolved impl so a gate refusal
+    # below can name it (FormulationFallbackWarning carries the env var —
+    # the autotune sweeps annotate mislabeled timings structurally)
+    impl_source = "TMR_XCORR_IMPL"
     if impl == "auto":
-        impl = "fft" if T > FFT_CAPACITY_THRESHOLD else small
+        if T > FFT_CAPACITY_THRESHOLD:
+            impl = "fft"
+        else:
+            impl = small
+            impl_source = "TMR_XCORR_IMPL_SMALL"
     if impl == "auto":  # "auto" as the small-bucket value = backend default
         impl = small_impl_default()
     def _compute(f, t):
@@ -284,8 +292,21 @@ def cross_correlation(
                 return xcorr_pallas(f, t).astype(in_dtype)
             # self-check refused or capacity too big: fall back the way the
             # auto dispatch would — a direct SAME conv at T in the 100s is
-            # O(H^2 T^2 C) (module docstring), so big buckets go to FFT
-            if T > FFT_CAPACITY_THRESHOLD:
+            # O(H^2 T^2 C) (module docstring), so big buckets go to FFT.
+            # Say so at trace time: an A/B row (or cached autotune winner)
+            # labeled "pallas" must never silently record conv/FFT timings
+            # (the same contract as the attention formulations in vit.py)
+            import warnings
+
+            from tmr_tpu.diagnostics import FormulationFallbackWarning
+
+            fb = "fft" if T > FFT_CAPACITY_THRESHOLD else "conv"
+            warnings.warn(FormulationFallbackWarning(
+                impl_source,
+                f"{impl_source}=pallas: kernel self-check refused "
+                f"(C={C}, H={H}, W={W}, T={T}); running {fb} fallback"
+            ))
+            if fb == "fft":
                 return _xcorr_fft(f, t).astype(in_dtype)
             use = "conv"
         if use == "convnhwc":

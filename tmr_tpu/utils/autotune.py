@@ -32,6 +32,21 @@ WIN_ATTN_VARIANTS = ("dense", "folded", "flash", "pallas")
 GLOBAL_ATTN_VARIANTS = ("blockwise", "flash", "blockfolded", "pallas")
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 
+#: suffix marking a sweep entry whose timing measured a gate-refused
+#: variant's FALLBACK formulation, not the labeled one. Single source of
+#: truth for producer (_sweep_block_env), consumer (the winner filter in
+#: autotune()), and tests — the three must never desynchronize or fallback
+#: rows become electable again.
+FALLBACK_SUFFIX = " (fallback)"
+
+#: bumped when a sweep harness changes in a way that invalidates
+#: previously cached winners (folded into _variants_sig, so every stale
+#: entry re-sweeps at the next hardware window). "fallback-label":
+#: pre-revision sweeps could record a gate-refused variant's fallback
+#: timing under the requested label and crown it — such poisoned winners
+#: (seed or user cache) must not survive as cached hits.
+_SWEEP_REV = "fallback-label"
+
 
 def _sweep_xcorr_env(
     env_var: str, variants, batch: int, emb_dim: int, hw: int, capacity: int,
@@ -45,10 +60,13 @@ def _sweep_xcorr_env(
     ``train=True`` times forward + gradient w.r.t. the feature map (the
     matcher sits in the training grad path; backward cost ratios differ
     per lowering, so a fwd-only rank could mis-pick for training)."""
+    import warnings
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from tmr_tpu.diagnostics import FormulationFallbackWarning
     from tmr_tpu.ops.xcorr import match_templates
 
     rng = np.random.default_rng(0)
@@ -81,16 +99,64 @@ def _sweep_xcorr_env(
                     y = match_templates(f + fb, e, capacity=capacity)
                     return y, jnp.sum(y) * 0.0
 
-            try:
-                times[variant] = chained_seconds_per_iter(
-                    step, feat, ex, rtt=rtt
-                )
-            except Exception as e:  # failed variant = not chosen, but say so
-                log(f"autotune: {env_var}[{variant}] failed: "
-                    f"{type(e).__name__}: {e}")
+            # same fallback-labeling contract as _sweep_block_env: a
+            # gate-refused variant (pallas off-gate -> conv/fft) warns at
+            # trace time and its timing is recorded annotated
+            t = None
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                try:
+                    t = chained_seconds_per_iter(step, feat, ex, rtt=rtt)
+                except Exception as e:  # failed variant = not chosen
+                    log(f"autotune: {env_var}[{variant}] failed: "
+                        f"{type(e).__name__}: {e}")
+            _reemit_unrelated(caught, env_var)
+            if t is None:
+                continue
+            if any(
+                isinstance(w.message, FormulationFallbackWarning)
+                and w.message.env_var == env_var
+                for w in caught
+            ):
+                log(f"autotune: {env_var}[{variant}] gate-refused; timed "
+                    "the fallback formulation — recording annotated")
+                times[variant + FALLBACK_SUFFIX] = t
+            else:
+                times[variant] = t
     finally:
         _restore(prev, env_var)
     return times
+
+
+def _electable(times: Dict[str, float]) -> Dict[str, float]:
+    """Drop FALLBACK_SUFFIX-annotated sweep entries from winner selection:
+    they measured a DIFFERENT formulation than their label requested (gate
+    refusal) — kept in the report as evidence, but exporting one as the
+    winner would set an invalid env value whose timing belongs to another
+    variant. Shared by every knob's selection so no sweep can diverge."""
+    return {
+        k: v for k, v in times.items() if not k.endswith(FALLBACK_SUFFIX)
+    }
+
+
+def _reemit_unrelated(caught, env_var: str) -> None:
+    """Re-emit warnings the sweep's record=True capture swallowed, except
+    the fallback markers for THE KNOB BEING SWEPT (those become the
+    FALLBACK_SUFFIX annotation). Everything else must still reach the
+    operator: a JAX transfer/deprecation warning that explains an anomalous
+    timing, and fallback markers for a DIFFERENT knob (e.g. the user's
+    pinned TMR_XCORR_IMPL=pallas falling back during the precision sweep)."""
+    import warnings
+
+    from tmr_tpu.diagnostics import FormulationFallbackWarning
+
+    for w in caught:
+        if (
+            isinstance(w.message, FormulationFallbackWarning)
+            and w.message.env_var == env_var
+        ):
+            continue
+        warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
 
 
 def pick_xcorr_impl(
@@ -152,7 +218,10 @@ def _sweep_block_env(
     import jax.numpy as jnp
     import numpy as np
 
+    from tmr_tpu.diagnostics import FormulationFallbackWarning
     from tmr_tpu.models.vit import Block
+
+    import warnings
 
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(
@@ -166,32 +235,59 @@ def _sweep_block_env(
             os.environ[env_var] = impl
             blk = Block(num_heads=num_heads, window_size=window_size,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
-            params = jax.jit(blk.init)(jax.random.key(1), tokens)["params"]
 
-            if train:
-                def loss_fn(p, x, _blk=blk):
-                    y = _blk.apply({"params": p}, x)
-                    return jnp.sum(y.astype(jnp.float32) ** 2)
+            # a gate-refused request silently traces the fallback
+            # formulation (vit.py warns at trace time): capture those
+            # warnings so the timing is labeled with what was MEASURED —
+            # an entry recorded under the requested name would poison the
+            # cached winner and the exported A/B evidence
+            t = None
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                try:
+                    params = jax.jit(blk.init)(
+                        jax.random.key(1), tokens
+                    )["params"]
 
-                @jax.jit
-                def step(p, x, fb):
-                    l, g = jax.value_and_grad(loss_fn)(
-                        p, x + fb.astype(x.dtype)
+                    if train:
+                        def loss_fn(p, x, _blk=blk):
+                            y = _blk.apply({"params": p}, x)
+                            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+                        @jax.jit
+                        def step(p, x, fb):
+                            l, g = jax.value_and_grad(loss_fn)(
+                                p, x + fb.astype(x.dtype)
+                            )
+                            return g, l * 0.0
+                    else:
+                        @jax.jit
+                        def step(p, x, fb):
+                            y = blk.apply(
+                                {"params": p}, x + fb.astype(x.dtype)
+                            )
+                            return y, jnp.sum(y).astype(jnp.float32) * 0.0
+
+                    t = chained_seconds_per_iter(
+                        step, params, tokens, rtt=rtt
                     )
-                    return g, l * 0.0
+                except Exception as e:
+                    log(f"autotune: {env_var}[{impl}] failed: "
+                        f"{type(e).__name__}: {e}")
+            _reemit_unrelated(caught, env_var)
+            if t is None:
+                continue
+            fell_back = any(
+                isinstance(w.message, FormulationFallbackWarning)
+                and w.message.env_var == env_var
+                for w in caught
+            )
+            if fell_back:
+                log(f"autotune: {env_var}[{impl}] gate-refused; timed the "
+                    "fallback formulation — recording annotated")
+                times[impl + FALLBACK_SUFFIX] = t
             else:
-                @jax.jit
-                def step(p, x, fb):
-                    y = blk.apply({"params": p}, x + fb.astype(x.dtype))
-                    return y, jnp.sum(y).astype(jnp.float32) * 0.0
-
-            try:
-                times[impl] = chained_seconds_per_iter(
-                    step, params, tokens, rtt=rtt
-                )
-            except Exception as e:
-                log(f"autotune: {env_var}[{impl}] failed: "
-                    f"{type(e).__name__}: {e}")
+                times[impl] = t
     finally:
         _restore(prev, env_var)
     return times
@@ -324,7 +420,15 @@ def _variants_sig(knob: str) -> str:
         "TMR_GLOBAL_ATTN": GLOBAL_ATTN_VARIANTS,
         "TMR_XCORR_PRECISION": XCORR_PRECISIONS,
     }
-    return ",".join(sets[knob])
+    sig = ",".join(sets[knob])
+    if knob in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_XCORR_IMPL_SMALL"):
+        # formulation-sweep winners are additionally versioned by the
+        # harness revision: a winner picked by a pre-revision sweep may be
+        # a mislabeled fallback timing (see _SWEEP_REV) and must go stale
+        # rather than load as a cached hit. (TMR_XCORR_PRECISION rows are
+        # precision labels, valid regardless of which impl dispatched.)
+        sig += f"|{_SWEEP_REV}"
+    return sig
 
 
 def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
@@ -498,8 +602,9 @@ def autotune(
         # exported through the SMALL-scoped knob (see module docstring)
         times = pick_xcorr_impl(batch, cfg.emb_dim, up_hw, 17, rtt=rtt,
                                 log=log, train=train)
-        if times:
-            best = min(times, key=times.get)
+        pickable = _electable(times)
+        if pickable:
+            best = min(pickable, key=pickable.get)
             os.environ["TMR_XCORR_IMPL_SMALL"] = best
             report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
             log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
@@ -560,8 +665,9 @@ def autotune(
             batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt, log=log,
             train=train,
         )
-        if times:
-            best = min(times, key=times.get)
+        pickable = _electable(times)
+        if pickable:
+            best = min(pickable, key=pickable.get)
             os.environ[knob] = best
             report[knob] = {"picked": best, "times": times}
             log(f"autotune: {knob}={best} {times}")
