@@ -107,12 +107,50 @@ def _emit_error(msg: str) -> None:
             ).stdout.strip()
             if dirty or not date:
                 # file differs from (or was never in) git: real measurement,
-                # but the commit date would misattribute it — say so instead
-                rec["last_live_uncommitted"] = live
+                # but the commit date would misattribute it — say so instead.
+                # Age from the file mtime (the measurement landed then).
+                age_h = (time.time() - os.path.getmtime(
+                    os.path.join(here, "BENCH_LIVE.json"))) / 3600.0
+                rec["last_live_uncommitted"] = {
+                    **live, "stale_hours": round(age_h, 1)
+                }
             else:
-                rec["last_committed_live"] = {**live, "committed_at": date}
+                import datetime as _dt
+
+                age_h = (
+                    _dt.datetime.now(_dt.timezone.utc)
+                    - _dt.datetime.fromisoformat(date)
+                ).total_seconds() / 3600.0
+                rec["last_committed_live"] = {
+                    **live, "committed_at": date,
+                    "stale_hours": round(age_h, 1),
+                }
     except Exception:
         pass  # the error record itself must never fail to print
+    try:
+        # last line of defense for a session that measured but died before
+        # committing: the watcher battery writes bench_live.json into the
+        # working tree — if it is valid and NEWER than the committed
+        # record, carry it too (clearly labeled, with its age)
+        here = os.path.dirname(os.path.abspath(__file__))
+        wpath = os.path.join(here, "bench_live.json")
+        cpath = os.path.join(here, "BENCH_LIVE.json")
+        if os.path.exists(wpath):
+            with open(wpath) as f:
+                wl = json.load(f)
+            if (
+                isinstance(wl, dict) and "error" not in wl and wl.get("value")
+                and (not os.path.exists(cpath)
+                     or os.path.getmtime(wpath) > os.path.getmtime(cpath))
+                and "last_live_uncommitted" not in rec
+            ):
+                age_h = (time.time() - os.path.getmtime(wpath)) / 3600.0
+                rec["last_live_uncommitted"] = {
+                    **wl, "stale_hours": round(age_h, 1),
+                    "source": "watcher working-tree bench_live.json",
+                }
+    except Exception:
+        pass
     print(json.dumps(rec), flush=True)
 
 
@@ -361,6 +399,7 @@ def _run(cancel_watchdog) -> None:
                 "tflops_per_image": round(tflops, 3),
                 "ms_per_batch": round(per_batch * 1000, 2),
                 "batch": BATCH,
+                "device_kind": jax.devices()[0].device_kind,
                 "rtt_floor_ms": round(rtt * 1000, 1),
                 "autotuned": {k: v["picked"] for k, v in tune.items()},
                 # per-variant sweep timings (sec/iter) for knobs measured

@@ -42,6 +42,18 @@ t = pick_global_attn_impl(4, 64, 768, 12, log=lambda s: None)
 print(json.dumps({'one_global_block_sec': t}))
 " >"$OUT/global_attn_sweep.json" 2>>"$LOG"
     log "global sweep rc=$? -> $OUT/global_attn_sweep.json"
+    # 1b: FRESH unpinned autotuned headline — the baseline every pinned
+    # A/B below is judged against (stage 3d's pick refuses to pin without
+    # it; a stale bench_live.json from an earlier battery would compare
+    # apples to oranges). Valid results also land as the committed-copy
+    # candidate BENCH_LIVE.json for the session driver to commit.
+    TMR_BENCH_ALARM=2700 timeout 3000 python bench.py \
+      >"$OUT/bench_live.json" 2>>"$LOG"
+    log "bench (autotuned headline) rc=$? -> $OUT/bench_live.json"
+    if grep -q '"value"' "$OUT/bench_live.json" 2>/dev/null \
+        && ! grep -q '"error"' "$OUT/bench_live.json" 2>/dev/null; then
+      cp "$OUT/bench_live.json" "$REPO/BENCH_LIVE.json" 2>/dev/null
+    fi
     # 2: headline with the pallas kernel forced (winner check happens at
     # analysis time; a gate-refused geometry silently falls back, which the
     # bench JSON will show as an unchanged number)
@@ -62,6 +74,15 @@ print(json.dumps({'one_global_block_sec': t}))
       TMR_BENCH_ALARM=2700 timeout 3000 python bench.py \
       >"$OUT/bench_allpallas.json" 2>>"$LOG"
     log "bench (all-pallas g8) rc=$? -> $OUT/bench_allpallas.json"
+    # 3d: full-program arbitration (VERDICT r4 #4): if an env-pinned combo
+    # decisively beat the autotuned headline, pin its knobs into the seed
+    # (offline, no tunnel client) — the session commits the updated seed
+    timeout 120 python scripts/pick_full_program.py \
+      "$OUT/bench_live.json" "$OUT/bench_pallas.json" \
+      "$OUT/bench_windense.json" "$OUT/bench_combined.json" \
+      "$OUT/bench_allpallas.json" \
+      >"$OUT/full_program_pick.json" 2>>"$LOG"
+    log "full-program pick rc=$? -> $OUT/full_program_pick.json"
     # 4: ckpt anomaly probe (only if the battery's ckpt still exists)
     if [ -d "$OUT/bench_ckpt/params" ]; then
       timeout 2400 python -u scripts/ckpt_probe.py \
