@@ -441,9 +441,20 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
     }
-    # measured throughput-optimal eval batch (bench_extra's batch sweep);
-    # value is a positive int rendered as a string
-    digit_keys = {"TMR_BENCH_BATCH"}
+    # measured throughput-optimal eval batch (bench_extra's batch sweep)
+    # and the Pallas windowed-kernel group — positive ints as strings
+    digit_keys = {"TMR_BENCH_BATCH", "TMR_PALLAS_WIN_GROUP"}
+    # global-kernel tile preferences: powers of two >= 128 (the contract
+    # _env_tile enforces at read time — an off-contract seed value would
+    # otherwise crash the next trace instead of being dropped here)
+    tile_keys = {"TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK"}
+
+    def _tile_ok(vv: str) -> bool:
+        if not (vv.isascii() and vv.isdigit()):
+            return False
+        n = int(vv)
+        return n >= 128 and not (n & (n - 1))
+
     # per-knob filtering: one invalid/unknown winner drops only itself —
     # the valid sibling survives (and all-or-nothing would let the next
     # _cache_store rewrite erase it from disk permanently)
@@ -458,6 +469,7 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
                 vv in valid.get(kk, ())
                 or (kk in digit_keys and vv.isascii() and vv.isdigit()
                     and int(vv) > 0)
+                or (kk in tile_keys and _tile_ok(vv))
                 # variant-set version stamps: free-form comma-joined
                 # names, compared verbatim against _variants_sig()
                 or kk.startswith("_variants_")
@@ -557,6 +569,19 @@ def autotune(
             cached.pop(knob)
             log(f"autotune: cached {knob} predates the current variant "
                 "set; re-measuring")
+
+    # Pallas tile/group sub-knobs pinned by a full-program A/B
+    # (scripts/pick_full_program.py writes them into the seed next to the
+    # formulation they tuned): export when present and not user-set. Must
+    # run BEFORE the everything-pinned early return below — a fully
+    # env-pinned A/B rerun still needs the endorsed tiles. Only the pallas
+    # paths read them, so exporting alongside a non-pallas winner is inert.
+    for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
+                 "TMR_PALLAS_WIN_GROUP"):
+        if knob in cached and knob not in os.environ:
+            os.environ[knob] = cached[knob]
+            report[knob] = {"picked": cached[knob], "cached": True}
+            log(f"autotune: {knob}={cached[knob]} (cached, {key})")
 
     wanted = set()
     if (
