@@ -21,6 +21,9 @@ from tmr_tpu.utils.convert import convert_sam_vit
 REF_SAM_DIR = "/root/reference/models/backbone/sam"
 
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _load_ref_vit():
     """Load reference sam_ViT by path (the reference's package __init__ pulls
     in torchvision, which this image lacks, so we can't import it normally)."""
@@ -295,3 +298,169 @@ def test_vit_matches_reference_production_widths_1024(
     got = np.asarray(got).transpose(0, 3, 1, 2)
     assert want.shape == got.shape == (1, 256, 64, 64)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_vit_h_full_depth_layout_golden():
+    """The FULL vit_h layer map (VERDICT r4 #7): depth 32 at 1280-d/16-head
+    with global attention exactly at indexes (7, 15, 23, 31) and window 14
+    everywhere else — the registry config the reference ships
+    (sam_ViT.py via sam.py:20-30) — golden vs the torch encoder. Input 256
+    (16x16 grid) keeps the single-core torch oracle tractable while the
+    32-block windowed/global interleave, qkv/proj/mlp stacking, and the
+    converter's full-depth key mapping run at the real width. (The
+    127-row production rel-pos tables are covered by the 1024-input
+    production-width test above; this one proves the depth-32 layout.)"""
+    import torch
+
+    ref_vit = _load_ref_vit()
+    torch.manual_seed(23)
+    cfg = dict(
+        img_size=256, patch_size=16, embed_dim=1280, depth=32,
+        num_heads=16, global_attn_indexes=(7, 15, 23, 31), window_size=14,
+        out_chans=256,
+    )
+    ref = ref_vit.ImageEncoderViT(
+        depth=cfg["depth"], embed_dim=cfg["embed_dim"],
+        img_size=cfg["img_size"], mlp_ratio=4,
+        norm_layer=lambda d: torch.nn.LayerNorm(d, eps=1e-6),
+        num_heads=cfg["num_heads"], patch_size=cfg["patch_size"],
+        qkv_bias=True, use_rel_pos=True,
+        global_attn_indexes=cfg["global_attn_indexes"],
+        window_size=cfg["window_size"], out_chans=cfg["out_chans"],
+    )
+    with torch.no_grad():
+        ref.pos_embed.normal_(std=0.02)
+        for blk in ref.blocks:
+            blk.attn.rel_pos_h.normal_(std=0.02)
+            blk.attn.rel_pos_w.normal_(std=0.02)
+    ref.eval()
+
+    mine = SamViT(
+        embed_dim=cfg["embed_dim"], depth=cfg["depth"],
+        num_heads=cfg["num_heads"],
+        global_attn_indexes=cfg["global_attn_indexes"],
+        patch_size=cfg["patch_size"], window_size=cfg["window_size"],
+        out_chans=cfg["out_chans"], pretrain_img_size=cfg["img_size"],
+    )
+    params = convert_sam_vit(dict(ref.state_dict()), prefix="")
+
+    x = np.random.default_rng(23).standard_normal(
+        (1, 3, 256, 256)
+    ).astype(np.float32)
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x)).numpy()  # (1, 256, 16, 16)
+    got = mine.apply({"params": params}, jnp.array(x.transpose(0, 2, 3, 1)))
+    got = np.asarray(got).transpose(0, 3, 1, 2)
+    assert want.shape == got.shape == (1, 256, 16, 16)
+    # 32 accumulated blocks: slightly wider tolerance than the depth-2 runs
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+
+def test_vit_1536_bucket_production_width_golden():
+    """The 1536 bucket END-TO-END at production width (VERDICT r4 #7):
+    vit_b (768-d/12-head) pretrained at 1024 (64-grid pos embed, 127-row
+    rel-pos tables) fed a 1536 input (96-grid, 9216 tokens) — the escape-
+    hatch bucket for <25px exemplars (reference mapper semantics). The
+    torch oracle replicates the reference's non-native forward
+    (sam.py:72-76): pos embed bilinearly resized to the 96-grid; the
+    blocks' get_rel_pos interpolates the 127-row tables to 191 internally
+    on both sides. One windowed + one global block at real window 14
+    (96-grid -> pad 98) and the full 9216-token global attention."""
+    import torch
+    import torch.nn.functional as F
+
+    ref_vit = _load_ref_vit()
+    torch.manual_seed(31)
+    cfg = dict(
+        img_size=1024, patch_size=16, embed_dim=768, depth=2,
+        num_heads=12, global_attn_indexes=(1,), window_size=14,
+        out_chans=256,
+    )
+    ref = ref_vit.ImageEncoderViT(
+        depth=cfg["depth"], embed_dim=cfg["embed_dim"],
+        img_size=cfg["img_size"], mlp_ratio=4,
+        norm_layer=lambda d: torch.nn.LayerNorm(d, eps=1e-6),
+        num_heads=cfg["num_heads"], patch_size=cfg["patch_size"],
+        qkv_bias=True, use_rel_pos=True,
+        global_attn_indexes=cfg["global_attn_indexes"],
+        window_size=cfg["window_size"], out_chans=cfg["out_chans"],
+    )
+    with torch.no_grad():
+        ref.pos_embed.normal_(std=0.02)
+        for blk in ref.blocks:
+            blk.attn.rel_pos_h.normal_(std=0.02)
+            blk.attn.rel_pos_w.normal_(std=0.02)
+    ref.eval()
+
+    mine = SamViT(
+        embed_dim=cfg["embed_dim"], depth=cfg["depth"],
+        num_heads=cfg["num_heads"],
+        global_attn_indexes=cfg["global_attn_indexes"],
+        patch_size=cfg["patch_size"], window_size=cfg["window_size"],
+        out_chans=cfg["out_chans"], pretrain_img_size=cfg["img_size"],
+    )
+    params = convert_sam_vit(dict(ref.state_dict()), prefix="")
+
+    x = np.random.default_rng(31).standard_normal(
+        (1, 3, 1536, 1536)
+    ).astype(np.float32)
+    with torch.no_grad():
+        t = torch.from_numpy(x)
+        h = ref.patch_embed(t)  # (1, 96, 96, 768)
+        pos = F.interpolate(
+            ref.pos_embed.permute(0, 3, 1, 2), size=h.shape[1:3],
+            mode="bilinear",
+        ).permute(0, 2, 3, 1)
+        h = h + pos
+        for blk in ref.blocks:
+            h = blk(h)
+        want = ref.neck(h.permute(0, 3, 1, 2)).numpy()  # (1, 256, 96, 96)
+
+    got = mine.apply({"params": params}, jnp.array(x.transpose(0, 2, 3, 1)))
+    got = np.asarray(got).transpose(0, 3, 1, 2)
+    assert want.shape == got.shape == (1, 256, 96, 96)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_cli_sam_hq_pth_recipe(tmp_path):
+    """The real-weight conversion recipe (VERDICT r4 #7), end to end on a
+    simulated ``sam_hq_vit_b.pth``: a torch state dict with the actual
+    SAM-HQ layout — ``image_encoder.*`` plus the prompt-encoder /
+    mask-decoder subtrees the converter must IGNORE — saved with
+    torch.save, converted via the documented CLI
+    (``python -m tmr_tpu.utils.convert --ckpt sam_hq_vit_b.pth --out d``),
+    restored from orbax, and the restored encoder's output pinned to the
+    torch oracle. This is the exact command sequence README.md documents
+    for the day a real weight file exists; only the tensor sizes are tiny.
+    """
+    import torch
+
+    import orbax.checkpoint as ocp
+
+    from tmr_tpu.utils import convert as cv
+
+    ref, mine, _ = _build_pair(seed=5)
+    sd = {f"image_encoder.{k}": v for k, v in ref.state_dict().items()}
+    # the rest of the SAM-HQ checkpoint the encoder converter must skip
+    sd["prompt_encoder.pe_layer.positional_encoding_gaussian_matrix"] = (
+        torch.randn(2, 8)
+    )
+    sd["mask_decoder.iou_token.weight"] = torch.randn(1, 16)
+    ckpt = tmp_path / "sam_hq_vit_b.pth"
+    torch.save(sd, ckpt)
+
+    out = tmp_path / "orbax"
+    cv.main(["--ckpt", str(ckpt), "--out", str(out)])  # --kind auto sniffs
+
+    restored = ocp.StandardCheckpointer().restore(str(out))
+    x = np.random.default_rng(5).standard_normal((1, 3, 32, 32)).astype(
+        np.float32
+    )
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x)).numpy()
+    got = mine.apply(
+        {"params": restored["params"]}, jnp.array(x.transpose(0, 2, 3, 1))
+    )
+    np.testing.assert_allclose(
+        np.asarray(got).transpose(0, 3, 1, 2), want, rtol=2e-4, atol=2e-5
+    )
