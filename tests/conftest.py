@@ -24,3 +24,27 @@ jax.config.update("jax_platforms", "cpu")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute test (real-width compiles, depth-32 goldens, "
+        "e2e training); excluded from the fast dev loop",
+    )
+    config.addinivalue_line(
+        "markers",
+        "fast: auto-applied complement of slow — `pytest -m fast` is the "
+        "sub-2-minute dev loop, the full (unmarked) run is CI",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Every test not marked slow is fast: `-m fast` and `-m "not slow"`
+    select the identical set, so the dev loop works with either spelling
+    (VERDICT r4 #8 asks for `pytest -m fast` under 120s)."""
+    import pytest
+
+    for item in items:
+        if item.get_closest_marker("slow") is None:
+            item.add_marker(pytest.mark.fast)

@@ -149,6 +149,7 @@ def test_small_scope_keeps_fft_for_big_buckets(clean_knobs, monkeypatch):
     assert abs(np.asarray(got) - np.asarray(feat)).max() > 0
 
 
+@pytest.mark.slow
 def test_microbenchmarks_run_and_time_all_variants(clean_knobs):
     """The pick_* functions themselves must run every variant end to end
     (tiny shapes; CPU is fine for exercising the machinery). Off-TPU the
@@ -388,6 +389,7 @@ def test_train_autotune_uses_separate_key_and_grad_sweep(
     assert r3["TMR_WIN_ATTN"] == {"picked": "dense", "cached": True}
 
 
+@pytest.mark.slow
 def test_block_sweep_train_mode_times_grad(clean_knobs, monkeypatch):
     """The real harness under train=True must build a differentiable step
     (value_and_grad through the block) and produce a time for every
@@ -548,6 +550,7 @@ def test_cache_accepts_measured_batch_winner(clean_knobs):
     assert "other" not in loaded
 
 
+@pytest.mark.slow
 def test_global_attn_knob_validates_and_matches(monkeypatch):
     """TMR_GLOBAL_ATTN forces the global-attention formulation at trace
     time: invalid values raise, and 'blockwise' matches the auto dispatch

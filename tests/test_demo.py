@@ -8,6 +8,9 @@ import demo as demo_mod
 from tmr_tpu.config import Config
 
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def small_cfg(**kw):
     base = dict(
         backbone="resnet50_layer1", emb_dim=16, fusion=True,

@@ -23,6 +23,9 @@ import jax.numpy as jnp
 from oracles import giou_loss_np, nms_np, roi_align_np
 from test_vit_golden import TINY, _build_pair
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 REF = "/root/reference"
 
 

@@ -17,6 +17,9 @@ from tmr_tpu.utils.export import (
     save_exported,
 )
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 TINY = dict(embed_dim=32, depth=2, num_heads=2, global_attn_indexes=(1,),
             window_size=2, out_chans=16, pretrain_img_size=64)
 SIZE = 64

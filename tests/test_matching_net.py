@@ -1,6 +1,7 @@
 """MatchingNet forward: shapes, jit, matcher semantics, bucket selection."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +42,7 @@ def _data(b=2, s=64):
     return jnp.array(image), jnp.array(exemplars)
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_finiteness():
     model = _tiny_model()
     image, exemplars = _data()
@@ -58,6 +60,7 @@ def test_forward_shapes_and_finiteness():
     assert (np.asarray(out["f_tm"][0]) >= 0).all()
 
 
+@pytest.mark.slow
 def test_no_matcher_and_no_boxreg_variants():
     image, exemplars = _data()
     m1 = _tiny_model(no_matcher=True, fusion=False)
@@ -73,6 +76,7 @@ def test_no_matcher_and_no_boxreg_variants():
     assert "decoder_b_0" not in p2
 
 
+@pytest.mark.slow
 def test_gradients_flow_to_heads_not_nan():
     model = _tiny_model()
     image, exemplars = _data()
@@ -124,6 +128,7 @@ def test_backbone_flag_validation():
     assert bb.remat is True
 
 
+@pytest.mark.slow
 def test_vit_h_production_config_abstract_forward():
     """Full ViT-H (1280-d, 32 blocks, global attention at 7/15/23/31) under
     the production RPINE/--refine_box configuration at 1024: abstract

@@ -22,6 +22,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mh_worker.py")
 
 
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _free_port() -> int:
     # NB: TOCTOU — the port is released before the coordinator binds it
     # (seconds later, after worker startup). Collisions are unlikely on

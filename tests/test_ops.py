@@ -45,6 +45,7 @@ def test_box_codecs_roundtrip():
 # ----------------------------------------------------------------- roi_align
 @pytest.mark.parametrize("sampling_ratio", [-1, 1, 2])
 @pytest.mark.parametrize("aligned", [True, False])
+@pytest.mark.slow
 def test_roi_align_matches_torchvision_port(sampling_ratio, aligned):
     feat = RNG.standard_normal((3, 24, 20)).astype(np.float32)
     boxes = np.array(
@@ -68,6 +69,7 @@ def test_roi_align_matches_torchvision_port(sampling_ratio, aligned):
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_roi_align_odd_template_sizes():
     """The template-extraction configuration: aligned=True, adaptive ratio."""
     feat = RNG.standard_normal((2, 32, 32)).astype(np.float32)
@@ -85,6 +87,7 @@ def test_roi_align_odd_template_sizes():
 
 
 # --------------------------------------------------------------------- xcorr
+@pytest.mark.slow
 def test_extract_template_centered_in_capacity():
     feat = RNG.standard_normal((4, 16, 16)).astype(np.float32)
     exemplar = np.array([0.2, 0.3, 0.55, 0.62], np.float32)
@@ -162,6 +165,7 @@ def test_cross_correlation_fft_path_matches_reference():
     np.testing.assert_allclose(np.asarray(got)[0], want, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_match_templates_huge_exemplar_exact():
     """An exemplar spanning 0.9x the image must match the reference oracle
     exactly (no clamp): the 127-capacity bucket + FFT correlation."""
@@ -373,6 +377,7 @@ def test_cross_correlation_precision_invalid_raises(monkeypatch):
 # either.
 
 
+@pytest.mark.slow
 def test_roi_align_hand_derived_unit_bins():
     """f[y,x] = 10y + x, aligned ROI (0.5,0.5)-(2.5,2.5) -> sample grid
     starts at 0, unit bins, ratio 1 -> one bilinear sample per bin center
@@ -393,6 +398,7 @@ def test_roi_align_hand_derived_unit_bins():
     )
 
 
+@pytest.mark.slow
 def test_roi_align_hand_derived_adaptive_ratio():
     """Adaptive sampling (ratio -1): a 4-pixel ROI into 2 bins gives
     ceil(4/2)=2 samples/axis/bin at 2i + {0.5, 1.5}. On the LINEAR field
@@ -414,6 +420,7 @@ def test_roi_align_hand_derived_adaptive_ratio():
     )
 
 
+@pytest.mark.slow
 def test_roi_align_hand_derived_out_of_bounds_rule():
     """The CUDA kernel's boundary convention, pinned on one axis: x samples
     at -2.5, -1.5 (pos < -1 -> ZERO contribution, not clamped), -0.5

@@ -20,6 +20,7 @@ def rand_boxes(n, seed, spread=1.0):
 
 @pytest.mark.parametrize("n,seed,thr", [(64, 0, 0.5), (128, 1, 0.3),
                                         (256, 2, 0.7), (128, 3, 0.15)])
+@pytest.mark.slow
 def test_pallas_nms_matches_xla(n, seed, thr):
     boxes, scores = rand_boxes(n, seed, spread=0.6)  # dense -> many overlaps
     want = nms_keep_mask(boxes, scores, thr)
@@ -27,6 +28,7 @@ def test_pallas_nms_matches_xla(n, seed, thr):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_pallas_nms_valid_mask():
     boxes, scores = rand_boxes(96, 4, spread=0.4)
     valid = jnp.asarray(np.random.default_rng(5).uniform(0, 1, 96) > 0.3)
@@ -53,6 +55,7 @@ def test_pallas_nms_all_invalid():
     assert int(np.asarray(got).sum()) == 0
 
 
+@pytest.mark.slow
 def test_batched_nms_backend_parity():
     """postprocess.batched_nms gives identical results on both backends
     (vmap over the pallas kernel included)."""
@@ -73,6 +76,7 @@ def test_batched_nms_backend_parity():
 
 
 @pytest.mark.parametrize("n", [150, 2000])
+@pytest.mark.slow
 def test_pallas_nms_non_lane_aligned(n):
     """N not a multiple of 128 (the eval default 2000 isn't either after
     padding semantics changed): the wrapper pads rows to a lane multiple with
@@ -130,6 +134,7 @@ def test_pallas_nms_suppression_chain():
 
 
 # ---- pallas depthwise correlation (ops/pallas_xcorr.py) --------------------
+@pytest.mark.slow
 def test_pallas_xcorr_matches_conv_path():
     """The Pallas correlation kernel (interpret mode on CPU) must equal the
     HIGHEST-precision grouped-conv lowering on identical inputs, across

@@ -34,6 +34,9 @@ TINY_VIT = dict(
 )
 
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _model_cfg():
     cfg = Config(
         backbone="sam_vit_b", emb_dim=16, fusion=True,

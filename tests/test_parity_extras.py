@@ -91,6 +91,7 @@ def test_package_modules(tmp_path, monkeypatch):
         sys.modules.update(saved_mods)
 
 
+@pytest.mark.slow
 def test_refiner_save_masks(tmp_path):
     import jax.numpy as jnp
 

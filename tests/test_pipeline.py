@@ -28,6 +28,9 @@ TINY = dict(
 )
 
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _model_and_params(seed=0):
     vit = SamViT(**TINY)
     x = jnp.asarray(

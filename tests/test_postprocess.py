@@ -69,6 +69,7 @@ def test_decode_matches_reference(seed, cls_thr):
     np.testing.assert_allclose(got_refs[go], want_refs[wo], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_decode_no_box_reg_uses_exemplar_size():
     rng = np.random.default_rng(2)
     H = W = 16
@@ -84,6 +85,7 @@ def test_decode_no_box_reg_uses_exemplar_size():
     np.testing.assert_allclose(wh, np.tile([[0.2, 0.3]], (len(wh), 1)), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_full_pipeline_with_nms_matches_reference():
     rng = np.random.default_rng(3)
     H = W = 24
@@ -109,6 +111,7 @@ def test_full_pipeline_with_nms_matches_reference():
     np.testing.assert_allclose(got, np.sort(want), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_empty_detections_are_clean():
     obj = jnp.full((1, 16, 16), -10.0)  # sigmoid ~ 0
     regs = jnp.zeros((1, 16, 16, 4))

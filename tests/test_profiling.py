@@ -5,6 +5,8 @@ subsystem we add: phase timers, trace capture producing on-disk artifacts,
 annotations composing with jit, and the reducer.py-compatible stderr
 protocol."""
 
+import pytest
+
 import os
 
 import jax
@@ -81,6 +83,7 @@ def test_stderr_protocol_format(capsys):
     assert "[PROGRESS] 3/10" in err
 
 
+@pytest.mark.slow
 def test_xprof_top_ops_extracts_dominant_op(tmp_path):
     """scripts/xprof_top_ops.py parses a jax.profiler trace without
     TensorBoard and ranks ops by device time — on the CPU test backend the

@@ -104,6 +104,7 @@ def test_ring_bf16_inputs():
     )
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     q, k, v = rand_qkv(5)
     mesh = seq_mesh(4)
@@ -157,6 +158,7 @@ def test_ring_decomposed_matches_vit_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_vit_seq_parallel_matches_dense():
     """SamViT with a 'seq' mesh (ring-attention global blocks) must produce
     the same features as the single-device dense path."""
@@ -180,6 +182,7 @@ def test_vit_seq_parallel_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_vit_seq_parallel_grad_matches_dense():
     """Backward pass through the ring island matches the dense grad (the
     training path under context parallelism)."""
@@ -272,6 +275,7 @@ def test_make_ring_attention_fn_convenience():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_blockwise_attention_matches_dense_at_global_grid():
     """The blockwise path is the production kernel for every global-attention
     block at real image sizes (h*w >= 1024 in models/vit.py); pin it to the
@@ -312,6 +316,7 @@ def test_blockwise_attention_matches_dense_at_global_grid():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_blockfolded_attention_matches_blockwise():
     """TMR_GLOBAL_ATTN=blockfolded (fold-into-QK + band scan, models/vit.py)
     must equal the exact blockwise path in f32 — the fold is algebraically
@@ -356,6 +361,7 @@ def test_blockfolded_attention_matches_blockwise():
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_global_attn_env_dispatch_blockfolded(monkeypatch):
     """The Attention module must actually dispatch to the blockfolded path
     (and produce blockwise-equal output) when TMR_GLOBAL_ATTN=blockfolded —
@@ -394,6 +400,7 @@ def test_global_attn_env_dispatch_blockfolded(monkeypatch):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pallas_decomposed_attention_matches_blockwise():
     """The custom VMEM-resident global-attention kernel
     (ops/pallas_attn.py, TMR_GLOBAL_ATTN=pallas) vs the exact blockwise
@@ -451,6 +458,7 @@ def test_pallas_decomposed_attention_matches_blockwise():
 
 
 @pytest.mark.parametrize("gh,gw,D", [(16, 32, 8), (16, 32, 80)])
+@pytest.mark.slow
 def test_pallas_attention_multiblock_seq(gh, gw, D):
     """S=512 at block 256 forces a real multi-k-block online-softmax pass
     (running max/denominator rescaling across iterations); D=80 is vit_h's
@@ -513,6 +521,7 @@ def test_pallas_global_gate_keys_on_effective_tiles(monkeypatch):
 
 
 @pytest.mark.parametrize("group,D", [(None, 8), ("3", 8), (None, 80)])
+@pytest.mark.slow
 def test_pallas_windowed_attention_matches_blockwise(group, D, monkeypatch):
     """TMR_WIN_ATTN=pallas (ops/pallas_attn.pallas_windowed_attention) vs
     the exact blockwise oracle at the REAL 14x14 window grid (196 tokens
@@ -558,6 +567,7 @@ def test_pallas_windowed_attention_matches_blockwise(group, D, monkeypatch):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_win_attn_env_dispatch_pallas(monkeypatch):
     """A windowed Attention module under TMR_WIN_ATTN=pallas must equal the
     dense default (off-TPU the gate refuses -> dense fallback, which is the
@@ -579,6 +589,7 @@ def test_win_attn_env_dispatch_pallas(monkeypatch):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fold_rel_pos_into_qk_exact():
     """The augmented-QK trick (ops/flash_attn.py) must reproduce the biased
     scores EXACTLY in f32: q'.k'^T == scale*q.k^T + decomposed bias."""
@@ -665,6 +676,7 @@ def test_flash_attention_ok_callable_under_trace():
     flash_attention_ok.cache_clear()
 
 
+@pytest.mark.slow
 def test_windowed_attention_folded_matches_dense(monkeypatch):
     """TMR_WIN_ATTN=folded routes the windowed blocks' bias through the QK
     contraction (ops/flash_attn.fold_rel_pos_into_qk); in f32 the algebra is
@@ -693,6 +705,7 @@ def test_windowed_attention_folded_matches_dense(monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_flash_windowed_padding_and_segments(monkeypatch):
     """flash_windowed_attention pads 196-token windows to 256 and masks the
     pad via a second segment. The Pallas kernel itself needs a TPU, but its
@@ -729,6 +742,7 @@ def test_flash_windowed_padding_and_segments(monkeypatch):
     assert got.shape == (b, hds, s, d)
 
 
+@pytest.mark.slow
 def test_windowed_attention_folded_grads_match_dense(monkeypatch):
     """Training differentiates through whatever attention formulation is
     active; the folded QK path must carry the same gradients as dense."""
@@ -761,6 +775,7 @@ def test_windowed_attention_folded_grads_match_dense(monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_flash_self_check_harness_including_grads(monkeypatch):
     """_self_check gates the flash paths on TPU (forward AND backward since
     the train step differentiates through them). Off-TPU it must refuse;
@@ -830,6 +845,7 @@ def test_flash_supported_production_lengths():
     assert not flash_supported(196)  # windows go through the padded path
 
 
+@pytest.mark.slow
 def test_ring_at_1536_bucket_scale():
     """The 1536 small-object bucket is the reference's longest sequence
     (96x96 = 9216 tokens, sam.py:72-76 pos-embed re-interpolation); ring

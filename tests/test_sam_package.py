@@ -8,6 +8,9 @@ import pytest
 from tmr_tpu.models.vit import SamViT
 from tmr_tpu.sam import Sam, SamAutomaticMaskGenerator, SamPredictor, sam_model_registry
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 SIZE = 64
 
 
@@ -29,6 +32,7 @@ def test_registry():
     assert sam_model_registry["default"]().image_encoder.embed_dim == 1280
 
 
+@pytest.mark.slow
 def test_predictor_point_and_box(tiny_sam):
     pred = SamPredictor(tiny_sam)
     rng = np.random.default_rng(0)

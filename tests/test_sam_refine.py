@@ -24,6 +24,9 @@ from tmr_tpu.utils.convert import (
 DIM = 32  # small transformer dim for fast tests (divisible by 8 heads, /8=4)
 
 
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _tiny_torch_pair(seed=0):
     """Build torch oracle modules + converted Flax params at DIM=32."""
     torch.manual_seed(seed)

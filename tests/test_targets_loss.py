@@ -81,6 +81,7 @@ def _random_boxes(rng, n):
 @pytest.mark.parametrize("seed", [0, 1, 2])
 @pytest.mark.parametrize("thr", [(0.5, 0.5), (0.7, 0.7), (1.0, 1.0)])
 @pytest.mark.parametrize("is_last", [True, False])
+@pytest.mark.slow
 def test_assignment_matches_reference(seed, thr, is_last):
     rng = np.random.default_rng(seed)
     H = W = 16
@@ -111,6 +112,7 @@ def test_assignment_matches_reference(seed, thr, is_last):
     )
 
 
+@pytest.mark.slow
 def test_padding_boxes_do_not_leak():
     """A padded (invalid) giant box must not claim any location."""
     H = W = 16
@@ -172,6 +174,7 @@ def _torch_reference_loss(obj_logits, reg, pos, neg, box_t, exemplar):
     return ce.sum().item() / num_pos, giou.sum() / num_pos
 
 
+@pytest.mark.slow
 def test_criterion_matches_reference():
     rng = np.random.default_rng(3)
     H = W = 16
@@ -199,6 +202,7 @@ def test_criterion_matches_reference():
     np.testing.assert_allclose(float(got["loss_giou"]), want_giou, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_criterion_zero_positive_dummy():
     """Image with no positives contributes giou 1.0 and counts 1 (the
     reference's degenerate-box fallback, TM_utils.py:201-203)."""

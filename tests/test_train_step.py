@@ -1,5 +1,7 @@
 """Train step: optimizer groups, freezing, loss decrease smoke test."""
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -48,6 +50,7 @@ def _setup(lr_backbone=0.0, **cfg_overrides):
     return state, step, batch
 
 
+@pytest.mark.slow
 def test_frozen_backbone_and_head_updates():
     state, step, batch = _setup(lr_backbone=0.0)
     p0 = jax.tree_util.tree_map(np.asarray, state.params)
@@ -70,6 +73,7 @@ def test_frozen_backbone_and_head_updates():
     assert np.isfinite(float(losses["loss"]))
 
 
+@pytest.mark.slow
 def test_loss_decreases_over_steps():
     state, step, batch = _setup()
     first = None
@@ -82,6 +86,7 @@ def test_loss_decreases_over_steps():
     assert last < first  # overfits the fixed batch
 
 
+@pytest.mark.slow
 def test_trainable_backbone_updates():
     state, step, batch = _setup(lr_backbone=1e-4)
     p0 = jax.tree_util.tree_map(np.asarray, state.params)
@@ -97,6 +102,7 @@ def test_trainable_backbone_updates():
     assert moved
 
 
+@pytest.mark.slow
 def test_nonfinite_loss_skips_update():
     """A batch producing a non-finite loss must leave params unchanged
     (failure containment; the reference trains through NaNs)."""
@@ -161,6 +167,7 @@ def test_nonfinite_loss_skips_update():
     assert not all(jax.tree_util.tree_leaves(leaves_eq))
 
 
+@pytest.mark.slow
 def test_grad_accumulation_updates_every_k_steps():
     """--grad_accum_steps k (optax.MultiSteps): params stay bit-identical
     for k-1 micro-steps, then one combined update applies; the mean of the

@@ -16,6 +16,11 @@ TINY_VIT = dict(
 )
 
 
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute module: CI-only, excluded from the `-m fast` dev loop (VERDICT r4 #8)
+
 def _write_fixture(root, n_train=4, n_val=2):
     """Images with 2 bright square 'objects' on dark background (the
     package's own quickstart fixture generator)."""
