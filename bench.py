@@ -399,6 +399,7 @@ def _run(cancel_watchdog) -> None:
                 "tflops_per_image": round(tflops, 3),
                 "ms_per_batch": round(per_batch * 1000, 2),
                 "batch": BATCH,
+                "image_size": IMAGE_SIZE,
                 "device_kind": jax.devices()[0].device_kind,
                 "rtt_floor_ms": round(rtt * 1000, 1),
                 "autotuned": {k: v["picked"] for k, v in tune.items()},

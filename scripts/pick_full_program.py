@@ -138,27 +138,40 @@ def main(argv=None) -> int:
         seed = {}
     # headline config key: matches autotune()'s key for the bench program
     # (device kind | image | up_hw | batch | emb | vit kind). Update ONLY
-    # entries matching the winning record's batch — a batch-4 A/B must not
-    # overwrite a batch-8 entry's winners. New keys are created only when
-    # the record carries its device kind (bench.py emits it); fabricating
-    # one would poison the seed on any other accelerator.
+    # entries matching the winning record's image size AND batch — a
+    # batch-4 A/B must not overwrite a batch-8 entry's winners, nor a
+    # 256-px dry run a 1024 entry. New keys are created only when the
+    # record carries device_kind + image_size + batch (bench.py emits
+    # all three); fabricating any of them would poison the seed.
     batch = best.get("batch")
-    keys = [
-        k for k in seed
-        if "|1024|" in k and k.endswith("vit_b")
-        and (batch is None or f"|{batch}|" in k)
-    ]
+    size = best.get("image_size")
+
+    def _key_matches(k: str) -> bool:
+        # positional comparison — substring matching would collide with
+        # the other pipe-delimited fields (emb=512 is in every key,
+        # up_hw=128 in the 1024 entry)
+        parts = k.split("|")
+        if len(parts) != 6 or parts[5] != "vit_b":
+            return False
+        return (
+            (size is None or parts[1] == str(size))
+            and (batch is None or parts[3] == str(batch))
+        )
+
+    keys = [k for k in seed if _key_matches(k)]
     if not keys:
         kind = best.get("device_kind")
-        if not kind or batch is None:
+        if not kind or batch is None or size is None:
             summary.update(
                 updated=False,
                 reason="no matching seed entry and the record lacks "
-                       "device_kind/batch to build one",
+                       "device_kind/image_size/batch to build one",
             )
             print(json.dumps(summary))
             return 3
-        keys = [f"{kind}|1024|128|{batch}|512|vit_b"]
+        # up_hw = 2x the 16-px patch grid (feature_upsample, bench preset);
+        # emb 512 = the flagship preset — both fixed for the bench program
+        keys = [f"{kind}|{size}|{2 * (size // 16)}|{batch}|512|vit_b"]
     updated = {}
     for key in keys:
         entry = dict(seed.get(key, {}))

@@ -148,6 +148,30 @@ def test_pins_only_matching_batch_entries(tmp_path, seed_file, capsys):
         "TMR_GLOBAL_ATTN"] == "flash"
 
 
+def test_size_match_is_positional_not_substring(tmp_path, seed_file, capsys):
+    """A 512-px record must NOT update the 1024 entry: '|512|' would
+    substring-match the emb field of EVERY key (kind|image|up_hw|batch|emb|
+    vit) — the match must compare the image field positionally."""
+    arb = _arbiter()
+    base = _rec(10.0, knobs={"TMR_GLOBAL_ATTN": "blockwise"},
+                autotuned={"TMR_GLOBAL_ATTN": "blockwise"})
+    pin = _rec(20.0, knobs={"TMR_GLOBAL_ATTN": "pallas"})
+    for r in (base, pin):
+        r["image_size"] = 512
+        r["device_kind"] = "TPU v5 lite"
+    (tmp_path / "bench_live.json").write_text(json.dumps(base))
+    (tmp_path / "bench_pallas.json").write_text(json.dumps(pin))
+    rc = arb.main([str(tmp_path / "bench_live.json"),
+                   str(tmp_path / "bench_pallas.json")])
+    assert rc == 0
+    seed = json.loads(seed_file.read_text())
+    # the 1024 entry is untouched; a NEW 512 key was created instead
+    assert seed["TPU v5 lite|1024|128|4|512|vit_b"][
+        "TMR_GLOBAL_ATTN"] == "blockwise"
+    assert seed["TPU v5 lite|512|64|4|512|vit_b"][
+        "TMR_GLOBAL_ATTN"] == "pallas"
+
+
 def test_error_records_and_missing_files_are_skipped(tmp_path, seed_file,
                                                      capsys):
     arb = _arbiter()
