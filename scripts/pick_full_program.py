@@ -127,15 +127,9 @@ def main(argv=None) -> int:
 
     # pin into the committed seed under the headline's autotune key, with
     # fresh variant stamps so the entry loads as a valid cached hit
-    from tmr_tpu.utils.autotune import SEED_PATH, _variants_sig
+    from tmr_tpu.utils.autotune import _variants_sig, seed_load, seed_store
 
-    seed_path = os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH)
-    try:
-        with open(seed_path) as f:
-            seed = json.load(f)
-        assert isinstance(seed, dict)
-    except (OSError, ValueError, AssertionError):
-        seed = {}
+    seed = seed_load()
     # headline config key: matches autotune()'s key for the bench program
     # (device kind | image | up_hw | batch | emb | vit kind). Update ONLY
     # entries matching the winning record's image size AND batch — a
@@ -191,15 +185,12 @@ def main(argv=None) -> int:
         )
         seed[key] = entry
         updated[key] = {k: entry[k] for k in PINNABLE if k in entry}
-    # atomic replace, like autotune._cache_store: a concurrent reader
-    # (driver bench, battery stage) must see the old seed or the new one,
-    # never a truncated file that degrades it to "no cache"
-    tmp = f"{seed_path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(seed, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, seed_path)
-    summary.update(updated=True, seed=seed_path, entries=updated)
+    seed_store(seed)
+    summary.update(
+        updated=True,
+        seed=os.environ.get("TMR_AUTOTUNE_SEED", "seed"),
+        entries=updated,
+    )
     print(json.dumps(summary))
     return 0
 

@@ -168,6 +168,14 @@ print(json.dumps({'one_global_block_sec': t}))
       --only batch_sweep,1536,refine,train,stream \
       >"$OUT/bench_extra_live.json" 2>>"$LOG"
     log "bench_extra (rest) rc=$? -> $OUT/bench_extra_live.json"
+    # 7: promote this battery's stamped-fresh sweep winners from the user
+    # cache into the committed seed (full-program pins from 3d outrank and
+    # are preserved) — the session commits AUTOTUNE_SEED.json so the
+    # driver's round-end bench in a fresh container cache-hits instead of
+    # re-sweeping over the tunnel
+    timeout 120 python scripts/promote_cache_to_seed.py \
+      >"$OUT/promote_seed.json" 2>>"$LOG"
+    log "promote cache->seed rc=$? -> $OUT/promote_seed.json"
     log "battery done"
     break
   fi

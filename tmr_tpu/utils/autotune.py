@@ -480,6 +480,40 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
     return out
 
 
+def seed_load(path: Optional[str] = None) -> Dict[str, dict]:
+    """Raw load of the committed seed for WRITER scripts
+    (scripts/pick_full_program.py, scripts/promote_cache_to_seed.py).
+    Unlike ``_load_validated`` (the READER path, which drops unknown
+    keys), writers must keep provenance keys like ``_full_program_ab``
+    intact — so this only enforces shape: top-level dict, per-entry
+    dicts; anything else degrades to absent, never a crash."""
+    import json
+
+    path = path or os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(obj, dict):
+        return {}
+    return {k: v for k, v in obj.items() if isinstance(v, dict)}
+
+
+def seed_store(seed: Dict[str, dict], path: Optional[str] = None) -> None:
+    """Atomic seed write shared by the writer scripts — one protocol
+    (tmp + os.replace, stable formatting) so concurrent readers see the
+    old seed or the new one, never a truncated file."""
+    import json
+
+    path = path or os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(seed, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def _cache_store(
     key: str, report: Dict[str, object], extra: Optional[Dict[str, str]] = None
 ) -> None:
