@@ -70,19 +70,35 @@ def _metric() -> str:
 
 _T0 = time.time()
 
+#: a completed PRE-SWEEP measurement banked by _run: if the sweeps that
+#: follow wedge the tunnel (watchdog or exception), _emit_error prints
+#: this real record instead of a zero-value outage line (rc 0)
+_PRELIM_REC = None
+
 
 def _progress(msg: str) -> None:
     print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def _emit_error(msg: str) -> None:
+def _emit_error(msg: str):
     """The contract with the driver: ONE JSON line on stdout, no matter what.
 
-    An outage record additionally carries the last COMMITTED live
-    measurement (BENCH_LIVE.json, captured by scripts/tpu_watch.sh when the
-    tunnel last served) under ``last_committed_live`` with its commit date —
-    clearly-labeled provenance, so a round-end wedge doesn't erase the
-    round's actual measured number from the driver's artifact."""
+    When a PRE-SWEEP preliminary measurement was banked (_PRELIM_REC), the
+    failure happened during the optional sweep/re-measure phase — print
+    the real measurement (annotated) and return exit code 0: a measured
+    number beats an outage record every time.
+
+    Otherwise an outage record additionally carries the last COMMITTED
+    live measurement (BENCH_LIVE.json, captured by the watcher when the
+    tunnel last served) under ``last_committed_live`` with its commit date
+    and age — clearly-labeled provenance, so a round-end wedge doesn't
+    erase the round's actual measured number from the driver's artifact."""
+    if _PRELIM_REC is not None:
+        rec = dict(_PRELIM_REC)
+        rec["preliminary"] = True
+        rec["sweep_aborted"] = msg
+        print(json.dumps(rec), flush=True)
+        return 0
     rec = {
         "metric": _metric(),
         "value": 0.0,
@@ -289,24 +305,90 @@ def _run(cancel_watchdog) -> None:
 
     # measured formulation selection at the production shapes (TPU only;
     # TMR_AUTOTUNE=0/false/no/off disables, explicitly set knobs are
-    # respected) — the winners are exported via env before the full
-    # program is traced
+    # respected). Two-phase: an export-only pass (cached/seed winners, no
+    # measuring) feeds a PRELIMINARY headline measurement first, so a
+    # tunnel wedge during the sweeps that follow still leaves a real
+    # number (_emit_error prints the banked preliminary, rc 0) — two
+    # rounds of rc!=0 driver records motivated this (VERDICT r3/r4).
     tune = {}
-    if os.environ.get("TMR_AUTOTUNE", "1").lower() not in (
+    pending = []
+    autotune_on = os.environ.get("TMR_AUTOTUNE", "1").lower() not in (
         "0", "false", "no", "off"
-    ):
+    )
+    if autotune_on:
         from tmr_tpu.utils.autotune import autotune
 
-        tune = autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)
-        # TMR_AUTOTUNE_EXPORT=<file>: persist the winners as K=V lines so a
-        # follow-up bench process (e.g. the watcher's trained-weights run at
-        # identical shapes) can source them and skip the sweep — halves the
-        # tunnel exposure per battery
-        export = os.environ.get("TMR_AUTOTUNE_EXPORT")
-        if export:
-            with open(export, "a") as f:  # batch line written above
-                for k, v in tune.items():
-                    f.write(f"{k}={v['picked']}\n")
+        tune = autotune(cfg, IMAGE_SIZE, BATCH, log=_progress, sweep=False)
+        pending = tune.pop("_pending", [])
+
+    global _PRELIM_REC
+    export_lines = None
+    rec = _build_and_measure(cfg, tune)
+    if os.environ.get("TMR_BENCH_SELFTEST_PRELIM"):
+        # contract test hook: simulate a wedge AFTER the preliminary
+        # measurement banked (the sweep phase is TPU-only, so CPU tests
+        # can't reach it organically)
+        _PRELIM_REC = dict(rec)
+        raise RuntimeError("selftest: forced post-preliminary failure")
+    if pending:
+        _PRELIM_REC = dict(rec)
+        _progress(
+            f"preliminary {rec['value']} img/s banked (pre-sweep knobs); "
+            f"sweeping {pending}"
+        )
+        from tmr_tpu.utils.autotune import autotune
+
+        snap_keys = ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_XCORR_IMPL",
+                     "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION")
+        before = {k: os.environ.get(k) for k in snap_keys}
+        tune = {**tune, **autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)}
+        if {k: os.environ.get(k) for k in snap_keys} != before:
+            rec2 = _build_and_measure(cfg, tune)
+            if rec2["value"] >= rec["value"]:
+                rec = rec2
+            else:
+                # the sweep's one-block winners measured SLOWER in the
+                # full program: report the faster pre-sweep config (its
+                # own "knobs" field says what ran) and keep the sweep
+                # evidence alongside. The export file must then carry the
+                # HEADLINE's config, not the sweep picks — follow-up
+                # benches sourcing it must measure the reported program.
+                rec["note"] = (
+                    "sweep winners were slower in the full program "
+                    f"({rec2['value']} vs {rec['value']} img/s); "
+                    "reporting the pre-sweep configuration"
+                )
+                rec["autotune_times"] = rec2.get("autotune_times", {})
+                export_lines = dict(rec["knobs"])
+        # (no else: pending knobs are unset by definition, so a sweep that
+        # elected ANY winner changes the env; an unchanged env means every
+        # picker came back empty and rec's bookkeeping already stands)
+        _PRELIM_REC = None  # a final record exists; never emit the prelim
+
+    # TMR_AUTOTUNE_EXPORT=<file>: persist the winners as K=V lines so a
+    # follow-up bench process (e.g. the watcher's trained-weights run at
+    # identical shapes) can source them and skip the sweep — halves the
+    # tunnel exposure per battery. export_lines overrides when the
+    # reported config differs from the sweep picks (slower-branch above).
+    export = os.environ.get("TMR_AUTOTUNE_EXPORT")
+    if export and autotune_on:
+        if export_lines is None:
+            export_lines = {k: v["picked"] for k, v in tune.items()}
+        with open(export, "a") as f:  # batch line written above
+            for k, v in export_lines.items():
+                f.write(f"{k}={v}\n")
+
+    cancel_watchdog()  # before the success print: no success-then-watchdog
+    print(json.dumps(rec))
+
+
+def _build_and_measure(cfg, tune) -> dict:
+    """Compile the production fused program under the CURRENT env knobs,
+    time it with the chained methodology, and return the record dict
+    (unprinted — the caller owns the one-line stdout contract)."""
+    import jax
+    import jax.numpy as jnp
+
     # the PRODUCTION fused program via the Predictor's chain_feedback hook —
     # the benchmark compiles the same pipeline eval runs, no copy
     from tmr_tpu.inference import Predictor
@@ -383,47 +465,42 @@ def _run(cancel_watchdog) -> None:
         _ = jax.device_get(fb)
         dt = time.perf_counter() - t0
 
-    cancel_watchdog()  # before the success print: no success-then-watchdog
     per_batch = max((dt - rtt) / CHAIN, 1e-9)
     img_per_sec = BATCH / per_batch
     tflops = forward_tflops_per_image(IMAGE_SIZE)
     mfu = img_per_sec * tflops / V5E_PEAK_TFLOPS
-    print(
-        json.dumps(
-            {
-                "metric": _metric(),
-                "value": round(img_per_sec, 3),
-                "unit": "img/s",
-                "vs_baseline": round(img_per_sec / A100_BASELINE_IMG_PER_SEC, 3),
-                "mfu": round(mfu, 4),
-                "tflops_per_image": round(tflops, 3),
-                "ms_per_batch": round(per_batch * 1000, 2),
-                "batch": BATCH,
-                "image_size": IMAGE_SIZE,
-                "device_kind": jax.devices()[0].device_kind,
-                "rtt_floor_ms": round(rtt * 1000, 1),
-                "autotuned": {k: v["picked"] for k, v in tune.items()},
-                # per-variant sweep timings (sec/iter) for knobs measured
-                # THIS run — the A/B evidence itself, not just the winner;
-                # cached hits carry no times and are omitted
-                "autotune_times": {
-                    k: {vk: round(vv, 6) for vk, vv in v["times"].items()}
-                    for k, v in tune.items() if v.get("times")
-                },
-                # the formulations the measured program actually traced
-                # with (env at trace time) — autotuned reports only sweep
-                # picks, so env-pinned A/B runs need this to be readable
-                "knobs": {
-                    k: os.environ[k]
-                    for k in ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN",
-                              "TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL",
-                              "TMR_XCORR_PRECISION", "TMR_PALLAS_ATTN_BQ",
-                              "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
-                    if k in os.environ
-                },
-            }
-        )
-    )
+    return {
+        "metric": _metric(),
+        "value": round(img_per_sec, 3),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / A100_BASELINE_IMG_PER_SEC, 3),
+        "mfu": round(mfu, 4),
+        "tflops_per_image": round(tflops, 3),
+        "ms_per_batch": round(per_batch * 1000, 2),
+        "batch": BATCH,
+        "image_size": IMAGE_SIZE,
+        "device_kind": jax.devices()[0].device_kind,
+        "rtt_floor_ms": round(rtt * 1000, 1),
+        "autotuned": {k: v["picked"] for k, v in tune.items()},
+        # per-variant sweep timings (sec/iter) for knobs measured
+        # THIS run — the A/B evidence itself, not just the winner;
+        # cached hits carry no times and are omitted
+        "autotune_times": {
+            k: {vk: round(vv, 6) for vk, vv in v["times"].items()}
+            for k, v in tune.items() if v.get("times")
+        },
+        # the formulations the measured program actually traced
+        # with (env at trace time) — autotuned reports only sweep
+        # picks, so env-pinned A/B runs need this to be readable
+        "knobs": {
+            k: os.environ[k]
+            for k in ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN",
+                      "TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL",
+                      "TMR_XCORR_PRECISION", "TMR_PALLAS_ATTN_BQ",
+                      "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
+            if k in os.environ
+        },
+    }
 
 
 def main() -> int:

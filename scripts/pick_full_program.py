@@ -127,7 +127,12 @@ def main(argv=None) -> int:
 
     # pin into the committed seed under the headline's autotune key, with
     # fresh variant stamps so the entry loads as a valid cached hit
-    from tmr_tpu.utils.autotune import _variants_sig, seed_load, seed_store
+    from tmr_tpu.utils.autotune import (
+        SEED_PATH,
+        _variants_sig,
+        seed_load,
+        seed_store,
+    )
 
     seed = seed_load()
     # headline config key: matches autotune()'s key for the bench program
@@ -188,7 +193,7 @@ def main(argv=None) -> int:
     seed_store(seed)
     summary.update(
         updated=True,
-        seed=os.environ.get("TMR_AUTOTUNE_SEED", "seed"),
+        seed=os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH),
         entries=updated,
     )
     print(json.dumps(summary))

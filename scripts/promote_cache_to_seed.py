@@ -75,6 +75,14 @@ def main(argv=None) -> int:
                     out[knob] = entry[knob]
                     out[f"_variants_{knob}"] = stamp
                     changed[knob] = entry[knob]
+                    if (
+                        knob in FULL_PROGRAM_KNOBS
+                        and "_full_program_ab" in out
+                    ):
+                        # the stale pin just got replaced by a SWEEP
+                        # winner: drop the marker, or the sweep pick would
+                        # inherit pin-level protection it never earned
+                        del out["_full_program_ab"]
         # _precision_impl is the impl pairing TMR_XCORR_PRECISION's
         # decisive win was validated under — it moves ONLY with its owner
         # (a lone stale pairing would vouch for numerics on the wrong impl)
@@ -99,9 +107,13 @@ def main(argv=None) -> int:
                           "reason": "no stamped-fresh winners to promote"}))
         return 3
     seed_store(seed)
-    print(json.dumps({"updated": True,
-                      "seed": os.environ.get("TMR_AUTOTUNE_SEED", "seed"),
-                      "promoted": promoted}))
+    from tmr_tpu.utils.autotune import SEED_PATH
+
+    print(json.dumps({
+        "updated": True,
+        "seed": os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH),
+        "promoted": promoted,
+    }))
     return 0
 
 

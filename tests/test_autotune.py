@@ -102,6 +102,48 @@ def test_fallback_annotated_entries_never_win(clean_knobs, monkeypatch):
     )
 
 
+def test_autotune_sweep_false_exports_cached_and_reports_pending(
+    clean_knobs, monkeypatch, tmp_path
+):
+    """sweep=False (bench.py's preliminary pass) must export cached
+    winners, run NO measurements, and report the knobs a full call would
+    sweep under "_pending"."""
+    import json
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    boom = lambda tag: lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError(f"{tag} swept under sweep=False")
+    )
+    monkeypatch.setattr(at, "pick_xcorr_impl", boom("x"))
+    monkeypatch.setattr(at, "pick_win_attn_impl", boom("w"))
+    monkeypatch.setattr(at, "pick_global_attn_impl", boom("g"))
+    monkeypatch.setattr(at, "pick_xcorr_precision", boom("p"))
+    monkeypatch.setattr(at, "measure_rtt_floor", boom("rtt"))
+
+    class _Dev:
+        device_kind = "cpu"
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+    seed = tmp_path / "seed.json"
+    seed.write_text(json.dumps({
+        "cpu|1024|128|4|512|vit_b": {
+            "TMR_GLOBAL_ATTN": "blockwise",
+            "_variants_TMR_GLOBAL_ATTN": at._variants_sig(
+                "TMR_GLOBAL_ATTN"
+            ),
+        }
+    }))
+    monkeypatch.setenv("TMR_AUTOTUNE_SEED", str(seed))
+    report = at.autotune(_cfg(), 1024, 4, sweep=False)
+    assert report["TMR_GLOBAL_ATTN"] == {"picked": "blockwise",
+                                         "cached": True}
+    assert os.environ["TMR_GLOBAL_ATTN"] == "blockwise"
+    # the un-cached knobs are reported, not measured
+    assert set(report["_pending"]) == {
+        "TMR_WIN_ATTN", "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION"
+    }
+
+
 def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setenv("TMR_XCORR_IMPL", "conv")
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")

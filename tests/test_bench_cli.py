@@ -105,6 +105,25 @@ def test_bench_fast_failure_emits_error_line():
                 assert rec["last_live_uncommitted"]["stale_hours"] >= 0
 
 
+def test_bench_preliminary_survives_post_measure_failure():
+    """A failure AFTER the pre-sweep preliminary measurement banked must
+    print the real measurement (annotated, rc 0), not a zero-value outage
+    record — a wedge during the sweeps can no longer erase a completed
+    headline (VERDICT r4 #6 follow-through)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(TMR_BENCH_SELFTEST_PRELIM="1"),
+        capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0
+    assert rec["preliminary"] is True
+    assert "selftest" in rec["sweep_aborted"]
+
+
 def test_bench_restores_checkpoint(tmp_path):
     # plumbing mode: --epochs 0 saves init params in the exact bench model
     # layout; bench must restore them and say so in the metric line

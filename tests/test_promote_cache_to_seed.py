@@ -114,6 +114,29 @@ def test_stale_full_program_pin_does_not_block_promotion(paths, capsys):
     assert out["TMR_WIN_ATTN"] == "flash"
 
 
+def test_overwritten_stale_pin_loses_its_marker(paths, capsys):
+    """When a stale full-program pin is replaced by a sweep winner, the
+    _full_program_ab marker must go with it — otherwise the sweep pick
+    inherits pin-level protection it never earned and blocks every later
+    fresh sweep winner (review finding r5)."""
+    from tmr_tpu.utils.autotune import _variants_sig
+
+    cache, seed = paths
+    cache.write_text(json.dumps({KEY: {
+        "TMR_WIN_ATTN": "flash",
+        "_variants_TMR_WIN_ATTN": _variants_sig("TMR_WIN_ATTN"),
+    }}))
+    seed.write_text(json.dumps({KEY: {
+        "TMR_WIN_ATTN": "dense",
+        "_variants_TMR_WIN_ATTN": "pre-revision,stale",
+        "_full_program_ab": "{}",
+    }}))
+    assert _promoter().main([]) == 0
+    out = json.loads(seed.read_text())[KEY]
+    assert out["TMR_WIN_ATTN"] == "flash"
+    assert "_full_program_ab" not in out
+
+
 def test_lone_precision_impl_does_not_ride(paths, capsys):
     """_precision_impl moves only with its owner TMR_XCORR_PRECISION: a
     stale precision winner's pairing must not overwrite the seed's

@@ -545,6 +545,7 @@ def autotune(
     log: Callable[[str], None] = lambda s: None,
     tune_precision: bool = True,
     train: bool = False,
+    sweep: bool = True,
 ) -> Dict[str, object]:
     """Measure the variant sets at the production shapes of ``cfg`` and
     EXPORT the winners via their env knobs (os.environ, read by the modules
@@ -653,6 +654,13 @@ def autotune(
         log(f"autotune: {knob}={cached[knob]} (cached, {key})")
     wanted -= set(cached)
     if not wanted:
+        return report
+    if not sweep:
+        # sweep=False: export-only pass (bench.py's preliminary headline
+        # runs BEFORE any sweeping so a mid-sweep tunnel wedge still
+        # leaves a real measurement). Report which knobs a full call
+        # would measure; nothing is stored.
+        report["_pending"] = sorted(wanted)
         return report
 
     rtt = measure_rtt_floor()

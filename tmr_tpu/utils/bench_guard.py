@@ -47,8 +47,11 @@ def run_guarded(
     watchdog = None
     if alarm > 0:
         def fire():
-            emit_error(WATCHDOG_MSG.format(alarm=alarm))
-            os._exit(2)
+            # an emitter may return an explicit exit code (bench.py
+            # returns 0 when it printed a banked preliminary MEASUREMENT
+            # instead of an outage record); default stays 2
+            code = emit_error(WATCHDOG_MSG.format(alarm=alarm))
+            os._exit(2 if code is None else int(code))
 
         watchdog = threading.Timer(alarm, fire)
         watchdog.daemon = True
@@ -64,7 +67,7 @@ def run_guarded(
     except BaseException as e:  # noqa: BLE001 — the JSON line IS the contract
         if isinstance(e, KeyboardInterrupt):
             raise
-        emit_error(f"{type(e).__name__}: {e}")
-        return 1
+        code = emit_error(f"{type(e).__name__}: {e}")
+        return 1 if code is None else int(code)
     finally:
         cancel()
