@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Render the watch2 battery's JSON outputs as one markdown summary.
+
+Offline helper for the session log (PERF.md): reads whatever battery
+artifacts exist in the output dir (default: repo root) and prints a
+compact report — headline + A/B table with knob provenance, ckpt-anomaly
+probe, full-program arbitration verdict, profile top rows, bench_extra
+configs. Missing/error files render as such instead of crashing: the
+summary is most useful precisely when a battery died partway.
+
+Usage: python scripts/summarize_battery.py [out_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def main(argv=None) -> int:
+    out = (argv or sys.argv[1:] or [REPO])[0]
+    p = lambda name: os.path.join(out, name)
+
+    print("## Battery summary\n")
+
+    # headline + A/Bs
+    rows = []
+    for name, label in (
+        ("bench_live.json", "autotuned headline"),
+        ("bench_pallas.json", "global=pallas"),
+        ("bench_windense.json", "win=dense"),
+        ("bench_combined.json", "global=pallas + win=dense"),
+        ("bench_allpallas.json", "all-pallas (win group 8)"),
+        ("bench_ckpt_live.json", "trained ckpt"),
+        ("bench_traced.json", "traced (chain 3)"),
+    ):
+        rec = _load(p(name))
+        if rec is None:
+            rows.append((label, "—", "missing"))
+        elif "error" in rec:
+            rows.append((label, "—", f"ERROR: {rec['error'][:60]}"))
+        else:
+            extra = []
+            if rec.get("preliminary"):
+                extra.append("PRELIMINARY")
+            if rec.get("note"):
+                extra.append(rec["note"][:60])
+            kn = rec.get("knobs", {})
+            fmt = ",".join(
+                f"{k.replace('TMR_', '')}={v}" for k, v in sorted(kn.items())
+            )
+            rows.append((
+                label,
+                f"{rec['value']} img/s (mfu {rec.get('mfu', '?')}, "
+                f"vs_baseline {rec.get('vs_baseline', '?')})",
+                "; ".join(extra + [fmt])[:110],
+            ))
+    w = max(len(r[0]) for r in rows)
+    print("| config | result | notes |")
+    print("|---|---|---|")
+    for label, val, notes in rows:
+        print(f"| {label.ljust(w)} | {val} | {notes} |")
+
+    pick = _load(p("full_program_pick.json"))
+    if pick:
+        print(f"\nfull-program pick: best={pick.get('best')} "
+              f"updated={pick.get('updated')} "
+              f"{pick.get('reason', pick.get('entries', ''))}")
+
+    probe = _load(p("ckpt_probe.json"))
+    if probe and "error" not in probe:
+        print(f"\nckpt probe (ms/batch): init={probe.get('init')} "
+              f"restored={probe.get('restored')} "
+              f"roundtrip={probe.get('roundtrip')}")
+
+    prof = _load(p("profile_live.json"))
+    if prof and "error" not in prof:
+        stages = {
+            k: v for k, v in prof.items()
+            if isinstance(v, (int, float))
+            and k not in ("rtt_floor_ms", "batch", "size", "chain")
+        }
+        print("\nprofile (top 10, sec/iter):")
+        for k, v in sorted(stages.items(), key=lambda kv: -kv[1])[:10]:
+            print(f"  {v * 1000:9.2f} ms  {k}")
+
+    extra = _load(p("bench_extra_live.json"))
+    if extra:
+        print("\nbench_extra:")
+        for k, v in extra.items():
+            if isinstance(v, dict):
+                s = v.get("img_per_sec", v.get("error", v))
+                print(f"  {k}: {s}")
+
+    promote = _load(p("promote_seed.json"))
+    if promote:
+        print(f"\npromote cache->seed: {promote}")
+    sweep = _load(p("global_attn_sweep.json"))
+    if sweep:
+        print(f"\none-block global sweep: {sweep}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
