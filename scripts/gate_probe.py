@@ -1,0 +1,128 @@
+"""Diagnose WHY each gated custom kernel is refused on the live backend.
+
+Round-5 session-7 finding: on the real TPU every require_tpu formulation
+(flash global/windowed, pallas global/windowed, pallas xcorr) fell back,
+while the one pure-XLA alternative (blockfolded) won the headline — but
+the gates swallow their refusal reason, so "Mosaic can't lower through
+this backend" vs "kernel miscompiles numerically" vs "backend-name
+mismatch" were indistinguishable. This script runs each gate at the
+production geometry with TMR_GATE_DEBUG=1 and, for the pallas paths, also
+calls the kernel DIRECTLY (no gate) so a lowering exception surfaces with
+its full traceback.
+
+Single tunnel client; run only when no other bench/battery stage is live.
+Output: one JSON line per probe on stdout; tracebacks/debug on stderr.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["TMR_GATE_DEBUG"] = "1"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    emit(
+        probe="backend",
+        default_backend=jax.default_backend(),
+        devices=[str(d) for d in jax.devices()],
+        device_kind=jax.devices()[0].device_kind,
+        platform=jax.devices()[0].platform,
+        jax_version=jax.__version__,
+    )
+
+    # 1. trivial pallas kernel, compiled mode — does Mosaic lower AT ALL?
+    try:
+        from jax.experimental import pallas as pl
+
+        def add1(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        x = jnp.zeros((256, 256), jnp.float32)
+        y = pl.pallas_call(
+            add1, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )(x)
+        ok = bool(np.asarray(y)[0, 0] == 1.0)
+        emit(probe="pallas_trivial", ok=ok)
+    except Exception as e:
+        traceback.print_exc()
+        emit(probe="pallas_trivial", ok=False,
+             error=f"{type(e).__name__}: {e}")
+
+    # 2. the global-attention pallas kernel DIRECT (no gate), bench
+    # geometry: grid 64x64, head_dim 64, B1 H2 (the gate's own shape)
+    try:
+        from tmr_tpu.ops.pallas_attn import pallas_decomposed_attention
+
+        rng = np.random.default_rng(0)
+        gh = gw = 64
+        D = 64
+        S = gh * gw
+        q = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+        rh = jnp.asarray(rng.standard_normal((gh, gh, D)) * 0.2, jnp.float32)
+        rw = jnp.asarray(rng.standard_normal((gw, gw, D)) * 0.2, jnp.float32)
+        got = jax.jit(
+            lambda *a: pallas_decomposed_attention(*a, (gh, gw), D**-0.5)
+        )(q, k, v, rh, rw)
+        got.block_until_ready()
+
+        from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+        want = jax.jit(
+            lambda *a: blockwise_decomposed_attention(*a, (gh, gw), D**-0.5)
+        )(q, k, v, rh, rw)
+        err = float(
+            np.abs(
+                np.asarray(got, np.float32) - np.asarray(want, np.float32)
+            ).max()
+        )
+        ref = float(np.abs(np.asarray(want, np.float32)).max())
+        emit(probe="pallas_global_direct", ok=bool(err / (ref + 1e-6) < 0.05),
+             rel_err=err / (ref + 1e-6))
+    except Exception as e:
+        traceback.print_exc()
+        emit(probe="pallas_global_direct", ok=False,
+             error=f"{type(e).__name__}: {e}")
+
+    # 3. every production gate, debug on (reasons land on stderr)
+    from tmr_tpu.ops.flash_attn import (
+        blockfolded_ok, flash_attention_ok, flash_window_ok,
+    )
+    from tmr_tpu.ops.pallas_attn import (
+        effective_global_tiles, pallas_global_ok, pallas_window_ok,
+    )
+    from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok
+
+    bq, bk = effective_global_tiles(64 * 64)
+    gates = {
+        "flash_global_64x64_d64": lambda: flash_attention_ok(64, 64, 64),
+        "blockfolded_64x64_d64": lambda: blockfolded_ok(64, 64, 64),
+        "flash_window_14x14_d64": lambda: flash_window_ok(14, 14, 64),
+        "pallas_global_64x64_d64":
+            lambda: pallas_global_ok(64, 64, 64, bq, bk),
+        "pallas_window_14x14_d64_g8":
+            lambda: pallas_window_ok(14, 14, 64, 8),
+        "pallas_xcorr_c256_64_t17": lambda: pallas_xcorr_ok(256, 64, 64, 17),
+    }
+    for name, fn in gates.items():
+        try:
+            emit(probe=name, ok=bool(fn()))
+        except Exception as e:
+            traceback.print_exc()
+            emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -223,12 +223,29 @@ def _self_check(
     ever runs under jit), so the whole check runs under
     ``jax.ensure_compile_time_eval()`` — concrete values, real compiled
     executions, no leakage into the ambient trace.
+
+    ``TMR_GATE_DEBUG=1`` reports every refusal's concrete reason (backend,
+    kill-switch, forward/grad relative error, or the swallowed exception)
+    to stderr — the gate's False is otherwise indistinguishable from any
+    of those causes, which matters when diagnosing why a kernel that
+    should win never runs on a given backend.
     """
+    def _refused(reason: str) -> bool:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import sys
+
+            print(
+                f"[gate] {getattr(attn_fn, '__name__', attn_fn)} "
+                f"B{B} H{H} {gh}x{gw} D{D}: refused — {reason}",
+                file=sys.stderr,
+            )
+        return False
+
     if require_tpu:
         if os.environ.get("TMR_NO_FLASH_ATTN"):
-            return False
+            return _refused("TMR_NO_FLASH_ATTN kill-switch")
         if jax.default_backend() != "tpu":
-            return False
+            return _refused(f"backend {jax.default_backend()!r} != 'tpu'")
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
@@ -261,7 +278,9 @@ def _self_check(
             # (classic Mosaic-miscompile symptom) REJECTS — ``diff >= tol``
             # would let NaN through, since both comparisons are False on NaN
             if not (err / scale_ref < 0.05):
-                return False
+                return _refused(
+                    f"forward rel err {err / scale_ref:.4g} >= 0.05"
+                )
 
             # the TRAIN step differentiates through whichever path is
             # active, and a backward-pass Mosaic failure would otherwise
@@ -280,14 +299,21 @@ def _self_check(
                     loss_of(blockwise_decomposed_attention), argnums=(0, 1, 2)
                 )
             )(q, k, v)
-            for a, b in zip(g_got, g_want):
+            for i, (a, b) in enumerate(zip(g_got, g_want)):
                 a = np.asarray(a, np.float32)
                 b = np.asarray(b, np.float32)
-                if not (np.abs(a - b).max() / (np.abs(b).max() + 1e-6) < 0.05):
-                    return False
+                rel = np.abs(a - b).max() / (np.abs(b).max() + 1e-6)
+                if not (rel < 0.05):
+                    return _refused(
+                        f"grad arg {i} rel err {rel:.4g} >= 0.05"
+                    )
             return True
-    except Exception:
-        return False
+    except Exception as e:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        return _refused(f"{type(e).__name__}: {e}")
 
 
 @functools.lru_cache(maxsize=None)

@@ -117,12 +117,22 @@ def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
     beyond f32 tolerance -> False (dispatcher falls back to the conv
     lowering). TMR_NO_PALLAS_XCORR=1 force-disables.
     """
+    def _refused(reason: str) -> bool:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import sys
+
+            print(
+                f"[gate] xcorr_pallas C{C} {H}x{W} T{T}: refused — {reason}",
+                file=sys.stderr,
+            )
+        return False
+
     if os.environ.get("TMR_NO_PALLAS_XCORR"):
-        return False
+        return _refused("TMR_NO_PALLAS_XCORR kill-switch")
     if T > MAX_UNROLL_T:
-        return False
+        return _refused(f"T {T} > MAX_UNROLL_T {MAX_UNROLL_T}")
     if jax.default_backend() != "tpu":
-        return False
+        return _refused(f"backend {jax.default_backend()!r} != 'tpu'")
     cb = _CB if C % _CB == 0 else 1
     key = (cb, H, W, T)
     if key in _OK_CACHE:
@@ -153,8 +163,16 @@ def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
                 )
             )
             scale = np.abs(want).max() + 1e-6
-            ok = bool(np.abs(got - want).max() / scale < 5e-5)
-    except Exception:
+            rel = np.abs(got - want).max() / scale
+            ok = bool(rel < 5e-5)
+            if not ok:
+                _refused(f"rel err {rel:.4g} >= 5e-5")
+    except Exception as e:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        _refused(f"{type(e).__name__}: {e}")
         ok = False
     _OK_CACHE[key] = ok
     return ok

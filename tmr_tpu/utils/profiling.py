@@ -112,19 +112,31 @@ def chained_seconds_per_iter(step, *args, iters: int = 5, rtt: float = 0.0):
     timing closes with ONE scalar fetch and subtracts the measured
     round-trip floor. First call (compile + warmup) happens outside the
     timed window.
+
+    When the whole chain finishes inside ~3x the RTT floor the subtraction
+    is noise (a ~1 ms/iter op under a 67 ms tunnel RTT used to bank 0.0 —
+    indistinguishable from free), so the chain length doubles until the
+    elapsed window dominates the RTT or a 4096-iter cap is hit. Fast ops
+    are exactly the ones that can afford the extra iterations.
     """
     import jax
     import jax.numpy as jnp
 
     fb = jnp.zeros((), jnp.float32)
     out, fb = step(*args, fb)
-    fb = fb * 0.0
-    _ = jax.device_get(fb)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out, fb = step(*args, fb)
-    _ = jax.device_get(fb)
-    return max((time.perf_counter() - t0 - rtt) / iters, 1e-9)
+    while True:
+        fb = fb * 0.0
+        _ = jax.device_get(fb)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out, fb = step(*args, fb)
+        _ = jax.device_get(fb)
+        elapsed = time.perf_counter() - t0
+        if elapsed >= 3.0 * rtt or iters >= 4096:
+            return max((elapsed - rtt) / iters, 1e-9)
+        iters = min(
+            4096, max(iters * 2, int(iters * 4.0 * rtt / (elapsed + 1e-9)))
+        )
 
 
 # ------------------------------------------------------------------ timing
