@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Session-7 recovery battery. Prior batteries measured the headline
+# (21.065 img/s, blockfolded) and killed the ckpt anomaly; what remains is
+# (a) WHY every pallas/flash kernel gate-refuses on the real chip — the
+# answer decides whether the next 2x (global attention is still ~55% of
+# the 190 ms batch) is a kernel fix or new XLA formulation work — and
+# (b) the bench_extra BASELINE configs a concurrent-client wedge consumed.
+# Order: cheapest + highest-information first.
+#   1. gate_probe (TMR_GATE_DEBUG): per-gate refusal reasons + direct
+#      kernel calls with full tracebacks
+#   2. conditional: if the direct pallas-global run WORKED, re-bench the
+#      headline under TMR_GLOBAL_ATTN=pallas (its gate may be what's wrong)
+#   3. bench_extra remaining stages (batch_sweep,1536,refine,train,stream)
+#   4. profile_breakdown under the MEASURED winner knobs (autotune.env)
+#      with the RTT-adaptive chained timer (real decode/NMS tail numbers)
+# Results land as working-tree files; the session driver commits.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${TMR_WATCH_OUT:-$REPO}"
+LOG="${TMR_WATCH_LOG:-/tmp/tpu_watch3.log}"
+
+log() { echo "[$(date +%H:%M:%S)] $*" >>"$LOG"; }
+
+probe() {
+  timeout 150 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+x = jnp.ones((256, 256), jnp.bfloat16)
+print(jax.device_get(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x)))
+" >>"$LOG" 2>&1
+}
+
+log "watch3 started (pid $$)"
+
+while true; do
+  if probe; then
+    log "TPU ALIVE — running session-7 recovery battery"
+    cd "$REPO"
+    # 1: gate refusal diagnosis (small compiles, biggest unknown)
+    timeout 1800 python scripts/gate_probe.py \
+      >"$OUT/gate_probe.json" 2>"$OUT/gate_probe.err"
+    log "gate_probe rc=$? -> $OUT/gate_probe.json"
+    # 2: if the direct pallas-global kernel ran and agreed, the gate was
+    # the problem — measure the kernel headline immediately
+    if grep -q '"probe": "pallas_global_direct", "ok": true' \
+        "$OUT/gate_probe.json" 2>/dev/null; then
+      TMR_GLOBAL_ATTN=pallas TMR_BENCH_ALARM=2700 timeout 3000 \
+        python bench.py >"$OUT/bench_pallas2.json" 2>>"$LOG"
+      log "bench (pallas, post-diagnosis) rc=$? -> $OUT/bench_pallas2.json"
+    fi
+    # 3: the BASELINE configs the wedge consumed
+    timeout 5400 python scripts/bench_extra.py \
+      --only batch_sweep,1536,refine,train,stream \
+      >"$OUT/bench_extra_live.json" 2>>"$LOG"
+    log "bench_extra (rest) rc=$? -> $OUT/bench_extra_live.json"
+    if grep -q '"' "$OUT/bench_extra_live.json" 2>/dev/null \
+        && ! grep -q '"error"' "$OUT/bench_extra_live.json" 2>/dev/null; then
+      cp "$OUT/bench_extra_live.json" "$REPO/BENCH_EXTRA_LIVE.json" \
+        2>/dev/null
+    fi
+    # 4: post-fix attribution under the measured winners
+    tuned=""
+    [ -f "$OUT/autotune.env" ] \
+      && tuned=$(grep -v '^#' "$OUT/autotune.env" | xargs)
+    env $tuned timeout 5400 python scripts/profile_breakdown.py \
+      >"$OUT/profile_live.json" 2>>"$LOG"
+    log "profile_breakdown (winner knobs) rc=$? -> $OUT/profile_live.json"
+    if ! grep -q '"error"' "$OUT/profile_live.json" 2>/dev/null \
+        && grep -q '"full_program"' "$OUT/profile_live.json" 2>/dev/null; then
+      cp "$OUT/profile_live.json" "$REPO/PROFILE_LIVE.json" 2>/dev/null
+    fi
+    log "battery done"
+    break
+  fi
+  log "probe failed; sleeping 600s"
+  sleep 600
+done
