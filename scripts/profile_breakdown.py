@@ -119,6 +119,14 @@ def main():
         ("one_global_block_flash", 0, {"TMR_GLOBAL_ATTN": "flash"}),
         ("one_global_block_blockfolded", 0,
          {"TMR_GLOBAL_ATTN": "blockfolded"}),
+        ("one_global_block_blockfolded_unroll2", 0,
+         {"TMR_GLOBAL_ATTN": "blockfolded",
+          "TMR_GLOBAL_BANDS_UNROLL": "2"}),
+        ("one_global_block_blockfolded_unroll4", 0,
+         {"TMR_GLOBAL_ATTN": "blockfolded",
+          "TMR_GLOBAL_BANDS_UNROLL": "4"}),
+        ("one_global_block_densefolded", 0,
+         {"TMR_GLOBAL_ATTN": "densefolded"}),
         ("one_global_block_pallas", 0, {"TMR_GLOBAL_ATTN": "pallas"}),
         ("one_global_block_pallas_bq256", 0,
          {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BQ": "256"}),
@@ -139,7 +147,8 @@ def main():
     prev = {
         k: os.environ.get(k)
         for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_PALLAS_ATTN_BQ",
-                  "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
+                  "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
+                  "TMR_GLOBAL_BANDS_UNROLL")
     }
     try:
         for label, win, knobs in cases:
@@ -178,7 +187,7 @@ def main():
                     continue
             _progress(f"stage 3: {label}")
             for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                      "TMR_PALLAS_WIN_GROUP"):
+                      "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
                 os.environ.pop(k, None)  # tile/group overrides are per-case
             os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,

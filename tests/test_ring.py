@@ -362,6 +362,107 @@ def test_blockfolded_attention_matches_blockwise():
 
 
 @pytest.mark.slow
+def test_densefolded_attention_matches_blockwise():
+    """TMR_GLOBAL_ATTN=densefolded (folded QK, no band scan) must equal the
+    exact blockwise path in f32, bias on and off, non-square grid included
+    — same contract as blockfolded, different XLA schedule."""
+    from tmr_tpu.models.vit import (
+        blockwise_decomposed_attention,
+        densefolded_decomposed_attention,
+    )
+
+    rng = np.random.default_rng(13)
+    for gh, gw in ((32, 32), (16, 8)):
+        B, H, D = 2, 3, 8
+        S = gh * gw
+        q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+        rh = jnp.asarray(rng.standard_normal((gh, gh, D)), jnp.float32) * 0.2
+        rw = jnp.asarray(rng.standard_normal((gw, gw, D)), jnp.float32) * 0.2
+        scale = D**-0.5
+
+        got = jax.jit(
+            lambda *a: densefolded_decomposed_attention(*a, (gh, gw), scale)
+        )(q, k, v, rh, rw)
+        want = jax.jit(
+            lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+        )(q, k, v, rh, rw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+        got_nb = jax.jit(
+            lambda *a: densefolded_decomposed_attention(
+                *a, None, None, (gh, gw), scale)
+        )(q, k, v)
+        want_nb = jax.jit(
+            lambda *a: blockwise_decomposed_attention(
+                *a, None, None, (gh, gw), scale)
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(got_nb), np.asarray(want_nb),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_global_bands_unroll_invariance(monkeypatch):
+    """TMR_GLOBAL_BANDS_UNROLL is a schedule knob: unroll 2/4 (and a value
+    past the band count, which clamps) must match the default scan — the
+    bands compute the same ops either way. Tolerance instead of bit-equal:
+    rolled vs unrolled scan bodies are different XLA programs and the
+    compiler may legally reassociate the per-band reductions."""
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    rng = np.random.default_rng(14)
+    gh = gw = 32
+    B, H, D = 2, 3, 8
+    S = gh * gw
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    rh = jnp.asarray(rng.standard_normal((gh, gh, D)), jnp.float32) * 0.2
+    rw = jnp.asarray(rng.standard_normal((gw, gw, D)), jnp.float32) * 0.2
+    scale = D**-0.5
+
+    monkeypatch.delenv("TMR_GLOBAL_BANDS_UNROLL", raising=False)
+    want = jax.jit(
+        lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+    )(q, k, v, rh, rw)
+    for unroll in ("2", "4", "1000"):
+        monkeypatch.setenv("TMR_GLOBAL_BANDS_UNROLL", unroll)
+        got = jax.jit(
+            lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+        )(q, k, v, rh, rw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-7)
+
+    monkeypatch.setenv("TMR_GLOBAL_BANDS_UNROLL", "auto")
+    with pytest.raises(ValueError, match="TMR_GLOBAL_BANDS_UNROLL"):
+        jax.jit(
+            lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+        )(q, k, v, rh, rw)
+
+
+@pytest.mark.slow
+def test_global_attn_env_dispatch_densefolded(monkeypatch):
+    """Attention must dispatch to densefolded (blockwise-equal output)
+    when TMR_GLOBAL_ATTN=densefolded — the env plumbing, not just the
+    free function."""
+    from tmr_tpu.models.vit import Attention
+
+    rng = np.random.default_rng(15)
+    x = jnp.asarray(rng.standard_normal((1, 32, 32, 16)), jnp.float32)
+    attn = Attention(num_heads=2, rel_pos_size=(32, 32))
+    params = attn.init(jax.random.key(0), x)
+
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
+    want = jax.jit(attn.apply)(params, x)
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "densefolded")
+    got = jax.jit(attn.apply)(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
 def test_global_attn_env_dispatch_blockfolded(monkeypatch):
     """The Attention module must actually dispatch to the blockfolded path
     (and produce blockwise-equal output) when TMR_GLOBAL_ATTN=blockfolded —

@@ -187,7 +187,23 @@ def blockwise_decomposed_attention(
         )
         return ob.astype(work)
 
-    out = jax.lax.map(one_band, (q_blocks, rh_blocks))  # (nb, B, H, rows, gw, Dv)
+    # Band schedule: lax.map == scan(unroll=1). TMR_GLOBAL_BANDS_UNROLL
+    # (trace-time, default 1 = the parity schedule) unrolls N bands per
+    # loop step so XLA can software-pipeline the next band's K/V and
+    # score-tile HBM traffic behind the current band's compute — same ops
+    # per band, same numerics, different schedule. Autotune measures it
+    # via the profile's sub-knob rows, like the Pallas tile sizes.
+    raw_unroll = os.environ.get("TMR_GLOBAL_BANDS_UNROLL", "1")
+    if not (raw_unroll.isascii() and raw_unroll.isdigit()):
+        raise ValueError(
+            f"TMR_GLOBAL_BANDS_UNROLL={raw_unroll!r}: expected a positive "
+            "integer unroll factor"
+        )
+    unroll = max(1, int(raw_unroll))
+    out = jax.lax.scan(
+        lambda c, x: (c, one_band(x)), (), (q_blocks, rh_blocks),
+        unroll=min(unroll, nb),
+    )[1]  # (nb, B, H, rows, gw, Dv)
     # output width comes from v: under the folded-QK variant q/k are
     # augmented past v's head dim
     return jnp.moveaxis(out, 0, 2).reshape(B, H, S, v.shape[-1])
@@ -226,6 +242,47 @@ def blockfolded_decomposed_attention(
     return blockwise_decomposed_attention(
         q_aug, k_aug, v, None, None, grid_hw, 1.0
     )
+
+
+def densefolded_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Folded-QK attention with NO band scan: one (B, H, S, S) einsum,
+    f32 softmax, one AV einsum, and XLA free to pick its own tiling.
+
+    The band scan exists to bound HBM high-water, but it also serializes
+    the schedule and hides the whole attention from XLA's fusion/tiling
+    autotuner. At the 4096-token global blocks the full f32 score tensor
+    is 3.2 GB per batch-4, 12-head block (4*12*4096^2*4 B) — it fits a
+    v5e's 16 GB for inference-shaped programs but is NOT free; selection
+    is by measurement only (TMR_GLOBAL_ATTN=densefolded, autotune-swept
+    like every formulation), and an OOM during the sweep's compile simply
+    loses the A/B to the banded variants.
+    Same math as blockfolded (identical fold; softmax over the full key
+    axis), so the same bf16 numerics gate applies.
+    """
+    if rh is None:
+        q_aug, k_aug = q * scale, k
+    else:
+        from tmr_tpu.ops.flash_attn import fold_rel_pos_into_qk
+
+        q_aug, k_aug = fold_rel_pos_into_qk(q, k, rh, rw, grid_hw, scale)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q_aug, k_aug,
+        preferred_element_type=jnp.float32,
+    )
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
 
 
 class Attention(nn.Module):
@@ -292,6 +349,9 @@ class Attention(nn.Module):
             #   blockfolded  band scan, bias folded into the QK contraction
             #                (exact in f32; bf16 is numerics-self-checked
             #                with blockwise fallback)
+            #   densefolded  folded QK with NO band scan — one dense
+            #                einsum/softmax/einsum, XLA picks the tiling
+            #                (same fold, same bf16 gate as blockfolded)
             #   flash        stock Pallas flash over the 256-padded folded
             #                QK (bf16 only; self-check gate -> blockwise)
             #   pallas       custom decomposed-bias kernel, VMEM-resident
@@ -300,28 +360,41 @@ class Attention(nn.Module):
             #   auto         flash when its gate passes, else blockwise
             impl = os.environ.get("TMR_GLOBAL_ATTN", "auto")
             if impl not in (
-                "auto", "blockwise", "flash", "blockfolded", "pallas"
+                "auto", "blockwise", "flash", "blockfolded", "densefolded",
+                "pallas",
             ):
                 raise ValueError(
                     f"TMR_GLOBAL_ATTN={impl!r}: expected "
-                    "auto|blockwise|flash|blockfolded|pallas"
+                    "auto|blockwise|flash|blockfolded|densefolded|pallas"
                 )
             attn_fn = blockwise_decomposed_attention
-            if impl == "blockfolded":
+            if impl in ("blockfolded", "densefolded"):
                 # exact in f32; under bf16 the folded bias rounds to bf16,
                 # so the selection is self-check-gated like every other
                 # formulation (PARITY.md contract). The gate is pure XLA
                 # (runs on any backend, Pallas kill-switch exempt).
-                attn_fn = blockfolded_decomposed_attention
+                attn_fn = (
+                    blockfolded_decomposed_attention
+                    if impl == "blockfolded"
+                    else densefolded_decomposed_attention
+                )
                 if self.dtype == jnp.bfloat16:
-                    from tmr_tpu.ops.flash_attn import blockfolded_ok
+                    from tmr_tpu.ops.flash_attn import (
+                        blockfolded_ok,
+                        densefolded_ok,
+                    )
 
-                    if not blockfolded_ok(h, w, head_dim):
+                    ok = (
+                        blockfolded_ok
+                        if impl == "blockfolded"
+                        else densefolded_ok
+                    )
+                    if not ok(h, w, head_dim):
                         import warnings
 
                         warnings.warn(FormulationFallbackWarning(
                             "TMR_GLOBAL_ATTN",
-                            "TMR_GLOBAL_ATTN=blockfolded: bf16 numerics "
+                            f"TMR_GLOBAL_ATTN={impl}: bf16 numerics "
                             f"self-check failed at grid ({h}, {w}, "
                             f"head_dim {head_dim}); running blockwise "
                             "fallback"

@@ -330,6 +330,17 @@ def blockfolded_ok(gh: int, gw: int, head_dim: int) -> bool:
 
 
 @functools.lru_cache(maxsize=None)
+def densefolded_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """blockfolded_ok's twin for the scan-free densefolded formulation —
+    same fold, same bf16 rounding surface, separately compiled/checked
+    because the dense schedule is a different XLA program."""
+    from tmr_tpu.models.vit import densefolded_decomposed_attention
+
+    return _self_check(densefolded_decomposed_attention, 1, 2, gh, gw,
+                       head_dim, require_tpu=False)
+
+
+@functools.lru_cache(maxsize=None)
 def flash_window_ok(gh: int, gw: int, head_dim: int) -> bool:
     """Per-geometry compiled self-check of the windowed flash path — the
     caller passes the ACTUAL window grid and head dim it is about to run

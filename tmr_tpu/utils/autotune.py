@@ -29,7 +29,9 @@ from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 
 XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft", "pallas")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash", "pallas")
-GLOBAL_ATTN_VARIANTS = ("blockwise", "flash", "blockfolded", "pallas")
+GLOBAL_ATTN_VARIANTS = (
+    "blockwise", "flash", "blockfolded", "densefolded", "pallas"
+)
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 
 #: suffix marking a sweep entry whose timing measured a gate-refused
@@ -441,9 +443,13 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
     }
-    # measured throughput-optimal eval batch (bench_extra's batch sweep)
-    # and the Pallas windowed-kernel group — positive ints as strings
-    digit_keys = {"TMR_BENCH_BATCH", "TMR_PALLAS_WIN_GROUP"}
+    # measured throughput-optimal eval batch (bench_extra's batch sweep),
+    # the Pallas windowed-kernel group, and the band-scan unroll — positive
+    # ints as strings
+    digit_keys = {
+        "TMR_BENCH_BATCH", "TMR_PALLAS_WIN_GROUP",
+        "TMR_GLOBAL_BANDS_UNROLL",
+    }
     # global-kernel tile preferences: powers of two >= 128 (the contract
     # _env_tile enforces at read time — an off-contract seed value would
     # otherwise crash the next trace instead of being dropped here)
@@ -605,14 +611,16 @@ def autotune(
             log(f"autotune: cached {knob} predates the current variant "
                 "set; re-measuring")
 
-    # Pallas tile/group sub-knobs pinned by a full-program A/B
-    # (scripts/pick_full_program.py writes them into the seed next to the
-    # formulation they tuned): export when present and not user-set. Must
-    # run BEFORE the everything-pinned early return below — a fully
-    # env-pinned A/B rerun still needs the endorsed tiles. Only the pallas
-    # paths read them, so exporting alongside a non-pallas winner is inert.
+    # Schedule sub-knobs pinned by a full-program A/B — Pallas tiles/group
+    # plus the band-scan unroll (scripts/pick_full_program.py writes them
+    # into the seed next to the formulation they tuned): export when
+    # present and not user-set. Must run BEFORE the everything-pinned
+    # early return below — a fully env-pinned A/B rerun still needs the
+    # endorsed values. Each is read only by the formulation it tunes
+    # (pallas kernels / the blockwise-family band scan), so exporting
+    # alongside a different winner is inert.
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                 "TMR_PALLAS_WIN_GROUP"):
+                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
