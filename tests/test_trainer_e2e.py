@@ -321,6 +321,34 @@ def test_eval_mode_restore_matches_live_metrics(tmp_path):
         )
 
 
+def test_restore_returns_host_numpy_leaves(tmp_path):
+    """CheckpointManager.restore must hand back HOST numpy leaves: orbax
+    can return committed device arrays whose sharding annotations pessimize
+    every downstream compiled program (measured 9.2x eval slowdown on TPU
+    v5 lite — ckpt_probe.json / PERF.md 2026-08-01). The production eval
+    path (main --eval -> Trainer.test -> ckpt.restore) relies on this."""
+    from tmr_tpu.data.synthetic import write_synthetic_fscd147
+
+    root = str(tmp_path / "data")
+    logdir = str(tmp_path / "logs")
+    os.makedirs(root)
+    write_synthetic_fscd147(root, n_train=2, n_val=1, square=26)
+
+    trainer = _make_trainer(root, logdir, max_epochs=1)
+    trainer.fit()
+
+    import jax
+
+    restored = trainer.ckpt.restore(
+        trainer.ckpt.last_path(), trainer.state
+    )
+    leaves = jax.tree.leaves(restored)
+    assert leaves
+    for leaf in leaves:
+        if hasattr(leaf, "shape"):
+            assert isinstance(leaf, np.ndarray), type(leaf)
+
+
 def test_split_per_image_unbatches_everything():
     """Ragged eval tails split into exact B=1 sub-batches (arrays sliced,
     meta list itemized) — the no-recompile path for leftover size buckets."""
