@@ -497,7 +497,8 @@ def _build_and_measure(cfg, tune) -> dict:
             for k in ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN",
                       "TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL",
                       "TMR_XCORR_PRECISION", "TMR_PALLAS_ATTN_BQ",
-                      "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP")
+                      "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
+                      "TMR_GLOBAL_BANDS_UNROLL")
             if k in os.environ
         },
     }
