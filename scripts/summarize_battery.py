@@ -44,6 +44,7 @@ def main(argv=None) -> int:
         ("bench_allpallas.json", "all-pallas (win group 8)"),
         ("bench_ckpt_live.json", "trained ckpt"),
         ("bench_traced.json", "traced (chain 3)"),
+        ("bench_pallas2.json", "global=pallas (post-diagnosis)"),
     ):
         rec = _load(p(name))
         if rec is None:
@@ -71,6 +72,25 @@ def main(argv=None) -> int:
     print("|---|---|---|")
     for label, val, notes in rows:
         print(f"| {label.ljust(w)} | {val} | {notes} |")
+
+    gates = None
+    try:
+        with open(p("gate_probe.json")) as f:
+            gates = [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError):
+        pass
+    if gates:
+        print("\ngate probe (watch3):")
+        for g in gates:
+            bits = [f"ok={g.get('ok')}" if "ok" in g else ""]
+            if g.get("error"):
+                bits.append(g["error"][:80])
+            if "rel_err" in g:
+                bits.append(f"rel_err={g['rel_err']:.2g}")
+            if g.get("probe") == "backend":
+                bits = [f"{g.get('default_backend')} "
+                        f"{g.get('device_kind')} jax {g.get('jax_version')}"]
+            print(f"  {g.get('probe', '?')}: {' '.join(b for b in bits if b)}")
 
     pick = _load(p("full_program_pick.json"))
     if pick:
