@@ -106,9 +106,16 @@ def main():
     from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok
 
     bq, bk = effective_global_tiles(64 * 64)
+    from tmr_tpu.ops.flash_attn import densefolded_ok
+    from tmr_tpu.models.vit import _scores_dtype
+
+    live_scores = _scores_dtype()
     gates = {
         "flash_global_64x64_d64": lambda: flash_attention_ok(64, 64, 64),
-        "blockfolded_64x64_d64": lambda: blockfolded_ok(64, 64, 64),
+        f"blockfolded_64x64_d64_scores_{live_scores}":
+            lambda: blockfolded_ok(64, 64, 64, live_scores),
+        f"densefolded_64x64_d64_scores_{live_scores}":
+            lambda: densefolded_ok(64, 64, 64, live_scores),
         "flash_window_14x14_d64": lambda: flash_window_ok(14, 14, 64),
         "pallas_global_64x64_d64":
             lambda: pallas_global_ok(64, 64, 64, bq, bk),
@@ -122,6 +129,26 @@ def main():
         except Exception as e:
             traceback.print_exc()
             emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}")
+
+    # the bf16-score-tile gates (the env the check traces under must match
+    # the cache key being probed — set it for the duration)
+    if live_scores != "bf16":
+        os.environ["TMR_GLOBAL_SCORES_DTYPE"] = "bf16"
+        try:
+            for name, fn in {
+                "blockfolded_64x64_d64_scores_bf16":
+                    lambda: blockfolded_ok(64, 64, 64, "bf16"),
+                "densefolded_64x64_d64_scores_bf16":
+                    lambda: densefolded_ok(64, 64, 64, "bf16"),
+            }.items():
+                try:
+                    emit(probe=name, ok=bool(fn()))
+                except Exception as e:
+                    traceback.print_exc()
+                    emit(probe=name, ok=False,
+                         error=f"{type(e).__name__}: {e}")
+        finally:
+            os.environ.pop("TMR_GLOBAL_SCORES_DTYPE", None)
 
 
 if __name__ == "__main__":

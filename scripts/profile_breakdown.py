@@ -127,6 +127,12 @@ def main():
           "TMR_GLOBAL_BANDS_UNROLL": "4"}),
         ("one_global_block_densefolded", 0,
          {"TMR_GLOBAL_ATTN": "densefolded"}),
+        ("one_global_block_blockfolded_scores16", 0,
+         {"TMR_GLOBAL_ATTN": "blockfolded",
+          "TMR_GLOBAL_SCORES_DTYPE": "bf16"}),
+        ("one_global_block_densefolded_scores16", 0,
+         {"TMR_GLOBAL_ATTN": "densefolded",
+          "TMR_GLOBAL_SCORES_DTYPE": "bf16"}),
         ("one_global_block_pallas", 0, {"TMR_GLOBAL_ATTN": "pallas"}),
         ("one_global_block_pallas_bq256", 0,
          {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BQ": "256"}),
@@ -148,7 +154,7 @@ def main():
         k: os.environ.get(k)
         for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_PALLAS_ATTN_BQ",
                   "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
-                  "TMR_GLOBAL_BANDS_UNROLL")
+                  "TMR_GLOBAL_BANDS_UNROLL", "TMR_GLOBAL_SCORES_DTYPE")
     }
     try:
         for label, win, knobs in cases:
@@ -187,7 +193,8 @@ def main():
                     continue
             _progress(f"stage 3: {label}")
             for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                      "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
+                      "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
+                      "TMR_GLOBAL_SCORES_DTYPE"):
                 os.environ.pop(k, None)  # tile/group overrides are per-case
             os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,

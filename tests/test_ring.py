@@ -443,6 +443,99 @@ def test_global_bands_unroll_invariance(monkeypatch):
 
 
 @pytest.mark.slow
+def test_scores_dtype_bf16_matches_oracle(monkeypatch):
+    """TMR_GLOBAL_SCORES_DTYPE=bf16 (folded paths materialize the score
+    tiles in bf16 — half the HBM traffic) must stay within bf16-rounding
+    tolerance of the exact blockwise oracle, for both folded formulations;
+    f32 inputs must be untouched by the knob (bit-equal to f32 scores)."""
+    from tmr_tpu.models.vit import (
+        blockfolded_decomposed_attention,
+        blockwise_decomposed_attention,
+        densefolded_decomposed_attention,
+    )
+
+    rng = np.random.default_rng(16)
+    gh = gw = 16
+    B, H, D = 2, 3, 8
+    S = gh * gw
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    q, k, v = mk(B, H, S, D), mk(B, H, S, D), mk(B, H, S, D)
+    rh, rw = mk(gh, gh, D) * 0.2, mk(gw, gw, D) * 0.2
+    scale = D**-0.5
+
+    monkeypatch.delenv("TMR_GLOBAL_SCORES_DTYPE", raising=False)
+    want16 = {}
+    for name, fn in (("blockfolded", blockfolded_decomposed_attention),
+                     ("densefolded", densefolded_decomposed_attention)):
+        want16[name] = np.asarray(jax.jit(
+            lambda *a, _f=fn: _f(*a, (gh, gw), scale)
+        )(*(t.astype(jnp.bfloat16) for t in (q, k, v)), rh, rw), np.float32)
+
+    oracle = np.asarray(jax.jit(
+        lambda *a: blockwise_decomposed_attention(*a, (gh, gw), scale)
+    )(q, k, v, rh, rw), np.float32)
+
+    monkeypatch.setenv("TMR_GLOBAL_SCORES_DTYPE", "bf16")
+    for name, fn in (("blockfolded", blockfolded_decomposed_attention),
+                     ("densefolded", densefolded_decomposed_attention)):
+        got16 = np.asarray(jax.jit(
+            lambda *a, _f=fn: _f(*a, (gh, gw), scale)
+        )(*(t.astype(jnp.bfloat16) for t in (q, k, v)), rh, rw), np.float32)
+        rel = np.abs(got16 - oracle).max() / (np.abs(oracle).max() + 1e-6)
+        assert rel < 0.05, (name, rel)
+        # liveness: bf16 score tiles must actually change the rounding vs
+        # the f32-scores run — a silent no-op knob must fail here
+        assert not np.array_equal(got16, want16[name]), name
+
+        # f32 inputs: the knob must be inert (exact path untouched)
+        got_f32 = np.asarray(jax.jit(
+            lambda *a, _f=fn: _f(*a, (gh, gw), scale)
+        )(q, k, v, rh, rw), np.float32)
+        np.testing.assert_allclose(got_f32, oracle, rtol=1e-5, atol=1e-5)
+
+    # the PARITY ORACLE must ignore the env knob entirely: a bare
+    # blockwise call with rh=None (no-rel-pos models, and the pallas
+    # custom_vjp's backward oracle) under TMR_GLOBAL_SCORES_DTYPE=bf16
+    # must be bit-equal to the knob-unset run — the knob is plumbed as an
+    # explicit parameter only the gated folded formulations pass
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    bw16 = np.asarray(jax.jit(
+        lambda *a: blockwise_decomposed_attention(
+            *a, None, None, (gh, gw), scale)
+    )(qb, kb, vb), np.float32)
+    monkeypatch.delenv("TMR_GLOBAL_SCORES_DTYPE")
+    bw_ref = np.asarray(jax.jit(
+        lambda *a: blockwise_decomposed_attention(
+            *a, None, None, (gh, gw), scale)
+    )(qb, kb, vb), np.float32)
+    np.testing.assert_array_equal(bw16, bw_ref)
+
+    monkeypatch.setenv("TMR_GLOBAL_SCORES_DTYPE", "fp8")
+    with pytest.raises(ValueError, match="TMR_GLOBAL_SCORES_DTYPE"):
+        jax.jit(
+            lambda *a: blockfolded_decomposed_attention(
+                *a, (gh, gw), scale)
+        )(*(t.astype(jnp.bfloat16) for t in (q, k, v)), rh, rw)
+
+
+@pytest.mark.slow
+def test_scores_dtype_gate_keys_on_knob(monkeypatch):
+    """The blockfolded/densefolded numerics gates must cache their verdict
+    PER scores dtype — a verdict under f32 scores must never vouch for
+    bf16 score tiles (different checked numerics)."""
+    from tmr_tpu.ops import flash_attn
+
+    flash_attn.blockfolded_ok.cache_clear()
+    monkeypatch.delenv("TMR_GLOBAL_SCORES_DTYPE", raising=False)
+    v_f32 = flash_attn.blockfolded_ok(16, 16, 8, "f32")
+    monkeypatch.setenv("TMR_GLOBAL_SCORES_DTYPE", "bf16")
+    v_bf16 = flash_attn.blockfolded_ok(16, 16, 8, "bf16")
+    assert isinstance(v_f32, bool) and isinstance(v_bf16, bool)
+    info = flash_attn.blockfolded_ok.cache_info()
+    assert info.currsize >= 2  # two distinct cache entries, not one reused
+
+
+@pytest.mark.slow
 def test_global_attn_env_dispatch_densefolded(monkeypatch):
     """Attention must dispatch to densefolded (blockwise-equal output)
     when TMR_GLOBAL_ATTN=densefolded — the env plumbing, not just the

@@ -118,6 +118,19 @@ def get_rel_pos(q_size: int, k_size: int, rel_pos: jnp.ndarray) -> jnp.ndarray:
     return rel[rel_coords.astype(np.int64)]
 
 
+def _scores_dtype() -> str:
+    """TMR_GLOBAL_SCORES_DTYPE: materialization dtype for the folded global
+    attention score tiles — 'f32' (default, exact) or 'bf16' (half the
+    HBM traffic of the bandwidth-bound stage; numerics-gated). Read at
+    trace time like every formulation knob."""
+    val = os.environ.get("TMR_GLOBAL_SCORES_DTYPE", "f32")
+    if val not in ("f32", "bf16"):
+        raise ValueError(
+            f"TMR_GLOBAL_SCORES_DTYPE={val!r}: expected f32|bf16"
+        )
+    return val
+
+
 def _q_block_rows(h: int, w: int, target_tokens: int = 512) -> int:
     """Largest divisor of ``h`` whose row-band holds <= target_tokens."""
     best = 1
@@ -135,6 +148,7 @@ def blockwise_decomposed_attention(
     rw: Optional[jnp.ndarray],
     grid_hw: Tuple[int, int],
     scale: float,
+    scores_dtype: Optional[str] = None,
 ) -> jnp.ndarray:
     """Attention with decomposed rel-pos bias, scanned over query row-bands.
 
@@ -155,6 +169,20 @@ def blockwise_decomposed_attention(
     rows = _q_block_rows(gh, gw)
     nb = gh // rows
     work = q.dtype
+    # scores_dtype="bf16" (EXPLICIT parameter — this parity oracle never
+    # reads the env knob itself, so the default blockwise path and the
+    # pallas custom_vjp's backward oracle stay exact): materialize each
+    # band's score tile in bf16 instead of f32, halving the dominant HBM
+    # traffic of this bandwidth-bound stage. Only the gated folded
+    # formulations pass it (bias already inside q/k — the einsum output IS
+    # the final logits). The MXU still accumulates in f32
+    # (preferred_element_type only rounds the OUTPUT) and softmax upcasts
+    # to f32 — a fused convert on the read path. Rounds logits to bf16
+    # (~0.4% rel), gated by flash_attn.blockfolded_ok/densefolded_ok,
+    # which key on the dtype.
+    score_pet = jnp.float32
+    if rh is None and work == jnp.bfloat16 and scores_dtype == "bf16":
+        score_pet = jnp.bfloat16
 
     q_g = q.reshape(B, H, nb, rows, gw, D)
     q_blocks = jnp.moveaxis(q_g, 2, 0)  # (nb, B, H, rows, gw, D)
@@ -167,8 +195,9 @@ def blockwise_decomposed_attention(
         qb, rhb = args  # (B, H, rows, gw, D), (rows, gh, D)
         s = jnp.einsum(
             "bhrwd,bhkd->bhrwk", qb, k,
-            preferred_element_type=jnp.float32,
-        ) * scale  # (B, H, rows, gw, S)
+            preferred_element_type=score_pet,
+        ) * scale  # (B, H, rows, gw, S); python scale is weakly typed —
+        # the tile keeps score_pet (and the folded calls pass scale=1.0)
         if rh is not None:
             qf = qb.astype(jnp.float32)
             rel_h = jnp.einsum(
@@ -180,7 +209,9 @@ def blockwise_decomposed_attention(
             s = s.reshape(B, H, rows, gw, gh, gw)
             s = s + rel_h[..., :, None] + rel_w[..., None, :]
             s = s.reshape(B, H, rows, gw, S)
-        p = jax.nn.softmax(s, axis=-1)
+        # softmax always in f32: under bf16 score tiles the upcast is a
+        # convert fused into the softmax's read of the tile
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
         ob = jnp.einsum(
             "bhrwk,bhkd->bhrwd", p.astype(work), v,
             preferred_element_type=jnp.float32,
@@ -238,9 +269,12 @@ def blockfolded_decomposed_attention(
 
     q_aug, k_aug = fold_rel_pos_into_qk(q, k, rh, rw, grid_hw, scale)
     # v keeps the original head dim: the band einsum takes its output width
-    # from v, so the augmented contraction never widens the result
+    # from v, so the augmented contraction never widens the result.
+    # scores_dtype is resolved HERE (the gated formulation), not inside the
+    # blockwise oracle — the env knob must never touch the parity path.
     return blockwise_decomposed_attention(
-        q_aug, k_aug, v, None, None, grid_hw, 1.0
+        q_aug, k_aug, v, None, None, grid_hw, 1.0,
+        scores_dtype=_scores_dtype(),
     )
 
 
@@ -273,11 +307,16 @@ def densefolded_decomposed_attention(
         from tmr_tpu.ops.flash_attn import fold_rel_pos_into_qk
 
         q_aug, k_aug = fold_rel_pos_into_qk(q, k, rh, rw, grid_hw, scale)
+    score_pet = (
+        jnp.bfloat16
+        if q.dtype == jnp.bfloat16 and _scores_dtype() == "bf16"
+        else jnp.float32
+    )
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q_aug, k_aug,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=score_pet,
     )
-    p = jax.nn.softmax(s, axis=-1)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
     out = jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
         preferred_element_type=jnp.float32,
@@ -389,7 +428,7 @@ class Attention(nn.Module):
                         if impl == "blockfolded"
                         else densefolded_ok
                     )
-                    if not ok(h, w, head_dim):
+                    if not ok(h, w, head_dim, _scores_dtype()):
                         import warnings
 
                         warnings.warn(FormulationFallbackWarning(

@@ -317,25 +317,37 @@ def _self_check(
 
 
 @functools.lru_cache(maxsize=None)
-def blockfolded_ok(gh: int, gw: int, head_dim: int) -> bool:
+def blockfolded_ok(
+    gh: int, gw: int, head_dim: int, scores: str = "f32"
+) -> bool:
     """Per-geometry compiled self-check of the blockfolded formulation
     under bf16 (the folded bias rounds to bf16; in f32 the fold is
     algebraically exact and needs no gate). Pure XLA — runs on any backend
     and ignores the Pallas kill-switch. Keeps the PARITY.md contract:
-    every selectable formulation is pinned to the blockwise oracle."""
+    every selectable formulation is pinned to the blockwise oracle.
+
+    ``scores`` must be the resolved TMR_GLOBAL_SCORES_DTYPE the model will
+    trace with (the knob changes the checked numerics — bf16 score tiles
+    round the logits — so a verdict under one dtype must never vouch for
+    the other; same pattern as pallas_global_ok's tile params)."""
     from tmr_tpu.models.vit import blockfolded_decomposed_attention
 
+    del scores  # cache key only; the env the caller resolved from is live
     return _self_check(blockfolded_decomposed_attention, 1, 2, gh, gw,
                        head_dim, require_tpu=False)
 
 
 @functools.lru_cache(maxsize=None)
-def densefolded_ok(gh: int, gw: int, head_dim: int) -> bool:
+def densefolded_ok(
+    gh: int, gw: int, head_dim: int, scores: str = "f32"
+) -> bool:
     """blockfolded_ok's twin for the scan-free densefolded formulation —
-    same fold, same bf16 rounding surface, separately compiled/checked
-    because the dense schedule is a different XLA program."""
+    same fold, same bf16 rounding surface (including the ``scores`` cache
+    key), separately compiled/checked because the dense schedule is a
+    different XLA program."""
     from tmr_tpu.models.vit import densefolded_decomposed_attention
 
+    del scores  # cache key only; the env the caller resolved from is live
     return _self_check(densefolded_decomposed_attention, 1, 2, gh, gw,
                        head_dim, require_tpu=False)
 

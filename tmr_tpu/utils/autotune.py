@@ -439,6 +439,7 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
         "TMR_GLOBAL_ATTN": set(GLOBAL_ATTN_VARIANTS) | {"auto"},
         "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
+        "TMR_GLOBAL_SCORES_DTYPE": {"f32", "bf16"},
         # metadata, not an env knob: which impl the precision winner was
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
@@ -620,7 +621,8 @@ def autotune(
     # (pallas kernels / the blockwise-family band scan), so exporting
     # alongside a different winner is inert.
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
+                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
+                 "TMR_GLOBAL_SCORES_DTYPE"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
