@@ -175,9 +175,13 @@ def main(argv=None) -> int:
     updated = {}
     for key in keys:
         entry = dict(seed.get(key, {}))
+        from tmr_tpu.utils.autotune import _VERSIONED_KNOBS
+
         for k, v in pinned.items():
             entry[k] = str(v)
-            if k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN"):
+            if k in _VERSIONED_KNOBS:
+                # every versioned knob needs a fresh stamp or the loader
+                # drops the pin as stale on the very next run
                 entry[f"_variants_{k}"] = _variants_sig(k)
         # full-program A/Bs supersede the one-block sweep for BOTH
         # formulation knobs: a knob the winner left at its autotuned value
@@ -186,6 +190,14 @@ def main(argv=None) -> int:
             if k not in pinned and k in best.get("autotuned", {}):
                 entry[k] = best["autotuned"][k]
                 entry[f"_variants_{k}"] = _variants_sig(k)
+        if "TMR_GLOBAL_SCORES_DTYPE" in entry:
+            # the scores-dtype evidence is paired to the global formulation
+            # of the winning run — record it or the loader's pairing check
+            # drops (or worse, mis-vouches) the pin
+            entry["_scores_global_impl"] = entry.get(
+                "TMR_GLOBAL_ATTN",
+                best.get("autotuned", {}).get("TMR_GLOBAL_ATTN", "auto"),
+            )
         entry["_full_program_ab"] = json.dumps(
             {n: r["value"] for n, r in records.items()}, sort_keys=True
         )

@@ -90,6 +90,13 @@ def main(argv=None) -> int:
             if out.get("_precision_impl") != entry["_precision_impl"]:
                 out["_precision_impl"] = entry["_precision_impl"]
                 changed["_precision_impl"] = entry["_precision_impl"]
+        # same ownership rule for the scores-dtype <-> global formulation
+        # pairing: it moves only with its owner knob
+        if ("TMR_GLOBAL_SCORES_DTYPE" in changed
+                and "_scores_global_impl" in entry):
+            if out.get("_scores_global_impl") != entry["_scores_global_impl"]:
+                out["_scores_global_impl"] = entry["_scores_global_impl"]
+                changed["_scores_global_impl"] = entry["_scores_global_impl"]
         # the measured throughput-optimal batch is an independent
         # measurement: rides alone
         if (

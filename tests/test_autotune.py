@@ -11,7 +11,8 @@ from tmr_tpu.config import preset
 from tmr_tpu.utils import autotune as at
 
 KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN",
-         "TMR_XCORR_PRECISION", "TMR_GLOBAL_ATTN")
+         "TMR_XCORR_PRECISION", "TMR_GLOBAL_ATTN",
+         "TMR_GLOBAL_SCORES_DTYPE")
 
 
 @pytest.fixture
@@ -138,7 +139,11 @@ def test_autotune_sweep_false_exports_cached_and_reports_pending(
     assert report["TMR_GLOBAL_ATTN"] == {"picked": "blockwise",
                                          "cached": True}
     assert os.environ["TMR_GLOBAL_ATTN"] == "blockwise"
-    # the un-cached knobs are reported, not measured
+    # the un-cached knobs are reported, not measured; the scores knob
+    # resolved to its measurement-free no-op (seeded global formulation is
+    # not folded) so it is recorded, not pending
+    assert report["TMR_GLOBAL_SCORES_DTYPE"] == {"picked": "f32",
+                                                 "times": {}}
     assert set(report["_pending"]) == {
         "TMR_WIN_ATTN", "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION"
     }
@@ -164,7 +169,12 @@ def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setattr(
         at, "pick_global_attn_impl", lambda *a, **k: called.append("g") or {}
     )
-    assert at.autotune(_cfg(), 1024, 4) == {}
+    # the one unpinned knob (scores dtype) completes its cache entry as
+    # the f32 no-op — no measurement runs (the pinned global formulation
+    # is not folded, so there is nothing to sweep)
+    assert at.autotune(_cfg(), 1024, 4) == {
+        "TMR_GLOBAL_SCORES_DTYPE": {"picked": "f32", "times": {}}
+    }
     assert called == []
     assert os.environ["TMR_XCORR_IMPL"] == "conv"
 
@@ -720,3 +730,80 @@ def test_cached_precision_dropped_when_impl_sweep_pending(
     assert reswept, "cached precision must not be exported past a fresh sweep"
     assert r["TMR_XCORR_PRECISION"]["picked"] == "highest"
     assert os.environ["TMR_XCORR_PRECISION"] == "highest"
+
+
+def test_scores_dtype_sweep_decisive_win_policy(clean_knobs, monkeypatch):
+    """The TMR_GLOBAL_SCORES_DTYPE stage mirrors the xcorr precision
+    policy: swept only when a folded formulation won, bf16 exported only
+    on a decisive (>10%) win over the exact f32 baseline, f32 kept when
+    the margin is thin or the baseline is missing, and the evidence paired
+    to the formulation it was measured under."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl", lambda *a, **k: {"conv": 0.01})
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision", lambda *a, **k: {"highest": 0.01})
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl", lambda *a, **k: {"folded": 0.01})
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl",
+        lambda *a, **k: {"blockwise": 0.03, "blockfolded": 0.01},
+    )
+
+    # decisive win: bf16 exported, evidence paired to blockfolded
+    monkeypatch.setattr(
+        at, "pick_global_scores_dtype",
+        lambda *a, **k: {"f32": 0.010, "bf16": 0.005},
+    )
+    report = at.autotune(_cfg(), 1024, 4)
+    assert report["TMR_GLOBAL_SCORES_DTYPE"]["picked"] == "bf16"
+    assert os.environ["TMR_GLOBAL_SCORES_DTYPE"] == "bf16"
+
+    # thin margin: f32 kept
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "pick_global_scores_dtype",
+        lambda *a, **k: {"f32": 0.010, "bf16": 0.0095},
+    )
+    monkeypatch.setenv(
+        "TMR_AUTOTUNE_CACHE",
+        os.environ["TMR_AUTOTUNE_CACHE"] + ".2",
+    )
+    report = at.autotune(_cfg(), 1024, 4)
+    assert report["TMR_GLOBAL_SCORES_DTYPE"]["picked"] == "f32"
+    assert os.environ["TMR_GLOBAL_SCORES_DTYPE"] == "f32"
+
+    # fallback-annotated bf16 row (TMR_GLOBAL_ATTN gate refused mid-sweep)
+    # must not be electable -> f32
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "pick_global_scores_dtype",
+        lambda *a, **k: {"f32": 0.010,
+                         "bf16" + at.FALLBACK_SUFFIX: 0.001},
+    )
+    monkeypatch.setenv(
+        "TMR_AUTOTUNE_CACHE",
+        os.environ["TMR_AUTOTUNE_CACHE"] + ".3",
+    )
+    report = at.autotune(_cfg(), 1024, 4)
+    assert report["TMR_GLOBAL_SCORES_DTYPE"]["picked"] == "f32"
+
+    # non-folded winner: stage records the no-op without sweeping
+    for k in KNOBS:
+        os.environ.pop(k, None)
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl", lambda *a, **k: {"blockwise": 0.01})
+    monkeypatch.setattr(
+        at, "pick_global_scores_dtype",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("swept!")),
+    )
+    monkeypatch.setenv(
+        "TMR_AUTOTUNE_CACHE",
+        os.environ["TMR_AUTOTUNE_CACHE"] + ".4",
+    )
+    report = at.autotune(_cfg(), 1024, 4)
+    assert report["TMR_GLOBAL_SCORES_DTYPE"]["picked"] == "f32"
+    assert report["TMR_GLOBAL_SCORES_DTYPE"]["times"] == {}

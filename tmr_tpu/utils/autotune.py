@@ -33,6 +33,7 @@ GLOBAL_ATTN_VARIANTS = (
     "blockwise", "flash", "blockfolded", "densefolded", "pallas"
 )
 XCORR_PRECISIONS = ("highest", "default", "bf16")
+GLOBAL_SCORES_DTYPES = ("f32", "bf16")
 
 #: suffix marking a sweep entry whose timing measured a gate-refused
 #: variant's FALLBACK formulation, not the labeled one. Single source of
@@ -141,6 +142,29 @@ def _electable(times: Dict[str, float]) -> Dict[str, float]:
     }
 
 
+def _decisive_pick(
+    times: Dict[str, float], baseline: str, log: Callable[[str], None],
+    knob: str,
+) -> str:
+    """Relaxed-numerics selection policy, single-sourced for the
+    TMR_XCORR_PRECISION and TMR_GLOBAL_SCORES_DTYPE stages: pick the
+    fastest electable row, but keep the exact ``baseline`` unless the win
+    is decisive (>10%) — only a clear speedup justifies changed numerics —
+    and fall back to the baseline when no exact row was measured (gate
+    refusals/failures must never export unverified numerics)."""
+    pickable = _electable(times)
+    base = pickable.get(baseline)
+    if not pickable or base is None:
+        log(f"autotune: {knob}={baseline} "
+            f"(no {baseline!r} baseline in {times})")
+        return baseline
+    best = min(pickable, key=pickable.get)
+    if pickable[best] > 0.9 * base:
+        best = baseline
+    log(f"autotune: {knob}={best} {times}")
+    return best
+
+
 def _reemit_unrelated(caught, env_var: str) -> None:
     """Re-emit warnings the sweep's record=True capture swallowed, except
     the fallback markers for THE KNOB BEING SWEPT (those become the
@@ -204,6 +228,7 @@ def _sweep_block_env(
     batch: int, grid: int, embed_dim: int, num_heads: int,
     rtt: Optional[float], log: Callable[[str], None],
     train: bool = False,
+    also_fallback_envs: tuple = (),
 ) -> Dict[str, float]:
     """Shared microbenchmark harness for the trace-time transformer-block
     knobs: pin ``env_var`` to each variant, jit one Block at the production
@@ -279,9 +304,13 @@ def _sweep_block_env(
             _reemit_unrelated(caught, env_var)
             if t is None:
                 continue
+            # ``also_fallback_envs``: a sub-knob sweep (scores dtype under
+            # a pinned TMR_GLOBAL_ATTN) must also treat the FORMULATION
+            # knob's refusal as a fallback — its timing would otherwise be
+            # recorded under the sub-knob value while measuring blockwise
             fell_back = any(
                 isinstance(w.message, FormulationFallbackWarning)
-                and w.message.env_var == env_var
+                and w.message.env_var in (env_var,) + tuple(also_fallback_envs)
                 for w in caught
             )
             if fell_back:
@@ -324,6 +353,25 @@ def pick_global_attn_impl(
     return _sweep_block_env(
         "TMR_GLOBAL_ATTN", GLOBAL_ATTN_VARIANTS, 0,
         batch, grid, embed_dim, num_heads, rtt, log, train=train,
+    )
+
+
+def pick_global_scores_dtype(
+    batch: int, grid: int, embed_dim: int, num_heads: int,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+    train: bool = False,
+) -> Dict[str, float]:
+    """Time one GLOBAL block at each TMR_GLOBAL_SCORES_DTYPE under the
+    CURRENTLY exported global formulation (run after the formulation sweep,
+    like the xcorr precision stage). Only the gated folded formulations
+    read the knob; a TMR_GLOBAL_ATTN gate refusal during the sweep is
+    annotated as a fallback row so a blockwise timing can never masquerade
+    as bf16-scores evidence. Returns {dtype: sec/iter}."""
+    return _sweep_block_env(
+        "TMR_GLOBAL_SCORES_DTYPE", GLOBAL_SCORES_DTYPES, 0,
+        batch, grid, embed_dim, num_heads, rtt, log, train=train,
+        also_fallback_envs=("TMR_GLOBAL_ATTN",),
     )
 
 
@@ -411,7 +459,7 @@ def _cache_load() -> Dict[str, dict]:
 #: being silently locked out by an older pick.
 _VERSIONED_KNOBS = (
     "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
-    "TMR_XCORR_PRECISION",
+    "TMR_XCORR_PRECISION", "TMR_GLOBAL_SCORES_DTYPE",
 )
 
 
@@ -421,6 +469,7 @@ def _variants_sig(knob: str) -> str:
         "TMR_WIN_ATTN": WIN_ATTN_VARIANTS,
         "TMR_GLOBAL_ATTN": GLOBAL_ATTN_VARIANTS,
         "TMR_XCORR_PRECISION": XCORR_PRECISIONS,
+        "TMR_GLOBAL_SCORES_DTYPE": GLOBAL_SCORES_DTYPES,
     }
     sig = ",".join(sets[knob])
     if knob in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_XCORR_IMPL_SMALL"):
@@ -439,7 +488,10 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         "TMR_WIN_ATTN": set(WIN_ATTN_VARIANTS),
         "TMR_GLOBAL_ATTN": set(GLOBAL_ATTN_VARIANTS) | {"auto"},
         "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
-        "TMR_GLOBAL_SCORES_DTYPE": {"f32", "bf16"},
+        "TMR_GLOBAL_SCORES_DTYPE": set(GLOBAL_SCORES_DTYPES),
+        # metadata, not an env knob: which global formulation the scores-
+        # dtype winner was measured under (evidence is impl-specific)
+        "_scores_global_impl": set(GLOBAL_ATTN_VARIANTS),
         # metadata, not an env knob: which impl the precision winner was
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
@@ -621,8 +673,7 @@ def autotune(
     # (pallas kernels / the blockwise-family band scan), so exporting
     # alongside a different winner is inert.
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
-                 "TMR_GLOBAL_SCORES_DTYPE"):
+                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
@@ -640,6 +691,16 @@ def autotune(
         wanted.add("TMR_GLOBAL_ATTN")
     if tune_precision and "TMR_XCORR_PRECISION" not in os.environ:
         wanted.add("TMR_XCORR_PRECISION")
+    if (
+        tune_precision
+        and "TMR_GLOBAL_SCORES_DTYPE" not in os.environ
+        and vit_kind is not None
+        and cfg.compute_dtype == "bfloat16"
+    ):
+        # same relaxed-numerics policy as the precision sweep: inference
+        # sweeps only (tune_precision=False for training), bf16 models only
+        # (the knob is inert elsewhere)
+        wanted.add("TMR_GLOBAL_SCORES_DTYPE")
     if not wanted:
         return report  # everything pinned: skip even the rtt round trip
     if cached.get("TMR_XCORR_PRECISION", "highest") != "highest" and (
@@ -654,6 +715,21 @@ def autotune(
         # they were validated on (re-measured after the fresh pick instead)
         cached = {k: v for k, v in cached.items()
                   if k != "TMR_XCORR_PRECISION"}
+    active_global = os.environ.get(
+        "TMR_GLOBAL_ATTN", cached.get("TMR_GLOBAL_ATTN")
+    )
+    if "TMR_GLOBAL_SCORES_DTYPE" in cached and (
+        "TMR_GLOBAL_ATTN" in wanted
+        or cached.get("_scores_global_impl") != active_global
+    ):
+        # the scores-dtype record — a bf16 win AND the f32 "nothing to
+        # sweep" no-op alike — is evidence about ONE global formulation:
+        # drop it when the formulation it was recorded under changes or is
+        # about to be re-swept (re-decided after the fresh pick instead),
+        # else a no-op recorded under blockwise would permanently suppress
+        # the sweep after blockfolded starts winning
+        cached = {k: v for k, v in cached.items()
+                  if k != "TMR_GLOBAL_SCORES_DTYPE"}
     # export every cached wanted knob up front; only the remainder is
     # measured. A seed file (AUTOTUNE_SEED.json) typically covers the big
     # knobs, so a fresh container sweeps just the unseeded ones instead of
@@ -663,7 +739,29 @@ def autotune(
         report[knob] = {"picked": cached[knob], "cached": True}
         log(f"autotune: {knob}={cached[knob]} (cached, {key})")
     wanted -= set(cached)
+    if (
+        "TMR_GLOBAL_SCORES_DTYPE" in wanted
+        and "TMR_GLOBAL_ATTN" not in wanted
+        and os.environ.get("TMR_GLOBAL_ATTN", "auto")
+        not in ("blockfolded", "densefolded")
+    ):
+        # the active formulation is settled and not folded: the stage
+        # resolves to the f32 no-op with zero measurements — record it
+        # here so an otherwise-pinned run skips the rtt round trip too
+        os.environ["TMR_GLOBAL_SCORES_DTYPE"] = "f32"
+        report["TMR_GLOBAL_SCORES_DTYPE"] = {"picked": "f32", "times": {}}
+        wanted.discard("TMR_GLOBAL_SCORES_DTYPE")
     if not wanted:
+        if report:
+            extra = {}
+            if "TMR_GLOBAL_SCORES_DTYPE" in report:
+                extra["_scores_global_impl"] = os.environ.get(
+                    "TMR_GLOBAL_ATTN", "auto"
+                )
+            for knob in _VERSIONED_KNOBS:
+                if knob in report:
+                    extra[f"_variants_{knob}"] = _variants_sig(knob)
+            _cache_store(key, report, extra)
         return report
     if not sweep:
         # sweep=False: export-only pass (bench.py's preliminary headline
@@ -711,25 +809,12 @@ def autotune(
                 batch, cfg.emb_dim, up_hw, 17, rtt=rtt, log=log,
                 seed_highest=seed,
             )
-            base = times.get("highest")
-            if times and base is not None:
-                best = min(times, key=times.get)
-                if times[best] > 0.9 * base:
-                    # <10% win: keep the reference-parity f32 precision —
-                    # only a decisive speedup justifies changed numerics
-                    best = "highest"
+            if times:
+                best = _decisive_pick(times, "highest", log,
+                                      "TMR_XCORR_PRECISION")
                 os.environ["TMR_XCORR_PRECISION"] = best
                 report["TMR_XCORR_PRECISION"] = {"picked": best,
                                                  "times": times}
-                log(f"autotune: TMR_XCORR_PRECISION={best} {times}")
-            elif times:
-                # no parity baseline measured -> no justified flip: stay on
-                # the f32 default rather than export unverified numerics
-                os.environ["TMR_XCORR_PRECISION"] = "highest"
-                report["TMR_XCORR_PRECISION"] = {"picked": "highest",
-                                                 "times": times}
-                log("autotune: TMR_XCORR_PRECISION=highest "
-                    f"(no 'highest' baseline in {times})")
 
     for knob, picker in (
         ("TMR_WIN_ATTN", pick_win_attn_impl),
@@ -748,10 +833,37 @@ def autotune(
             os.environ[knob] = best
             report[knob] = {"picked": best, "times": times}
             log(f"autotune: {knob}={best} {times}")
+
+    if "TMR_GLOBAL_SCORES_DTYPE" in wanted:
+        # sweep AFTER the formulation pick (the knob only matters to the
+        # folded formulations, and its win is paired to the one active)
+        active = os.environ.get("TMR_GLOBAL_ATTN", "auto")
+        if active not in ("blockfolded", "densefolded"):
+            # no folded formulation active: record the no-op so the cache
+            # entry is complete and later runs skip the sweep
+            os.environ["TMR_GLOBAL_SCORES_DTYPE"] = "f32"
+            report["TMR_GLOBAL_SCORES_DTYPE"] = {"picked": "f32",
+                                                 "times": {}}
+        else:
+            vc = VIT_CONFIGS[vit_kind]
+            times = pick_global_scores_dtype(
+                batch, grid, vc["embed_dim"], vc["num_heads"], rtt=rtt,
+                log=log, train=train,
+            )
+            best = _decisive_pick(times, "f32", log,
+                                  "TMR_GLOBAL_SCORES_DTYPE")
+            os.environ["TMR_GLOBAL_SCORES_DTYPE"] = best
+            report["TMR_GLOBAL_SCORES_DTYPE"] = {"picked": best,
+                                                 "times": times}
+
     if report:
         extra = {}
         if "TMR_XCORR_PRECISION" in report:
             extra["_precision_impl"] = _active_small_impl({})
+        if "TMR_GLOBAL_SCORES_DTYPE" in report:
+            extra["_scores_global_impl"] = os.environ.get(
+                "TMR_GLOBAL_ATTN", "auto"
+            )
         for knob in _VERSIONED_KNOBS:
             # stamp every exported winner — fresh sweeps beat the current
             # set by construction, and cached hits passed the staleness
