@@ -323,7 +323,29 @@ def _run(cancel_watchdog) -> None:
 
     global _PRELIM_REC
     export_lines = None
+    # Bank under the last known-good configuration, not the library
+    # defaults: a knob whose cached winner went STALE (variant set grew /
+    # harness revision bumped) is in `pending` for the sweep, but its old
+    # value is still a valid formulation — exactly what the last committed
+    # headline measured. Set those for the bank measurement only and
+    # restore before the sweep so the re-election still runs from scratch.
+    stale_overrides = {}
+    if autotune_on and pending:
+        from tmr_tpu.utils.autotune import stale_winners
+
+        stale_overrides = {
+            k: v for k, v in stale_winners(cfg, IMAGE_SIZE, BATCH).items()
+            if k in pending
+        }
+        if stale_overrides:
+            _progress(
+                "banking under stale-stamped previous winners "
+                f"{stale_overrides} (the sweep re-decides them)"
+            )
+            os.environ.update(stale_overrides)
     rec = _build_and_measure(cfg, tune)
+    for k in stale_overrides:
+        os.environ.pop(k, None)
     if os.environ.get("TMR_BENCH_SELFTEST_PRELIM"):
         # contract test hook: simulate a wedge AFTER the preliminary
         # measurement banked (the sweep phase is TPU-only, so CPU tests

@@ -807,3 +807,38 @@ def test_scores_dtype_sweep_decisive_win_policy(clean_knobs, monkeypatch):
     report = at.autotune(_cfg(), 1024, 4)
     assert report["TMR_GLOBAL_SCORES_DTYPE"]["picked"] == "f32"
     assert report["TMR_GLOBAL_SCORES_DTYPE"]["times"] == {}
+
+
+def test_stale_winners_returns_only_stale_stamped_entries(
+    clean_knobs, monkeypatch, tmp_path
+):
+    """stale_winners() feeds bench.py's pre-sweep bank: it must return
+    exactly the cached winners whose variant stamp is stale (still-valid
+    values the sweep will re-decide), skip fresh-stamped entries (those
+    export normally), and respect explicit env pins."""
+    import json
+
+    class _Dev:
+        device_kind = "cpu"
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({
+        "cpu|1024|128|4|512|vit_b": {
+            "TMR_GLOBAL_ATTN": "blockfolded",
+            "_variants_TMR_GLOBAL_ATTN": "old,set|old-rev",  # stale
+            "TMR_WIN_ATTN": "folded",
+            "_variants_TMR_WIN_ATTN": at._variants_sig("TMR_WIN_ATTN"),
+            "TMR_XCORR_PRECISION": "bf16",
+            "_variants_TMR_XCORR_PRECISION": "also,old",  # stale
+        }
+    }))
+    monkeypatch.setenv("TMR_AUTOTUNE_CACHE", str(cache))
+    out = at.stale_winners(_cfg(), 1024, 4)
+    assert out == {"TMR_GLOBAL_ATTN": "blockfolded",
+                   "TMR_XCORR_PRECISION": "bf16"}
+
+    # an env pin wins over the stale entry
+    monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
+    out = at.stale_winners(_cfg(), 1024, 4)
+    assert out == {"TMR_XCORR_PRECISION": "bf16"}

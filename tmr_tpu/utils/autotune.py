@@ -375,6 +375,63 @@ def pick_global_scores_dtype(
     )
 
 
+def _vit_kind(cfg):
+    """backbone -> sweep geometry family (None for non-ViT backbones) —
+    single source for autotune() and stale_winners(), whose cache keys
+    must never diverge."""
+    return {"sam": "vit_h", "sam_vit_h": "vit_h",
+            "sam_vit_b": "vit_b"}.get(cfg.backbone)
+
+
+def _cache_key(cfg, image_size: int, batch: int, vit_kind, train: bool) -> str:
+    """The per-(device, shape) winner-cache key. up_hw (not image_size
+    alone) keys it: the xcorr sweep shape depends on feature_upsample, and
+    a winner measured at the wrong map size must never be silently reused.
+    Training keys separately — fwd-only winners must never be reused for
+    training (the Pallas kernels' recompute backward inverts the ranking)
+    and vice versa."""
+    import jax
+
+    grid = image_size // 16
+    up_hw = 2 * grid if cfg.feature_upsample else grid
+    key = "|".join(
+        str(p) for p in (
+            jax.devices()[0].device_kind, image_size, up_hw, batch,
+            cfg.emb_dim, vit_kind,
+        )
+    )
+    if train:
+        key += "|train"
+    return key
+
+
+def stale_winners(
+    cfg, image_size: int, batch: int, train: bool = False
+) -> Dict[str, str]:
+    """Cached/seeded winners whose ``_variants_`` stamp is STALE (the
+    variant set grew or the harness revision bumped) — still-valid env
+    values that a fresh sweep will re-decide, returned so bench.py's
+    pre-sweep bank can measure under the last known-good configuration
+    instead of the library defaults. Without this, growing a variant set
+    silently downgrades the banked wedge-fallback number to whatever the
+    ungated default formulation happens to be (e.g. the 21 img/s
+    blockfolded headline banking at ~11 img/s under blockwise)."""
+    vit_kind = {"sam": "vit_h", "sam_vit_h": "vit_h", "sam_vit_b": "vit_b"}.get(
+        cfg.backbone
+    )
+    key = _cache_key(cfg, image_size, batch, vit_kind, train)
+    cached = _cache_load().get(key, {})
+    out: Dict[str, str] = {}
+    for knob in _VERSIONED_KNOBS:
+        if (
+            knob in cached
+            and knob not in os.environ
+            and cached.get(f"_variants_{knob}") != _variants_sig(knob)
+        ):
+            out[knob] = cached[knob]
+    return out
+
+
 def _active_small_impl(cached: Dict[str, str]) -> str:
     """The impl the small-bucket correlation will actually dispatch to,
     resolved the way ops/xcorr.py does: explicit TMR_XCORR_IMPL, else the
@@ -632,26 +689,12 @@ def autotune(
 
     if jax.default_backend() != "tpu":
         return {}
-    vit_kind = {"sam": "vit_h", "sam_vit_h": "vit_h", "sam_vit_b": "vit_b"}.get(
-        cfg.backbone
-    )
+    vit_kind = _vit_kind(cfg)
     report: Dict[str, object] = {}
     grid = image_size // 16
     up_hw = 2 * grid if cfg.feature_upsample else grid
 
-    # up_hw (not image_size alone) keys the cache: the xcorr sweep shape
-    # depends on feature_upsample, and a winner measured at the wrong map
-    # size must never be silently reused
-    key = "|".join(
-        str(p) for p in (
-            jax.devices()[0].device_kind, image_size, up_hw, batch,
-            cfg.emb_dim, vit_kind,
-        )
-    )
-    if train:
-        # fwd-only winners must never be reused for training (the Pallas
-        # kernels' recompute backward inverts the ranking) and vice versa
-        key += "|train"
+    key = _cache_key(cfg, image_size, batch, vit_kind, train)
     force = os.environ.get("TMR_AUTOTUNE_FORCE", "") not in ("", "0")
     cached = {} if force else _cache_load().get(key, {})
     for knob in _VERSIONED_KNOBS:
