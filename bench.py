@@ -361,7 +361,8 @@ def _run(cancel_watchdog) -> None:
         from tmr_tpu.utils.autotune import autotune
 
         snap_keys = ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_XCORR_IMPL",
-                     "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION")
+                     "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION",
+                     "TMR_GLOBAL_SCORES_DTYPE")
         before = {k: os.environ.get(k) for k in snap_keys}
         tune = {**tune, **autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)}
         if {k: os.environ.get(k) for k in snap_keys} != before:
