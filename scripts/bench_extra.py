@@ -363,6 +363,27 @@ def _run(cancel_watchdog, argv=None) -> int:
     names = list(ALL) if not args.only else args.only.split(",")
     import jax
 
+    # Measure every stage under the headline's tuned formulations, not the
+    # library defaults (a blockwise-default batch sweep would understate
+    # the framework ~2x): export cached fresh winners without measuring,
+    # then fall back to stale-stamped previous winners (valid values whose
+    # variant set grew — bench.py's bank uses the same policy). Explicit
+    # env pins always win (setdefault). Non-headline geometries (1536,
+    # vit_h) re-gate each formulation per geometry at trace time.
+    if jax.default_backend() == "tpu":
+        from tmr_tpu.config import preset
+        from tmr_tpu.utils.autotune import autotune, stale_winners
+
+        cfg0 = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE,
+                      compute_dtype=DTYPE, batch_size=4)
+        autotune(cfg0, SIZE, 4, sweep=False,
+                 log=lambda m: print(f"[bench_extra] {m}", file=sys.stderr,
+                                     flush=True))
+        for k, v in stale_winners(cfg0, SIZE, 4).items():
+            os.environ.setdefault(k, v)
+            print(f"[bench_extra] pinned stale-stamped winner {k}={v}",
+                  file=sys.stderr, flush=True)
+
     results = {"device": str(jax.devices()[0])}
     for name in names:
         t0 = time.perf_counter()
