@@ -522,7 +522,7 @@ def _build_and_measure(cfg, tune) -> dict:
                       "TMR_XCORR_PRECISION", "TMR_PALLAS_ATTN_BQ",
                       "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
                       "TMR_GLOBAL_BANDS_UNROLL",
-                      "TMR_GLOBAL_SCORES_DTYPE")
+                      "TMR_GLOBAL_SCORES_DTYPE", "TMR_WIN_SCORES_DTYPE")
             if k in os.environ
         },
     }

@@ -140,6 +140,8 @@ def main():
          {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BK": "1024"}),
         ("one_windowed_block", 14, {"TMR_WIN_ATTN": "dense"}),
         ("one_windowed_block_folded", 14, {"TMR_WIN_ATTN": "folded"}),
+        ("one_windowed_block_folded_scores16", 14,
+         {"TMR_WIN_ATTN": "folded", "TMR_WIN_SCORES_DTYPE": "bf16"}),
         ("one_windowed_block_flash", 14, {"TMR_WIN_ATTN": "flash"}),
         ("one_windowed_block_pallas", 14, {"TMR_WIN_ATTN": "pallas"}),
         ("one_windowed_block_pallas_g8", 14,
@@ -154,7 +156,8 @@ def main():
         k: os.environ.get(k)
         for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_PALLAS_ATTN_BQ",
                   "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
-                  "TMR_GLOBAL_BANDS_UNROLL", "TMR_GLOBAL_SCORES_DTYPE")
+                  "TMR_GLOBAL_BANDS_UNROLL", "TMR_GLOBAL_SCORES_DTYPE",
+                  "TMR_WIN_SCORES_DTYPE")
     }
     try:
         for label, win, knobs in cases:
@@ -194,7 +197,7 @@ def main():
             _progress(f"stage 3: {label}")
             for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
                       "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
-                      "TMR_GLOBAL_SCORES_DTYPE"):
+                      "TMR_GLOBAL_SCORES_DTYPE", "TMR_WIN_SCORES_DTYPE"):
                 os.environ.pop(k, None)  # tile/group overrides are per-case
             os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,

@@ -1062,3 +1062,55 @@ def test_ring_at_1536_bucket_scale():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
     )
+
+
+@pytest.mark.slow
+def test_win_scores_dtype_bf16_matches_dense(monkeypatch):
+    """TMR_WIN_SCORES_DTYPE=bf16 (experiment knob: per-window folded score
+    tensors materialize in bf16) must stay within bf16 tolerance of the
+    dense windowed oracle on the bf16 deployment dtype, change the
+    rounding vs f32 scores (liveness), and be inert for f32 models."""
+    from tmr_tpu.models.vit import Attention
+
+    rng = np.random.default_rng(17)
+    # drive the Attention module directly at the window grid (14x14
+    # tokens — the windowed folded branch)
+    xw = jnp.asarray(rng.standard_normal((4, 14, 14, 32)), jnp.bfloat16)
+    attn16 = Attention(num_heads=2, rel_pos_size=(14, 14),
+                       dtype=jnp.bfloat16)
+    params = attn16.init(jax.random.key(0), xw)
+
+    monkeypatch.setenv("TMR_WIN_ATTN", "dense")
+    monkeypatch.delenv("TMR_WIN_SCORES_DTYPE", raising=False)
+    ref = np.asarray(jax.jit(attn16.apply)(params, xw), np.float32)
+
+    monkeypatch.setenv("TMR_WIN_ATTN", "folded")
+    f32s = np.asarray(jax.jit(attn16.apply)(params, xw), np.float32)
+    monkeypatch.setenv("TMR_WIN_SCORES_DTYPE", "bf16")
+    b16s = np.asarray(jax.jit(attn16.apply)(params, xw), np.float32)
+
+    scale = np.abs(ref).max() + 1e-6
+    assert np.abs(f32s - ref).max() / scale < 0.05
+    assert np.abs(b16s - ref).max() / scale < 0.05
+    # liveness at the trace level: the lowered programs must differ (the
+    # bf16-rounded scores can coincide with f32 scores after the final
+    # bf16 output cast at this tiny scale, so output inequality is not a
+    # reliable signal here — unlike the global-path test)
+    monkeypatch.delenv("TMR_WIN_SCORES_DTYPE")
+    h_f32 = jax.jit(attn16.apply).lower(params, xw).as_text()
+    monkeypatch.setenv("TMR_WIN_SCORES_DTYPE", "bf16")
+    h_b16 = jax.jit(attn16.apply).lower(params, xw).as_text()
+    assert h_f32 != h_b16
+
+    # f32 model: knob inert (bit-equal to the unset run)
+    attn32 = Attention(num_heads=2, rel_pos_size=(14, 14))
+    xw32 = jnp.asarray(rng.standard_normal((4, 14, 14, 32)), jnp.float32)
+    p32 = attn32.init(jax.random.key(0), xw32)
+    with_knob = np.asarray(jax.jit(attn32.apply)(p32, xw32), np.float32)
+    monkeypatch.delenv("TMR_WIN_SCORES_DTYPE")
+    without = np.asarray(jax.jit(attn32.apply)(p32, xw32), np.float32)
+    np.testing.assert_array_equal(with_knob, without)
+
+    monkeypatch.setenv("TMR_WIN_SCORES_DTYPE", "int8")
+    with pytest.raises(ValueError, match="TMR_WIN_SCORES_DTYPE"):
+        jax.jit(attn16.apply)(params, xw)

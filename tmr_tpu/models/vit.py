@@ -131,6 +131,19 @@ def _scores_dtype() -> str:
     return val
 
 
+def _win_scores_dtype() -> str:
+    """TMR_WIN_SCORES_DTYPE: _scores_dtype()'s sibling for the folded
+    windowed score tensors. Same contract: 'f32' (default, exact) or
+    'bf16' (halved score-tile traffic; opt-in via env / full-program
+    pin)."""
+    val = os.environ.get("TMR_WIN_SCORES_DTYPE", "f32")
+    if val not in ("f32", "bf16"):
+        raise ValueError(
+            f"TMR_WIN_SCORES_DTYPE={val!r}: expected f32|bf16"
+        )
+    return val
+
+
 def _q_block_rows(h: int, w: int, target_tokens: int = 512) -> int:
     """Largest divisor of ``h`` whose row-band holds <= target_tokens."""
     best = 1
@@ -564,9 +577,21 @@ class Attention(nn.Module):
                 q_aug, k_aug = fold_rel_pos_into_qk(
                     q, k, rh, rw, (h, w), scale
                 )
+                # TMR_WIN_SCORES_DTYPE=bf16 (experiment knob, folded-only
+                # like its global sibling): materialize the per-window
+                # score tensors in bf16 — f32 MXU accumulate, softmax
+                # upcasts on the fused read. Opt-in via env/A-B pin only
+                # (no autotune stage yet); the folded formulation itself
+                # is already the opt-in measured variant.
+                win_pet = (
+                    jnp.bfloat16
+                    if self.dtype == jnp.bfloat16
+                    and _win_scores_dtype() == "bf16"
+                    else jnp.float32
+                )
                 attn = jnp.einsum(
                     "bnqc,bnkc->bnqk", q_aug, k_aug,
-                    preferred_element_type=jnp.float32,
+                    preferred_element_type=win_pet,
                 )
             else:
                 attn = jnp.einsum(
@@ -585,7 +610,11 @@ class Attention(nn.Module):
                     attn = attn.reshape(b, self.num_heads, h, w, h, w)
                     attn = attn + rel_h[..., :, None] + rel_w[..., None, :]
                     attn = attn.reshape(b, self.num_heads, h * w, h * w)
-            attn = jax.nn.softmax(attn, axis=-1).astype(self.dtype)
+            # softmax always in f32 (a fused convert on the read path when
+            # the folded score tensor materialized in bf16; no-op otherwise)
+            attn = jax.nn.softmax(
+                attn.astype(jnp.float32), axis=-1
+            ).astype(self.dtype)
             x = jnp.einsum(
                 "bnqk,bnkc->bnqc", attn, v,
                 preferred_element_type=jnp.float32,

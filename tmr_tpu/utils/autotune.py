@@ -546,6 +546,7 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         "TMR_GLOBAL_ATTN": set(GLOBAL_ATTN_VARIANTS) | {"auto"},
         "TMR_XCORR_PRECISION": set(XCORR_PRECISIONS),
         "TMR_GLOBAL_SCORES_DTYPE": set(GLOBAL_SCORES_DTYPES),
+        "TMR_WIN_SCORES_DTYPE": set(GLOBAL_SCORES_DTYPES),
         # metadata, not an env knob: which global formulation the scores-
         # dtype winner was measured under (evidence is impl-specific)
         "_scores_global_impl": set(GLOBAL_ATTN_VARIANTS),
@@ -716,7 +717,8 @@ def autotune(
     # (pallas kernels / the blockwise-family band scan), so exporting
     # alongside a different winner is inert.
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
-                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL"):
+                 "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
+                 "TMR_WIN_SCORES_DTYPE"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
