@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Session-7 follow-up, run ONCE after scripts/tpu_watch3.sh's battery
+# completes (single tunnel client discipline): the definitive headline
+# bench under the GROWN variant set (densefolded + bf16 score tiles in
+# the running), then seed promotion so the driver's round-end bench
+# cache-hits the winners instead of re-sweeping.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="${TMR_WATCH_LOG:-/tmp/post_battery.log}"
+
+log() { echo "[$(date +%H:%M:%S)] $*" >>"$LOG"; }
+
+cd "$REPO"
+log "post_battery started"
+rm -f "$REPO/autotune.env"
+TMR_AUTOTUNE_EXPORT="$REPO/autotune.env" TMR_BENCH_ALARM=2700 \
+  timeout 3000 python bench.py >"$REPO/bench_live.json" 2>>"$LOG"
+log "final headline rc=$? -> bench_live.json"
+if grep -q '"value"' "$REPO/bench_live.json" 2>/dev/null \
+    && ! grep -q '"error"' "$REPO/bench_live.json" 2>/dev/null; then
+  cp "$REPO/bench_live.json" "$REPO/BENCH_LIVE.json"
+fi
+timeout 120 python scripts/promote_cache_to_seed.py \
+  >"$REPO/promote_seed.json" 2>>"$LOG"
+log "promote rc=$? -> promote_seed.json"
+log "post_battery done"
