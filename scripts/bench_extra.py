@@ -35,6 +35,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# CPU-intended invocations must never dial the TPU relay — strip the
+# tunnel env BEFORE any jax import (single-client tunnel; session-7 wedge)
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+scrub_cpu_tunnel_env()
+
 # TMR_BENCH_TINY=1: shrink every config so the whole script smoke-runs on
 # CPU in minutes (validating the code paths); real numbers use defaults.
 TINY = os.environ.get("TMR_BENCH_TINY", "") not in ("", "0", "false")

@@ -28,6 +28,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# CPU-intended invocations must never dial the TPU relay — strip the
+# tunnel env BEFORE any jax import (single-client tunnel; session-7 wedge)
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+scrub_cpu_tunnel_env()
+
 BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
 SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
 CKPT = os.environ.get("TMR_BENCH_CKPT", "bench_ckpt/params")

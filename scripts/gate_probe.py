@@ -6,40 +6,73 @@ while the one pure-XLA alternative (blockfolded) won the headline — but
 the gates swallow their refusal reason, so "Mosaic can't lower through
 this backend" vs "kernel miscompiles numerically" vs "backend-name
 mismatch" were indistinguishable. This script runs each gate at the
-production geometry with TMR_GATE_DEBUG=1 and, for the pallas paths, also
-calls the kernel DIRECTLY (no gate) so a lowering exception surfaces with
-its full traceback.
+production geometry and, for the pallas paths, also calls the kernel
+DIRECTLY (no gate) so a lowering exception surfaces with its full
+traceback.
+
+Since the structured-diagnostics layer (tmr_tpu/diagnostics.py) landed,
+every gate refusal records a machine-readable cause (category, exception
+class + message, tile config, device kind); the gates are cache_clear'd
+here first so a cause is recorded even for verdicts another trace already
+cached.
+
+Output modes:
+  default        one JSON line per probe on stdout (legacy watcher format);
+                 tracebacks/debug on stderr
+  --json         ONE gate_probe/v1 JSON document on stdout:
+                 {"schema", "backend", "probes": [{..., "refusals": [...]}],
+                  "refusals": [...]}   (the flat list aggregates all causes)
+  --out FILE     additionally write the --json document to FILE
+                 (gate_probe.json schema — the committed artifact)
 
 Single tunnel client; run only when no other bench/battery stage is live.
-Output: one JSON line per probe on stdout; tracebacks/debug on stderr.
 """
 
+import argparse
 import json
 import os
 import sys
 import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-intended invocations must never dial the TPU relay — strip the
+# tunnel env BEFORE jax import (single-client tunnel; session-7 wedge)
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+scrub_cpu_tunnel_env()
 os.environ["TMR_GATE_DEBUG"] = "1"
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 
-def emit(**kw):
-    print(json.dumps(kw), flush=True)
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true", dest="as_doc",
+                    help="emit ONE gate_probe/v1 JSON document")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document to this path")
+    args = ap.parse_args(argv)
 
+    from tmr_tpu.diagnostics import GATE_PROBE_SCHEMA, drain_gate_refusals
 
-def main():
-    emit(
-        probe="backend",
+    probes = []
+
+    def emit(**kw):
+        probes.append(kw)
+        if not args.as_doc:
+            print(json.dumps(kw), flush=True)
+
+    backend = dict(
         default_backend=jax.default_backend(),
         devices=[str(d) for d in jax.devices()],
         device_kind=jax.devices()[0].device_kind,
         platform=jax.devices()[0].platform,
         jax_version=jax.__version__,
     )
+    emit(probe="backend", **backend)
 
     # 1. trivial pallas kernel, compiled mode — does Mosaic lower AT ALL?
     try:
@@ -59,56 +92,76 @@ def main():
         emit(probe="pallas_trivial", ok=False,
              error=f"{type(e).__name__}: {e}")
 
-    # 2. the global-attention pallas kernel DIRECT (no gate), bench
+    # 2. the global-attention pallas kernels DIRECT (no gate), bench
     # geometry: grid 64x64, head_dim 64, B1 H2 (the gate's own shape)
-    try:
-        from tmr_tpu.ops.pallas_attn import pallas_decomposed_attention
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+    from tmr_tpu.ops.pallas_attn import (
+        pallas_decomposed_attention,
+        pallas_fused_attention,
+    )
 
-        rng = np.random.default_rng(0)
-        gh = gw = 64
-        D = 64
-        S = gh * gw
-        q = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
-        k = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
-        v = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
-        rh = jnp.asarray(rng.standard_normal((gh, gh, D)) * 0.2, jnp.float32)
-        rw = jnp.asarray(rng.standard_normal((gw, gw, D)) * 0.2, jnp.float32)
-        got = jax.jit(
-            lambda *a: pallas_decomposed_attention(*a, (gh, gw), D**-0.5)
-        )(q, k, v, rh, rw)
-        got.block_until_ready()
+    rng = np.random.default_rng(0)
+    gh = gw = 64
+    D = 64
+    S = gh * gw
+    q = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 2, S, D)), jnp.bfloat16)
+    rh = jnp.asarray(rng.standard_normal((gh, gh, D)) * 0.2, jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((gw, gw, D)) * 0.2, jnp.float32)
+    # the blockwise oracle is the same for every probed kernel: run once
+    want = None
+    for probe_name, attn_fn in (
+        ("pallas_global_direct", pallas_decomposed_attention),
+        ("pallas_fused_direct", pallas_fused_attention),
+    ):
+        try:
+            got = jax.jit(
+                lambda *a, _f=attn_fn: _f(*a, (gh, gw), D**-0.5)
+            )(q, k, v, rh, rw)
+            got.block_until_ready()
 
-        from tmr_tpu.models.vit import blockwise_decomposed_attention
+            if want is None:
+                want = np.asarray(jax.jit(
+                    lambda *a: blockwise_decomposed_attention(
+                        *a, (gh, gw), D**-0.5)
+                )(q, k, v, rh, rw), np.float32)
+            err = float(np.abs(np.asarray(got, np.float32) - want).max())
+            ref = float(np.abs(want).max())
+            emit(probe=probe_name, ok=bool(err / (ref + 1e-6) < 0.05),
+                 rel_err=err / (ref + 1e-6))
+        except Exception as e:
+            traceback.print_exc()
+            emit(probe=probe_name, ok=False,
+                 error=f"{type(e).__name__}: {e}")
 
-        want = jax.jit(
-            lambda *a: blockwise_decomposed_attention(*a, (gh, gw), D**-0.5)
-        )(q, k, v, rh, rw)
-        err = float(
-            np.abs(
-                np.asarray(got, np.float32) - np.asarray(want, np.float32)
-            ).max()
-        )
-        ref = float(np.abs(np.asarray(want, np.float32)).max())
-        emit(probe="pallas_global_direct", ok=bool(err / (ref + 1e-6) < 0.05),
-             rel_err=err / (ref + 1e-6))
-    except Exception as e:
-        traceback.print_exc()
-        emit(probe="pallas_global_direct", ok=False,
-             error=f"{type(e).__name__}: {e}")
-
-    # 3. every production gate, debug on (reasons land on stderr)
+    # 3. every production gate, cache-cleared so refusal causes record
     from tmr_tpu.ops.flash_attn import (
-        blockfolded_ok, flash_attention_ok, flash_window_ok,
+        blockfolded_ok,
+        densefolded_ok,
+        flash_attention_ok,
+        flash_window_ok,
+        xlaflash_ok,
     )
     from tmr_tpu.ops.pallas_attn import (
-        effective_global_tiles, pallas_global_ok, pallas_window_ok,
+        effective_fused_tiles,
+        effective_global_tiles,
+        pallas_fused_ok,
+        pallas_global_ok,
+        pallas_window_ok,
     )
     from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok
-
-    bq, bk = effective_global_tiles(64 * 64)
-    from tmr_tpu.ops.flash_attn import densefolded_ok
     from tmr_tpu.models.vit import _scores_dtype
 
+    for gate_fn in (blockfolded_ok, densefolded_ok, flash_attention_ok,
+                    flash_window_ok, xlaflash_ok, pallas_fused_ok,
+                    pallas_global_ok, pallas_window_ok, pallas_xcorr_ok):
+        clear = getattr(gate_fn, "cache_clear", None)  # not all are cached
+        if clear is not None:
+            clear()
+
+    bq, bk = effective_global_tiles(64 * 64)
+    fbq, fbk = effective_fused_tiles(64 * 64, 64)
     live_scores = _scores_dtype()
     gates = {
         "flash_global_64x64_d64": lambda: flash_attention_ok(64, 64, 64),
@@ -116,19 +169,25 @@ def main():
             lambda: blockfolded_ok(64, 64, 64, live_scores),
         f"densefolded_64x64_d64_scores_{live_scores}":
             lambda: densefolded_ok(64, 64, 64, live_scores),
+        "xlaflash_64x64_d64": lambda: xlaflash_ok(64, 64, 64),
         "flash_window_14x14_d64": lambda: flash_window_ok(14, 14, 64),
         "pallas_global_64x64_d64":
             lambda: pallas_global_ok(64, 64, 64, bq, bk),
+        f"pallas_fused_64x64_d64_bq{fbq}_bk{fbk}":
+            lambda: pallas_fused_ok(64, 64, 64, fbq, fbk),
         "pallas_window_14x14_d64_g8":
             lambda: pallas_window_ok(14, 14, 64, 8),
         "pallas_xcorr_c256_64_t17": lambda: pallas_xcorr_ok(256, 64, 64, 17),
     }
+    drain_gate_refusals()  # discard causes from the direct probes above
     for name, fn in gates.items():
         try:
-            emit(probe=name, ok=bool(fn()))
+            ok = bool(fn())
+            emit(probe=name, ok=ok, refusals=drain_gate_refusals())
         except Exception as e:
             traceback.print_exc()
-            emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}")
+            emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}",
+                 refusals=drain_gate_refusals())
 
     # the bf16-score-tile gates (the env the check traces under must match
     # the cache key being probed — set it for the duration)
@@ -142,14 +201,32 @@ def main():
                     lambda: densefolded_ok(64, 64, 64, "bf16"),
             }.items():
                 try:
-                    emit(probe=name, ok=bool(fn()))
+                    emit(probe=name, ok=bool(fn()),
+                         refusals=drain_gate_refusals())
                 except Exception as e:
                     traceback.print_exc()
                     emit(probe=name, ok=False,
-                         error=f"{type(e).__name__}: {e}")
+                         error=f"{type(e).__name__}: {e}",
+                         refusals=drain_gate_refusals())
         finally:
             os.environ.pop("TMR_GLOBAL_SCORES_DTYPE", None)
 
+    doc = {
+        "schema": GATE_PROBE_SCHEMA,
+        "backend": backend,
+        "probes": probes,
+        "refusals": [
+            r for p in probes for r in p.get("refusals", ())
+        ],
+    }
+    if args.as_doc:
+        print(json.dumps(doc), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

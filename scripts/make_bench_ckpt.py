@@ -28,6 +28,12 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# CPU-intended invocations must never dial the TPU relay — strip the
+# tunnel env BEFORE any jax import (single-client tunnel; session-7 wedge)
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+scrub_cpu_tunnel_env()
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()

@@ -42,7 +42,7 @@ PINNABLE = (
     "TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_PALLAS_ATTN_BQ",
     "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
     "TMR_GLOBAL_BANDS_UNROLL", "TMR_GLOBAL_SCORES_DTYPE",
-    "TMR_WIN_SCORES_DTYPE",
+    "TMR_WIN_SCORES_DTYPE", "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK",
 )
 #: decisive-win margin: below this the sweep ranking stands (same
 #: philosophy as the precision stage's >10% bar, scaled to whole-program

@@ -21,11 +21,17 @@ import json
 import os
 import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-intended invocations must never dial the TPU relay — strip the
+# tunnel env BEFORE jax import (single-client tunnel; session-7 wedge)
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+scrub_cpu_tunnel_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
 SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
@@ -138,6 +144,17 @@ def main():
          {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BQ": "256"}),
         ("one_global_block_pallas_bk1024", 0,
          {"TMR_GLOBAL_ATTN": "pallas", "TMR_PALLAS_ATTN_BK": "1024"}),
+        # the fused-bias rewrite (broadcast bias tiles, no selector
+        # matmuls) and its tile sweep — the verdict's "highest-information
+        # measurement" rows — plus the Mosaic-independent XLA flash form
+        ("one_global_block_fused", 0, {"TMR_GLOBAL_ATTN": "fused"}),
+        ("one_global_block_fused_bq256", 0,
+         {"TMR_GLOBAL_ATTN": "fused", "TMR_PALLAS_ATTN_BQ": "256"}),
+        ("one_global_block_fused_bk1024", 0,
+         {"TMR_GLOBAL_ATTN": "fused", "TMR_PALLAS_ATTN_BK": "1024"}),
+        ("one_global_block_xlaflash", 0, {"TMR_GLOBAL_ATTN": "xlaflash"}),
+        ("one_global_block_xlaflash_bk1024", 0,
+         {"TMR_GLOBAL_ATTN": "xlaflash", "TMR_XLA_FLASH_BK": "1024"}),
         ("one_windowed_block", 14, {"TMR_WIN_ATTN": "dense"}),
         ("one_windowed_block_folded", 14, {"TMR_WIN_ATTN": "folded"}),
         ("one_windowed_block_folded_scores16", 14,
@@ -157,7 +174,8 @@ def main():
         for k in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_PALLAS_ATTN_BQ",
                   "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
                   "TMR_GLOBAL_BANDS_UNROLL", "TMR_GLOBAL_SCORES_DTYPE",
-                  "TMR_WIN_SCORES_DTYPE")
+                  "TMR_WIN_SCORES_DTYPE", "TMR_XLA_FLASH_BQ",
+                  "TMR_XLA_FLASH_BK")
     }
     try:
         for label, win, knobs in cases:
@@ -197,7 +215,8 @@ def main():
             _progress(f"stage 3: {label}")
             for k in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
                       "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
-                      "TMR_GLOBAL_SCORES_DTYPE", "TMR_WIN_SCORES_DTYPE"):
+                      "TMR_GLOBAL_SCORES_DTYPE", "TMR_WIN_SCORES_DTYPE",
+                      "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK"):
                 os.environ.pop(k, None)  # tile/group overrides are per-case
             os.environ.update(knobs)
             blk = Block(num_heads=12, window_size=win,

@@ -842,3 +842,101 @@ def test_stale_winners_returns_only_stale_stamped_entries(
     monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
     out = at.stale_winners(_cfg(), 1024, 4)
     assert out == {"TMR_XCORR_PRECISION": "bf16"}
+
+
+def test_new_fused_variants_registered_and_rev_bumped():
+    """The fused kernel and the XLA flash path must be electable sweep
+    variants, and the _SWEEP_REV bump must make every pre-existing
+    TMR_GLOBAL_ATTN winner stamp stale so it re-records at the next
+    hardware window (the acceptance contract for registering a variant)."""
+    assert "fused" in at.GLOBAL_ATTN_VARIANTS
+    assert "xlaflash" in at.GLOBAL_ATTN_VARIANTS
+    sig = at._variants_sig("TMR_GLOBAL_ATTN")
+    assert "fused" in sig and "xlaflash" in sig
+    assert sig.endswith("|" + at._SWEEP_REV)
+    # the committed seed's stamps predate this revision by construction:
+    # whatever they say, they must not equal the live signature
+    for entry in at.seed_load().values():
+        stamp = entry.get("_variants_TMR_GLOBAL_ATTN")
+        if stamp is not None:
+            assert stamp != sig, (
+                "committed seed already stamped with the new revision — "
+                "bump _SWEEP_REV when the variant set or harness changes"
+            )
+    # validation accepts the new variants as cached winners, and the
+    # scores-dtype pairing stamp survives an 'auto' resolution (reload
+    # churn fix: autotune.py _validate_cache_obj)
+    kept = at._validate_cache_obj({
+        "k": {"TMR_GLOBAL_ATTN": "fused", "_scores_global_impl": "auto"},
+        "k2": {"TMR_GLOBAL_ATTN": "xlaflash"},
+    })
+    assert kept["k"]["TMR_GLOBAL_ATTN"] == "fused"
+    assert kept["k"]["_scores_global_impl"] == "auto"
+    assert kept["k2"]["TMR_GLOBAL_ATTN"] == "xlaflash"
+
+
+def test_stale_winners_uses_vit_kind_helper():
+    """stale_winners must derive the geometry family through _vit_kind —
+    the single source shared with autotune()'s cache key — not an inlined
+    mapping that can drift (the two keys must be identical or the banked
+    wedge-fallback measurement reads the wrong cache row)."""
+    import inspect
+
+    src = inspect.getsource(at.stale_winners)
+    assert "_vit_kind(" in src
+    assert '"sam_vit_b"' not in src  # the old inlined dict is gone
+
+
+@pytest.mark.slow
+def test_block_sweep_fallback_rows_carry_structured_refusals(
+    clean_knobs, monkeypatch
+):
+    """The real global-attention sweep harness off-TPU: gate-refused
+    kernel variants come back fallback-annotated AND their rows carry the
+    structured refusal causes (gate name, cause category, config) in
+    LAST_SWEEP_REFUSALS — the sweep-side half of the gate_probe.json
+    diagnostics (verdict r5 #1)."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    times = at._sweep_block_env(
+        "TMR_GLOBAL_ATTN", ("blockwise", "pallas", "fused"), 0,
+        1, 32, 16, 2, 0.0, lambda s: None,
+    )
+    assert "blockwise" in times
+    for impl, gate in (("pallas", "pallas_global_ok"),
+                       ("fused", "pallas_fused_ok")):
+        row = impl + at.FALLBACK_SUFFIX
+        assert row in times and impl not in times
+        causes = at.LAST_SWEEP_REFUSALS["TMR_GLOBAL_ATTN"][row]
+        assert causes, f"{row} carries no structured causes"
+        assert any(c["gate"] == gate for c in causes)
+        for c in causes:
+            assert c["schema"] == "gate_probe/v1"
+            assert c["cause"]
+            assert "config" in c and "device_kind" in c
+
+
+def test_autotune_report_attaches_sweep_refusals(clean_knobs, monkeypatch):
+    """autotune() must copy the harness's structured refusal causes into
+    the report entry of any knob whose sweep produced fallback rows — the
+    path bench.py's autotune_refusals JSON field reads."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cause = {"schema": "gate_probe/v1", "gate": "pallas_global_ok",
+             "cause": "backend", "message": "", "exception": None,
+             "config": {}, "backend": "cpu", "device_kind": "cpu"}
+
+    def fake_global_sweep(*a, **k):
+        at.LAST_SWEEP_REFUSALS["TMR_GLOBAL_ATTN"] = {
+            "pallas" + at.FALLBACK_SUFFIX: [cause],
+        }
+        return {"blockwise": 0.03,
+                "pallas" + at.FALLBACK_SUFFIX: 0.001}
+
+    monkeypatch.setattr(at, "pick_xcorr_impl", lambda *a, **k: {"conv": 0.01})
+    monkeypatch.setattr(at, "pick_win_attn_impl",
+                        lambda *a, **k: {"dense": 0.01})
+    monkeypatch.setattr(at, "pick_global_attn_impl", fake_global_sweep)
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert report["TMR_GLOBAL_ATTN"]["picked"] == "blockwise"
+    ref = report["TMR_GLOBAL_ATTN"]["refusals"]
+    assert ref == {"pallas" + at.FALLBACK_SUFFIX: [cause]}

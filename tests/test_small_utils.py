@@ -5,7 +5,60 @@ import json
 import pytest
 
 from tmr_tpu.data.coco_index import COCOIndex
-from tmr_tpu.utils.bench_guard import run_guarded
+from tmr_tpu.utils.bench_guard import run_guarded, scrub_cpu_tunnel_env
+
+
+def test_scrub_cpu_tunnel_env_strips_only_cpu_intent():
+    """Tunnel-client discipline as code (the session-7 10-hour wedge): a
+    JAX_PLATFORMS=cpu-intended env must lose PALLAS_AXON_POOL_IPS so the
+    axon sitecustomize can never dial the single-client TPU relay; any
+    other intent (tpu, mixed, unset) must leave the env untouched."""
+    env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+    assert scrub_cpu_tunnel_env(env) is True
+    assert "PALLAS_AXON_POOL_IPS" not in env
+
+    # case/whitespace-insensitive cpu-only intent still strips
+    env = {"JAX_PLATFORMS": " CPU ", "PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+    assert scrub_cpu_tunnel_env(env) is True
+    assert "PALLAS_AXON_POOL_IPS" not in env
+
+    # non-cpu or ambiguous intents never touch the tunnel var
+    for plats in ("", "tpu", "axon,cpu", "cpu,tpu"):
+        env = {"JAX_PLATFORMS": plats, "PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+        assert scrub_cpu_tunnel_env(env) is False
+        assert env["PALLAS_AXON_POOL_IPS"] == "10.0.0.1"
+
+    # cpu intent with no tunnel var set: no-op, not an error
+    env = {"JAX_PLATFORMS": "cpu"}
+    assert scrub_cpu_tunnel_env(env) is False
+
+
+def test_scrub_cpu_tunnel_env_wired_into_entry_points():
+    """Every scripts/ entry point that can reach a jax backend init (and
+    bench.py itself) must call the scrub BEFORE importing jax — the guard
+    exists as code, not prose, only if the entry points actually run it."""
+    import os
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    entries = [
+        os.path.join(repo, "bench.py"),
+        os.path.join(repo, "scripts", "bench_extra.py"),
+        os.path.join(repo, "scripts", "profile_breakdown.py"),
+        os.path.join(repo, "scripts", "ckpt_probe.py"),
+        os.path.join(repo, "scripts", "gate_probe.py"),
+        os.path.join(repo, "scripts", "make_bench_ckpt.py"),
+    ]
+    for path in entries:
+        src = open(path).read()
+        call = src.find("scrub_cpu_tunnel_env()")
+        assert call != -1, f"{path} does not call scrub_cpu_tunnel_env()"
+        # the scrub must run before the first module-level jax import
+        jax_import = re.search(r"^import jax", src, re.MULTILINE)
+        if jax_import is not None:
+            assert call < jax_import.start(), (
+                f"{path}: scrub_cpu_tunnel_env() after `import jax`"
+            )
 
 
 def test_run_guarded_success_and_cancel(monkeypatch):

@@ -1,5 +1,6 @@
-"""Shared diagnostic warning types (dependency-free — importable from any
-layer: ops, models, utils).
+"""Shared diagnostic warning types + the structured gate-refusal registry
+(dependency-free at import time — importable from any layer: ops, models,
+utils).
 
 ``FormulationFallbackWarning`` is the structural contract between the
 trace-time formulation dispatchers (models/vit.py attention, ops/xcorr.py
@@ -9,9 +10,36 @@ refused by its gate/dtype precondition and a fallback traces instead, the
 dispatcher warns with this category carrying ``env_var`` — so harnesses can
 detect by category + attribute (not message substrings) that a timing
 recorded under the requested label actually measured the fallback.
+
+The gate-refusal REGISTRY is the machine-readable side of the same story
+(round-5 verdict #1: on the live TPU every require_tpu kernel fell back
+and the gates swallowed WHY). Every refusal inside the compiled
+self-checks (ops/flash_attn._self_check and the gates built on it —
+pallas_global_ok, pallas_fused_ok, pallas_window_ok, flash_attention_ok,
+…) records a ``gate_probe.json``-schema cause here: refusal category,
+exception class + message when one was swallowed, the tile/geometry
+config the verdict keys on, and the device kind. Consumers drain it:
+scripts/gate_probe.py --json emits the causes next to each probe, and the
+autotune sweeps attach them to fallback-labeled rows so a "(fallback)"
+timing always travels with the reason the requested kernel refused.
 """
 
 from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: schema tag stamped on every refusal record and on the gate_probe.py
+#: --json document — bump when the record shape changes incompatibly
+GATE_PROBE_SCHEMA = "gate_probe/v1"
+
+#: registry bound: the attention gates are lru_cached (one record per
+#: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
+#: backend / shape) record on EVERY call — a long-lived process that
+#: never drains must not grow without bound, so the oldest records roll
+#: off past this many. Consumers drain far below it in practice.
+_MAX_GATE_REFUSALS = 256
+
+_GATE_REFUSALS: List[dict] = []
 
 
 class FormulationFallbackWarning(UserWarning):
@@ -24,3 +52,62 @@ class FormulationFallbackWarning(UserWarning):
     def __init__(self, env_var: str, message: str):
         super().__init__(message)
         self.env_var = env_var
+
+
+def record_gate_refusal(
+    gate: str,
+    cause: str,
+    message: str = "",
+    exception: Optional[str] = None,
+    config: Optional[Dict[str, object]] = None,
+) -> dict:
+    """Append one structured refusal record and return it.
+
+    ``cause`` is a small closed vocabulary so consumers can branch without
+    parsing messages: "kill-switch" (env force-disable), "backend" (wrong
+    default backend), "forward-mismatch" / "grad-mismatch" (numerics
+    disagreed with the oracle beyond tolerance), "exception" (the check
+    raised — ``exception`` then carries the class name and ``message`` the
+    stringified error, Mosaic lowering failures included). ``config`` is
+    the gate's cache key made explicit: geometry plus whatever the verdict
+    is scoped to (tile sizes, window group, scores dtype).
+
+    Note the gates are lru_cached: a refusal records only when the check
+    actually RUNS (cache miss). Diagnostics consumers that need causes for
+    a previously cached False must ``cache_clear()`` first — exactly what
+    scripts/gate_probe.py does.
+    """
+    rec: dict = {
+        "schema": GATE_PROBE_SCHEMA,
+        "gate": gate,
+        "cause": cause,
+        "message": message,
+        "exception": exception,
+        "config": dict(config or {}),
+    }
+    try:  # backend identity is best-effort: never let diagnostics raise
+        import jax
+
+        rec["backend"] = jax.default_backend()
+        rec["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        rec["backend"] = None
+        rec["device_kind"] = None
+    _GATE_REFUSALS.append(rec)
+    if len(_GATE_REFUSALS) > _MAX_GATE_REFUSALS:
+        del _GATE_REFUSALS[:-_MAX_GATE_REFUSALS]
+    return rec
+
+
+def gate_refusals() -> List[dict]:
+    """Snapshot of the recorded refusals (oldest first), not cleared."""
+    return list(_GATE_REFUSALS)
+
+
+def drain_gate_refusals() -> List[dict]:
+    """Return all recorded refusals and clear the registry — the harness
+    protocol: drain before a measurement to discard stale records, drain
+    after to attribute fresh ones to that measurement."""
+    out = list(_GATE_REFUSALS)
+    _GATE_REFUSALS.clear()
+    return out

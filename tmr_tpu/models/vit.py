@@ -238,12 +238,18 @@ def blockwise_decomposed_attention(
     # per band, same numerics, different schedule. Autotune measures it
     # via the profile's sub-knob rows, like the Pallas tile sizes.
     raw_unroll = os.environ.get("TMR_GLOBAL_BANDS_UNROLL", "1")
-    if not (raw_unroll.isascii() and raw_unroll.isdigit()):
+    if (
+        not (raw_unroll.isascii() and raw_unroll.isdigit())
+        or int(raw_unroll) == 0
+    ):
+        # "0" is rejected, not clamped: the documented contract is a
+        # positive integer, and silently running unroll=1 under a zero pin
+        # would mislabel any A/B evidence recorded against it
         raise ValueError(
             f"TMR_GLOBAL_BANDS_UNROLL={raw_unroll!r}: expected a positive "
             "integer unroll factor"
         )
-    unroll = max(1, int(raw_unroll))
+    unroll = int(raw_unroll)
     out = jax.lax.scan(
         lambda c, x: (c, one_band(x)), (), (q_blocks, rh_blocks),
         unroll=min(unroll, nb),
@@ -409,15 +415,25 @@ class Attention(nn.Module):
             #   pallas       custom decomposed-bias kernel, VMEM-resident
             #                tiles at native head dim (ops/pallas_attn.py;
             #                self-check gate -> blockwise)
+            #   fused        the rewritten fused-bias kernel: row+lane-
+            #                aligned v5e tiles, bias rebuilt per tile from
+            #                the (q, k) block offsets by broadcast alone —
+            #                no selector matmuls (ops/pallas_attn.py;
+            #                self-check gate -> blockwise)
+            #   xlaflash     pure-XLA online-softmax flash with the same
+            #                fused-bias tiling (ops/flash_attn.py) — the
+            #                Mosaic-independent form; largest live score
+            #                tile is (band, block_k), not (band, S)
             #   auto         flash when its gate passes, else blockwise
             impl = os.environ.get("TMR_GLOBAL_ATTN", "auto")
             if impl not in (
                 "auto", "blockwise", "flash", "blockfolded", "densefolded",
-                "pallas",
+                "pallas", "fused", "xlaflash",
             ):
                 raise ValueError(
                     f"TMR_GLOBAL_ATTN={impl!r}: expected "
-                    "auto|blockwise|flash|blockfolded|densefolded|pallas"
+                    "auto|blockwise|flash|blockfolded|densefolded|pallas|"
+                    "fused|xlaflash"
                 )
             attn_fn = blockwise_decomposed_attention
             if impl in ("blockfolded", "densefolded"):
@@ -480,6 +496,54 @@ class Attention(nn.Module):
                         f"grid ({h}, {w}, head_dim {head_dim}); running "
                         "blockwise fallback"
                     ))
+            elif impl == "fused":
+                # the fused-bias kernel: row+lane-aligned tiles, bias
+                # rebuilt per tile from the (q, k) block offsets —
+                # self-checked per (geometry, tile config) with fallback
+                from tmr_tpu.ops.pallas_attn import (
+                    effective_fused_tiles,
+                    fused_supported,
+                    pallas_fused_attention,
+                    pallas_fused_ok,
+                )
+
+                bq, bk = effective_fused_tiles(h * w, w)
+                if fused_supported(h * w, w) and pallas_fused_ok(
+                    h, w, head_dim, bq, bk
+                ):
+                    attn_fn = pallas_fused_attention
+                else:
+                    import warnings
+
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_GLOBAL_ATTN",
+                        "TMR_GLOBAL_ATTN=fused: self-check gate refused "
+                        f"grid ({h}, {w}, head_dim {head_dim}); running "
+                        "blockwise fallback"
+                    ))
+            elif impl == "xlaflash":
+                # pure-XLA online-softmax flash, fused bias tiles: exact
+                # in f32 up to reassociation (ungated there, like the
+                # folded formulations); bf16 is numerics-self-checked
+                # with blockwise fallback
+                from tmr_tpu.ops.flash_attn import (
+                    xla_flash_decomposed_attention,
+                    xlaflash_ok,
+                )
+
+                attn_fn = xla_flash_decomposed_attention
+                if self.dtype == jnp.bfloat16 and not xlaflash_ok(
+                    h, w, head_dim
+                ):
+                    import warnings
+
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_GLOBAL_ATTN",
+                        "TMR_GLOBAL_ATTN=xlaflash: bf16 numerics "
+                        f"self-check failed at grid ({h}, {w}, head_dim "
+                        f"{head_dim}); running blockwise fallback"
+                    ))
+                    attn_fn = blockwise_decomposed_attention
             elif impl != "blockwise" and self.dtype == jnp.bfloat16:
                 from tmr_tpu.ops.flash_attn import (
                     flash_attention_ok,

@@ -205,9 +205,163 @@ def flash_windowed_attention(
     return out[..., :S, :D].astype(q.dtype)
 
 
+def _band_rows(h: int, w: int, target_tokens: int) -> int:
+    """Largest divisor of ``h`` whose row-band holds <= target_tokens
+    (floor 1). Local copy of models/vit._q_block_rows: this module and
+    vit.py import each other lazily, and the XLA flash schedule must not
+    depend on the model layer at import time."""
+    best = 1
+    for rows in range(1, h + 1):
+        if h % rows == 0 and rows * w <= target_tokens:
+            best = rows
+    return best
+
+
+def _env_tokens(name: str, default: int) -> int:
+    """Positive-integer token-count knob, read at trace time."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if not (raw.isascii() and raw.isdigit()) or int(raw) == 0:
+        raise ValueError(
+            f"{name}={raw!r}: expected a positive integer token count"
+        )
+    return int(raw)
+
+
+def xla_flash_decomposed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Pure-XLA ONLINE-SOFTMAX flash attention with the decomposed rel-pos
+    bias fused per tile (TMR_GLOBAL_ATTN=xlaflash) — the Mosaic-independent
+    form of the fused Pallas kernel (ops/pallas_attn.pallas_fused_attention),
+    so the no-S^2 restructuring survives on backends where Pallas refuses.
+
+    Where blockwise holds a full (band, S) score strip (softmax over the
+    whole key axis at once), this streams over k in row-aligned blocks with
+    running (m, l, acc) f32 state — the StreamFlow/FastFlow trade (PAPERS.md)
+    of a little recomputation (the exp rescale) for HBM high-water: the
+    largest live score tile is (band_q, block_k), not (band_q, S). The bias
+    tile is rebuilt per (q-band, k-block) from the SMALL f32 q-projections
+    rel_h_q (B, H, S, gh) / rel_w_q (B, H, S, gw) by broadcast + reshape
+    over the row-aligned block structure — no (S, S) score tensor, no
+    broadcast (B, H, h, w, h, w) bias, no one-hot expansion matmuls, ever.
+
+    q/k/v: (B, H, S, D) on the (gh, gw) token grid; rh/rw the get_rel_pos
+    tables (None skips the bias). Exact online softmax: equal to the dense
+    softmax up to float reassociation (the same freedom XLA already has),
+    f32 accumulators throughout; under bf16 inputs the probability matrix
+    rounds to bf16 for the AV contraction exactly like the blockwise oracle.
+    Block targets: TMR_XLA_FLASH_BQ/BK (tokens, default 512), clamped to
+    whole grid rows.
+
+    Schedule: the q-band loop is a ROLLED lax.scan (blockwise's band
+    structure — one compiled body); the k-block loop inside each band is a
+    STATIC UNROLL. Not an accident: a nested scan-in-scan whose inner xs
+    mix outer-trace constants with band tracers trips an UnexpectedTracer
+    bug under jax.ensure_compile_time_eval on jax 0.4.x (the gate's
+    execution context), and the unrolled inner body is also what lets XLA
+    software-pipeline the next block's K/V fetch behind the current tile's
+    compute — the measured TMR_GLOBAL_BANDS_UNROLL lesson applied here by
+    construction.
+    """
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    work = q.dtype
+    rows_q = _band_rows(gh, gw, _env_tokens("TMR_XLA_FLASH_BQ", 512))
+    rows_k = _band_rows(gh, gw, _env_tokens("TMR_XLA_FLASH_BK", 512))
+    nqb, nkb = gh // rows_q, gh // rows_k
+    bq, bk = rows_q * gw, rows_k * gw
+    neg = jnp.float32(-1e30)
+
+    q_blocks = jnp.moveaxis(q.reshape(B, H, nqb, bq, D), 2, 0)
+
+    if rh is not None:
+        qf = q.reshape(B, H, gh, gw, D).astype(jnp.float32)
+        rel_h_q = jnp.einsum(
+            "bhywd,ykd->bhywk", qf, rh.astype(jnp.float32)
+        ).reshape(B, H, nqb, bq, gh)
+        rel_w_q = jnp.einsum(
+            "bhywd,wkd->bhywk", qf, rw.astype(jnp.float32)
+        ).reshape(B, H, nqb, bq, gw)
+        rel_h_blocks = jnp.moveaxis(rel_h_q, 2, 0)  # (nqb, B, H, bq, gh)
+        rel_w_blocks = jnp.moveaxis(rel_w_q, 2, 0)  # (nqb, B, H, bq, gw)
+    else:
+        rel_h_blocks = jnp.zeros((nqb, 0), jnp.float32)
+        rel_w_blocks = jnp.zeros((nqb, 0), jnp.float32)
+
+    def one_band(args):
+        qb, rhb, rwb = args  # (B, H, bq, D) + the band's bias projections
+        m = jnp.full((B, H, bq, 1), neg, jnp.float32)
+        l = jnp.zeros((B, H, bq, 1), jnp.float32)
+        acc = jnp.zeros((B, H, bq, v.shape[-1]), jnp.float32)
+        for ikb in range(nkb):
+            # static slices of the RAW q/k/v arguments, not of a reshaped
+            # intermediate: a scan body may close over argument tracers
+            # (blockwise does), but closing over an intermediate leaks
+            # under the gate's ensure_compile_time_eval on jax 0.4.x
+            kb = k[:, :, ikb * bk:(ikb + 1) * bk]
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk", qb, kb,
+                preferred_element_type=jnp.float32,
+            ) * scale  # (B, H, bq, bk) f32
+            if rh is not None:
+                # bias tile from the block index offsets: key token
+                # j = ky*gw + kx, so over the row-aligned block the rel-h
+                # column repeats gw-wide and the rel-w row tiles rows_k
+                # times — broadcast + reshape, no gather, no one-hots.
+                # This block's keys cover rows [ikb*rows_k, (ikb+1)*rows_k)
+                # of the rel-h projection — a static column slice.
+                rhk = rhb[..., ikb * rows_k:(ikb + 1) * rows_k]
+                s = s.reshape(B, H, bq, rows_k, gw)
+                s = s + rhk[..., :, None] + rwb[..., None, :]
+                s = s.reshape(B, H, bq, bk)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)  # (B, H, bq, bk) f32
+            l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(work),
+                v[:, :, ikb * bk:(ikb + 1) * bk],
+                preferred_element_type=jnp.float32,
+            )
+            m = m_new
+        return (acc / l).astype(work)
+
+    # scan, not lax.map: same rolled schedule, but lax.map's internal
+    # dispatch leaks tracers under the gate's ensure_compile_time_eval on
+    # jax 0.4.x where this scan spelling (blockwise's) does not
+    out = jax.lax.scan(
+        lambda c, x: (c, one_band(x)), (),
+        (q_blocks, rel_h_blocks, rel_w_blocks),
+    )[1]
+    return jnp.moveaxis(out, 0, 2).reshape(B, H, S, v.shape[-1])
+
+
+@functools.lru_cache(maxsize=None)
+def xlaflash_ok(gh: int, gw: int, head_dim: int) -> bool:
+    """Per-geometry compiled self-check of the XLA online-softmax flash
+    path. Pure XLA — any backend, Pallas kill-switch exempt — and gated
+    only under bf16 models (in f32 the online softmax differs from the
+    oracle by float reassociation alone, the same freedom the compiler
+    already has over the blockwise schedule). Same PARITY.md contract as
+    blockfolded_ok: every selectable formulation pins to the blockwise
+    oracle before it can trace."""
+    return _self_check(xla_flash_decomposed_attention, 1, 2, gh, gw,
+                       head_dim, require_tpu=False, gate="xlaflash_ok")
+
+
 def _self_check(
     attn_fn, B: int, H: int, gh: int, gw: int, D: int,
     require_tpu: bool = True,
+    gate: Optional[str] = None,
+    config: Optional[dict] = None,
 ) -> bool:
     """Shared compiled self-check: run ``attn_fn`` (a flash-path callable
     with the (q, k, v, rh, rw, grid_hw, scale) signature) against the exact
@@ -224,18 +378,34 @@ def _self_check(
     ``jax.ensure_compile_time_eval()`` — concrete values, real compiled
     executions, no leakage into the ambient trace.
 
-    ``TMR_GATE_DEBUG=1`` reports every refusal's concrete reason (backend,
-    kill-switch, forward/grad relative error, or the swallowed exception)
-    to stderr — the gate's False is otherwise indistinguishable from any
-    of those causes, which matters when diagnosing why a kernel that
-    should win never runs on a given backend.
+    Every refusal records a STRUCTURED cause (diagnostics.record_gate_
+    refusal: category, swallowed exception class + message, the gate's
+    ``gate`` name and ``config`` — its cache key made explicit — plus the
+    device kind) so "Mosaic can't lower this", "kernel miscompiles
+    numerically", and "wrong backend" stay distinguishable after the fact
+    (round-5 verdict #1). ``TMR_GATE_DEBUG=1`` additionally mirrors each
+    reason to stderr for interactive runs.
     """
-    def _refused(reason: str) -> bool:
+    from tmr_tpu.diagnostics import record_gate_refusal
+
+    gate_name = gate or getattr(attn_fn, "__name__", str(attn_fn))
+    gate_config = {
+        "B": B, "H": H, "gh": gh, "gw": gw, "head_dim": D,
+        **(config or {}),
+    }
+
+    def _refused(
+        reason: str, cause: str = "exception", exception: Optional[str] = None
+    ) -> bool:
+        record_gate_refusal(
+            gate_name, cause, message=reason, exception=exception,
+            config=gate_config,
+        )
         if os.environ.get("TMR_GATE_DEBUG"):
             import sys
 
             print(
-                f"[gate] {getattr(attn_fn, '__name__', attn_fn)} "
+                f"[gate] {gate_name} "
                 f"B{B} H{H} {gh}x{gw} D{D}: refused — {reason}",
                 file=sys.stderr,
             )
@@ -243,15 +413,35 @@ def _self_check(
 
     if require_tpu:
         if os.environ.get("TMR_NO_FLASH_ATTN"):
-            return _refused("TMR_NO_FLASH_ATTN kill-switch")
+            return _refused("TMR_NO_FLASH_ATTN kill-switch",
+                            cause="kill-switch")
         if jax.default_backend() != "tpu":
-            return _refused(f"backend {jax.default_backend()!r} != 'tpu'")
+            return _refused(f"backend {jax.default_backend()!r} != 'tpu'",
+                            cause="backend")
+    import contextlib
+
     import numpy as np
 
     from tmr_tpu.models.vit import blockwise_decomposed_attention
 
+    # ensure_compile_time_eval exists to keep the check's concrete values
+    # out of an AMBIENT trace (Attention.__call__ runs under jit). At top
+    # level (tests, gate_probe, the autotune sweeps between traces) it must
+    # NOT be entered: on jax 0.4.x it switches jit to eager trace-eval,
+    # where lax.scan's output stacking hits "Evaluation rule for 'empty'
+    # not implemented" — which silently turned EVERY scan-based gate
+    # (blockfolded/densefolded/xlaflash) into a constant False off-trace.
+    # When the introspection API is missing (future jax), default to
+    # entering it — the prior behavior, and harmless where the eval bug
+    # is fixed.
+    _clean = getattr(jax.core, "trace_state_clean", None)
+    ect = (
+        contextlib.nullcontext()
+        if _clean is not None and _clean()
+        else jax.ensure_compile_time_eval()
+    )
     try:
-        with jax.ensure_compile_time_eval():
+        with ect:
             rng = np.random.default_rng(0)
             S = gh * gw
             q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
@@ -279,7 +469,8 @@ def _self_check(
             # would let NaN through, since both comparisons are False on NaN
             if not (err / scale_ref < 0.05):
                 return _refused(
-                    f"forward rel err {err / scale_ref:.4g} >= 0.05"
+                    f"forward rel err {err / scale_ref:.4g} >= 0.05",
+                    cause="forward-mismatch",
                 )
 
             # the TRAIN step differentiates through whichever path is
@@ -305,7 +496,8 @@ def _self_check(
                 rel = np.abs(a - b).max() / (np.abs(b).max() + 1e-6)
                 if not (rel < 0.05):
                     return _refused(
-                        f"grad arg {i} rel err {rel:.4g} >= 0.05"
+                        f"grad arg {i} rel err {rel:.4g} >= 0.05",
+                        cause="grad-mismatch",
                     )
             return True
     except Exception as e:
@@ -313,7 +505,8 @@ def _self_check(
             import traceback
 
             traceback.print_exc()
-        return _refused(f"{type(e).__name__}: {e}")
+        return _refused(f"{type(e).__name__}: {e}", cause="exception",
+                        exception=type(e).__name__)
 
 
 @functools.lru_cache(maxsize=None)
@@ -332,9 +525,9 @@ def blockfolded_ok(
     the other; same pattern as pallas_global_ok's tile params)."""
     from tmr_tpu.models.vit import blockfolded_decomposed_attention
 
-    del scores  # cache key only; the env the caller resolved from is live
     return _self_check(blockfolded_decomposed_attention, 1, 2, gh, gw,
-                       head_dim, require_tpu=False)
+                       head_dim, require_tpu=False, gate="blockfolded_ok",
+                       config={"scores": scores})
 
 
 @functools.lru_cache(maxsize=None)
@@ -347,9 +540,9 @@ def densefolded_ok(
     different XLA program."""
     from tmr_tpu.models.vit import densefolded_decomposed_attention
 
-    del scores  # cache key only; the env the caller resolved from is live
     return _self_check(densefolded_decomposed_attention, 1, 2, gh, gw,
-                       head_dim, require_tpu=False)
+                       head_dim, require_tpu=False, gate="densefolded_ok",
+                       config={"scores": scores})
 
 
 @functools.lru_cache(maxsize=None)
@@ -358,7 +551,8 @@ def flash_window_ok(gh: int, gw: int, head_dim: int) -> bool:
     caller passes the ACTUAL window grid and head dim it is about to run
     (14x14/64 in production; any other geometry gets its own checked entry,
     so an unvalidated shape can never bypass the fallback-to-dense gate)."""
-    return _self_check(flash_windowed_attention, 2, 2, gh, gw, head_dim)
+    return _self_check(flash_windowed_attention, 2, 2, gh, gw, head_dim,
+                       gate="flash_window_ok")
 
 
 @functools.lru_cache(maxsize=None)
@@ -374,4 +568,5 @@ def flash_attention_ok(
     batch/heads (grid/blocks/d are what Mosaic failures key on). A
     config-specific failure must trip inside the check, not in the model
     trace."""
-    return _self_check(flash_decomposed_attention, 1, 2, gh, gw, head_dim)
+    return _self_check(flash_decomposed_attention, 1, 2, gh, gw, head_dim,
+                       gate="flash_attention_ok")

@@ -35,6 +35,7 @@ implementation (no handwritten flash backward to validate).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -43,6 +44,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def _tpu_compiler_params(dimension_semantics: Tuple[str, ...]):
+    """pltpu.CompilerParams across jax versions: renamed from
+    TPUCompilerParams in newer releases. The old name must keep working —
+    on jax 0.4.x the new-name AttributeError made every pallas_call here
+    raise at trace time, which the gates dutifully (and silently, before
+    the structured diagnostics) converted into a permanent fallback."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=dimension_semantics)
 
 
 def _attn_kernel(
@@ -171,6 +182,52 @@ def effective_global_tiles(
     )
 
 
+def _fused_block(seq_len: int, gw: int, preferred: int) -> Optional[int]:
+    """Tile size for the FUSED kernel: the largest multiple of
+    lcm(gw, 128) at or below ``preferred`` that divides ``seq_len``.
+
+    Double alignment is the kernel's whole trick: 128 keeps every tile
+    edge on a v5e lane boundary, and gw keeps every tile edge on a token-
+    grid ROW boundary — so within one (bq, bk) tile the key row index is
+    ``block_index * rk + (lane // gw)`` and the key column cycles
+    0..gw-1, letting the decomposed bias assemble from the (q, k) block
+    offsets by broadcast + reshape alone (no selector one-hot matmuls, no
+    gathers). None when no such tile exists (gate on fused_supported)."""
+    base = gw * 128 // math.gcd(gw, 128)
+    b = (preferred // base) * base
+    while b >= base:
+        if seq_len % b == 0:
+            return b
+        b -= base
+    return None
+
+
+def fused_supported(seq_len: int, gw: int) -> bool:
+    """True when row+lane-aligned tiles exist for this grid (production:
+    4096 tokens @ gw 64 -> 512-token tiles; 9216 @ 96 -> 384)."""
+    if seq_len % max(gw, 1):
+        return False
+    return (
+        _fused_block(seq_len, gw, _env_tile("TMR_PALLAS_ATTN_BQ", 512))
+        is not None
+        and _fused_block(seq_len, gw, _env_tile("TMR_PALLAS_ATTN_BK", 512))
+        is not None
+    )
+
+
+def effective_fused_tiles(
+    seq_len: int, gw: int
+) -> Tuple[Optional[int], Optional[int]]:
+    """effective_global_tiles' sibling for the fused kernel: the (bq, bk)
+    the fused forward will actually trace with under the current
+    TMR_PALLAS_ATTN_BQ/BK preferences. Callers of ``pallas_fused_ok`` MUST
+    pass these — the gate verdict is cached per tile config."""
+    return (
+        _fused_block(seq_len, gw, _env_tile("TMR_PALLAS_ATTN_BQ", 512)),
+        _fused_block(seq_len, gw, _env_tile("TMR_PALLAS_ATTN_BK", 512)),
+    )
+
+
 def pallas_decomposed_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -257,8 +314,8 @@ def _pallas_attn_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_tpu_compiler_params(
+            ("parallel", "parallel", "arbitrary")
         ),
         interpret=jax.default_backend() != "tpu",
     )(*inputs)
@@ -388,9 +445,7 @@ def _pallas_win_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
         ],
         out_specs=pl.BlockSpec((g, s_pad, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
+        compiler_params=_tpu_compiler_params(("parallel",)),
         interpret=jax.default_backend() != "tpu",
     )(
         qp.reshape(bh, s_pad, D), kp.reshape(bh, s_pad, D),
@@ -435,7 +490,8 @@ def pallas_window_ok(
     from tmr_tpu.ops.flash_attn import _self_check
 
     return _self_check(
-        pallas_windowed_attention, group, 1, gh, gw, head_dim
+        pallas_windowed_attention, group, 1, gh, gw, head_dim,
+        gate="pallas_window_ok", config={"group": group},
     )
 
 
@@ -458,8 +514,10 @@ def pallas_global_ok(
     gate — mirroring pallas_window_ok's ``group`` parameter)."""
     from tmr_tpu.ops.flash_attn import _self_check
 
-    del bq, bk  # cache key only; the env the caller resolved from is live
-    return _self_check(pallas_decomposed_attention, 1, 2, gh, gw, head_dim)
+    # (bq, bk) are cache key only — the env the caller resolved them from
+    # is live during the check — but they also label the refusal record
+    return _self_check(pallas_decomposed_attention, 1, 2, gh, gw, head_dim,
+                       gate="pallas_global_ok", config={"bq": bq, "bk": bk})
 
 
 def _vjp_fwd(q, k, v, rh, rw, grid_hw, scale):
@@ -489,3 +547,188 @@ def _vjp_bwd(grid_hw, scale, res, g):
 
 
 _pallas_attn_vjp.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# --------------------------------------------------------------------------
+# Fused rel-pos flash kernel (TMR_GLOBAL_ATTN=fused): v5e-shaped tiles.
+#
+# The original kernel above expands the bias per tile with TWO one-hot
+# selector matmuls, (BQ, gh)x(gh, BK) + (BQ, gw)x(gw, BK) — at the
+# production shape (BQ=BK=512, gh=gw=64, D=64) that is 2x the MXU work of
+# the actual QK contraction, i.e. the bias expansion TRIPLES the matmul
+# FLOPs of a kernel whose problem is already ~4% MXU efficiency. This
+# variant makes the expansion free: tiles are aligned to BOTH the 128-lane
+# boundary and the token-grid rows (_fused_block), so inside a (bq, bk)
+# tile the key's grid position is a pure function of the (q, k) BLOCK
+# OFFSETS — key row = ik*rk + (lane // gw), key column = lane % gw — and
+# the decomposed bias assembles from the small f32 q-projections by
+# broadcast + reshape ONLY. No selector matmuls, no gathers, no iota, no
+# (S, S) anything; the only MXU work is the native-head-dim QK and AV.
+#
+# The rel-h projection's gh axis is block-sliced BY THE K INDEX (BlockSpec
+# (1, bq, rk) indexed (b, iq, ik)), so Pallas's own block pipeline delivers
+# exactly the rk bias columns this tile needs — the "(q, k) index offsets"
+# are the block indices themselves.
+# --------------------------------------------------------------------------
+def _fused_attn_kernel(
+    q_ref, k_ref, v_ref, rhq_ref, rwq_ref, out_ref,
+    m_ref, l_ref, acc_ref,
+    *, scale: float, gw: int, nk: int,
+):
+    """One (batch*head, q-block, k-block) step, row+lane-aligned tiles.
+
+    Refs (VMEM blocks): q (1, BQ, D), k/v (1, BK, D), rhq (1, BQ, rk) —
+    the ik-th rk-wide column strip of the rel-h projection — rwq
+    (1, BQ, gw), out (1, BQ, D); scratch m/l (BQ, 128) f32 running
+    max/denominator (lane-broadcast), acc (BQ, D) f32 running numerator.
+    """
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    rk = rhq_ref.shape[-1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (BQ, BK)
+    # bias tile by broadcast alone: key j of this block sits at grid row
+    # (ik*rk + j//gw) — column j//gw of the rhq strip — and grid column
+    # j % gw — column j % gw of rwq. Both index patterns are the row-major
+    # layout itself, so a (BQ, rk, gw) view lines them up exactly.
+    s = s.reshape(bq, rk, gw)
+    s = s + rhq_ref[0].astype(jnp.float32)[:, :, None]
+    s = s + rwq_ref[0].astype(jnp.float32)[:, None, :]
+    s = s.reshape(bq, bk)
+
+    m_prev = m_ref[:, :1]  # (BQ, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)  # (BQ, 1)
+    p = jnp.exp(s - m_new)  # (BQ, BK) f32
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        out_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(out_ref.dtype)
+
+
+def pallas_fused_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    rh: Optional[jnp.ndarray],
+    rw: Optional[jnp.ndarray],
+    grid_hw: Tuple[int, int],
+    scale: float,
+) -> jnp.ndarray:
+    """Drop-in for blockwise_decomposed_attention running the fused-bias
+    kernel above (q/k/v (B, H, S, D), rh (gh, gh, D) / rw (gw, gw, D)
+    tables). bf16 inputs keep f32 accumulators and a full-f32 bias path,
+    exactly like the blockwise oracle. Differentiable: the backward
+    recomputes through the exact blockwise formulation (module docstring).
+    With ``rh`` None there is no bias to fuse — the original no-bias
+    kernel is already optimal and is reused. Off-TPU the kernel runs in
+    the Pallas interpreter (CPU tests); production gates on
+    ``fused_supported`` + ``pallas_fused_ok``."""
+    if rh is None:
+        return pallas_decomposed_attention(q, k, v, None, None, grid_hw,
+                                           scale)
+    return _pallas_fused_vjp(q, k, v, rh, rw, grid_hw, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _pallas_fused_vjp(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_fused_fwd_impl(q, k, v, rh, rw, grid_hw, scale)
+
+
+def _pallas_fused_fwd_impl(q, k, v, rh, rw, grid_hw, scale):
+    B, H, S, D = q.shape
+    gh, gw = grid_hw
+    bq = _fused_block(S, gw, _env_tile("TMR_PALLAS_ATTN_BQ", 512))
+    bk = _fused_block(S, gw, _env_tile("TMR_PALLAS_ATTN_BK", 512))
+    if bq is None or bk is None:
+        raise ValueError(
+            f"grid ({gh}, {gw}) has no row+lane-aligned tile; gate callers "
+            "on fused_supported()"
+        )
+    bh = B * H
+    nq, nk = S // bq, S // bk
+    rk = bk // gw  # grid rows per k block; gh == nk * rk by construction
+    rel_h_q, rel_w_q = _bias_projections(q, rh, rw, grid_hw)
+    out = pl.pallas_call(
+        functools.partial(_fused_attn_kernel, scale=scale, gw=gw, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+            # the k index slices the PROJECTION's gh axis: strip ik holds
+            # bias columns for exactly the grid rows k-block ik covers
+            pl.BlockSpec((1, bq, rk), lambda b, iq, ik: (b, iq, ik)),
+            pl.BlockSpec((1, bq, gw), lambda b, iq, ik: (b, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=_tpu_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(
+        q.reshape(bh, S, D), k.reshape(bh, S, D), v.reshape(bh, S, D),
+        rel_h_q, rel_w_q,
+    )
+    return out.reshape(B, H, S, D)
+
+
+def _fused_vjp_fwd(q, k, v, rh, rw, grid_hw, scale):
+    return _pallas_fused_fwd_impl(q, k, v, rh, rw, grid_hw, scale), (
+        q, k, v, rh, rw,
+    )
+
+
+def _fused_vjp_bwd(grid_hw, scale, res, g):
+    from tmr_tpu.models.vit import blockwise_decomposed_attention
+
+    q, k, v, rh, rw = res
+    _, pull = jax.vjp(
+        lambda a, b, c, d, e: blockwise_decomposed_attention(
+            a, b, c, d, e, grid_hw, scale),
+        q, k, v, rh, rw,
+    )
+    return pull(g)
+
+
+_pallas_fused_vjp.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_fused_ok(
+    gh: int, gw: int, head_dim: int, bq: int, bk: int
+) -> bool:
+    """Per-geometry compiled self-check of the fused kernel against the
+    exact blockwise oracle — pallas_global_ok's twin for the fused
+    variant, with the same contract: ``(bq, bk)`` must be the EFFECTIVE
+    tiles (effective_fused_tiles) so a verdict under one tile config never
+    vouches for another, and a tile-specific Mosaic failure trips here
+    with a structured cause, not in the model trace."""
+    from tmr_tpu.ops.flash_attn import _self_check
+
+    return _self_check(pallas_fused_attention, 1, 2, gh, gw, head_dim,
+                       gate="pallas_fused_ok", config={"bq": bq, "bk": bk})

@@ -117,7 +117,15 @@ def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
     beyond f32 tolerance -> False (dispatcher falls back to the conv
     lowering). TMR_NO_PALLAS_XCORR=1 force-disables.
     """
-    def _refused(reason: str) -> bool:
+    def _refused(
+        reason: str, cause: str = "exception", exception=None
+    ) -> bool:
+        from tmr_tpu.diagnostics import record_gate_refusal
+
+        record_gate_refusal(
+            "pallas_xcorr_ok", cause, message=reason, exception=exception,
+            config={"C": C, "H": H, "W": W, "T": T},
+        )
         if os.environ.get("TMR_GATE_DEBUG"):
             import sys
 
@@ -128,11 +136,14 @@ def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
         return False
 
     if os.environ.get("TMR_NO_PALLAS_XCORR"):
-        return _refused("TMR_NO_PALLAS_XCORR kill-switch")
+        return _refused("TMR_NO_PALLAS_XCORR kill-switch",
+                        cause="kill-switch")
     if T > MAX_UNROLL_T:
-        return _refused(f"T {T} > MAX_UNROLL_T {MAX_UNROLL_T}")
+        return _refused(f"T {T} > MAX_UNROLL_T {MAX_UNROLL_T}",
+                        cause="unsupported-shape")
     if jax.default_backend() != "tpu":
-        return _refused(f"backend {jax.default_backend()!r} != 'tpu'")
+        return _refused(f"backend {jax.default_backend()!r} != 'tpu'",
+                        cause="backend")
     cb = _CB if C % _CB == 0 else 1
     key = (cb, H, W, T)
     if key in _OK_CACHE:
@@ -166,13 +177,15 @@ def pallas_xcorr_ok(C: int, H: int, W: int, T: int) -> bool:
             rel = np.abs(got - want).max() / scale
             ok = bool(rel < 5e-5)
             if not ok:
-                _refused(f"rel err {rel:.4g} >= 5e-5")
+                _refused(f"rel err {rel:.4g} >= 5e-5",
+                         cause="forward-mismatch")
     except Exception as e:
         if os.environ.get("TMR_GATE_DEBUG"):
             import traceback
 
             traceback.print_exc()
-        _refused(f"{type(e).__name__}: {e}")
+        _refused(f"{type(e).__name__}: {e}", cause="exception",
+                 exception=type(e).__name__)
         ok = False
     _OK_CACHE[key] = ok
     return ok

@@ -30,10 +30,32 @@ from tmr_tpu.utils.profiling import chained_seconds_per_iter, measure_rtt_floor
 XCORR_VARIANTS = ("conv", "convnhwc", "vmap", "fft", "pallas")
 WIN_ATTN_VARIANTS = ("dense", "folded", "flash", "pallas")
 GLOBAL_ATTN_VARIANTS = (
-    "blockwise", "flash", "blockfolded", "densefolded", "pallas"
+    "blockwise", "flash", "blockfolded", "densefolded", "pallas",
+    "fused", "xlaflash",
 )
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 GLOBAL_SCORES_DTYPES = ("f32", "bf16")
+
+#: structured gate-refusal causes captured by the LAST sweep of each env
+#: knob, keyed {env_var: {annotated_row_label: [cause dicts]}} — populated
+#: by the sweep harnesses from diagnostics.drain_gate_refusals() whenever
+#: a variant's timing was recorded fallback-annotated, attached by
+#: autotune() to the report entry (and from there to bench.py's JSON), so
+#: a "(fallback)" row always travels with WHY the requested kernel refused
+LAST_SWEEP_REFUSALS: Dict[str, Dict[str, list]] = {}
+
+
+def _attach_refusals(
+    report: Dict[str, object], knob: str, sweep_env: Optional[str] = None
+) -> None:
+    """Copy the last sweep's structured refusal causes into ``report[knob]``
+    (under "refusals") when any fallback-annotated row recorded one.
+    ``sweep_env`` names the env var the harness actually swept when it
+    differs from the report knob (the xcorr impl sweep pins
+    TMR_XCORR_IMPL but reports TMR_XCORR_IMPL_SMALL)."""
+    ref = LAST_SWEEP_REFUSALS.get(sweep_env or knob)
+    if ref and knob in report:
+        report[knob]["refusals"] = {k: list(v) for k, v in ref.items()}
 
 #: suffix marking a sweep entry whose timing measured a gate-refused
 #: variant's FALLBACK formulation, not the labeled one. Single source of
@@ -44,11 +66,15 @@ FALLBACK_SUFFIX = " (fallback)"
 
 #: bumped when a sweep harness changes in a way that invalidates
 #: previously cached winners (folded into _variants_sig, so every stale
-#: entry re-sweeps at the next hardware window). "fallback-label":
-#: pre-revision sweeps could record a gate-refused variant's fallback
-#: timing under the requested label and crown it — such poisoned winners
-#: (seed or user cache) must not survive as cached hits.
-_SWEEP_REV = "fallback-label"
+#: entry re-sweeps at the next hardware window). History: "fallback-label"
+#: — pre-revision sweeps could record a gate-refused variant's fallback
+#: timing under the requested label and crown it. "fused-relpos" — the
+#: fused Pallas kernel and the XLA online-softmax flash path joined
+#: GLOBAL_ATTN_VARIANTS, and the jax-version CompilerParams fix plus the
+#: off-trace gate repair (flash_attn._self_check) mean every previously
+#: refused kernel row may now genuinely compile: stale cached winners must
+#: re-record at the next hardware window.
+_SWEEP_REV = "fused-relpos"
 
 
 def _sweep_xcorr_env(
@@ -69,7 +95,10 @@ def _sweep_xcorr_env(
     import jax.numpy as jnp
     import numpy as np
 
-    from tmr_tpu.diagnostics import FormulationFallbackWarning
+    from tmr_tpu.diagnostics import (
+        FormulationFallbackWarning,
+        drain_gate_refusals,
+    )
     from tmr_tpu.ops.xcorr import match_templates
 
     rng = np.random.default_rng(0)
@@ -80,12 +109,15 @@ def _sweep_xcorr_env(
                   (batch, 1))
     rtt = measure_rtt_floor() if rtt is None else rtt
     times: Dict[str, float] = {}
+    refusals = LAST_SWEEP_REFUSALS.setdefault(env_var, {})
+    refusals.clear()
     prev = os.environ.get(env_var)
     try:
         for variant in variants:
             if variant in skip:
                 continue
             os.environ[env_var] = variant
+            drain_gate_refusals()  # discard causes from earlier traces
 
             if train:
                 def loss_fn(f, e):
@@ -114,6 +146,7 @@ def _sweep_xcorr_env(
                     log(f"autotune: {env_var}[{variant}] failed: "
                         f"{type(e).__name__}: {e}")
             _reemit_unrelated(caught, env_var)
+            caused = drain_gate_refusals()
             if t is None:
                 continue
             if any(
@@ -124,6 +157,8 @@ def _sweep_xcorr_env(
                 log(f"autotune: {env_var}[{variant}] gate-refused; timed "
                     "the fallback formulation — recording annotated")
                 times[variant + FALLBACK_SUFFIX] = t
+                if caused:
+                    refusals[variant + FALLBACK_SUFFIX] = caused
             else:
                 times[variant] = t
     finally:
@@ -245,7 +280,10 @@ def _sweep_block_env(
     import jax.numpy as jnp
     import numpy as np
 
-    from tmr_tpu.diagnostics import FormulationFallbackWarning
+    from tmr_tpu.diagnostics import (
+        FormulationFallbackWarning,
+        drain_gate_refusals,
+    )
     from tmr_tpu.models.vit import Block
 
     import warnings
@@ -256,10 +294,13 @@ def _sweep_block_env(
     )
     rtt = measure_rtt_floor() if rtt is None else rtt
     times: Dict[str, float] = {}
+    refusals = LAST_SWEEP_REFUSALS.setdefault(env_var, {})
+    refusals.clear()
     prev = os.environ.get(env_var)
     try:
         for impl in variants:
             os.environ[env_var] = impl
+            drain_gate_refusals()  # discard causes from earlier traces
             blk = Block(num_heads=num_heads, window_size=window_size,
                         rel_pos_size=(grid, grid), dtype=jnp.bfloat16)
 
@@ -302,6 +343,7 @@ def _sweep_block_env(
                     log(f"autotune: {env_var}[{impl}] failed: "
                         f"{type(e).__name__}: {e}")
             _reemit_unrelated(caught, env_var)
+            caused = drain_gate_refusals()
             if t is None:
                 continue
             # ``also_fallback_envs``: a sub-knob sweep (scores dtype under
@@ -317,6 +359,8 @@ def _sweep_block_env(
                 log(f"autotune: {env_var}[{impl}] gate-refused; timed the "
                     "fallback formulation — recording annotated")
                 times[impl + FALLBACK_SUFFIX] = t
+                if caused:
+                    refusals[impl + FALLBACK_SUFFIX] = caused
             else:
                 times[impl] = t
     finally:
@@ -416,10 +460,7 @@ def stale_winners(
     silently downgrades the banked wedge-fallback number to whatever the
     ungated default formulation happens to be (e.g. the 21 img/s
     blockfolded headline banking at ~11 img/s under blockwise)."""
-    vit_kind = {"sam": "vit_h", "sam_vit_h": "vit_h", "sam_vit_b": "vit_b"}.get(
-        cfg.backbone
-    )
-    key = _cache_key(cfg, image_size, batch, vit_kind, train)
+    key = _cache_key(cfg, image_size, batch, _vit_kind(cfg), train)
     cached = _cache_load().get(key, {})
     out: Dict[str, str] = {}
     for knob in _VERSIONED_KNOBS:
@@ -548,18 +589,22 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         "TMR_GLOBAL_SCORES_DTYPE": set(GLOBAL_SCORES_DTYPES),
         "TMR_WIN_SCORES_DTYPE": set(GLOBAL_SCORES_DTYPES),
         # metadata, not an env knob: which global formulation the scores-
-        # dtype winner was measured under (evidence is impl-specific)
-        "_scores_global_impl": set(GLOBAL_ATTN_VARIANTS),
+        # dtype winner was measured under (evidence is impl-specific).
+        # "auto" is a legal pairing — a TMR_GLOBAL_ATTN=auto run records
+        # its scores-dtype evidence under that resolution, and dropping it
+        # here would strip the stamp on reload and re-record the pairing
+        # forever (cache churn on every launch)
+        "_scores_global_impl": set(GLOBAL_ATTN_VARIANTS) | {"auto"},
         # metadata, not an env knob: which impl the precision winner was
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
     }
     # measured throughput-optimal eval batch (bench_extra's batch sweep),
-    # the Pallas windowed-kernel group, and the band-scan unroll — positive
-    # ints as strings
+    # the Pallas windowed-kernel group, the band-scan unroll, and the XLA
+    # flash block targets — positive ints as strings
     digit_keys = {
         "TMR_BENCH_BATCH", "TMR_PALLAS_WIN_GROUP",
-        "TMR_GLOBAL_BANDS_UNROLL",
+        "TMR_GLOBAL_BANDS_UNROLL", "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK",
     }
     # global-kernel tile preferences: powers of two >= 128 (the contract
     # _env_tile enforces at read time — an off-contract seed value would
@@ -718,7 +763,8 @@ def autotune(
     # alongside a different winner is inert.
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
                  "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
-                 "TMR_WIN_SCORES_DTYPE"):
+                 "TMR_WIN_SCORES_DTYPE", "TMR_XLA_FLASH_BQ",
+                 "TMR_XLA_FLASH_BK"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
@@ -827,6 +873,8 @@ def autotune(
             best = min(pickable, key=pickable.get)
             os.environ["TMR_XCORR_IMPL_SMALL"] = best
             report["TMR_XCORR_IMPL_SMALL"] = {"picked": best, "times": times}
+            _attach_refusals(report, "TMR_XCORR_IMPL_SMALL",
+                             "TMR_XCORR_IMPL")
             log(f"autotune: TMR_XCORR_IMPL_SMALL={best} {times}")
 
     if "TMR_XCORR_PRECISION" in wanted:
@@ -860,6 +908,7 @@ def autotune(
                 os.environ["TMR_XCORR_PRECISION"] = best
                 report["TMR_XCORR_PRECISION"] = {"picked": best,
                                                  "times": times}
+                _attach_refusals(report, "TMR_XCORR_PRECISION")
 
     for knob, picker in (
         ("TMR_WIN_ATTN", pick_win_attn_impl),
@@ -877,6 +926,7 @@ def autotune(
             best = min(pickable, key=pickable.get)
             os.environ[knob] = best
             report[knob] = {"picked": best, "times": times}
+            _attach_refusals(report, knob)
             log(f"autotune: {knob}={best} {times}")
 
     if "TMR_GLOBAL_SCORES_DTYPE" in wanted:
@@ -900,6 +950,7 @@ def autotune(
             os.environ["TMR_GLOBAL_SCORES_DTYPE"] = best
             report["TMR_GLOBAL_SCORES_DTYPE"] = {"picked": best,
                                                  "times": times}
+            _attach_refusals(report, "TMR_GLOBAL_SCORES_DTYPE")
 
     if report:
         extra = {}

@@ -31,6 +31,32 @@ WATCHDOG_MSG = (
 )
 
 
+def scrub_cpu_tunnel_env(environ=None) -> bool:
+    """Tunnel-client discipline, encoded: a JAX_PLATFORMS=cpu-intended
+    process must NEVER dial the TPU relay. The axon sitecustomize registers
+    the tunneled backend whenever PALLAS_AXON_POOL_IPS is set — a stray
+    dial from a "CPU" helper process wedges the single-client tunnel for
+    every real bench stage behind it (the session-7 10-hour wedge; PERF.md).
+    When the env requests cpu-only platforms, drop PALLAS_AXON_POOL_IPS so
+    the relay cannot be touched even by init paths that ignore
+    JAX_PLATFORMS ordering. Call BEFORE the first ``import jax``.
+
+    Returns True when the variable was stripped. A mixed or TPU-intending
+    JAX_PLATFORMS (or an unset one) leaves the env alone — only an
+    unambiguous cpu-only intent is safe to act on.
+    """
+    env = os.environ if environ is None else environ
+    plats = [
+        p.strip().lower()
+        for p in env.get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    ]
+    if plats and all(p == "cpu" for p in plats) and "PALLAS_AXON_POOL_IPS" in env:
+        del env["PALLAS_AXON_POOL_IPS"]
+        return True
+    return False
+
+
 def run_guarded(
     run_fn: Callable[[Callable[[], None]], Optional[int]],
     emit_error: Callable[[str], None],

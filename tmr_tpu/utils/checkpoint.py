@@ -22,6 +22,24 @@ import jax
 import orbax.checkpoint as ocp
 
 
+def _host_leaf(x: Any) -> Any:
+    """The restore host-roundtrip for ONE leaf: fully-addressable arrays
+    come back as host numpy (dropping orbax's committed-sharding
+    annotations — the measured 9.2x eval fix, see ``restore``), while
+    multi-host/sharded leaves whose shards live partly on other processes
+    pass through untouched: ``np.asarray`` on a non-fully-addressable
+    array RAISES, which used to abort every multi-host / pipeline-mesh
+    resume. Those arrays keep their shardings — which is also correct:
+    a sharded restore target needs them to stay sharded."""
+    import numpy as np
+
+    if not hasattr(x, "shape"):
+        return x
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return x
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -96,21 +114,21 @@ class CheckpointManager:
         """Restore a full train state (optimizer/step included) for resume,
         or params-only when ``target`` is a params tree.
 
-        Leaves come back as HOST numpy arrays, on purpose: orbax restore
-        can return committed device arrays whose sharding annotations
-        pessimize every downstream compiled program — measured on TPU v5
-        lite as a 9.2x eval slowdown for a restored checkpoint vs the same
-        params round-tripped through host (`ckpt_probe.json`: 5733 vs
-        398 ms/batch; PERF.md 2026-08-01). Staging back to device is the
-        caller's normal jit/device_put path, which re-lays them out like
-        any fresh arrays.
+        Fully-addressable leaves come back as HOST numpy arrays, on
+        purpose: orbax restore can return committed device arrays whose
+        sharding annotations pessimize every downstream compiled program —
+        measured on TPU v5 lite as a 9.2x eval slowdown for a restored
+        checkpoint vs the same params round-tripped through host
+        (`ckpt_probe.json`: 5733 vs 398 ms/batch; PERF.md 2026-08-01).
+        Staging back to device is the caller's normal jit/device_put path,
+        which re-lays them out like any fresh arrays. Leaves that are NOT
+        fully addressable (multi-host / pipeline-mesh restores, where each
+        process holds only its shards) pass through as-is — the host
+        roundtrip would raise on them, and they must keep their shardings
+        anyway (``_host_leaf``).
         """
-        import numpy as np
-
         restored = self._ckpt.restore(path, target=target)
-        return jax.tree.map(
-            lambda x: np.asarray(x) if hasattr(x, "shape") else x, restored
-        )
+        return jax.tree.map(_host_leaf, restored)
 
     def wait(self):
         self._ckpt.wait_until_finished()
