@@ -1,0 +1,304 @@
+"""Chaos gauntlet for the fault-tolerant map phase (CI tier-1).
+
+Runs the synthetic-shard extraction under canned deterministic fault
+schedules (tmr_tpu/utils/faults.py) covering every injection point — I/O
+error, hung shard, corrupt member, NaN encoder output, failed save/journal
+commits, crash + resume — and exits nonzero unless:
+
+- every injected fault was observed (faults.fired()) and is accounted for
+  in the map_report/v1 document;
+- transient faults are retried to success: the reducer table and the
+  per-image feature files come out byte-identical to the fault-free run;
+- permanent faults quarantine with a recorded cause (or, for data damage,
+  show up exactly in the skipped/non-finite counters), and the table
+  equals the journal-predicted contribution of the unaffected shards;
+- a crash mid-run + `--resume` yields a byte-identical table, re-encoding
+  only unjournaled shards, with no partial `.npy` anywhere.
+
+Fast (seconds, tiny tensors, CPU): rides tier-1 via
+tests/test_chaos_probe.py.
+"""
+
+import argparse
+import glob
+import hashlib
+import io
+import os
+import shutil
+import sys
+import tarfile
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+scrub_cpu_tunnel_env()
+
+import numpy as np  # noqa: E402
+
+SIZE = 16  # decode size — tiny keeps the whole gauntlet in seconds
+SHARDS = (  # (name, n_images) — index order is the fault 'shard=' key
+    ("Easy_0.tar", 4),
+    ("Easy_1.tar", 3),
+    ("Normal_0.tar", 4),
+    ("Normal_1.tar", 2),
+    ("Hard_0.tar", 3),
+    ("misc.tar", 2),
+)
+
+
+def _make_tar(dirpath, name, n_images, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    path = os.path.join(dirpath, name)
+    with tarfile.open(path, "w") as tar:
+        for i in range(n_images):
+            img = Image.fromarray(
+                rng.integers(0, 255, (24, 24, 3), dtype=np.uint8)
+            )
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img_{i}.png")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+def _encode_fn():
+    import jax
+
+    from tmr_tpu.parallel.mapreduce import feature_stats
+
+    @jax.jit
+    def encode(images):
+        feats = images[:, ::4, ::4, :] - 0.5  # stand-in encoder features
+        return feats, feature_stats(feats)
+
+    return encode
+
+
+def _manifest(features_dir):
+    """{relpath: sha256} over every .npy under features_dir."""
+    out = {}
+    for path in sorted(
+        glob.glob(os.path.join(features_dir, "**", "*.npy"), recursive=True)
+    ):
+        with open(path, "rb") as f:
+            out[os.path.relpath(path, features_dir)] = hashlib.sha256(
+                f.read()
+            ).hexdigest()
+    return out
+
+
+def _tmp_leftovers(root):
+    return glob.glob(os.path.join(root, "**", "*.tmp.*"), recursive=True)
+
+
+def _run(paths, encode, out_dir, *, resume=False, retry=None, expect_crash=False):
+    from tmr_tpu.parallel.journal import ShardJournal
+    from tmr_tpu.parallel.mapreduce import (
+        CATEGORIES,
+        MapReport,
+        RetryPolicy,
+        atomic_save_npy,
+        category_of,
+        reducer_table,
+        run_stream,
+    )
+
+    features = os.path.join(out_dir, "features")
+
+    def save(shard, name, feat):
+        d = os.path.join(features, CATEGORIES[category_of(shard)],
+                         shard.replace(".tar", ""))
+        os.makedirs(d, exist_ok=True)
+        atomic_save_npy(
+            os.path.join(d, os.path.splitext(name)[0] + ".npy"), feat
+        )
+
+    journal = ShardJournal(os.path.join(out_dir, "features", "_journal"))
+    report = MapReport()
+    retry = retry or RetryPolicy(
+        max_attempts=3, shard_timeout=2.0, backoff_base=0.01,
+        backoff_jitter=0.0,
+    )
+    crashed = False
+    acc = None
+    try:
+        acc = run_stream(
+            paths, encode, batch_size=2, image_size=SIZE,
+            save_features=save, feeder_threads=2, retry=retry,
+            journal=journal, resume=resume, report=report,
+        )
+    except KeyboardInterrupt:
+        crashed = True
+        if not expect_crash:
+            raise
+    table = reducer_table(acc.table) if acc is not None else None
+    return {
+        "table": table,
+        "manifest": _manifest(features),
+        "report": report.document() if not crashed else None,
+        "journal": journal,
+        "crashed": crashed,
+        "features_dir": features,
+    }
+
+
+def main(argv=None) -> int:
+    from tmr_tpu.diagnostics import validate_map_report
+    from tmr_tpu.utils import faults
+    from tmr_tpu.parallel.mapreduce import (
+        CATEGORIES,
+        RetryPolicy,
+        reducer_table,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--work_dir", default=None,
+                    help="scratch dir (default: a fresh tempdir, removed "
+                         "on success)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    args = ap.parse_args(argv)
+
+    work = args.work_dir or tempfile.mkdtemp(prefix="chaos_probe_")
+    os.makedirs(work, exist_ok=True)
+    problems = []
+
+    def check(ok, msg):
+        print(f"[{'ok' if ok else 'FAIL'}] {msg}", file=sys.stderr)
+        if not ok:
+            problems.append(msg)
+
+    data = os.path.join(work, "shards")
+    os.makedirs(data, exist_ok=True)
+    paths = [
+        _make_tar(data, name, n, seed=i)
+        for i, (name, n) in enumerate(SHARDS)
+    ]
+    encode = _encode_fn()
+
+    # ---------------------------------------------------- 0: fault-free
+    faults.clear()
+    base = _run(paths, encode, os.path.join(work, "baseline"))
+    base_entries = base["journal"].load_all()
+    check(base["table"] is not None, "baseline: completed")
+    check(len(base_entries) == len(SHARDS), "baseline: every shard journaled")
+
+    # --------------------------- 1: transient faults -> retried to success
+    faults.configure(
+        "tar.open:shard=0:attempts=2:raise=OSError;"   # I/O error x2
+        "tar.open:shard=1:attempts=1:latency=1.5;"     # hung shard (timeout)
+        "save:shard=2:attempts=1:raise=OSError;"       # save dies mid-shard
+        "journal:shard=3:attempts=1:raise=OSError;"    # journal commit fails
+        "encode:shard=4:attempts=1:raise=RuntimeError"  # encoder fault
+    )
+    t = _run(
+        paths, encode, os.path.join(work, "transient"),
+        retry=RetryPolicy(max_attempts=3, shard_timeout=0.3,
+                          backoff_base=0.01, backoff_jitter=0.0),
+    )
+    fired_points = {f["point"] for f in faults.fired()}
+    for point in ("tar.open", "save", "journal", "encode"):
+        check(point in fired_points, f"transient: fault at {point} fired")
+    doc = t["report"]
+    check(validate_map_report(doc) == [], "transient: map_report/v1 valid")
+    check(t["table"] == base["table"],
+          "transient: reducer table identical to fault-free run")
+    check(t["manifest"] == base["manifest"],
+          "transient: feature files byte-identical to fault-free run")
+    check(not _tmp_leftovers(os.path.join(work, "transient")),
+          "transient: no partial .tmp files on disk")
+    check(all(r["status"] == "ok" for r in doc["shards"]),
+          "transient: every faulted shard retried to success")
+    causes = {r["shard"]: [c["cause"] for c in r["causes"]]
+              for r in doc["shards"]}
+    check(causes.get("Easy_1.tar") == ["timeout"],
+          "transient: hung shard recorded a timeout cause within budget")
+    check(doc["totals"]["retries"] >= 5,
+          "transient: every injected failure cost a recorded retry")
+
+    # ------------- 2: permanent damage -> quarantine / exact accounting
+    faults.configure(
+        "decode:shard=0:corrupt=1;"      # every Easy_0 image undecodable
+        "encode:shard=1:nan=1;"          # every Easy_1 stat non-finite
+        "tar.open:shard=2:raise=OSError"  # Normal_0 permanently unreadable
+    )
+    p = _run(paths, encode, os.path.join(work, "permanent"))
+    doc = p["report"]
+    by_shard = {r["shard"]: r for r in doc["shards"]}
+    fired_actions = {(f["point"], f["action"]) for f in faults.fired()}
+    check(("decode", "corrupt") in fired_actions,
+          "permanent: corrupt-member fault fired")
+    check(("encode", "nan") in fired_actions,
+          "permanent: NaN-poison fault fired")
+    check(validate_map_report(doc) == [], "permanent: map_report/v1 valid")
+    check(
+        by_shard["Easy_0.tar"]["skipped_images"]
+        == base_entries["Easy_0.tar"]["images"],
+        "permanent: corrupt members all counted as skipped",
+    )
+    check(
+        by_shard["Easy_1.tar"]["nonfinite_images"]
+        == base_entries["Easy_1.tar"]["images"],
+        "permanent: NaN outputs all counted as non-finite",
+    )
+    check(
+        by_shard["Normal_0.tar"]["status"] == "quarantined"
+        and [c["cause"] for c in by_shard["Normal_0.tar"]["causes"]]
+        == ["exception"] * 3,
+        "permanent: unreadable shard quarantined with recorded causes",
+    )
+    check(doc["quarantined"] == ["Normal_0.tar"],
+          "permanent: quarantine list exact")
+    # the table must equal the journal-predicted sum of unaffected shards
+    unaffected = [n for n, _ in SHARDS
+                  if n not in ("Easy_0.tar", "Easy_1.tar", "Normal_0.tar")]
+    want = np.zeros((len(CATEGORIES), 5), np.float64)
+    for name in unaffected:
+        e = base_entries[name]
+        want[e["category"]] += np.asarray(e["sums"], np.float64)
+    check(p["table"] == reducer_table(want),
+          "permanent: table equals journal-predicted unaffected shards")
+
+    # --------------------------------------------- 3: crash, then resume
+    faults.configure("tar.open:shard=3:raise=KeyboardInterrupt")
+    crash_dir = os.path.join(work, "crash")
+    c = _run(paths, encode, crash_dir, expect_crash=True)
+    check(c["crashed"], "crash: injected crash killed the run")
+    done_before = set(c["journal"].load_all())
+    check(
+        done_before and "Normal_1.tar" not in done_before,
+        f"crash: journal holds only pre-crash shards ({sorted(done_before)})",
+    )
+    faults.clear()
+    r = _run(paths, encode, crash_dir, resume=True)
+    doc = r["report"]
+    resumed = set(doc["resumed"])
+    check(resumed == done_before, "resume: exactly the journaled shards skipped")
+    check(r["table"] == base["table"],
+          "resume: reducer table byte-identical to fault-free run")
+    check(r["manifest"] == base["manifest"],
+          "resume: feature files byte-identical, no duplicates/partials")
+    check(not _tmp_leftovers(crash_dir), "resume: no partial .tmp files")
+
+    faults.clear()
+    if problems:
+        print(f"chaos_probe: {len(problems)} FAILED check(s):",
+              file=sys.stderr)
+        for msg in problems:
+            print(f"  - {msg}", file=sys.stderr)
+        return 1
+    print("chaos_probe: all checks passed", file=sys.stderr)
+    if not args.keep and args.work_dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,38 @@ def test_host_leaf_converts_addressable_and_passes_sharded():
     assert _host_leaf(stub) is stub  # passthrough, no __array__ call
 
 
+def test_meta_survives_corruption_and_writes_atomically(tmp_path):
+    """ckpt_meta.json: a truncated/garbage file (crash mid-write under the
+    old non-atomic writer, or disk damage) must fall back to defaults with
+    a warning instead of crashing json.load in the constructor; _save_meta
+    goes through tmp + os.replace so no partial meta can exist."""
+    import glob
+    import json
+
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    meta_path = os.path.join(d, "ckpt_meta.json")
+    with open(meta_path, "w") as f:
+        f.write('{"best_value": 0.9, "best_ver')  # truncated mid-write
+
+    mgr = CheckpointManager(d)  # must not raise
+    assert mgr.meta == {
+        "best_value": None, "best_version": -1, "last_epoch": -1
+    }
+
+    mgr.meta["last_epoch"] = 4
+    mgr._save_meta()
+    assert not glob.glob(meta_path + ".tmp.*")  # replace, not leftover
+    with open(meta_path) as f:
+        assert json.load(f)["last_epoch"] == 4
+    # a valid meta still round-trips through the constructor
+    assert CheckpointManager(d).meta["last_epoch"] == 4
+    # non-dict JSON is also rejected to defaults, not crashed on
+    with open(meta_path, "w") as f:
+        json.dump([1, 2, 3], f)
+    assert CheckpointManager(d).meta["best_version"] == -1
+
+
 def test_restore_on_eight_device_mesh(tmp_path):
     """Save a param tree sharded over the 8-virtual-device mesh, restore
     with the sharded tree as target: must not raise, and every fully-

@@ -1,0 +1,164 @@
+"""Deterministic fault-injection harness (tmr_tpu/utils/faults.py):
+schedule grammar, shard/attempt scoping, deterministic corruption/poison,
+the fired-fault log, the zero-overhead disabled path, and the retry
+backoff schedule (mapreduce.backoff_delay)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu.parallel.mapreduce import RetryPolicy, backoff_delay
+from tmr_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_parse_schedule_grammar():
+    specs = faults.parse_schedule(
+        "tar.open:shard=3:attempts=2:raise=OSError;"
+        "encode:shard=7:latency=30;"
+        "decode:corrupt=1;"
+        "encode:nan=1"
+    )
+    assert [s.point for s in specs] == [
+        "tar.open", "encode", "decode", "encode"
+    ]
+    assert specs[0].shard == 3 and specs[0].attempts == 2
+    assert specs[0].raise_ == "OSError"
+    assert specs[1].latency == 30.0 and specs[1].shard == 7
+    assert specs[2].corrupt and specs[2].shard is None
+    assert specs[3].nan
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense.point:raise=OSError",     # unknown point
+    "encode:frobnicate=1",              # unknown key
+    "encode:raise=NoSuchError",         # unknown exception class
+    "encode:raise",                     # malformed field
+])
+def test_parse_schedule_rejects_typos(bad):
+    with pytest.raises(ValueError):
+        faults.parse_schedule(bad)
+
+
+def test_fire_scopes_by_shard_and_attempt():
+    faults.configure("tar.open:shard=3:attempts=2:raise=OSError")
+    # wrong shard: no fire
+    with faults.shard_scope(1, 0):
+        faults.fire("tar.open")
+    # right shard, attempts 0 and 1 fire; attempt 2 clean (retry succeeds)
+    for attempt in (0, 1):
+        with faults.shard_scope(3, attempt):
+            with pytest.raises(OSError, match="injected fault at tar.open"):
+                faults.fire("tar.open")
+    with faults.shard_scope(3, 2):
+        faults.fire("tar.open")
+    assert [
+        (f["shard"], f["attempt"], f["action"]) for f in faults.fired()
+    ] == [(3, 0, "raise"), (3, 1, "raise")]
+
+
+def test_install_from_env():
+    assert not faults.install_from_env({"TMR_FAULTS": "  "})
+    assert faults.install_from_env(
+        {"TMR_FAULTS": "encode:nan=1", "TMR_FAULTS_SEED": "7"}
+    )
+    assert faults.active()
+
+
+def test_corrupt_bytes_is_deterministic():
+    payload = bytes(range(256)) * 4
+    faults.configure("decode:shard=0:corrupt=1", seed=5)
+    with faults.shard_scope(0, 0):
+        a = faults.corrupt_bytes("decode", payload)
+        b = faults.corrupt_bytes("decode", payload)
+    assert a == b != payload
+    # a different seed corrupts differently — replays are seed-exact
+    faults.configure("decode:shard=0:corrupt=1", seed=6)
+    with faults.shard_scope(0, 0):
+        c = faults.corrupt_bytes("decode", payload)
+    assert c != a
+    # unmatched shard: payload passes through untouched
+    with faults.shard_scope(1, 0):
+        assert faults.corrupt_bytes("decode", payload) == payload
+
+
+def test_poison_nans_whole_arrays():
+    faults.configure("encode:nan=1")
+    with faults.shard_scope(0, 0):
+        f, s = faults.poison(
+            "encode", np.ones((2, 3)), np.zeros((2, 4), np.float32)
+        )
+    assert np.isnan(f).all() and np.isnan(s).all()
+    assert s.dtype == np.float32
+    faults.clear()
+    x = np.ones((2, 3))
+    assert faults.poison("encode", x) is x  # disabled: identity, 1-arg form
+
+
+def test_disabled_hooks_are_noop_cheap():
+    """No schedule installed -> every hook is a falsy-dict check. 200k
+    calls in well under a second pins that nothing (env parsing, regex,
+    allocation) crept onto the per-image hot path."""
+    assert not faults.active()
+    payload = b"x" * 64
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        faults.fire("decode")
+        faults.corrupt_bytes("decode", payload)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0, f"disabled fault hooks cost {elapsed:.3f}s/400k"
+
+
+# ------------------------------------------------------- backoff schedule
+def test_backoff_doubles_and_caps_without_jitter():
+    got = [backoff_delay(a, base=0.5, cap=4.0, jitter=0.0) for a in
+           range(1, 7)]
+    assert got == [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+def test_backoff_jitter_bounded_and_deterministic():
+    for attempt in range(1, 8):
+        base_d = backoff_delay(attempt, base=0.2, cap=30.0, jitter=0.0)
+        d1 = backoff_delay(attempt, base=0.2, cap=30.0, jitter=0.5, key=11)
+        d2 = backoff_delay(attempt, base=0.2, cap=30.0, jitter=0.5, key=11)
+        assert d1 == d2  # replay-exact
+        assert base_d <= d1 <= base_d * 1.5  # jitter bounded
+    # schedule is monotone nondecreasing while the exponential dominates
+    seq = [backoff_delay(a, base=0.2, cap=300.0, jitter=0.4, key=3)
+           for a in range(1, 10)]
+    assert all(b >= a for a, b in zip(seq, seq[1:]))
+
+
+def test_validate_map_report_tolerates_garbage():
+    """The validator gates possibly-corrupt documents — it must return
+    problems, never raise, on malformed shapes."""
+    from tmr_tpu.diagnostics import validate_map_report
+
+    assert validate_map_report({}) != []
+    doc = {
+        "schema": "map_report/v1",
+        "shards": ["Easy_0.tar", {"status": "ok", "causes": "oops"}],
+        "quarantined": [], "resumed": [], "totals": {},
+    }
+    problems = validate_map_report(doc)
+    assert any("shards[0]: not a dict" in p for p in problems)
+    assert any("causes: not a list" in p for p in problems)
+    problems = validate_map_report({
+        "schema": "map_report/v1", "shards": [{"causes": [17]}],
+        "quarantined": [], "resumed": [], "totals": {},
+    })
+    assert any("causes[0]: not a dict" in p for p in problems)
+
+
+def test_retry_policy_delay_keys_on_shard():
+    pol = RetryPolicy(backoff_base=0.1, backoff_max=10.0,
+                      backoff_jitter=0.9, seed=1)
+    assert pol.delay(0, 1) == pol.delay(0, 1)
+    assert pol.delay(0, 1) != pol.delay(1, 1)  # shards decorrelate

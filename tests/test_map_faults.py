@@ -1,0 +1,359 @@
+"""Retrying shard executor (mapreduce._run_stream_impl) under injected
+faults: retry-to-success, bounded-retry quarantine, hung-shard timeout,
+NaN exclusion, atomic/idempotent feature writes, corrupt-image counters,
+and the map_report/v1 document."""
+
+import glob
+import io
+import os
+import tarfile
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tmr_tpu.parallel.mapreduce as mr
+from tmr_tpu.diagnostics import validate_map_report
+from tmr_tpu.utils import faults
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_tar(dirpath, name, n_images, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    path = os.path.join(dirpath, name)
+    with tarfile.open(path, "w") as tar:
+        for i in range(n_images):
+            img = Image.fromarray(
+                rng.integers(0, 255, (12, 12, 3), dtype=np.uint8)
+            )
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img_{i}.png")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+def _encode(images):
+    feats = jnp.asarray(images)[:, ::2, ::2, :] - 0.5
+    return feats, mr.feature_stats(feats)
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("backoff_base", 0.001)
+    kw.setdefault("backoff_jitter", 0.0)
+    return mr.RetryPolicy(**kw)
+
+
+@pytest.fixture
+def shards(tmp_path):
+    return [
+        _make_tar(str(tmp_path), "Easy_0.tar", 3, 0),
+        _make_tar(str(tmp_path), "Normal_0.tar", 2, 1),
+        _make_tar(str(tmp_path), "Hard_0.tar", 2, 2),
+    ]
+
+
+def test_transient_fault_retried_to_identical_table(shards):
+    ref = mr.run_stream(shards, _encode, batch_size=2, image_size=SIZE)
+
+    faults.configure("tar.open:shard=0:attempts=2:raise=OSError")
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(), report=report,
+    )
+    np.testing.assert_array_equal(acc.table, ref.table)
+    doc = report.document()
+    assert validate_map_report(doc) == []
+    rec = doc["shards"][0]
+    assert rec["status"] == "ok" and rec["attempts"] == 3
+    assert [c["cause"] for c in rec["causes"]] == ["exception", "exception"]
+    assert "OSError" in rec["causes"][0]["error"]
+    assert doc["totals"]["retries"] == 2 and doc["quarantined"] == []
+
+
+def test_permanent_fault_quarantines_without_aborting(shards):
+    faults.configure("tar.open:shard=1:raise=OSError")
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(max_attempts=2), report=report,
+    )
+    doc = report.document()
+    assert doc["quarantined"] == ["Normal_0.tar"]
+    rec = doc["shards"][1]
+    assert rec["status"] == "quarantined" and rec["attempts"] == 2
+    # the other shards still landed: Easy 3 images, Hard 2, Normal none
+    assert acc.table[0, 4] == 3
+    assert acc.table[1, 4] == 0
+    assert acc.table[2, 4] == 2
+
+
+def test_hung_shard_quarantined_within_budget(shards):
+    faults.configure("tar.open:shard=0:latency=3.0")
+    report = mr.MapReport()
+    t0 = time.monotonic()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(max_attempts=2, shard_timeout=0.25),
+        report=report,
+    )
+    elapsed = time.monotonic() - t0
+    doc = report.document()
+    rec = doc["shards"][0]
+    assert rec["status"] == "quarantined"
+    assert [c["cause"] for c in rec["causes"]] == ["timeout", "timeout"]
+    # the run made progress instead of wedging on the hung read
+    assert acc.table[1, 4] == 2 and acc.table[2, 4] == 2
+    assert elapsed < 2.5, f"hung shard held the run for {elapsed:.2f}s"
+
+
+def test_corrupt_tar_quarantines_on_first_attempt(tmp_path, shards):
+    (tmp_path / "broken.tar").write_bytes(b"definitely not a tar")
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards + [str(tmp_path / "broken.tar")], _encode, batch_size=2,
+        image_size=SIZE, retry=_fast_retry(), report=report,
+    )
+    rec = report.document()["shards"][3]
+    # deterministic corruption is non-retryable: one attempt, quarantined
+    assert rec["status"] == "quarantined" and rec["attempts"] == 1
+    assert acc.table[:, 4].sum() == 7
+
+
+def test_missing_shard_quarantines_without_backoff(tmp_path, shards):
+    """A shard path that does not exist reads the same on every attempt —
+    non-retryable, so a stale shard list doesn't burn the backoff budget
+    (the old load_shard skipped instantly; quarantine keeps that cost)."""
+    report = mr.MapReport()
+    mr.run_stream(
+        shards + [str(tmp_path / "no_such.tar")], _encode, batch_size=2,
+        image_size=SIZE, retry=_fast_retry(backoff_base=30.0), report=report,
+    )
+    rec = report.document()["shards"][3]
+    assert rec["status"] == "quarantined" and rec["attempts"] == 1
+    assert "FileNotFoundError" in rec["causes"][0]["error"]
+
+
+def test_quarantined_shard_reports_zero_images(shards):
+    """A shard whose encode succeeded but whose journal commit keeps
+    failing is quarantined — its images never reached the table, so the
+    report must say 0, keeping totals reconcilable with the count column."""
+    from tmr_tpu.parallel.journal import ShardJournal
+    import tempfile
+
+    faults.configure("journal:shard=0:raise=OSError")
+    report = mr.MapReport()
+    with tempfile.TemporaryDirectory() as d:
+        acc = mr.run_stream(
+            shards, _encode, batch_size=2, image_size=SIZE,
+            retry=_fast_retry(max_attempts=2), report=report,
+            journal=ShardJournal(d),
+        )
+    doc = report.document()
+    rec = doc["shards"][0]
+    assert rec["status"] == "quarantined"
+    assert rec["images"] == 0 and rec["nonfinite_images"] == 0
+    assert doc["totals"]["images"] == acc.table[:, 4].sum() == 4
+    assert acc.table[0, 4] == 0  # Easy never folded in
+
+
+def test_nan_outputs_excluded_and_counted(shards):
+    ref = mr.run_stream(shards, _encode, batch_size=2, image_size=SIZE)
+    faults.configure("encode:shard=0:nan=1")
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(), report=report,
+    )
+    doc = report.document()
+    assert doc["shards"][0]["nonfinite_images"] == 3
+    assert doc["totals"]["nonfinite_images"] == 3
+    assert np.isfinite(acc.table).all()
+    assert acc.table[0, 4] == 0  # poisoned images out of the Easy sums
+    np.testing.assert_array_equal(acc.table[1:], ref.table[1:])
+
+
+def test_undecodable_images_counted_not_silent(shards):
+    """A half-corrupt dataset must not look identical to a clean one:
+    injected byte corruption at decode shows up in skipped_images and the
+    report totals (satellite: iter_tar_images/preprocess_image drops are
+    counted per shard)."""
+    faults.configure("decode:shard=2:corrupt=1")
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(), report=report,
+    )
+    doc = report.document()
+    assert doc["shards"][2]["skipped_images"] == 2
+    assert doc["totals"]["skipped_images"] == 2
+    assert doc["shards"][2]["status"] == "ok"
+    assert acc.table[2, 4] == 0
+
+
+def test_save_fault_retries_idempotently(tmp_path, shards):
+    out = tmp_path / "features"
+
+    def save(shard, name, feat):
+        d = out / shard.replace(".tar", "")
+        os.makedirs(d, exist_ok=True)
+        mr.atomic_save_npy(
+            str(d / (os.path.splitext(name)[0] + ".npy")), feat
+        )
+
+    ref = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE, save_features=save,
+    )
+    want = {
+        p: open(p, "rb").read()
+        for p in sorted(glob.glob(str(out / "**" / "*.npy"), recursive=True))
+    }
+    assert len(want) == 7
+
+    import shutil
+
+    shutil.rmtree(out)
+    faults.configure("save:shard=0:attempts=1:raise=OSError")
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE, save_features=save,
+        retry=_fast_retry(),
+    )
+    got = {
+        p: open(p, "rb").read()
+        for p in sorted(glob.glob(str(out / "**" / "*.npy"), recursive=True))
+    }
+    assert got == want  # identical set, identical bytes — no partials/dupes
+    assert not glob.glob(str(out / "**" / "*.tmp.*"), recursive=True)
+    np.testing.assert_array_equal(acc.table, ref.table)
+
+
+def test_slow_but_progressing_shard_is_not_quarantined(shards):
+    """The timeout is a STALL budget, not total load time: a shard whose
+    members keep arriving — just slowly — must never quarantine, even
+    when its total load time exceeds shard_timeout."""
+    faults.configure("tar.member:shard=0:latency=0.2")  # 3 members -> 0.6s
+    report = mr.MapReport()
+    acc = mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(max_attempts=2, shard_timeout=0.45),
+        report=report,
+    )
+    rec = report.document()["shards"][0]
+    assert rec["status"] == "ok" and rec["causes"] == []
+    assert rec["wall_s"] > 0.45  # genuinely slower than the stall budget
+    assert acc.table[0, 4] == 3
+
+
+def test_quarantined_shard_partial_features_cleaned(tmp_path, shards):
+    """A shard quarantined after encode+save (journal commit keeps
+    failing) must not leave orphan .npy files that are in neither the
+    table nor the report totals."""
+    from tmr_tpu.parallel.journal import ShardJournal
+
+    out = tmp_path / "features"
+
+    def save(shard, name, feat):
+        d = out / shard.replace(".tar", "")
+        os.makedirs(d, exist_ok=True)
+        mr.atomic_save_npy(
+            str(d / (os.path.splitext(name)[0] + ".npy")), feat
+        )
+
+    def cleanup(shard):
+        import shutil
+
+        shutil.rmtree(out / shard.replace(".tar", ""), ignore_errors=True)
+
+    faults.configure("journal:shard=0:raise=OSError")
+    mr.run_stream(
+        shards, _encode, batch_size=2, image_size=SIZE, save_features=save,
+        retry=_fast_retry(max_attempts=2),
+        journal=ShardJournal(str(tmp_path / "_journal")),
+        cleanup_features=cleanup,
+    )
+    got = sorted(glob.glob(str(out / "**" / "*.npy"), recursive=True))
+    # Easy_0's saves were rolled back; Normal_0 + Hard_0 remain (2+2)
+    assert len(got) == 4
+    assert not any("Easy_0" in p for p in got)
+
+
+def test_native_path_shares_executor_semantics(shards):
+    """run_stream_native goes through the same retrying executor: a
+    transient tar.open fault retries to the identical table, and the
+    report carries the same per-shard records as the Python path."""
+    from tmr_tpu.data import native_io
+
+    if not native_io.available():
+        pytest.skip("no g++/make to build libtmr_io.so")
+    ref = mr.run_stream(shards, _encode, batch_size=2, image_size=SIZE)
+    faults.configure("tar.open:shard=0:attempts=1:raise=OSError")
+    report = mr.MapReport()
+    acc = mr.run_stream_native(
+        shards, _encode, batch_size=2, image_size=SIZE,
+        retry=_fast_retry(), report=report,
+    )
+    np.testing.assert_allclose(acc.table, ref.table, rtol=1e-6)
+    doc = report.document()
+    assert validate_map_report(doc) == []
+    assert doc["shards"][0]["status"] == "ok"
+    assert doc["shards"][0]["attempts"] == 2
+
+
+def test_heartbeat_beats_for_every_scanned_member(tmp_path):
+    """The stall detector's heartbeat must advance on every member the
+    tar read passes — non-image and undecodable ones included — so a
+    shard with a long run of skipped members is never falsely declared
+    stalled."""
+    path = os.path.join(str(tmp_path), "Easy_mixed.tar")
+    with tarfile.open(path, "w") as tar:
+        for name, payload in [
+            ("notes.txt", b"x"), ("bad.jpg", b"not an image"),
+            ("more.txt", b"y"),
+        ]:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    beats = []
+    images = list(
+        mr.iter_tar_images(path, heartbeat=lambda: beats.append(1))
+    )
+    assert images == []
+    assert len(beats) == 3  # every member scanned beat, none decoded
+
+
+def test_iter_tar_images_counts_unreadable_members(tmp_path):
+    """tar members whose payload PIL rejects are tallied, not silently
+    dropped (the pre-existing skip behavior keeps working)."""
+    from PIL import Image
+
+    path = os.path.join(str(tmp_path), "Easy_bad.tar")
+    with tarfile.open(path, "w") as tar:
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(buf, format="PNG")
+        good = buf.getvalue()
+        info = tarfile.TarInfo("good.png")
+        info.size = len(good)
+        tar.addfile(info, io.BytesIO(good))
+        bad = b"not an image"
+        info = tarfile.TarInfo("bad.jpg")
+        info.size = len(bad)
+        tar.addfile(info, io.BytesIO(bad))
+    counts = {}
+    images = list(mr.iter_tar_images(path, counts=counts))
+    assert [n for n, _ in images] == ["good.png"]
+    assert counts == {"skipped_images": 1}

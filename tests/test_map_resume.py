@@ -1,0 +1,279 @@
+"""Durable shard journal + crash resume: done-marker round trip and
+corruption rejection (parallel/journal.py), and the integration contract —
+a crash after N shards plus ``resume=True`` re-encodes only the
+unjournaled shards, produces a byte-identical reducer table, and leaves no
+duplicate or partial ``.npy`` on disk."""
+
+import glob
+import hashlib
+import io
+import os
+import tarfile
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tmr_tpu.parallel.mapreduce as mr
+from tmr_tpu.parallel.journal import MAP_JOURNAL_SCHEMA, ShardJournal
+from tmr_tpu.utils import faults
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------ journal unit
+def test_journal_round_trip(tmp_path):
+    j = ShardJournal(str(tmp_path / "_journal"))
+    assert j.done("Easy_0.tar") is None
+    entry = j.record(
+        "Easy_0.tar", category=0, sums=[1.5, 2.25, 3.0, 0.125, 4.0],
+        images=4, skipped_images=1, nonfinite_images=2, attempts=2,
+        wall_s=0.5,
+    )
+    assert entry["schema"] == MAP_JOURNAL_SCHEMA
+    got = j.done("Easy_0.tar")
+    assert got == entry
+    assert got["sums"] == [1.5, 2.25, 3.0, 0.125, 4.0]
+    assert j.load_all() == {"Easy_0.tar": entry}
+    # floats survive the JSON round trip exactly (repr round-trip)
+    j.record("Hard_0.tar", category=2, sums=[0.1 + 0.2, 1e-300, 0, 0, 3],
+             images=3)
+    assert j.done("Hard_0.tar")["sums"][0] == 0.1 + 0.2
+    assert j.done("Hard_0.tar")["sums"][1] == 1e-300
+
+
+def test_journal_rejects_tampered_and_garbage_markers(tmp_path):
+    j = ShardJournal(str(tmp_path))
+    j.record("Easy_0.tar", category=0, sums=[1, 2, 3, 4, 5], images=5)
+    path = os.path.join(str(tmp_path), "Easy_0.json")
+    assert j.done("Easy_0.tar") is not None
+
+    import json
+
+    entry = json.load(open(path))
+    entry["sums"][0] = 999.0  # tamper: digest no longer matches
+    json.dump(entry, open(path, "w"))
+    assert j.done("Easy_0.tar") is None  # -> shard re-runs
+
+    open(path, "w").write('{"truncated')  # crash mid-write of old code
+    assert j.done("Easy_0.tar") is None
+
+    json.dump({"schema": "map_journal/v999"}, open(path, "w"))
+    assert j.done("Easy_0.tar") is None
+
+
+def test_quarantine_invalidates_stale_journal_marker(tmp_path):
+    """A done-marker from an earlier successful run must not vouch for a
+    shard a later run quarantined (and whose features were cleaned): the
+    quarantine path deletes the marker, so a subsequent --resume re-runs
+    the shard instead of folding stale sums for missing features."""
+    shards = [_make_tar(str(tmp_path), "Easy_0.tar", 2, 0)]
+    journal = ShardJournal(str(tmp_path / "_journal"))
+    retry = mr.RetryPolicy(max_attempts=1, backoff_base=0.001,
+                           backoff_jitter=0.0)
+    encode = _encode_counting([])
+
+    mr.run_stream(shards, encode, batch_size=2, image_size=SIZE,
+                  journal=journal, retry=retry)
+    assert journal.done("Easy_0.tar") is not None
+
+    faults.configure("tar.open:shard=0:raise=OSError")
+    mr.run_stream(shards, encode, batch_size=2, image_size=SIZE,
+                  journal=journal, retry=retry)
+    assert journal.done("Easy_0.tar") is None  # stale marker gone
+
+    faults.clear()
+    calls = []
+    acc = mr.run_stream(shards, _encode_counting(calls), batch_size=2,
+                        image_size=SIZE, journal=journal, retry=retry,
+                        resume=True)
+    assert calls  # the shard really re-encoded
+    assert acc.table[0, 4] == 2
+
+
+def test_duplicate_basenames_refused_when_journaled(tmp_path):
+    """Markers key on shard basename; two paths sharing one would share a
+    done-marker and corrupt resume — refused up front."""
+    a = tmp_path / "batch1" / "Easy_0.tar"
+    b = tmp_path / "batch2" / "Easy_0.tar"
+    for p in (a, b):
+        os.makedirs(p.parent)
+        p.write_bytes(b"")
+    with pytest.raises(ValueError, match="duplicate shard journal keys"):
+        mr.run_stream(
+            [str(a), str(b)], lambda x: (x, x),
+            journal=ShardJournal(str(tmp_path / "_journal")),
+        )
+
+
+def test_atomic_write_cleans_up_on_failure(tmp_path):
+    """A failed write (disk full, injected fault) must not leave
+    *.tmp.<pid> orphans — the no-partials invariant holds in exactly the
+    fault scenarios the executor retries through."""
+    from tmr_tpu.utils.atomicio import atomic_write
+
+    target = str(tmp_path / "out.json")
+
+    def boom(f):
+        f.write("partial")
+        raise OSError("disk full")
+
+    with pytest.raises(OSError, match="disk full"):
+        atomic_write(target, boom)
+    assert os.listdir(str(tmp_path)) == []  # no target, no tmp orphan
+    atomic_write(target, lambda f: f.write("ok"))
+    assert open(target).read() == "ok"
+
+
+# -------------------------------------------------------------- integration
+def _make_tar(dirpath, name, n_images, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    path = os.path.join(dirpath, name)
+    with tarfile.open(path, "w") as tar:
+        for i in range(n_images):
+            img = Image.fromarray(
+                rng.integers(0, 255, (12, 12, 3), dtype=np.uint8)
+            )
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img_{i}.png")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+def _encode_counting(calls):
+    def encode(images):
+        calls.append(1)
+        feats = jnp.asarray(images)[:, ::2, ::2, :] - 0.5
+        return feats, mr.feature_stats(feats)
+
+    return encode
+
+
+def _manifest(root):
+    return {
+        os.path.relpath(p, root): hashlib.sha256(open(p, "rb").read())
+        .hexdigest()
+        for p in sorted(glob.glob(os.path.join(root, "**", "*.npy"),
+                                  recursive=True))
+    }
+
+
+def test_crash_then_resume_is_byte_identical(tmp_path):
+    shards = [
+        _make_tar(str(tmp_path), "Easy_0.tar", 3, 0),
+        _make_tar(str(tmp_path), "Easy_1.tar", 2, 1),
+        _make_tar(str(tmp_path), "Normal_0.tar", 3, 2),
+        _make_tar(str(tmp_path), "Hard_0.tar", 2, 3),
+    ]
+    retry = mr.RetryPolicy(backoff_base=0.001, backoff_jitter=0.0)
+
+    def run(out, encode, resume=False, report=None):
+        feat_dir = str(out / "features")
+
+        def save(shard, name, feat):
+            d = os.path.join(feat_dir, shard.replace(".tar", ""))
+            os.makedirs(d, exist_ok=True)
+            mr.atomic_save_npy(
+                os.path.join(d, os.path.splitext(name)[0] + ".npy"), feat
+            )
+
+        journal = ShardJournal(str(out / "_journal"))
+        return mr.run_stream(
+            shards, encode, batch_size=2, image_size=SIZE,
+            save_features=save, retry=retry, journal=journal,
+            resume=resume, report=report,
+        ), feat_dir, journal
+
+    # reference: fault-free run end to end
+    ref_acc, ref_feats, _ = run(tmp_path / "ref", _encode_counting([]))
+    ref_table = mr.reducer_table(ref_acc.table)
+    ref_manifest = _manifest(ref_feats)
+    assert len(ref_manifest) == 10
+
+    # crashed run: a fatal (non-retryable, non-quarantinable) fault kills
+    # the process after shards 0 and 1 have journaled
+    faults.configure("tar.open:shard=2:raise=KeyboardInterrupt")
+    out = tmp_path / "crashed"
+    with pytest.raises(KeyboardInterrupt):
+        run(out, _encode_counting([]))
+    journal = ShardJournal(str(out / "_journal"))
+    assert set(journal.load_all()) == {"Easy_0.tar", "Easy_1.tar"}
+
+    # resume: only the unjournaled shards re-encode
+    faults.clear()
+    calls = []
+    report = mr.MapReport()
+    pre_mtimes = {
+        p: os.stat(p).st_mtime_ns
+        for p in glob.glob(str(out / "features" / "Easy_*" / "*.npy"))
+    }
+    acc, feat_dir, _ = run(out, _encode_counting(calls), resume=True,
+                           report=report)
+
+    doc = report.document()
+    assert set(doc["resumed"]) == {"Easy_0.tar", "Easy_1.tar"}
+    # shards 2+3 have 3 and 2 images at batch 2 -> 2 + 1 encode calls
+    assert len(calls) == 3
+    # journaled shards' features were NOT rewritten
+    assert pre_mtimes and all(
+        os.stat(p).st_mtime_ns == t for p, t in pre_mtimes.items()
+    )
+    # byte-identical table, identical feature bytes, no partials
+    assert mr.reducer_table(acc.table) == ref_table
+    assert _manifest(feat_dir) == ref_manifest
+    assert not glob.glob(str(out / "**" / "*.tmp.*"), recursive=True)
+
+    # a second resume re-encodes nothing and still matches
+    calls2 = []
+    acc2, _, _ = run(out, _encode_counting(calls2), resume=True)
+    assert calls2 == []
+    assert mr.reducer_table(acc2.table) == ref_table
+
+
+def test_non_prefix_resume_is_still_byte_identical(tmp_path):
+    """Journaled shards need NOT form a prefix: a mid-list shard that was
+    quarantined in run 1 (transient fault) re-encodes in run 2 while its
+    neighbors resume — the table must still come out byte-identical
+    (contributions fold in shard-list order, not completion order;
+    float64 addition is not associative)."""
+    shards = [
+        _make_tar(str(tmp_path), "Easy_0.tar", 3, 0),
+        _make_tar(str(tmp_path), "Easy_1.tar", 2, 1),
+        _make_tar(str(tmp_path), "Easy_2.tar", 3, 2),
+    ]
+    retry = mr.RetryPolicy(max_attempts=1, backoff_base=0.001,
+                           backoff_jitter=0.0)
+    journal = ShardJournal(str(tmp_path / "_journal"))
+    encode = _encode_counting([])
+
+    ref = mr.run_stream(shards, encode, batch_size=2, image_size=SIZE)
+    ref_table = mr.reducer_table(ref.table)
+
+    # run 1: Easy_1 quarantined (transient env fault), 0 and 2 journaled
+    faults.configure("tar.open:shard=1:raise=OSError")
+    mr.run_stream(shards, encode, batch_size=2, image_size=SIZE,
+                  retry=retry, journal=journal)
+    assert set(journal.load_all()) == {"Easy_0.tar", "Easy_2.tar"}
+
+    # run 2: resume re-encodes only the mid-list hole
+    faults.clear()
+    calls = []
+    report = mr.MapReport()
+    acc = mr.run_stream(shards, _encode_counting(calls), batch_size=2,
+                        image_size=SIZE, retry=retry, journal=journal,
+                        resume=True, report=report)
+    assert set(report.document()["resumed"]) == {"Easy_0.tar", "Easy_2.tar"}
+    assert len(calls) == 1  # Easy_1's single 2-image batch
+    assert mr.reducer_table(acc.table) == ref_table

@@ -130,6 +130,47 @@ def test_reduce_matches_reference_reducer(tmp_path):
         assert r.split("|")[1:] == o.split("|")[1:], (r, o)
 
 
+def test_map_cli_resume_and_report(tmp_path, artifact, monkeypatch, capsys):
+    """`map --report_out` emits a valid map_report/v1; a rerun with
+    `--resume` skips every journaled shard (journal under
+    features_out/_journal) and prints byte-identical shuffle records."""
+    import json
+
+    from tmr_tpu.diagnostics import validate_map_report
+
+    _make_tar(str(tmp_path), "Easy_0.tar", 3, 0)
+    _make_tar(str(tmp_path), "Hard_0.tar", 2, 1)
+    argv = [
+        "map", "--data_dir", str(tmp_path), "--artifact", artifact,
+        "--features_out", str(tmp_path / "features_output"),
+        "--batch_size", "2", "--image_size", str(SIZE), "--no_native",
+        "--report_out", str(tmp_path / "report.json"),
+    ]
+    monkeypatch.setattr("sys.stdin", io.StringIO("Easy_0.tar\nHard_0.tar\n"))
+    assert mr.main(argv) == 0
+    first = sorted(
+        l for l in capsys.readouterr().out.splitlines() if l.strip()
+    )
+    doc = json.load(open(tmp_path / "report.json"))
+    assert validate_map_report(doc) == []
+    assert doc["totals"] == {
+        "shards": 2, "ok": 2, "quarantined": 0, "resumed": 0, "images": 5,
+        "skipped_images": 0, "skipped_members": 0, "nonfinite_images": 0,
+        "retries": 0, "wall_s": doc["totals"]["wall_s"],
+    }
+    assert (tmp_path / "features_output" / "_journal" / "Easy_0.json").exists()
+
+    monkeypatch.setattr("sys.stdin", io.StringIO("Easy_0.tar\nHard_0.tar\n"))
+    assert mr.main(argv + ["--resume"]) == 0
+    second = sorted(
+        l for l in capsys.readouterr().out.splitlines() if l.strip()
+    )
+    assert second == first  # byte-identical shuffle records
+    doc = json.load(open(tmp_path / "report.json"))
+    assert doc["totals"]["resumed"] == 2 and doc["totals"]["ok"] == 0
+    assert set(doc["resumed"]) == {"Easy_0.tar", "Hard_0.tar"}
+
+
 def test_run_stream_image_size_threaded(tmp_path):
     """image_size must reach the tar decode path (regression: it was
     silently ignored and everything decoded at 1024)."""

@@ -32,6 +32,67 @@ from typing import Dict, List, Optional
 #: --json document — bump when the record shape changes incompatibly
 GATE_PROBE_SCHEMA = "gate_probe/v1"
 
+#: schema tag of the structured map-phase extraction report
+#: (parallel/mapreduce.py MapReport, emitted by `map --report_out`) — the
+#: gate_probe/v1 pattern applied to fault tolerance: per-shard
+#: status/attempts/causes, quarantine and resume lists, skipped-image and
+#: non-finite counts, retry totals, wall-clock per shard. bench/CI assert
+#: on it via ``validate_map_report`` (scripts/chaos_probe.py).
+MAP_REPORT_SCHEMA = "map_report/v1"
+
+#: closed per-shard status vocabulary in a map_report/v1 document
+MAP_SHARD_STATUSES = ("ok", "quarantined", "resumed")
+
+#: closed per-attempt failure-cause vocabulary ("timeout" = the per-shard
+#: wall-clock budget elapsed; "exception" carries class + message)
+MAP_FAILURE_CAUSES = ("timeout", "exception")
+
+
+def validate_map_report(doc: dict) -> List[str]:
+    """Structural check of a map_report/v1 document; returns a list of
+    problems (empty == valid). Dependency-free so CI harnesses can gate on
+    the report without importing the extraction stack."""
+    problems: List[str] = []
+    if doc.get("schema") != MAP_REPORT_SCHEMA:
+        problems.append(f"schema != {MAP_REPORT_SCHEMA}: {doc.get('schema')!r}")
+    shards = doc.get("shards")
+    if not isinstance(shards, list):
+        return problems + ["shards: not a list"]
+    for i, rec in enumerate(shards):
+        where = f"shards[{i}]"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("shard", "status", "attempts", "images",
+                    "skipped_images", "nonfinite_images", "wall_s"):
+            if key not in rec:
+                problems.append(f"{where}: missing {key!r}")
+        if rec.get("status") not in MAP_SHARD_STATUSES:
+            problems.append(f"{where}: bad status {rec.get('status')!r}")
+        causes = rec.get("causes", ())
+        if not isinstance(causes, (list, tuple)):
+            problems.append(f"{where}.causes: not a list")
+            causes = ()
+        for j, cause in enumerate(causes):
+            if not isinstance(cause, dict):
+                problems.append(f"{where}.causes[{j}]: not a dict")
+            elif cause.get("cause") not in MAP_FAILURE_CAUSES:
+                problems.append(
+                    f"{where}.causes[{j}]: bad cause {cause.get('cause')!r}"
+                )
+    totals = doc.get("totals")
+    if not isinstance(totals, dict):
+        problems.append("totals: not a dict")
+    else:
+        for key in ("shards", "ok", "quarantined", "resumed", "images",
+                    "skipped_images", "nonfinite_images", "retries"):
+            if key not in totals:
+                problems.append(f"totals: missing {key!r}")
+    for key in ("quarantined", "resumed"):
+        if not isinstance(doc.get(key), list):
+            problems.append(f"{key}: not a list")
+    return problems
+
 #: registry bound: the attention gates are lru_cached (one record per
 #: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
 #: backend / shape) record on EVERY call — a long-lived process that

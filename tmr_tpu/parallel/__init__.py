@@ -21,6 +21,7 @@ required for reference parity (the reference has only DDP) but all are
 first-class here for scaling ViT-H and long token grids beyond one chip.
 """
 
+from tmr_tpu.parallel.journal import ShardJournal  # noqa: F401
 from tmr_tpu.parallel.mesh import make_mesh  # noqa: F401
 from tmr_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_vit_apply,

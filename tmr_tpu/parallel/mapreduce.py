@@ -17,22 +17,59 @@ TPU-native redesign:
   the entire Hadoop shuffle;
 - the reducer is a pure formatting function over the final (3, 5) matrix,
   emitting the identical table.
+
+Fault tolerance (the JobTracker replacement): every shard runs through a
+retrying executor — per-shard attempt loop with exponential backoff +
+deterministic jitter, a per-shard STALL timeout on the load half (a hung
+NFS/FUSE read parks a daemon thread instead of wedging the run, while a
+merely-slow shard keeps its heartbeat and never times out), bounded
+retries, then quarantine with a recorded cause instead of aborting —
+partial feature files of a quarantined shard are cleaned up so disk
+reconciles with the table. Feature ``.npy`` writes are atomic (tmp + ``os.replace``) and
+idempotent; a durable journal (parallel/journal.py) commits a per-shard
+done-marker after the shard's last feature lands, and ``resume=True``
+folds journaled shards into the accumulator without re-encoding —
+byte-identically, because shards accumulate into the table as one float64
+vector each. Non-finite encoder outputs (the skip-nonfinite containment
+from train/state.py, applied to inference) are excluded per image from
+the category sums and counted. Everything is observable through a
+``map_report/v1`` document (diagnostics.MAP_REPORT_SCHEMA) and provable
+with the deterministic fault-injection points threaded through this file
+(utils/faults.py; exercised by scripts/chaos_probe.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import io
+import json
 import os
 import tarfile
-from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterable, Iterator, Optional, Sequence
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tmr_tpu.diagnostics import MAP_REPORT_SCHEMA
+from tmr_tpu.utils import faults
+from tmr_tpu.utils.atomicio import atomic_write
+
 CATEGORIES = ("Easy", "Normal", "Hard", "Unknown")  # mapper.py:15-20
 STAT_NAMES = ("sum_mean", "sum_std", "sum_max", "sum_spar", "count")
+
+#: deterministic failures retrying cannot heal (a structurally corrupt
+#: tar, a shard path that does not exist) — quarantine on first sight
+#: instead of burning the whole backoff budget
+_NON_RETRYABLE = (
+    tarfile.ReadError,
+    FileNotFoundError,
+    NotADirectoryError,
+    IsADirectoryError,
+)
 
 
 def category_of(shard_name: str) -> int:
@@ -47,6 +84,8 @@ def preprocess_image(data: bytes, size: int = 1024) -> Optional[np.ndarray]:
     """PIL decode -> resize -> /255 (mapper.py:22-30), NHWC float32."""
     from PIL import Image
 
+    faults.fire("decode")
+    data = faults.corrupt_bytes("decode", data)
     try:
         img = Image.open(io.BytesIO(data)).convert("RGB")
         img = img.resize((size, size))
@@ -55,22 +94,48 @@ def preprocess_image(data: bytes, size: int = 1024) -> Optional[np.ndarray]:
         return None  # bad image -> skip, like mapper.py:31-32
 
 
+def _bump(counts: Optional[dict], key: str) -> None:
+    if counts is not None:
+        counts[key] = counts.get(key, 0) + 1
+
+
 def iter_tar_images(
-    path: str, size: int = 1024
+    path: str, size: int = 1024, counts: Optional[dict] = None,
+    heartbeat: Optional[Callable[[], None]] = None,
 ) -> Iterator[tuple[str, np.ndarray]]:
-    """Stream (name, image) from a tar shard; corrupt members skipped."""
+    """Stream (name, image) from a tar shard; corrupt members skipped.
+
+    ``counts`` (when given) tallies what was dropped — the reference
+    pipeline silently ate corrupt images, so a half-corrupt dataset looked
+    identical to a clean one: ``skipped_members`` (image-named members
+    whose payload could not be read out of the tar) and
+    ``skipped_images`` (payloads PIL could not decode).
+
+    ``heartbeat`` (when given) is called once per member SCANNED —
+    including skipped/non-image/undecodable ones — so the executor's
+    stall detector sees progress whenever the tar read advances, not
+    only when an image survives decode.
+    """
     with tarfile.open(path, "r") as tar:
         for member in tar:
+            if heartbeat is not None:
+                heartbeat()
             if not member.isfile():
                 continue
             if not member.name.lower().endswith((".png", ".jpg", ".jpeg")):
                 continue
             data = tar.extractfile(member)
             if data is None:
+                _bump(counts, "skipped_members")
                 continue
-            img = preprocess_image(data.read(), size)
-            if img is not None:
-                yield member.name, img
+            raw = data.read()
+            faults.fire("tar.member")
+            raw = faults.corrupt_bytes("tar.member", raw)
+            img = preprocess_image(raw, size)
+            if img is None:
+                _bump(counts, "skipped_images")
+                continue
+            yield member.name, img
 
 
 def feature_stats(features: jnp.ndarray) -> jnp.ndarray:
@@ -126,6 +191,13 @@ class StatAccumulator:
         """stats: (B, 4) per-image values for one shard batch."""
         self.table[category, :4] += stats.sum(axis=0)
         self.table[category, 4] += len(stats)
+
+    def add_totals(self, category: int, sums) -> None:
+        """Fold one shard's finished (5,) float64 sums in as a single
+        addition — the resume-equivalence unit: a journaled shard replays
+        into the table with exactly the float64 addition its live run
+        performed, so resumed tables come out byte-identical."""
+        self.table[category] += np.asarray(sums, np.float64)
 
     def merge(self, other: "StatAccumulator") -> None:
         self.table += other.table
@@ -195,6 +267,522 @@ def reduce_lines(lines: Iterable[str]) -> dict:
     return sums
 
 
+# ------------------------------------------------------------ retry policy
+def backoff_delay(
+    attempt: int,
+    base: float = 0.5,
+    cap: float = 30.0,
+    jitter: float = 0.5,
+    key: int = 0,
+) -> float:
+    """Delay before retry number ``attempt`` (1 = first retry): capped
+    exponential ``min(cap, base * 2**(attempt-1))`` plus a deterministic
+    jitter fraction in [0, jitter] of the capped delay, keyed on
+    (key, attempt) so replays sleep identically and concurrent runs
+    decorrelate by key."""
+    import random
+
+    d = min(cap, base * (2.0 ** max(attempt - 1, 0)))
+    if jitter > 0.0:
+        d *= 1.0 + jitter * random.Random(int(key) * 1000003 + attempt).random()
+    return d
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Shard-level retry/backoff/timeout knobs for the map executor.
+
+    ``shard_timeout`` is a STALL budget on each attempt's load half
+    (tar open/read/decode — the hang-prone IO): the attempt fails when
+    the loader makes no member progress for that many seconds, so a
+    big-but-healthy shard that simply takes long never times out, while
+    a hung NFS/FUSE read does. None disables. ``max_attempts`` bounds
+    tries before quarantine."""
+
+    max_attempts: int = 3
+    shard_timeout: Optional[float] = None
+    backoff_base: float = 0.5
+    backoff_max: float = 30.0
+    backoff_jitter: float = 0.5
+    seed: int = 0
+
+    def delay(self, shard_index: int, attempt: int) -> float:
+        return backoff_delay(
+            attempt,
+            base=self.backoff_base,
+            cap=self.backoff_max,
+            jitter=self.backoff_jitter,
+            key=(self.seed << 20) ^ shard_index,
+        )
+
+
+class MapReport:
+    """Builder for the ``map_report/v1`` document — per-shard records in
+    shard-list order plus aggregate totals (diagnostics.MAP_REPORT_SCHEMA
+    documents the schema; diagnostics.validate_map_report checks it)."""
+
+    def __init__(self):
+        self.shards: List[dict] = []
+
+    def add(self, record: dict) -> None:
+        self.shards.append(record)
+
+    def document(self) -> dict:
+        shards = sorted(self.shards, key=lambda r: r.get("index", 0))
+        totals = {
+            "shards": len(shards),
+            "ok": sum(1 for r in shards if r["status"] == "ok"),
+            "quarantined": sum(
+                1 for r in shards if r["status"] == "quarantined"
+            ),
+            "resumed": sum(1 for r in shards if r["status"] == "resumed"),
+            "images": sum(r["images"] for r in shards),
+            "skipped_images": sum(r["skipped_images"] for r in shards),
+            "skipped_members": sum(
+                r.get("skipped_members", 0) for r in shards
+            ),
+            "nonfinite_images": sum(r["nonfinite_images"] for r in shards),
+            "retries": sum(max(r["attempts"] - 1, 0) for r in shards),
+            "wall_s": sum(r["wall_s"] for r in shards),
+        }
+        return {
+            "schema": MAP_REPORT_SCHEMA,
+            "shards": shards,
+            "quarantined": [
+                r["shard"] for r in shards if r["status"] == "quarantined"
+            ],
+            "resumed": [
+                r["shard"] for r in shards if r["status"] == "resumed"
+            ],
+            "totals": totals,
+        }
+
+    def write(self, path: str) -> None:
+        doc = self.document()
+
+        def dump(f):
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+        atomic_write(path, dump)
+
+    def summary_line(self) -> str:
+        t = self.document()["totals"]
+        return (
+            f"map: {t['ok']} ok / {t['resumed']} resumed / "
+            f"{t['quarantined']} quarantined of {t['shards']} shards; "
+            f"{t['images']} images encoded, "
+            f"{t['skipped_images']} undecodable skipped, "
+            f"{t['skipped_members']} unreadable members, "
+            f"{t['nonfinite_images']} non-finite excluded, "
+            f"{t['retries']} retries"
+        )
+
+
+def atomic_save_npy(path: str, arr: np.ndarray) -> None:
+    """Write ``path`` via tmp + fsync + ``os.replace`` so a crash
+    mid-write never leaves a partial ``.npy``, a re-run (idempotent retry
+    / resume) replaces rather than appends, and the bytes are durable
+    BEFORE the shard's journal marker commits (the marker vouches for
+    these files — without the fsync a power loss could keep the marker
+    and lose the features). The per-file DIRECTORY fsync is skipped —
+    one ``sync_features`` directory fsync per shard, issued right before
+    the journal commit, makes all the renames durable at a thousandth of
+    the syscall cost on NFS/FUSE."""
+    atomic_write(path, lambda f: np.save(f, arr), mode="wb",
+                 sync_dir=False)
+
+
+# --------------------------------------------------------- shard executor
+@dataclasses.dataclass
+class _ShardTask:
+    index: int
+    path: str
+    category: int
+    attempt: int = 0
+    causes: List[dict] = dataclasses.field(default_factory=list)
+
+
+class _LoadBox:
+    """Result slot for one shard-load attempt running on a daemon thread.
+    Daemon so a wedged NFS/FUSE read (the hadoop fs -get replacement
+    path) parks the thread instead of blocking interpreter exit.
+    ``progress`` is a monotone heartbeat the loader bumps per tar member:
+    the executor's timeout measures STALL (no heartbeat for
+    ``shard_timeout`` seconds), not total load time, so a big-but-healthy
+    shard that simply takes a while never gets quarantined — only a read
+    that stops making progress does."""
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.progress = 0
+
+
+def _spawn_load(task: _ShardTask, loader: Callable, image_size: int) -> _LoadBox:
+    box = _LoadBox()
+
+    def run():
+        try:
+            with faults.shard_scope(task.index, task.attempt):
+                box.value = loader(task.path, image_size, box)
+        except BaseException as e:  # noqa: BLE001 — classified by the caller
+            box.error = e
+        finally:
+            box.event.set()
+
+    t = threading.Thread(
+        target=run,
+        daemon=True,
+        name=f"shard-load-{task.index}-a{task.attempt}",
+    )
+    t.start()
+    return box
+
+
+def _wait_or_stall(box: _LoadBox, stall_timeout: Optional[float]) -> bool:
+    """Wait for the load to finish; False when it went ``stall_timeout``
+    seconds without either finishing or advancing its progress heartbeat."""
+    if stall_timeout is None:
+        box.event.wait()
+        return True
+    seen = box.progress
+    while True:
+        if box.event.wait(stall_timeout):
+            return True
+        if box.progress == seen:
+            return False
+        seen = box.progress
+
+
+def _load_shard_python(path: str, image_size: int, box: _LoadBox):
+    """Load one shard via the Python tarfile path: [(name, img)], counts.
+
+    The whole decoded shard is materialized (like the seed's load_shard)
+    so the executor's retry/journal unit is the shard; peak memory is
+    ~(feeder_threads + 1) decoded shards — ``feeder_threads`` is the
+    memory lever."""
+    faults.fire("tar.open")
+    counts = {"skipped_members": 0, "skipped_images": 0}
+
+    def beat():
+        box.progress += 1
+
+    images = list(
+        iter_tar_images(path, image_size, counts=counts, heartbeat=beat)
+    )
+    return images, counts
+
+
+def _load_shard_native(path: str, image_size: int, box: _LoadBox):
+    """Load one shard via the native C++ IO runtime (native/tmr_io.cc).
+    One stream per shard keeps retry/timeout/journal semantics shard-
+    scoped; cross-shard overlap comes from the executor running
+    ``feeder_threads`` such streams concurrently. Like the Python loader
+    (and unlike the old batch-streaming native path) this holds one
+    decoded shard in memory — the price of a shard-scoped fault unit.
+    Error granularity is the whole shard: the C++ parser flags an
+    unreadable STREAM (-> retry/quarantine, like tarfile.open raising),
+    it does not classify individual members, so ``skipped_members`` stays
+    0 on this path."""
+    from tmr_tpu.data.native_io import NativeTarStream
+
+    faults.fire("tar.open")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)  # non-retryable, like the py path
+    counts = {"skipped_members": 0, "skipped_images": 0}
+    images = []
+    with NativeTarStream([path], threads=1) as stream:
+        for _, member, data in stream:
+            box.progress += 1
+            if not member.lower().endswith((".png", ".jpg", ".jpeg")):
+                continue
+            faults.fire("tar.member")
+            data = faults.corrupt_bytes("tar.member", data)
+            img = preprocess_image(data, image_size)
+            if img is None:
+                counts["skipped_images"] += 1
+                continue
+            images.append((member, img))
+        if stream.errors:
+            # the C++ parser flags structural corruption — deterministic,
+            # so raise the same non-retryable class as tarfile would
+            raise tarfile.ReadError(f"native IO: unreadable shard {path}")
+    return images, counts
+
+
+def _encode_shard(
+    task: _ShardTask,
+    images,
+    encode_stats_fn: Callable,
+    batch_size: int,
+    save_features,
+):
+    """Encode one loaded shard: (5,) float64 stat sums, non-finite count.
+
+    Per-image stats that come back non-finite (real encoder overflow or an
+    injected NaN poison) are excluded from the sums AND from the feature
+    dumps — mirroring the skip-nonfinite step of train/state.py — and
+    counted instead of silently averaged in."""
+    shard_base = os.path.basename(task.path)
+    sums = np.zeros(len(STAT_NAMES), np.float64)
+    nonfinite = 0
+    with faults.shard_scope(task.index, task.attempt):
+        for i in range(0, len(images), batch_size):
+            chunk = images[i : i + batch_size]
+            names = [n for n, _ in chunk]
+            arr = np.stack([im for _, im in chunk])
+            real = len(arr)
+            if real < batch_size:  # pad to the jitted batch shape
+                pad = np.zeros(
+                    (batch_size - real,) + arr.shape[1:], arr.dtype
+                )
+                arr = np.concatenate([arr, pad])
+            faults.fire("encode")
+            feats, stats = encode_stats_fn(jnp.asarray(arr))
+            feats = np.asarray(feats)[:real]
+            stats = np.asarray(stats)[:real]
+            feats, stats = faults.poison("encode", feats, stats)
+            finite = np.isfinite(stats).all(axis=1)
+            nonfinite += int((~finite).sum())
+            sums[:4] += stats[finite].sum(axis=0)
+            sums[4] += int(finite.sum())
+            if save_features is not None:
+                for name, feat, keep in zip(names, feats, finite):
+                    if not keep:
+                        continue
+                    faults.fire("save")
+                    save_features(shard_base, name, feat)
+    return sums, nonfinite
+
+
+def _cleanup_quarantined(task, cleanup_features, log_warning) -> None:
+    """A quarantined shard contributed nothing to the table — its
+    partially-written (atomic, but orphaned) feature files must not
+    linger and break the report/table/files reconciliation."""
+    if cleanup_features is None:
+        return
+    try:
+        cleanup_features(os.path.basename(task.path))
+    except Exception as e:
+        log_warning(
+            f"could not clean quarantined shard features for "
+            f"{os.path.basename(task.path)}: {e}"
+        )
+
+
+def _run_stream_impl(
+    shard_paths: Sequence[str],
+    encode_stats_fn: Callable,
+    batch_size: int,
+    image_size: int,
+    save_features,
+    feeder_threads: int,
+    loader: Callable,
+    retry: Optional[RetryPolicy],
+    journal,
+    resume: bool,
+    report: Optional[MapReport],
+    cleanup_features=None,
+    sync_features=None,
+) -> StatAccumulator:
+    from tmr_tpu.utils.profiling import log_progress, log_warning
+
+    retry = retry or RetryPolicy()
+    if journal is not None:
+        # journal markers are keyed on the shard's marker stem (stable
+        # across --data_dir spellings between a crash and its resume) —
+        # two paths sharing a stem would silently share one done-marker,
+        # so refuse up front instead of corrupting the resume ledger
+        from collections import Counter
+
+        from tmr_tpu.parallel.journal import shard_stem
+
+        dupes = [n for n, c in Counter(
+            shard_stem(p) for p in shard_paths
+        ).items() if c > 1]
+        if dupes:
+            raise ValueError(
+                f"duplicate shard journal keys {dupes!r} cannot be "
+                "journaled unambiguously; rename the shards or disable "
+                "the journal"
+            )
+    acc = StatAccumulator()
+    # (index, category, sums) per completed shard — folded into the table
+    # at the END in shard-list order, so a resumed run performs the exact
+    # float64 addition sequence of a fault-free run even when the
+    # journaled shards are not a prefix (float addition is not
+    # associative; byte-identical tables need identical order)
+    contributions: List[tuple] = []
+
+    live: List[_ShardTask] = []
+    for index, path in enumerate(shard_paths):
+        task = _ShardTask(index, path, category_of(path))
+        entry = journal.done(os.path.basename(path)) if (
+            journal is not None and resume
+        ) else None
+        if entry is not None:
+            contributions.append((index, entry["category"], entry["sums"]))
+            log_progress(
+                f"shard {os.path.basename(path)}: resumed from journal "
+                f"({entry['images']} images)"
+            )
+            if report is not None:
+                report.add({
+                    "index": index,
+                    "shard": os.path.basename(path),
+                    "category": CATEGORIES[entry["category"]],
+                    "status": "resumed",
+                    "attempts": 0,
+                    "causes": [],
+                    "images": entry["images"],
+                    "skipped_images": entry["skipped_images"],
+                    "skipped_members": entry.get("skipped_members", 0),
+                    "nonfinite_images": entry["nonfinite_images"],
+                    "wall_s": 0.0,
+                })
+            continue
+        live.append(task)
+
+    pending = deque(live)
+    inflight: deque = deque()
+
+    def launch_next() -> None:
+        if pending:
+            task = pending.popleft()
+            inflight.append((task, _spawn_load(task, loader, image_size)))
+
+    for _ in range(max(feeder_threads, 1) + 1):
+        launch_next()
+
+    # Shards are PROCESSED strictly in list order (FIFO pop + inline
+    # retry), on purpose: a retrying/hung head does stall encoding of
+    # later already-loaded shards, but in-order completion is what keeps
+    # crash semantics deterministic — the journal is always a prefix of
+    # the shard list (minus quarantines), so "resume re-does only
+    # in-flight work" is an exact statement rather than a race.
+    while inflight:
+        task, box = inflight.popleft()
+        t_start = time.monotonic()
+        status = "quarantined"
+        sums = None
+        counts = {"skipped_members": 0, "skipped_images": 0}
+        nonfinite = 0
+        n_images = 0
+        while True:
+            failure: Optional[dict] = None
+            if not _wait_or_stall(box, retry.shard_timeout):
+                failure = {
+                    "attempt": task.attempt,
+                    "cause": "timeout",
+                    "error": (
+                        f"shard load stalled: no progress for "
+                        f"{retry.shard_timeout}s"
+                    ),
+                }
+            elif box.error is not None:
+                err = box.error
+                if isinstance(err, (KeyboardInterrupt, SystemExit)):
+                    raise err  # a crash is a crash — resume handles it
+                failure = {
+                    "attempt": task.attempt,
+                    "cause": "exception",
+                    "error": f"{type(err).__name__}: {err}",
+                }
+                if isinstance(err, _NON_RETRYABLE):
+                    failure["retryable"] = False
+            else:
+                images, counts = box.value
+                log_progress(
+                    f"shard {os.path.basename(task.path)}: "
+                    f"{len(images)} images ({CATEGORIES[task.category]}, "
+                    f"attempt {task.attempt + 1})"
+                )
+                try:
+                    sums, nonfinite = _encode_shard(
+                        task, images, encode_stats_fn, batch_size,
+                        save_features,
+                    )
+                    n_images = int(sums[4])
+                    if journal is not None:
+                        if sync_features is not None:
+                            # ONE directory fsync per shard makes every
+                            # feature rename durable before the marker
+                            # that vouches for them commits
+                            sync_features(os.path.basename(task.path))
+                        with faults.shard_scope(task.index, task.attempt):
+                            journal.record(
+                                os.path.basename(task.path),
+                                category=task.category,
+                                sums=sums,
+                                images=n_images,
+                                skipped_images=counts["skipped_images"],
+                                skipped_members=counts["skipped_members"],
+                                nonfinite_images=nonfinite,
+                                attempts=task.attempt + 1,
+                                wall_s=time.monotonic() - t_start,
+                            )
+                    status = "ok"
+                    break
+                except Exception as e:
+                    failure = {
+                        "attempt": task.attempt,
+                        "cause": "exception",
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                    if isinstance(e, _NON_RETRYABLE):
+                        # the encode/save/journal half hits permanent
+                        # errors too (features_out on an unmounted volume)
+                        failure["retryable"] = False
+
+            task.causes.append(failure)
+            task.attempt += 1
+            retryable = failure.pop("retryable", True)
+            if task.attempt >= retry.max_attempts or not retryable:
+                log_warning(
+                    f"quarantining shard {os.path.basename(task.path)} "
+                    f"after {task.attempt} attempt(s): {failure['error']}"
+                )
+                break
+            time.sleep(retry.delay(task.index, task.attempt))
+            box = _spawn_load(task, loader, image_size)
+
+        if status == "ok":
+            contributions.append((task.index, task.category, sums))
+        elif status == "quarantined":
+            if journal is not None:
+                # a marker from an EARLIER successful run must not vouch
+                # for features this quarantine just invalidated/cleaned
+                journal.invalidate(os.path.basename(task.path))
+            _cleanup_quarantined(task, cleanup_features, log_warning)
+        launch_next()
+        if report is not None:
+            # a quarantined shard contributed nothing to the table, even
+            # if a late attempt got through load/encode before failing —
+            # report zeros for every per-image counter so the totals
+            # reconcile with the table's count column
+            ok = status == "ok"
+            report.add({
+                "index": task.index,
+                "shard": os.path.basename(task.path),
+                "category": CATEGORIES[task.category],
+                "status": status,
+                "attempts": task.attempt + (1 if ok else 0),
+                "causes": task.causes,
+                "images": n_images if ok else 0,
+                "skipped_images": counts["skipped_images"] if ok else 0,
+                "skipped_members": counts["skipped_members"] if ok else 0,
+                "nonfinite_images": nonfinite if ok else 0,
+                "wall_s": time.monotonic() - t_start,
+            })
+    # one float64 addition per shard, in shard-list order — the
+    # resume-equivalence unit
+    for _, category, sums in sorted(contributions, key=lambda c: c[0]):
+        acc.add_totals(category, sums)
+    return acc
+
+
 def run_stream(
     shard_paths: Sequence[str],
     encode_stats_fn: Callable,
@@ -202,6 +790,13 @@ def run_stream(
     image_size: int = 1024,
     save_features: Optional[Callable[[str, str, np.ndarray], None]] = None,
     feeder_threads: int = 4,
+    *,
+    retry: Optional[RetryPolicy] = None,
+    journal=None,
+    resume: bool = False,
+    report: Optional[MapReport] = None,
+    cleanup_features=None,
+    sync_features=None,
 ) -> StatAccumulator:
     """Single-host streaming map phase over tar shards.
 
@@ -209,58 +804,24 @@ def run_stream(
     the jitted encoder on fixed-size batches (short tails padded and
     masked out of the stats). ``save_features(shard, image_name, features)``
     is the .npy side-effect hook (mapper.py:117-118).
+
+    Fault tolerance: shards run under ``retry`` (RetryPolicy — attempt
+    loop, backoff, per-shard stall timeout, quarantine); ``journal``
+    (journal.ShardJournal) records per-shard done-markers and
+    ``resume=True`` skips journaled shards; ``report`` (MapReport)
+    collects the map_report/v1 record per shard;
+    ``cleanup_features(shard_base)`` is invoked for quarantined shards so
+    partially-written feature files don't outlive their exclusion from
+    the table (their journal marker, if any, is invalidated too);
+    ``sync_features(shard_base)`` is invoked once per shard right before
+    its journal commit to fsync the feature directory. Peak memory is
+    ~(feeder_threads + 1) decoded shards.
     """
-    from tmr_tpu.utils.profiling import log_progress, log_warning
-
-    acc = StatAccumulator()
-
-    def load_shard(path):
-        # bad/missing tar -> log + skip the whole shard (mapper.py:79-81)
-        try:
-            return list(iter_tar_images(path, image_size))
-        except Exception as e:
-            log_warning(f"skipping shard {path}: {e}")
-            return []
-
-    from collections import deque
-
-    with ThreadPoolExecutor(max_workers=feeder_threads) as pool:
-        # bounded shard prefetch — whole decoded shards are large
-        queue: deque = deque()
-        path_iter = iter(shard_paths)
-        for path in path_iter:
-            queue.append((path, pool.submit(load_shard, path)))
-            if len(queue) >= feeder_threads + 1:
-                break
-        while queue:
-            path, fut = queue.popleft()
-            images = fut.result()
-            nxt = next(path_iter, None)
-            if nxt is not None:
-                queue.append((nxt, pool.submit(load_shard, nxt)))
-            cat = category_of(path)
-            log_progress(
-                f"shard {os.path.basename(path)}: {len(images)} images "
-                f"({CATEGORIES[cat]})"
-            )
-            for i in range(0, len(images), batch_size):
-                chunk = images[i : i + batch_size]
-                names = [n for n, _ in chunk]
-                arr = np.stack([im for _, im in chunk])
-                real = len(arr)
-                if real < batch_size:  # pad to the jitted batch shape
-                    pad = np.zeros(
-                        (batch_size - real,) + arr.shape[1:], arr.dtype
-                    )
-                    arr = np.concatenate([arr, pad])
-                feats, stats = encode_stats_fn(jnp.asarray(arr))
-                stats = np.asarray(stats)[:real]
-                acc.add(cat, stats)
-                if save_features is not None:
-                    f_np = np.asarray(feats)[:real]
-                    for name, feat in zip(names, f_np):
-                        save_features(os.path.basename(path), name, feat)
-    return acc
+    return _run_stream_impl(
+        shard_paths, encode_stats_fn, batch_size, image_size,
+        save_features, feeder_threads, _load_shard_python, retry, journal,
+        resume, report, cleanup_features, sync_features,
+    )
 
 
 def run_stream_native(
@@ -270,55 +831,25 @@ def run_stream_native(
     image_size: int = 1024,
     save_features: Optional[Callable[[str, str, np.ndarray], None]] = None,
     feeder_threads: int = 4,
+    *,
+    retry: Optional[RetryPolicy] = None,
+    journal=None,
+    resume: bool = False,
+    report: Optional[MapReport] = None,
+    cleanup_features=None,
+    sync_features=None,
 ) -> StatAccumulator:
     """run_stream on the native C++ IO runtime (native/tmr_io.cc): tar
-    parsing + prefetch happen in a C++ thread pool outside the GIL; Python
-    only decodes images and feeds the device. Members from different shards
-    interleave (workers stream shards concurrently) — per-item category
-    tracking keeps the stats identical to the sequential path."""
-    from tmr_tpu.data.native_io import NativeTarStream
-    from tmr_tpu.utils.profiling import log_warning
-
-    acc = StatAccumulator()
-    cats = [category_of(p) for p in shard_paths]
-    shard_names = [os.path.basename(p) for p in shard_paths]
-    buf_imgs: list = []
-    buf_meta: list = []
-
-    def flush():
-        if not buf_imgs:
-            return
-        real = len(buf_imgs)
-        arr = np.stack(buf_imgs)
-        if real < batch_size:
-            pad = np.zeros((batch_size - real,) + arr.shape[1:], arr.dtype)
-            arr = np.concatenate([arr, pad])
-        feats, stats = encode_stats_fn(jnp.asarray(arr))
-        stats = np.asarray(stats)[:real]
-        for (cat, _, _), row in zip(buf_meta, stats):
-            acc.add(cat, row[None])
-        if save_features is not None:
-            f_np = np.asarray(feats)[:real]
-            for (_, shard, name), feat in zip(buf_meta, f_np):
-                save_features(shard, name, feat)
-        buf_imgs.clear()
-        buf_meta.clear()
-
-    with NativeTarStream(shard_paths, threads=feeder_threads) as stream:
-        for shard_idx, member, data in stream:
-            if not member.lower().endswith((".png", ".jpg", ".jpeg")):
-                continue
-            img = preprocess_image(data, image_size)
-            if img is None:
-                continue
-            buf_imgs.append(img)
-            buf_meta.append((cats[shard_idx], shard_names[shard_idx], member))
-            if len(buf_imgs) == batch_size:
-                flush()
-        flush()
-        if stream.errors:
-            log_warning(f"{stream.errors} unreadable shards skipped")
-    return acc
+    parsing happens in C++ outside the GIL; Python only decodes images and
+    feeds the device. Each shard gets its own single-thread stream so the
+    retry/timeout/journal unit stays the shard (cross-shard overlap comes
+    from ``feeder_threads`` concurrent streams), with semantics — and the
+    stats table — identical to the Python path."""
+    return _run_stream_impl(
+        shard_paths, encode_stats_fn, batch_size, image_size,
+        save_features, feeder_threads, _load_shard_native, retry, journal,
+        resume, report, cleanup_features, sync_features,
+    )
 
 
 def allreduce_stats(table: jnp.ndarray, axis_name: str = "data") -> jnp.ndarray:
@@ -336,17 +867,52 @@ def allreduce_stats(table: jnp.ndarray, axis_name: str = "data") -> jnp.ndarray:
 # The map phase reads tar names from stdin (mapper.py:51), prefixes
 # --data_dir (the `hadoop fs -get` replacement: a posix/NFS/FUSE path),
 # streams every shard through the jitted encoder, writes per-image feature
-# .npy files under features_out/<category>/ (mapper.py:126-130), and emits
-# aggregated `category\tsums,count` records (mapper.py:138; aggregated
-# per-run rather than per-tar — reduce semantics are identical since the
-# reducer sums). The reduce phase needs no sort (dict aggregation) but
-# tolerates sorted Hadoop-style streams identically.
+# .npy files ATOMICALLY (tmp + os.replace) under features_out/<category>/
+# (mapper.py:126-130), and emits aggregated `category\tsums,count` records
+# (mapper.py:138; aggregated per-run rather than per-tar — reduce
+# semantics are identical since the reducer sums). The reduce phase needs
+# no sort (dict aggregation) but tolerates sorted Hadoop-style streams
+# identically.
+#
+# Fault tolerance knobs (the Hadoop JobTracker replacement):
+#   --max_attempts N     per-shard tries before quarantine (default 3)
+#   --shard_timeout S    per-attempt STALL budget for the shard load — no
+#                        member progress for S seconds fails the attempt
+#                        (hung NFS/FUSE protection that never quarantines a
+#                        merely-slow shard; 0 disables; default 600)
+#   --backoff_base S / --backoff_max S
+#                        capped exponential retry backoff with
+#                        deterministic jitter (backoff_delay)
+#   --resume             skip shards with a valid journal done-marker,
+#                        folding their journaled sums into the table
+#                        (byte-identical to a fault-free run)
+#   --journal_dir DIR    done-marker directory (default
+#                        <features_out>/_journal when --features_out set)
+#   --report_out FILE    write the map_report/v1 document: per-shard
+#                        status/attempts/causes, quarantined + resumed
+#                        lists, skipped-image / non-finite counts, retry
+#                        totals, wall-clock per shard (schema registered
+#                        in tmr_tpu/diagnostics.py:MAP_REPORT_SCHEMA)
+# Deterministic fault injection for drills/tests: set TMR_FAULTS (and
+# TMR_FAULTS_SEED), e.g.
+#   TMR_FAULTS="tar.open:shard=3:attempts=2:raise=OSError;encode:shard=7:latency=30"
+# — see tmr_tpu/utils/faults.py for the schedule grammar and
+# scripts/chaos_probe.py for the canned gauntlet.
 
 
 def _cli_map(args) -> int:
     import sys
 
+    from tmr_tpu.parallel.journal import ShardJournal
     from tmr_tpu.utils.profiling import log_info, log_warning
+
+    if faults.install_from_env():
+        # loud on purpose: a TMR_FAULTS left over from a drill would
+        # otherwise corrupt a production run that still exits 0
+        log_warning(
+            "fault injection ACTIVE (TMR_FAULTS="
+            f"{os.environ.get('TMR_FAULTS', '')!r})"
+        )
 
     names = [ln.strip() for ln in sys.stdin if ln.strip()]
     paths = [
@@ -370,13 +936,44 @@ def _cli_map(args) -> int:
     save = None
     if args.features_out:
 
-        def save(shard: str, name: str, feat: np.ndarray) -> None:
+        def _shard_dir(shard: str) -> str:
             cat = CATEGORIES[category_of(shard)]
-            d = os.path.join(args.features_out, cat,
-                             shard.replace(".tar", ""))
+            return os.path.join(args.features_out, cat,
+                                shard.replace(".tar", ""))
+
+        def save(shard: str, name: str, feat: np.ndarray) -> None:
+            d = _shard_dir(shard)
             os.makedirs(d, exist_ok=True)
             base = os.path.splitext(os.path.basename(name))[0]
-            np.save(os.path.join(d, base + ".npy"), feat)
+            atomic_save_npy(os.path.join(d, base + ".npy"), feat)
+
+        def cleanup(shard: str) -> None:
+            import shutil
+
+            shutil.rmtree(_shard_dir(shard), ignore_errors=True)
+
+        def sync(shard: str) -> None:
+            from tmr_tpu.utils.atomicio import fsync_dir
+
+            fsync_dir(_shard_dir(shard))
+
+    journal_dir = args.journal_dir
+    if journal_dir is None and args.features_out:
+        journal_dir = os.path.join(args.features_out, "_journal")
+    journal = ShardJournal(journal_dir) if journal_dir else None
+    if args.resume and journal is None:
+        log_warning(
+            "map: --resume without --journal_dir/--features_out has no "
+            "journal to resume from; running everything"
+        )
+
+    retry = RetryPolicy(
+        max_attempts=max(1, args.max_attempts),
+        shard_timeout=args.shard_timeout if args.shard_timeout > 0 else None,
+        backoff_base=args.backoff_base,
+        backoff_max=args.backoff_max,
+    )
+    report = MapReport()
 
     use_native = not args.no_native
     if use_native:
@@ -389,7 +986,13 @@ def _cli_map(args) -> int:
     acc = runner(
         paths, fn, batch_size=args.batch_size, image_size=args.image_size,
         save_features=save, feeder_threads=args.feeder_threads,
+        retry=retry, journal=journal, resume=args.resume, report=report,
+        cleanup_features=cleanup if save is not None else None,
+        sync_features=sync if save is not None else None,
     )
+    log_info(report.summary_line())
+    if args.report_out:
+        report.write(args.report_out)
     for line in acc.emit_lines():
         print(line)
     return 0
@@ -428,6 +1031,24 @@ def main(argv=None) -> int:
     m.add_argument("--no_native", action="store_true",
                    help="force the Python tarfile path instead of the C++ "
                         "IO runtime (native/tmr_io.cc)")
+    m.add_argument("--max_attempts", default=3, type=int,
+                   help="per-shard attempts before quarantine")
+    m.add_argument("--shard_timeout", default=600.0, type=float,
+                   help="per-attempt STALL budget (s): quarantine-path "
+                        "timeout fires only when the shard load makes no "
+                        "member progress for this long; 0 disables")
+    m.add_argument("--backoff_base", default=0.5, type=float,
+                   help="first-retry backoff (s), doubled per retry")
+    m.add_argument("--backoff_max", default=30.0, type=float,
+                   help="backoff cap (s)")
+    m.add_argument("--resume", action="store_true",
+                   help="skip shards journaled as done; their journaled "
+                        "sums keep the stats table byte-identical")
+    m.add_argument("--journal_dir", default=None,
+                   help="done-marker directory (default "
+                        "<features_out>/_journal)")
+    m.add_argument("--report_out", default=None,
+                   help="write the map_report/v1 JSON document here")
     sub.add_parser("reduce", help="stat records on stdin -> averages table")
     args = p.parse_args(argv)
     return _cli_map(args) if args.cmd == "map" else _cli_reduce(args)

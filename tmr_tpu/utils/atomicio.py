@@ -1,0 +1,69 @@
+"""Crash-safe file writes — the one implementation of tmp + ``os.replace``.
+
+Every durability-sensitive writer (feature ``.npy`` dumps, journal
+done-markers, the map report, checkpoint metadata) goes through
+``atomic_write`` so the semantics stay uniform: a crash mid-write leaves
+the previous file intact (or no file), never a truncated one, and a
+re-run replaces rather than appends. ``fsync=True`` (the default) forces
+the data to storage before the rename AND fsyncs the parent directory
+after it, so the rename itself is durable — required wherever a later
+write acts as a commit marker for this one (the journal protocol:
+features must be durable before the shard's done-marker, or a power loss
+could persist the marker while losing the features it vouches for). A
+failed write (disk full, injected fault) unlinks its temp file on the
+way out instead of littering ``*.tmp.<pid>`` orphans.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, IO, Optional
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a DIRECTORY, making completed renames inside
+    it durable (not every filesystem supports directory fds)."""
+    try:
+        dfd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def atomic_write(
+    path: str,
+    write_fn: Callable[[IO], None],
+    mode: str = "w",
+    fsync: bool = True,
+    sync_dir: Optional[bool] = None,
+) -> None:
+    """Write ``path`` by calling ``write_fn(file)`` on a same-directory
+    temp file and renaming it into place.
+
+    ``sync_dir`` (default: follow ``fsync``) controls the parent-directory
+    fsync that makes the rename itself durable. High-volume writers whose
+    files share a directory (per-image feature dumps) pass False and
+    issue ONE ``fsync_dir`` per batch/shard instead of two syscalls per
+    file — the durability point is whoever commits the marker that
+    vouches for them."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync if sync_dir is None else sync_dir:
+        fsync_dir(os.path.dirname(path))

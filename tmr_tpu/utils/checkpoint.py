@@ -68,12 +68,29 @@ class CheckpointManager:
         self._meta_path = os.path.join(self.directory, "ckpt_meta.json")
         self.meta = {"best_value": None, "best_version": -1, "last_epoch": -1}
         if os.path.exists(self._meta_path):
-            with open(self._meta_path) as f:
-                self.meta = json.load(f)
+            try:
+                with open(self._meta_path) as f:
+                    loaded = json.load(f)
+                if not isinstance(loaded, dict):
+                    raise ValueError(f"expected a dict, got {type(loaded)}")
+                self.meta.update(loaded)
+            except (OSError, ValueError) as e:
+                # a corrupt/truncated meta (crash mid-write predating the
+                # atomic _save_meta, or disk damage) must not brick the
+                # manager — best/last tracking restarts from defaults
+                from tmr_tpu.utils.profiling import log_warning
+
+                log_warning(
+                    f"unparseable {self._meta_path} ({e}); "
+                    "falling back to default checkpoint metadata"
+                )
 
     def _save_meta(self):
-        with open(self._meta_path, "w") as f:
-            json.dump(self.meta, f)
+        # atomic: a crash mid-write leaves the previous meta intact
+        # instead of a truncated JSON the next run dies parsing
+        from tmr_tpu.utils.atomicio import atomic_write
+
+        atomic_write(self._meta_path, lambda f: json.dump(self.meta, f))
 
     def _is_better(self, value: float) -> bool:
         best = self.meta["best_value"]
