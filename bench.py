@@ -302,12 +302,11 @@ def _run(cancel_watchdog) -> None:
     # once measured; explicit TMR_BENCH_BATCH always wins
     global BATCH
     if "TMR_BENCH_BATCH" not in os.environ and jax.default_backend() == "tpu":
-        from tmr_tpu.utils.autotune import _cache_load, bench_batch_cache_key
+        from tmr_tpu.utils.autotune import measured_bench_batch
 
-        key = bench_batch_cache_key(jax.devices()[0].device_kind, IMAGE_SIZE)
-        picked = _cache_load().get(key, {}).get("TMR_BENCH_BATCH")
+        picked = measured_bench_batch(IMAGE_SIZE)
         if picked:
-            BATCH = int(picked)
+            BATCH = picked
             _progress(f"batch {BATCH}: measured winner from the autotune "
                       "cache (bench_extra batch sweep)")
 

@@ -13,7 +13,11 @@ ViT-B @ 1024, batch 4). This script measures the remaining tracked configs
   5. one training step, ViT-B @ 1024 batch 4 — config #5's inner loop;
   plus the 1536 small-object bucket (eval protocol, batch 1).
 
-Usage:  python scripts/bench_extra.py [--only demo,refine,stream,train,1536]
+  6. serving layer vs sequential Predictor loop (tmr_tpu/serve closed-loop
+     interactive mix; scripts/serve_bench.py holds the full sweep).
+
+Usage:  python scripts/bench_extra.py
+        [--only demo,batch_sweep,refine,stream,train,1536,serve]
 Results are committed as BENCH_EXTRA.json next to BENCH_r{N}.json.
 
 Same measurement rules as bench.py: device-staged inputs, chained execution
@@ -348,6 +352,74 @@ def bench_stream() -> dict:
     return out
 
 
+def bench_serve() -> dict:
+    """The serving layer (tmr_tpu/serve) vs the sequential Predictor loop
+    at the headline geometry: closed-loop batched+cached throughput over an
+    interactive mix (unique images, exact repeats, same-image-new-exemplar
+    queries). scripts/serve_bench.py is the full offered-load sweep with
+    latency percentiles; this stage is the battery's one-number summary."""
+    import time
+
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+    from tmr_tpu.serve import ServeEngine
+
+    cfg = preset("TMR_FSCD147", backbone=BACKBONE_B, image_size=SIZE,
+                 compute_dtype=DTYPE, batch_size=1)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=SIZE)
+    rng = np.random.default_rng(0)
+    ex = np.asarray([[0.45, 0.45, 0.53, 0.55]], np.float32)
+    ex2 = np.asarray([[0.2, 0.2, 0.28, 0.3]], np.float32)
+    ex3 = np.asarray([[0.6, 0.55, 0.68, 0.66]], np.float32)
+    n_imgs = 2 if TINY else 4
+    imgs = [rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+            for _ in range(n_imgs)]
+    # the interactive mix: cold wave, exact repeats (result cache),
+    # same-image-new-exemplar (promotion fills, then feature-cache hits)
+    waves = [[(im, ex) for im in imgs], [(im, ex) for im in imgs],
+             [(im, ex2) for im in imgs], [(im, ex3) for im in imgs],
+             [(im, ex2) for im in imgs]]
+    flat = [r for w in waves for r in w]
+
+    def run_waves(engine, wave_list):
+        for wave in wave_list:
+            futs = [engine.submit(img, e) for img, e in wave]
+            for f in futs:
+                f.result(timeout=600)
+
+    # warmup on THROWAWAY images: compiles every program the timed waves
+    # hit (fused + backbone + heads at the wave batch shape) without
+    # seeding the measured workload's caches
+    _ = np.asarray(pred(imgs[0][None], ex[None])["scores"])
+    w_imgs = [rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+              for _ in range(n_imgs)]
+    with ServeEngine(pred) as warm:
+        run_waves(warm, [[(im, ex) for im in w_imgs],
+                         [(im, ex2) for im in w_imgs],
+                         [(im, ex3) for im in w_imgs]])
+
+    t0 = time.perf_counter()
+    for img, e in flat:
+        np.asarray(pred(img[None], e[None])["scores"])
+    seq = len(flat) / (time.perf_counter() - t0)
+
+    with ServeEngine(pred) as eng:
+        t0 = time.perf_counter()
+        run_waves(eng, waves)
+        serve = len(flat) / (time.perf_counter() - t0)
+        stats = eng.stats()
+    return {
+        "sequential_img_per_sec": round(seq, 3),
+        "serve_img_per_sec": round(serve, 3),
+        "speedup": round(serve / seq, 2),
+        "batch": stats["batch_bounds"],
+        "batch_occupancy": stats["batch_occupancy"],
+        "result_cache_hits": stats["result_cache"]["hits"],
+        "feature_cache_hits": stats["feature_cache"]["hits"],
+    }
+
+
 ALL = {
     "demo": bench_demo,
     "batch_sweep": bench_batch_sweep,
@@ -355,6 +427,7 @@ ALL = {
     "refine": bench_refine,
     "train": bench_train,
     "stream": bench_stream,
+    "serve": bench_serve,
 }
 
 

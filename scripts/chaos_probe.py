@@ -152,6 +152,9 @@ def _run(paths, encode, out_dir, *, resume=False, retry=None, expect_crash=False
 def main(argv=None) -> int:
     from tmr_tpu.diagnostics import validate_map_report
     from tmr_tpu.utils import faults
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()  # the gauntlet re-encodes shards repeatedly
     from tmr_tpu.parallel.mapreduce import (
         CATEGORIES,
         RetryPolicy,

@@ -49,6 +49,9 @@ import numpy as np  # noqa: E402
 
 
 def main(argv=None) -> int:
+    from tmr_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()  # probes jit self-checks; reuse them
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true", dest="as_doc",
                     help="emit ONE gate_probe/v1 JSON document")

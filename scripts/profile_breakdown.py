@@ -2,10 +2,11 @@
 
 Times the dominant stages of the fused FSCD-147 eval program in isolation —
 the full program, the SAM ViT-B backbone, one global- and one windowed-
-attention block at real dims, and the matcher x-corr at two capacity
-buckets; the residual full_program - backbone - xcorr attributes the
-head/decode/NMS tail — with the SAME methodology as bench.py (PERF.md
-Finding 1):
+attention block at real dims, the matcher x-corr at two capacity buckets,
+the decode+NMS tail, and the two 1024-channel decoder conv stacks + heads
+on the upsampled 128^2 grid (``decoder_heads`` — the post-attention budget
+PERF.md lists as the never-measured remaining candidate) — with the SAME
+methodology as bench.py (PERF.md Finding 1):
 device-staged inputs, iterations chained through a scalar data dependency
 inside each jitted program, one closing fetch, measured RTT floor
 subtracted — `jax.block_until_ready` is advisory over the tunneled
@@ -306,6 +307,51 @@ def main():
     report[f"decode_nms_tail_n{cfg.max_detections}"] = chained(
         tail_step, obj, reg, ex0, rtt=rtt
     )
+
+    # 6. decoder conv stacks + prediction heads in isolation (PERF.md
+    # "known remaining candidates"): the two channel-preserving 1024-ch
+    # 3x3 conv stacks (fusion doubles emb_dim=512) on the 2x-upsampled
+    # 128^2 grid, plus the 1x1 objectness/ltrb heads — the never-measured
+    # post-attention budget, so the next hardware window can attribute the
+    # full_program - backbone - xcorr residual between decode/NMS (stage 5)
+    # and these convs.
+    from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
+
+    c_cat = cfg.emb_dim * 2 if cfg.fusion else cfg.emb_dim
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    f_cat = jnp.asarray(
+        rng.standard_normal((BATCH, up_hw, up_hw, c_cat)), dtype
+    )
+    dec_o = Decoder(num_layers=cfg.decoder_num_layer,
+                    kernel_size=cfg.decoder_kernel_size, dtype=dtype)
+    dec_b = Decoder(num_layers=cfg.decoder_num_layer,
+                    kernel_size=cfg.decoder_kernel_size, dtype=dtype)
+    head_o = ObjectnessHead(dtype=dtype)
+    head_b = BboxesHead(dtype=dtype)
+
+    _progress(f"stage 6: decoder_heads ({c_cat}ch @ {up_hw}^2)")
+    key6 = jax.random.key(2)
+    dp = {
+        "dec_o": jax.jit(dec_o.init)(key6, f_cat)["params"],
+        "dec_b": jax.jit(dec_b.init)(key6, f_cat)["params"],
+        "head_o": jax.jit(head_o.init)(key6, f_cat)["params"],
+        "head_b": jax.jit(head_b.init)(key6, f_cat)["params"],
+    }
+
+    @jax.jit
+    def dec_step(p, x, fb):
+        x = x + fb.astype(x.dtype)
+        o = head_o.apply({"params": p["head_o"]},
+                         dec_o.apply({"params": p["dec_o"]}, x))
+        b = head_b.apply({"params": p["head_b"]},
+                         dec_b.apply({"params": p["dec_b"]}, x))
+        s = jnp.sum(o).astype(jnp.float32) + jnp.sum(b).astype(jnp.float32)
+        return (o, b), s * 0.0
+
+    report["decoder_heads"] = chained(
+        lambda x, fb: dec_step(dp, x, fb), f_cat, rtt=rtt
+    )
+    _progress(f"decoder_heads: {report['decoder_heads']*1000:.2f} ms")
 
     report = {
         k: (round(v, 5) if isinstance(v, float) else v)

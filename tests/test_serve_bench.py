@@ -1,0 +1,148 @@
+"""scripts/serve_bench.py: the serve_report/v1 contract.
+
+The smoke test runs the real script in a subprocess at tiny CPU shapes in
+a CLEAN env (no forced host-device count — conftest's 8 virtual devices
+change XLA:CPU's thread partitioning per batch shape, see test_serve.py)
+and asserts the acceptance checks: batched+cached speedup >= 1.5x over the
+sequential Predictor loop, results bitwise-identical to sequential, p99
+bounded, cache hits observed. The validator tests pin the schema both ways.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve_env(**extra):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS")
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TMR_BENCH_TINY="1",
+        TMR_BENCH_SIZE="128",
+        **extra,
+    )
+    return env
+
+
+def _valid_doc():
+    from tmr_tpu.diagnostics import SERVE_REPORT_SCHEMA
+
+    cache = {"result_cache": {"hits": 1, "misses": 2, "evictions": 0,
+                              "inserts": 2},
+             "feature_cache": {"hits": 0, "misses": 3, "evictions": 1,
+                               "inserts": 1}}
+    return {
+        "schema": SERVE_REPORT_SCHEMA,
+        "device": "cpu",
+        "config": {"image_size": 128, "batch": 4, "max_wait_ms": 10.0},
+        "workloads": [{
+            "name": "exact_closed", "mode": "closed", "requests": 11,
+            "throughput_img_per_sec": 1.2,
+            "latency_ms": {"p50": 10.0, "p95": 20.0, "p99": 30.0},
+            "batch_occupancy": {"4": 2, "3": 1},
+            "cache": cache,
+        }],
+        "checks": {"speedup_vs_sequential": 1.9, "speedup_ok": True,
+                   "exact_match": True, "p99_bounded": True,
+                   "cache_hit": True},
+    }
+
+
+def test_validate_serve_report_accepts_valid_and_error_docs():
+    from tmr_tpu.diagnostics import SERVE_REPORT_SCHEMA, validate_serve_report
+
+    assert validate_serve_report(_valid_doc()) == []
+    # bench_guard's wedge record is contractually valid
+    assert validate_serve_report(
+        {"schema": SERVE_REPORT_SCHEMA, "error": "watchdog: ..."}
+    ) == []
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda d: d.update(schema="bogus/v9"), "schema"),
+    (lambda d: d.pop("workloads"), "workloads"),
+    (lambda d: d["workloads"][0].update(mode="sideways"), "mode"),
+    (lambda d: d["workloads"][0]["latency_ms"].pop("p99"), "p99"),
+    (lambda d: d["workloads"][0].update(batch_occupancy={"4": "two"}),
+     "batch_occupancy"),
+    (lambda d: d["workloads"][0]["cache"].pop("feature_cache"),
+     "feature_cache"),
+    (lambda d: d.pop("checks"), "checks"),
+    (lambda d: d["checks"].pop("exact_match"), "exact_match"),
+    (lambda d: d.update(error=""), "error"),
+])
+def test_validate_serve_report_rejects_broken_docs(mutate, fragment):
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    doc = _valid_doc()
+    mutate(doc)
+    problems = validate_serve_report(doc)
+    assert problems, f"expected a problem for {fragment}"
+    assert any(fragment in p for p in problems), problems
+
+
+def test_serve_bench_tiny_smoke_meets_acceptance_checks(tmp_path):
+    """The acceptance proof, end to end on CPU: one JSON line, valid
+    serve_report/v1, speedup >= 1.5x, bitwise exactness, bounded p99,
+    cache hits > 0."""
+    out_file = tmp_path / "serve_report.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--tiny", "--batch", "4", "--out", str(out_file)],
+        env=_serve_env(), capture_output=True, text=True, timeout=560,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    doc = json.loads(lines[0])
+
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    assert validate_serve_report(doc) == []
+    assert "validator_problems" not in doc
+    checks = doc["checks"]
+    assert checks["exact_match"] is True
+    assert checks["speedup_ok"] is True, checks
+    assert checks["speedup_vs_sequential"] >= 1.5
+    assert checks["p99_bounded"] is True, checks
+    assert checks["cache_hit"] is True and checks["cache_hits"] > 0
+    names = [w["name"] for w in doc["workloads"]]
+    assert "exact_closed" in names and "mixed_closed" in names
+    assert any(n.startswith("open_rate_") for n in names)
+    open_w = next(w for w in doc["workloads"]
+                  if w["name"].startswith("open_rate_"))
+    assert open_w["mode"] == "open" and "offered_img_per_sec" in open_w
+    # --out wrote the same document
+    assert json.loads(out_file.read_text())["checks"] == checks
+    # progress goes to stderr, never stdout
+    assert "[serve_bench]" in out.stderr
+
+
+@pytest.mark.slow
+def test_serve_bench_watchdog_emits_error_record(tmp_path):
+    """A wedge yields the contractual one-line error record — still a
+    valid serve_report/v1 document (the bench_guard pattern)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--tiny"],
+        env=_serve_env(
+            TMR_BENCH_ALARM="1",
+            TMR_COMPILATION_CACHE=str(tmp_path / "xla-cache"),
+        ),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 2
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "watchdog" in rec["error"]
+
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    assert validate_serve_report(rec) == []

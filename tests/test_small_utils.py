@@ -48,6 +48,7 @@ def test_scrub_cpu_tunnel_env_wired_into_entry_points():
         os.path.join(repo, "scripts", "ckpt_probe.py"),
         os.path.join(repo, "scripts", "gate_probe.py"),
         os.path.join(repo, "scripts", "make_bench_ckpt.py"),
+        os.path.join(repo, "scripts", "serve_bench.py"),
     ]
     for path in entries:
         src = open(path).read()
@@ -128,3 +129,69 @@ def test_coco_index_read_paths(tmp_path):
     anns = idx.load_anns(ids)
     assert [a["id"] for a in anns] == sorted(ids)
     assert idx.get_ann_ids([9, 7]) and len(idx.get_ann_ids([9, 7])) == 3
+
+
+def test_compilation_cache_opt_out(monkeypatch):
+    """TMR_COMPILATION_CACHE=0 (and friends) must skip enabling entirely
+    — no directory creation, no jax config mutation — and return None."""
+    from tmr_tpu.utils import cache as cache_mod
+
+    def boom(*a, **k):
+        raise AssertionError("opt-out must not touch the filesystem")
+
+    for val in ("0", "off", "FALSE", " no "):
+        monkeypatch.setenv("TMR_COMPILATION_CACHE", val)
+        monkeypatch.setattr(cache_mod.os, "makedirs", boom)
+        assert cache_mod.enable_compilation_cache() is None
+
+
+def test_compilation_cache_failure_degrades_to_warning(
+    monkeypatch, tmp_path
+):
+    """An un-writable cache dir (or any enabling failure) warns and
+    returns None instead of crashing the caller — the uniform script call
+    sites must never turn a cache nicety into a benchmark failure."""
+    from tmr_tpu.utils import cache as cache_mod
+
+    monkeypatch.delenv("TMR_COMPILATION_CACHE", raising=False)
+
+    def denied(*a, **k):
+        raise OSError("read-only filesystem")
+
+    monkeypatch.setattr(cache_mod.os, "makedirs", denied)
+    with pytest.warns(UserWarning, match="compilation cache disabled"):
+        assert cache_mod.enable_compilation_cache(
+            str(tmp_path / "xla")
+        ) is None
+
+
+def test_compilation_cache_env_path_still_works(monkeypatch, tmp_path):
+    """A directory-valued TMR_COMPILATION_CACHE keeps meaning 'relocate':
+    the opt-out reading must not break the path reading."""
+    from tmr_tpu.utils import cache as cache_mod
+
+    target = tmp_path / "xla-cache"
+    monkeypatch.setenv("TMR_COMPILATION_CACHE", str(target))
+    calls = {}
+    monkeypatch.setattr(
+        cache_mod, "os",
+        type("O", (), {
+            "makedirs": staticmethod(
+                lambda p, exist_ok=False: calls.setdefault("dir", p)
+            ),
+            "environ": cache_mod.os.environ,
+            "path": cache_mod.os.path,
+        }),
+    )
+
+    class _Cfg:
+        @staticmethod
+        def update(k, v):
+            calls[k] = v
+
+    import jax
+
+    monkeypatch.setattr(jax, "config", _Cfg())
+    assert cache_mod.enable_compilation_cache() == str(target)
+    assert calls["dir"] == str(target)
+    assert calls["jax_compilation_cache_dir"] == str(target)

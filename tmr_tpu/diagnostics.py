@@ -93,6 +93,91 @@ def validate_map_report(doc: dict) -> List[str]:
             problems.append(f"{key}: not a list")
     return problems
 
+#: schema tag of the serving-layer benchmark document emitted by
+#: scripts/serve_bench.py (offered-load sweep over tmr_tpu/serve): per-
+#: workload throughput + latency percentiles + batch-occupancy histogram +
+#: cache hit rates, plus the acceptance checks (speedup vs the sequential
+#: Predictor loop, bitwise exactness, p99 bound, cache hit). bench_guard
+#: wraps the script, so a wedged tunnel yields {"schema": ..., "error":
+#: ...} — also a valid document per ``validate_serve_report``.
+SERVE_REPORT_SCHEMA = "serve_report/v1"
+
+#: closed workload-mode vocabulary in a serve_report/v1 document
+SERVE_WORKLOAD_MODES = ("closed", "open")
+
+
+def validate_serve_report(doc: dict) -> List[str]:
+    """Structural check of a serve_report/v1 document; returns a list of
+    problems (empty == valid). Dependency-free so CI harnesses can gate on
+    the report without importing the serving stack. An error record
+    ({"schema": ..., "error": str}) is contractually valid."""
+    problems: List[str] = []
+    if doc.get("schema") != SERVE_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {SERVE_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("config: not a dict")
+    else:
+        for key in ("batch", "max_wait_ms", "image_size"):
+            if key not in cfg:
+                problems.append(f"config: missing {key!r}")
+    workloads = doc.get("workloads")
+    if not isinstance(workloads, list) or not workloads:
+        problems.append("workloads: not a non-empty list")
+        workloads = []
+    for i, w in enumerate(workloads):
+        where = f"workloads[{i}]"
+        if not isinstance(w, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("name", "mode", "requests", "throughput_img_per_sec",
+                    "latency_ms", "batch_occupancy", "cache"):
+            if key not in w:
+                problems.append(f"{where}: missing {key!r}")
+        if w.get("mode") not in SERVE_WORKLOAD_MODES:
+            problems.append(f"{where}: bad mode {w.get('mode')!r}")
+        lat = w.get("latency_ms", {})
+        if not isinstance(lat, dict):
+            problems.append(f"{where}.latency_ms: not a dict")
+        else:
+            for q in ("p50", "p95", "p99"):
+                if not isinstance(lat.get(q), (int, float)):
+                    problems.append(f"{where}.latency_ms: missing {q!r}")
+        occ = w.get("batch_occupancy", {})
+        if not isinstance(occ, dict) or not all(
+            isinstance(v, int) for v in occ.values()
+        ):
+            problems.append(f"{where}.batch_occupancy: not {{size: count}}")
+        cache = w.get("cache", {})
+        if not isinstance(cache, dict):
+            problems.append(f"{where}.cache: not a dict")
+        else:
+            for which in ("result_cache", "feature_cache"):
+                sub = cache.get(which)
+                if not isinstance(sub, dict) or not all(
+                    k in sub for k in ("hits", "misses", "evictions")
+                ):
+                    problems.append(
+                        f"{where}.cache.{which}: missing hits/misses/"
+                        "evictions"
+                    )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("speedup_vs_sequential", "speedup_ok", "exact_match",
+                    "p99_bounded", "cache_hit"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 #: registry bound: the attention gates are lru_cached (one record per
 #: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
 #: backend / shape) record on EVERY call — a long-lived process that

@@ -15,7 +15,9 @@ host-selected static buckets, each compiled once and cached.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import functools
+
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,9 +102,16 @@ class Predictor:
         return batched_nms(dets, self.cfg.NMS_iou_threshold)
 
     def _get_fn(self, capacity: int, loss_fn=None,
-                chain_feedback: bool = False):
+                chain_feedback: bool = False, donate: bool = False):
         """Compiled forward -> decode -> [refine] -> NMS program for one
         template-capacity bucket.
+
+        ``donate=True`` donates the staged image buffer to the program
+        (``donate_argnums``): the serving layer's H2D staging buffers are
+        single-use, so XLA may alias them for scratch/output instead of
+        holding both live — only meaningful on backends that implement
+        donation (TPU/GPU; XLA:CPU ignores it with a warning, so the serve
+        engine requests it only there).
 
         With ``loss_fn(model_out, exemplars, *extra) -> losses`` the program
         additionally returns losses computed from the SAME forward — the
@@ -122,12 +131,21 @@ class Predictor:
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
         )
-        key = (capacity, refine, loss_fn, chain_feedback)
+        # int() the capacity: a numpy-int bucket (e.g. derived from array
+        # geometry by a caller) must land on the same compiled entry as the
+        # equal Python int — tuple keys compare equal but a second jit
+        # wrapper per int flavor would silently recompile
+        capacity = int(capacity)
+        key = (capacity, refine, loss_fn, chain_feedback, donate)
         if key in self._compiled:
             return self._compiled[key]
         model = self.model.clone(template_capacity=capacity)
+        jit = (
+            functools.partial(jax.jit, donate_argnums=(2,)) if donate
+            else jax.jit
+        )
 
-        @jax.jit
+        @jit
         def run(params, refiner_params, image, exemplars, *extra):
             if chain_feedback:
                 image = image + extra[-1]
@@ -149,15 +167,44 @@ class Predictor:
         return run
 
     def pick_capacity(self, exemplars: np.ndarray, image_size: int) -> int:
-        """Host-side template bucket for a batch: the largest per-exemplar need."""
-        hw = self.feature_hw(image_size)
+        """Host-side template bucket for a batch: the largest per-exemplar
+        need. Always a Python int (numpy ints from array-derived geometry
+        must not fork the ``_compiled`` key space)."""
+        hw = self.feature_hw(int(image_size))
         need = 1
         for ex in np.asarray(exemplars).reshape(-1, 4):
             need = max(
                 need,
                 select_capacity_bucket(ex, hw, hw, self.cfg.template_buckets),
             )
-        return need
+        return int(need)
+
+    def bucket_key(self, image_size: int, exemplars,
+                   multi: bool = False, k_real: Optional[int] = None
+                   ) -> Tuple[str, int, int, int]:
+        """The static-program bucket a request compiles into, as one
+        hashable tuple — the serving layer's coalescing key.
+
+        Returns ``("single", image_size, capacity, K)`` for the
+        ``__call__`` path (K = exemplar slots carried per image; the
+        matcher consumes slot 0) or ``("multi", image_size, capacity,
+        k_bucket)`` for the union-NMS multi-exemplar path. Requests with
+        equal keys batch into one jitted program; every element is a
+        Python int (see :meth:`pick_capacity`)."""
+        image_size = int(image_size)
+        exemplars = np.asarray(exemplars, np.float32).reshape(-1, 4)
+        if multi:
+            k = int(k_real) if k_real is not None else len(exemplars)
+            cap = self.pick_capacity(exemplars[:k], image_size)
+            k_bucket = int(next((b for b in self.K_BUCKETS if b >= k), k))
+            return ("multi", image_size, cap, k_bucket)
+        # __call__ sizes the template bucket from every carried slot
+        # (pick_capacity over the full (K, 4)) — mirror it exactly so a
+        # batched-serve request compiles into the same-capacity program as
+        # the sequential call it must match bitwise
+        return ("single", image_size, self.pick_capacity(exemplars,
+                                                         image_size),
+                len(exemplars))
 
     def __call__(self, image, exemplars) -> dict:
         """image (B, S, S, 3) float32 normalized; exemplars (B, K, 4).
@@ -199,6 +246,10 @@ class Predictor:
         refine = self.refiner is not None and getattr(
             self.cfg, "refine_box", False
         )
+        # int-normalized key: a numpy-int capacity/k_bucket (callers deriving
+        # them from array shapes) must hit the same compiled entry as the
+        # equal Python int instead of silently recompiling
+        capacity, k_bucket = int(capacity), int(k_bucket)
         key = ("multi", capacity, k_bucket, refine, loss_fn)
         if key in self._compiled:
             return self._compiled[key]
@@ -264,16 +315,27 @@ class Predictor:
         return run
 
     def predict_multi_exemplar(self, image, exemplars, loss_fn=None,
-                               loss_args=()):
+                               loss_args=(), k_real=None):
         """Reference multi-exemplar eval (trainer.py:75-121): per-exemplar
         decode, concatenated, single NMS over the union. image (1, S, S, 3);
         exemplars (K, 4). With ``loss_fn`` (see _get_multi_fn) returns
-        (losses summed over exemplars, dets); else just dets."""
+        (losses summed over exemplars, dets); else just dets.
+
+        ``k_real`` marks how many leading exemplar rows are real when the
+        caller hands over a pre-padded array (the serving layer does); rows
+        past it are ignored. Any integer flavor is accepted — the bucket
+        key is int-normalized, so a numpy-int ``k_real`` can never fork
+        ``_compiled`` into a recompile (pinned by tests/test_serve.py)."""
         if self.params is None:
             raise RuntimeError("call init_params() or load params first")
         exemplars = np.asarray(exemplars, np.float32).reshape(-1, 4)
-        k = len(exemplars)
-        k_bucket = next((b for b in self.K_BUCKETS if b >= k), k)
+        k = int(k_real) if k_real is not None else len(exemplars)
+        if not 1 <= k <= len(exemplars):
+            raise ValueError(
+                f"k_real={k} out of range for {len(exemplars)} exemplar rows"
+            )
+        exemplars = exemplars[:k]
+        k_bucket = int(next((b for b in self.K_BUCKETS if b >= k), k))
         pad = np.tile(exemplars[-1:], (k_bucket - k, 1))  # masked below
         cap = self.pick_capacity(exemplars, int(image.shape[1]))
         fn = self._get_multi_fn(cap, k_bucket, loss_fn=loss_fn)
@@ -285,6 +347,147 @@ class Predictor:
             jnp.asarray(k, jnp.int32),
             *loss_args,
         )
+
+
+    # ---------------------------------------------------------------- serve
+    # Batched entry points for the throughput serving layer (tmr_tpu/serve):
+    # the batcher coalesces single-image requests into these fixed-(B, K)
+    # programs, pads ragged tails, and unpads per request. They reuse the
+    # exact _decode/_refine_nms pipeline, so serve results stay the
+    # production numerics.
+
+    def _get_multi_batched_fn(self, capacity: int, k_bucket: int,
+                              donate: bool = False):
+        """The B>1 generalization of :meth:`_get_multi_fn`: encoder once per
+        image, heads batched over B*k_bucket exemplar rows, one union NMS
+        per image. image (B, S, S, 3); exemplars (B, k_bucket, 4); k_real
+        (B,) int32 — each image masks its own padded rows, so a batch can
+        mix real exemplar counts inside one k bucket. The B=1 slice traces
+        the same op sequence as ``_get_multi_fn``."""
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity, k_bucket = int(capacity), int(k_bucket)
+        key = ("multi_batched", capacity, k_bucket, refine, donate)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model.clone(template_capacity=capacity)
+        heads = model.clone(backbone=_PassthroughBackbone())
+        jit = (
+            functools.partial(jax.jit, donate_argnums=(2,)) if donate
+            else jax.jit
+        )
+
+        @jit
+        def run(params, refiner_params, image, exemplars, k_real):
+            b = image.shape[0]
+            feat = model.backbone.apply(
+                {"params": params["backbone"]}, image
+            )
+            if isinstance(feat, (list, tuple)):
+                if len(feat) != 1:
+                    raise NotImplementedError(
+                        "fused multi-exemplar inference supports single-"
+                        "level backbones only (every shipped backbone is)"
+                    )
+                feat = feat[0]
+            head_params = {n: v for n, v in params.items() if n != "backbone"}
+            out = heads.apply(
+                {"params": head_params},
+                jnp.repeat(feat, k_bucket, axis=0),  # image-major (B*k, ...)
+                exemplars.reshape(b * k_bucket, 1, 4),
+            )
+            dets = self._decode(out, exemplars.reshape(b * k_bucket, 4))
+            row_ok = jnp.arange(k_bucket)[None, :] < k_real[:, None]
+            dets["valid"] = dets["valid"] & row_ok.reshape(-1)[:, None]
+            merged = {
+                name: dets[name].reshape((b, -1) + dets[name].shape[2:])
+                for name in ("boxes", "scores", "refs", "valid")
+            }
+            return self._refine_nms(
+                merged, feat, (image.shape[1], image.shape[2]),
+                refiner_params, refine,
+            )
+
+        self._compiled[key] = run
+        return run
+
+    def predict_multi_batch(self, images, exemplars, k_real,
+                            donate: bool = False) -> dict:
+        """Batched union-NMS inference: images (B, S, S, 3), exemplars
+        (B, k_bucket, 4) pre-padded to one k bucket, k_real (B,) real row
+        counts. Returns fixed-slot dets with leading dim B."""
+        if self.params is None:
+            raise RuntimeError("call init_params() or load params first")
+        exemplars = jnp.asarray(exemplars)
+        fn = self._get_multi_batched_fn(
+            self.pick_capacity(exemplars, int(images.shape[1])),
+            int(exemplars.shape[1]), donate=donate,
+        )
+        return fn(
+            self.params, self.refiner_params, jnp.asarray(images),
+            exemplars, jnp.asarray(k_real, jnp.int32),
+        )
+
+    def _get_backbone_fn(self):
+        """Encoder-only program: image (B, S, S, 3) -> pre-upsample backbone
+        features (B, h, w, C) — the tensor the serving layer's image-feature
+        cache stores, and exactly what :meth:`_get_heads_fn` consumes."""
+        key = ("backbone",)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        @jax.jit
+        def run(params, image):
+            f = self.model.backbone.apply({"params": params["backbone"]},
+                                          image)
+            if isinstance(f, (list, tuple)):
+                if len(f) != 1:
+                    raise NotImplementedError(
+                        "feature-cached serving supports single-level "
+                        "backbones only (every shipped backbone is)"
+                    )
+                f = f[0]
+            return f
+
+        self._compiled[key] = run
+        return run
+
+    def _get_heads_fn(self, capacity: int, image_size: int):
+        """Heads-on-precomputed-features program for one capacity bucket:
+        features (B, h, w, C) from :meth:`_get_backbone_fn` -> the same
+        upsample/proj/match/decode/[refine]/NMS tail as ``_get_fn``.
+
+        Feature-cache hits skip the encoder (the dominant cost) through
+        this program. Because the tail compiles as its OWN XLA program
+        here, its outputs can differ from the fused single program at the
+        last-ULP level (different fusion decisions); the serving layer
+        documents this and keeps the bitwise-exactness contract on the
+        fused path only."""
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity, image_size = int(capacity), int(image_size)
+        key = ("heads", capacity, image_size, refine)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model.clone(template_capacity=capacity)
+
+        @jax.jit
+        def run(params, refiner_params, features, exemplars):
+            out = model.apply(
+                {"params": params},
+                jnp.zeros((features.shape[0], 1, 1, 3), jnp.float32),
+                exemplars, features=features,
+            )
+            dets = self._decode(out, exemplars[:, 0, :])
+            return self._refine_nms(
+                dets, out["backbone_feature"], (image_size, image_size),
+                refiner_params, refine,
+            )
+
+        self._compiled[key] = run
+        return run
 
 
 def detections_to_numpy(dets: dict) -> list:

@@ -1,0 +1,22 @@
+"""Throughput serving layer over the bucketed fused inference programs.
+
+``ServeEngine`` is the entry point: dynamic micro-batching under a latency
+bound, content-addressed exemplar/feature caches, and pipelined
+round-robin multi-device dispatch — see engine.py for the architecture and
+contracts, scripts/serve_bench.py for the measured proof.
+"""
+
+from tmr_tpu.serve.batcher import MicroBatcher, Request
+from tmr_tpu.serve.caches import LRUCache, array_digest
+from tmr_tpu.serve.engine import ServeEngine
+from tmr_tpu.serve.staging import DeviceStager, StagedBatch
+
+__all__ = [
+    "DeviceStager",
+    "LRUCache",
+    "MicroBatcher",
+    "Request",
+    "ServeEngine",
+    "StagedBatch",
+    "array_digest",
+]

@@ -1,0 +1,145 @@
+"""Dynamic micro-batching: coalesce single-image requests into bucket
+programs under a latency bound.
+
+The queue discipline (the StreamFlow lesson from PAPERS.md applied to the
+eval path): requests accumulate per bucket key (Predictor.bucket_key — one
+compiled program per key) and a batch is released when EITHER
+
+- a bucket reaches its size bound (``bound_for(bucket)`` — by default the
+  measured throughput-optimal batch from bench_extra's sweep via the
+  autotune cache, see engine.py), or
+- the OLDEST request in a bucket has waited ``max_wait_ms`` (the latency
+  bound: a lone request is never held hostage to batch-filling).
+
+Ragged releases (timeout flushes, close-time drains) are padded up to the
+bound by the staging layer so every dispatch hits the one compiled program
+shape per bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class Request:
+    """One in-flight inference request riding the batching pipeline."""
+
+    image: Any  # host (S, S, 3) float32
+    exemplars: Any  # host (K, 4) float32 (multi: padded to k_bucket)
+    bucket: tuple  # Predictor.bucket_key(...)
+    futures: List[Any] = field(default_factory=list)  # resolved together
+    t_submit: float = field(default_factory=time.perf_counter)
+    k_real: int = 1  # multi path: real exemplar rows
+    image_digest: str = ""
+    result_key: Optional[tuple] = None  # exemplar/result-cache key
+    features: Any = None  # cached device features (heads path, hit)
+    needs_features: bool = False  # heads path, promotion fill
+
+    def resolve(self, value) -> None:
+        for f in self.futures:
+            if not f.done():
+                f.set_result(value)
+
+    def fail(self, exc: BaseException) -> None:
+        for f in self.futures:
+            if not f.done():
+                f.set_exception(exc)
+
+
+class MicroBatcher:
+    """Per-bucket request queue with size- and latency-bounded release.
+
+    ``next_batch()`` blocks until a batch is due and returns
+    ``(bucket, [Request, ...])`` — or None once the batcher is closed AND
+    drained (the consumer thread's shutdown signal). Thread-safe: any
+    number of producers (``put``), one consumer.
+    """
+
+    def __init__(self, max_wait_ms: float,
+                 bound_for: Callable[[tuple], int]):
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.bound_for = bound_for
+        # ordered so the flush scan visits buckets in first-use order —
+        # no bucket can be starved behind a constantly-full sibling
+        self._pending: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._cond = threading.Condition()
+        self._closed = False
+        #: released-batch size histogram {occupied_slots: count} — the
+        #: serve report's batch-occupancy evidence
+        self.occupancy: Counter = Counter()
+
+    def put(self, req: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._pending.setdefault(req.bucket, deque()).append(req)
+            self._cond.notify()
+
+    def close(self) -> None:
+        """Stop accepting; pending requests still drain via next_batch."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _pop(self, bucket: tuple, n: int) -> Tuple[tuple, List[Request]]:
+        dq = self._pending[bucket]
+        out = [dq.popleft() for _ in range(min(n, len(dq)))]
+        if not dq:
+            del self._pending[bucket]
+        else:
+            # rotate a bucket that released but still holds requests to the
+            # back of the scan order: a sustained-load bucket must not
+            # monopolize rule 2's full-bucket scan while siblings queue
+            self._pending.move_to_end(bucket)
+        self.occupancy[len(out)] += 1
+        return bucket, out
+
+    def next_batch(self) -> Optional[Tuple[tuple, List[Request]]]:
+        with self._cond:
+            while True:
+                # 1. an EXPIRED latency deadline releases first — the
+                # max_wait_ms bound holds even while a sibling bucket is
+                # kept full by sustained load (full buckets can wait one
+                # round; an expired lone request has already waited its
+                # contractual maximum)
+                now = time.perf_counter()
+                deadline = None
+                due = None
+                for bucket, dq in self._pending.items():
+                    t = dq[0].t_submit + self.max_wait_s
+                    if deadline is None or t < deadline:
+                        deadline, due = t, bucket
+                if deadline is not None and now >= deadline:
+                    return self._pop(
+                        due, max(1, int(self.bound_for(due)))
+                    )
+                # 2. any full bucket releases immediately (first-use order,
+                # rotated by _pop so equals take turns)
+                for bucket, dq in self._pending.items():
+                    bound = max(1, int(self.bound_for(bucket)))
+                    if len(dq) >= bound:
+                        return self._pop(bucket, bound)
+                if self._closed:
+                    # drain: flush partial buckets oldest-first
+                    for bucket in self._pending:
+                        return self._pop(
+                            bucket, max(1, int(self.bound_for(bucket)))
+                        )
+                    return None
+                # 3. else sleep until the earliest deadline (or new work)
+                self._cond.wait(
+                    timeout=None if deadline is None else deadline - now
+                )
+
+    def pending(self) -> int:
+        with self._cond:
+            return sum(len(d) for d in self._pending.values())
+
+    def occupancy_snapshot(self) -> Dict[int, int]:
+        with self._cond:
+            return dict(self.occupancy)

@@ -1,0 +1,101 @@
+"""Content-addressed LRU caches for the serving layer.
+
+Two instances back the engine (tmr_tpu/serve/engine.py):
+
+- the **exemplar/result cache** — keyed by (image digest, exemplar bytes,
+  bucket), holding finished per-request detections. Interactive users
+  re-querying the same pattern on the same image skip the device entirely,
+  and the stored result is the bytes the original request returned, so a
+  hit is bitwise-identical by construction.
+- the **image-feature cache** — keyed by (image digest, image size),
+  holding the encoder's pre-upsample feature map ON DEVICE. The
+  multi-query-same-image pattern re-runs only the matcher/head tail
+  (Predictor._get_heads_fn) against it.
+
+Both expose hit/miss/eviction/insert counters (``stats()``) — the serve
+report's cache section — and are thread-safe: the engine's submit path and
+its completion thread touch them concurrently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+import numpy as np
+
+
+def array_digest(*arrays) -> str:
+    """Content digest of numpy arrays: dtype + shape + bytes, so two
+    logically different tensors that share a byte pattern (e.g. a (4,)
+    f32 vs a (16,) u8) can never collide onto one key."""
+    h = hashlib.sha1()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class LRUCache:
+    """Bounded LRU mapping with observable counters.
+
+    ``capacity <= 0`` constructs a disabled cache: every ``get`` misses,
+    ``put`` is a no-op — callers never need an "is caching on" branch.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._data: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.inserts = 0
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                self.hits += 1
+                return self._data[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: Hashable, value: Any) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            self._data[key] = value
+            self.inserts += 1
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __contains__(self, key: Hashable) -> bool:
+        """Presence probe that does NOT touch the hit/miss counters (or
+        recency) — bookkeeping lookups must not masquerade as traffic."""
+        with self._lock:
+            return key in self._data
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "capacity": self.capacity,
+                "size": len(self._data),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "inserts": self.inserts,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }

@@ -1,0 +1,480 @@
+"""ServeEngine: throughput-oriented serving on top of Predictor's bucketed
+jitted programs.
+
+Pipeline (one thread per stage, bounded queues between them):
+
+    submit() -> [result cache / in-flight coalescing / feature routing]
+        -> MicroBatcher (dynamic micro-batching under max_wait_ms)
+        -> staging thread (pad + stack + device_put, round-robin devices,
+           depth-2 queue = double-buffered prefetch)
+        -> dispatch thread (the bucket's jitted program; async dispatch)
+        -> completion thread (one fetch per batch, unpad, resolve futures,
+           populate caches)
+
+Contracts:
+
+- **Exactness**: a request served through the fused batched path returns
+  detections bitwise-identical to ``Predictor.__call__`` /
+  ``predict_multi_exemplar`` on the same inputs — padded slots are
+  dropped, real rows are untouched (tests/test_serve.py pins this across
+  bucket boundaries). The feature-cached path (``_get_heads_fn``) recompiles
+  the tail as its own XLA program and may differ at the last ULP; cold
+  traffic never takes it (promotion starts at an image's second sighting).
+- **Isolation**: a request that cannot be served fails only its own
+  future. Malformed requests are rejected at submit; a batch-level failure
+  falls back to per-request execution so one poison request cannot sink
+  its batch-mates.
+- **Measured defaults**: the batch bound defaults to the measured
+  throughput-optimal batch persisted by bench_extra's sweep
+  (utils/autotune.measured_bench_batch), then ``TMR_SERVE_BATCH``/the
+  constructor argument override it.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tmr_tpu.serve.batcher import MicroBatcher, Request
+from tmr_tpu.serve.caches import LRUCache, array_digest
+from tmr_tpu.serve.staging import DeviceStager, StagedBatch
+
+_DET_FIELDS = ("boxes", "scores", "refs", "valid")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class ServeEngine:
+    """Batched, cached, multi-device request serving for one Predictor.
+
+    Parameters
+    ----------
+    predictor: an initialized tmr_tpu.inference.Predictor (params loaded).
+    batch: per-bucket coalescing bound. None resolves, in order:
+        ``TMR_SERVE_BATCH`` env -> the measured bench_extra batch-sweep
+        winner for this (device kind, image size) -> 4.
+    max_wait_ms: latency bound a lone request waits for batch-mates
+        (None -> ``TMR_SERVE_MAX_WAIT_MS``, default 10).
+    devices: explicit device list for round-robin data-parallel dispatch.
+        None -> all local devices on TPU; the first device elsewhere
+        (virtual CPU devices share host threads — round-robin over them
+        buys compilations, not throughput).
+    exemplar_cache / feature_cache: LRU capacities (None -> env knobs
+        ``TMR_SERVE_EXEMPLAR_CACHE`` (default 256) /
+        ``TMR_SERVE_FEATURE_CACHE`` (default 8); 0 disables).
+    donate: donate staged image buffers to the program (None -> only on
+        backends that implement donation: tpu/gpu).
+    """
+
+    def __init__(self, predictor, *, batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 exemplar_cache: Optional[int] = None,
+                 feature_cache: Optional[int] = None,
+                 donate: Optional[bool] = None):
+        import jax
+
+        if predictor.params is None:
+            raise RuntimeError("predictor has no params loaded")
+        self._pred = predictor
+        self._explicit_batch = batch
+        self.max_wait_ms = (
+            _env_float("TMR_SERVE_MAX_WAIT_MS", 10.0)
+            if max_wait_ms is None else float(max_wait_ms)
+        )
+        backend = jax.default_backend()
+        if devices is None:
+            local = jax.local_devices()
+            # accelerators round-robin across every local device; only the
+            # CPU backend pins to one (virtual host "devices" share the
+            # same threads — round-robin there buys compiles, not speed)
+            devices = local if backend in ("tpu", "gpu") else local[:1]
+        self.devices = list(devices)
+        self.donate = (
+            backend in ("tpu", "gpu") if donate is None else bool(donate)
+        )
+        self.result_cache = LRUCache(
+            _env_int("TMR_SERVE_EXEMPLAR_CACHE", 256)
+            if exemplar_cache is None else exemplar_cache
+        )
+        self.feature_cache = LRUCache(
+            _env_int("TMR_SERVE_FEATURE_CACHE", 8)
+            if feature_cache is None else feature_cache
+        )
+        # image digests seen once: the second sighting promotes the image
+        # into the feature cache (cold traffic stays on the bitwise-exact
+        # fused path; hot images amortize one split-path fill)
+        self._seen = LRUCache(max(4 * self.feature_cache.capacity, 16))
+
+        self._batch_bounds: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._inflight: Dict[tuple, Request] = {}
+        self._closed = False
+        self.counters = {
+            "submitted": 0, "completed": 0, "errors": 0, "rejected": 0,
+            "coalesced": 0, "batches": 0, "padded_slots": 0,
+            "batch_fallbacks": 0, "heads_batches": 0, "feature_fills": 0,
+        }
+        self._per_device: Dict[str, int] = {}
+
+        self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for)
+        self._stager = DeviceStager(
+            self.devices, predictor.params, predictor.refiner_params
+        )
+        self._staged_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._done_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._threads = [
+            threading.Thread(target=self._stage_loop, name="serve-stage",
+                             daemon=True),
+            threading.Thread(target=self._dispatch_loop,
+                             name="serve-dispatch", daemon=True),
+            threading.Thread(target=self._complete_loop,
+                             name="serve-complete", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -------------------------------------------------------------- sizing
+    def _bound_for(self, bucket: tuple) -> int:
+        """Coalescing bound for a bucket: explicit arg > TMR_SERVE_BATCH >
+        measured bench_extra winner for this image size > 4."""
+        size = bucket[1]
+        if size in self._batch_bounds:
+            return self._batch_bounds[size]
+        if self._explicit_batch is not None:
+            bound = int(self._explicit_batch)
+        else:
+            bound = _env_int("TMR_SERVE_BATCH", 0)
+            if bound <= 0:
+                from tmr_tpu.utils.autotune import measured_bench_batch
+
+                bound = measured_bench_batch(size) or 4
+        bound = max(1, bound)
+        self._batch_bounds[size] = bound
+        return bound
+
+    # -------------------------------------------------------------- submit
+    def submit(self, image, exemplars, multi: bool = False,
+               k_real: Optional[int] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the
+        fixed-slot detections dict (numpy, leading dim 1 — treat as
+        read-only, results may be shared with the cache).
+
+        A request that cannot be served (bad shapes, an exemplar needing a
+        template bucket beyond cfg.template_buckets, ...) fails only its
+        own future."""
+        fut: Future = Future()
+        if self._closed:
+            fut.set_exception(RuntimeError("engine is closed"))
+            return fut
+        try:
+            req = self._make_request(image, exemplars, multi, k_real, fut)
+        except Exception as e:  # isolation: reject this request alone
+            with self._lock:
+                self.counters["rejected"] += 1
+            fut.set_exception(e)
+            return fut
+        if req is None:  # resolved from cache / coalesced
+            return fut
+        try:
+            self._batcher.put(req)
+        except Exception as e:  # closed mid-submit: a rejection, not traffic
+            self._drop_inflight(req)
+            with self._lock:
+                self.counters["rejected"] += 1
+            fut.set_exception(e)
+            return fut
+        with self._lock:
+            self.counters["submitted"] += 1
+        return fut
+
+    def predict(self, image, exemplars, **kw) -> dict:
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(image, exemplars, **kw).result()
+
+    def _make_request(self, image, exemplars, multi, k_real,
+                      fut) -> Optional[Request]:
+        image = np.asarray(image, np.float32)
+        if image.ndim == 4 and image.shape[0] == 1:
+            image = image[0]
+        if image.ndim != 3 or image.shape[0] != image.shape[1] \
+                or image.shape[2] != 3:
+            raise ValueError(
+                f"expected one square (S, S, 3) image, got {image.shape}"
+            )
+        ex = np.asarray(exemplars, np.float32).reshape(-1, 4)
+        size = int(image.shape[0])
+        k = int(k_real) if k_real is not None else len(ex)
+        if not 1 <= k <= len(ex):
+            raise ValueError(
+                f"k_real={k} out of range for {len(ex)} exemplar rows"
+            )
+        bucket = self._pred.bucket_key(size, ex, multi=multi, k_real=k_real)
+        if multi:
+            ex = ex[:k]
+            k_bucket = bucket[3]
+            ex = np.concatenate(
+                [ex, np.tile(ex[-1:], (k_bucket - k, 1))], axis=0
+            )
+        digest = array_digest(image)
+        result_key = (bucket, digest, array_digest(ex[:k] if multi else ex),
+                      k if multi else None)
+
+        cached = self.result_cache.get(result_key)
+        if cached is not None:
+            fut.set_result(cached)
+            with self._lock:
+                self.counters["submitted"] += 1
+                self.counters["completed"] += 1
+            return None
+
+        req = Request(image=image, exemplars=ex, bucket=bucket,
+                      futures=[fut], k_real=k, image_digest=digest,
+                      result_key=result_key)
+        if not multi and self.feature_cache.capacity > 0:
+            feat = self.feature_cache.get((digest, size))
+            if feat is not None:
+                req.features = feat
+                req.bucket = ("heads",) + bucket[1:]
+            elif (digest, size) in self._seen:
+                req.needs_features = True
+                req.bucket = ("heads",) + bucket[1:]
+            else:
+                self._seen.put((digest, size), True)
+        # lookup + registration under ONE lock hold: a second identical
+        # submit racing this one must either see our registration or win
+        # the slot itself — split critical sections would let both execute
+        # and silently defeat the dedup (TOCTOU)
+        with self._lock:
+            live = self._inflight.get(result_key)
+            if live is not None:
+                live.futures.append(fut)
+                self.counters["submitted"] += 1
+                self.counters["coalesced"] += 1
+                return None
+            self._inflight[result_key] = req
+        return req
+
+    # ------------------------------------------------------------- threads
+    def _stage_loop(self) -> None:
+        while True:
+            nb = self._batcher.next_batch()
+            if nb is None:
+                self._staged_q.put(None)
+                return
+            bucket, reqs = nb
+            try:
+                staged = self._stager.stage(
+                    bucket, reqs, self._bound_for(bucket)
+                )
+                with self._lock:
+                    self.counters["batches"] += 1
+                    self.counters["padded_slots"] += staged.padded_slots
+                    dev = str(staged.device)
+                    self._per_device[dev] = self._per_device.get(dev, 0) + 1
+                self._staged_q.put(staged)
+            except Exception as e:
+                self._isolate(reqs, e)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            staged = self._staged_q.get()
+            if staged is None:
+                self._done_q.put(None)
+                return
+            try:
+                out, fill_feats = self._run_batch(staged)
+                self._done_q.put((staged, out, fill_feats))
+            except Exception as e:
+                self._isolate(staged.requests, e, batch_level=True)
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                return
+            staged, out, fill_feats = item
+            try:
+                self._finish(staged, out, fill_feats)
+            except Exception as e:
+                self._isolate(staged.requests, e, batch_level=True)
+
+    # ------------------------------------------------------------ dispatch
+    def _run_batch(self, staged: StagedBatch):
+        """Run the bucket's jitted program on the staged arrays. Returns
+        (dets, fill_features) — fill_features is the heads path's freshly
+        encoded (n_fill, h, w, C) device array (None elsewhere)."""
+        kind, size, cap, k = staged.bucket
+        params, rparams = self._stager.params_for(staged.device)
+        if kind == "single":
+            fn = self._pred._get_fn(cap, donate=self.donate)
+            return fn(params, rparams, staged.images, staged.exemplars), None
+        if kind == "multi":
+            fn = self._pred._get_multi_batched_fn(cap, k,
+                                                  donate=self.donate)
+            return fn(params, rparams, staged.images, staged.exemplars,
+                      staged.k_real), None
+        if kind == "heads":
+            return self._run_heads(staged, params, rparams, size, cap)
+        raise RuntimeError(f"unknown bucket kind {kind!r}")
+
+    def _run_heads(self, staged: StagedBatch, params, rparams, size, cap):
+        import jax.numpy as jnp
+
+        with self._lock:
+            self.counters["heads_batches"] += 1
+        fill_feats = None
+        if staged.fill_index:
+            bb = self._pred._get_backbone_fn()
+            fill_feats = bb(params, staged.images)
+            with self._lock:
+                self.counters["feature_fills"] += len(staged.fill_index)
+        rows: List[Any] = []
+        fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
+        for i in range(len(staged.requests)):
+            if i in fill_pos:
+                rows.append(fill_feats[fill_pos[i]:fill_pos[i] + 1])
+            else:
+                rows.append(staged.features[i])
+        bound = staged.exemplars.shape[0]
+        pad = bound - len(rows)
+        if pad:
+            rows.extend([jnp.zeros_like(rows[0])] * pad)
+        feats = jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+        fn = self._pred._get_heads_fn(cap, size)
+        return fn(params, rparams, feats, staged.exemplars), fill_feats
+
+    # ---------------------------------------------------------- completion
+    def _finish(self, staged: StagedBatch, out: dict, fill_feats) -> None:
+        host = {name: np.asarray(out[name]) for name in _DET_FIELDS}
+        kind, size = staged.bucket[0], staged.bucket[1]
+        fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
+        for i, req in enumerate(staged.requests):
+            try:
+                # .copy(): a 1-row slice VIEW would pin the whole padded
+                # batch's host arrays alive for as long as the result sits
+                # in the cache (or with the caller) — a ~batch-size memory
+                # retention multiplier at production geometry
+                result = {
+                    name: host[name][i:i + 1].copy()
+                    for name in _DET_FIELDS
+                }
+                if req.result_key is not None:
+                    self.result_cache.put(req.result_key, result)
+                if kind == "heads" and i in fill_pos:
+                    self.feature_cache.put(
+                        (req.image_digest, size),
+                        fill_feats[fill_pos[i]:fill_pos[i] + 1],
+                    )
+                self._drop_inflight(req)
+                req.resolve(result)
+                with self._lock:
+                    # per FUTURE, not per request: coalesced duplicates
+                    # counted into `submitted` must land in a terminal
+                    # bucket too, or submitted - (completed+errors+rejected)
+                    # reads as phantom backlog forever
+                    self.counters["completed"] += len(req.futures)
+            except Exception as e:  # isolation: this request alone
+                self._drop_inflight(req)
+                req.fail(e)
+                with self._lock:
+                    self.counters["errors"] += len(req.futures)
+
+    # ------------------------------------------------------ error fallback
+    def _isolate(self, requests: List[Request], exc: BaseException,
+                 batch_level: bool = False) -> None:
+        """Batch-level failure -> per-request fallback: each request
+        re-runs alone through the predictor, so one poison request fails
+        alone while its batch-mates still get served."""
+        if batch_level:
+            with self._lock:
+                self.counters["batch_fallbacks"] += 1
+        for req in requests:
+            try:
+                result = self._run_single(req)
+                self._drop_inflight(req)
+                req.resolve(result)
+                with self._lock:
+                    self.counters["completed"] += len(req.futures)
+            except Exception as e:
+                self._drop_inflight(req)
+                req.fail(e)
+                with self._lock:
+                    self.counters["errors"] += len(req.futures)
+
+    def _run_single(self, req: Request) -> dict:
+        kind = req.bucket[0]
+        if kind == "multi":
+            dets = self._pred.predict_multi_exemplar(
+                req.image[None], req.exemplars, k_real=req.k_real
+            )
+        else:  # single and heads requests share __call__ semantics
+            dets = self._pred(req.image[None], req.exemplars[None])
+        return {name: np.asarray(dets[name]) for name in _DET_FIELDS}
+
+    def _drop_inflight(self, req: Request) -> None:
+        if req.result_key is None:
+            return
+        with self._lock:
+            if self._inflight.get(req.result_key) is req:
+                del self._inflight[req.result_key]
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self, timeout: float = 300.0) -> None:
+        """Drain pending requests and stop the pipeline threads."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._batcher.close()
+        for t in self._threads:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                raise RuntimeError(f"serve thread {t.name} failed to drain")
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- metrics
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            per_device = dict(self._per_device)
+        return {
+            **counters,
+            "batch_occupancy": {
+                str(k): v
+                for k, v in sorted(
+                    self._batcher.occupancy_snapshot().items()
+                )
+            },
+            "pending": self._batcher.pending(),
+            "result_cache": self.result_cache.stats(),
+            "feature_cache": self.feature_cache.stats(),
+            "devices": [str(d) for d in self.devices],
+            "per_device_batches": per_device,
+            "max_wait_ms": self.max_wait_ms,
+            "batch_bounds": {str(k): v
+                             for k, v in self._batch_bounds.items()},
+            "donate": self.donate,
+        }

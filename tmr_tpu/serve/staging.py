@@ -1,0 +1,157 @@
+"""Host->device staging for the serving pipeline.
+
+One formed batch becomes a :class:`StagedBatch`: host-side padding/stacking
+(a ragged tail pads up to its power-of-two sub-bucket — see ``_pad_to`` —
+with zero images and a dummy exemplar; padded rows compute garbage that
+unpadding drops, real rows are untouched, which is what keeps batched
+results bitwise-identical to sequential calls) followed by
+``jax.device_put`` onto the next device in a
+round-robin over the engine's device list. The engine runs this on a
+dedicated staging thread feeding a depth-2 queue, so batch N+1's H2D copy
+overlaps batch N's device compute (double buffering), and successive
+batches land on different chips for data-parallel multi-device serving —
+the eval path is embarrassingly parallel, no collective involved.
+
+Params are replicated lazily: the first batch staged for a device pays one
+params transfer; every later batch reuses the committed copy.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Sequence
+
+import numpy as np
+
+from tmr_tpu.serve.batcher import Request
+
+#: dummy exemplar box for padded slots — any in-range box works (the rows
+#: are dropped at unpad); mid-image keeps select_capacity_bucket happy
+_PAD_BOX = (0.45, 0.45, 0.55, 0.55)
+
+
+@dataclass
+class StagedBatch:
+    bucket: tuple
+    requests: List[Request]
+    device: Any
+    images: Any = None  # device (B, S, S, 3) f32; None for pure-hit heads
+    exemplars: Any = None  # device (B, K, 4) f32
+    k_real: Any = None  # device (B,) i32 (multi path)
+    features: Any = None  # device (B, h, w, C) (heads path, after fill)
+    fill_index: List[int] = field(default_factory=list)  # rows needing bb
+    padded_slots: int = 0
+    t_staged: float = 0.0
+
+
+def _pad_to(n: int, bound: int) -> int:
+    """Ragged-tail batch shape: the next power of two >= n, capped at the
+    bucket's bound. A lone timeout-flushed request must not pay a full
+    bound-sized execution (it collapses low-offered-load capacity and the
+    p99 bound), so tails run in power-of-two sub-buckets — at most
+    log2(bound) extra compiles per bucket, each shape compiled lazily on
+    first occurrence, and per-image results stay bitwise-identical (the
+    programs are batch-invariant per row; tests/test_serve.py)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return min(max(p, 1), max(bound, n))
+
+
+class DeviceStager:
+    """Round-robin device placement + lazy per-device params replication."""
+
+    def __init__(self, devices: Sequence[Any], params, refiner_params=None):
+        if not devices:
+            raise ValueError("DeviceStager needs at least one device")
+        self.devices = list(devices)
+        self._rr = itertools.cycle(self.devices)
+        self._host_params = (params, refiner_params)
+        self._per_device: dict = {}
+        self._lock = threading.Lock()
+
+    def params_for(self, device):
+        """(params, refiner_params) committed to ``device`` (cached)."""
+        with self._lock:
+            if device not in self._per_device:
+                import jax
+
+                self._per_device[device] = jax.device_put(
+                    self._host_params, device
+                )
+            return self._per_device[device]
+
+    def next_device(self):
+        return next(self._rr)
+
+    # ------------------------------------------------------------- staging
+    def stage(self, bucket: tuple, requests: List[Request],
+              bound: int) -> StagedBatch:
+        """Pad/stack the batch host-side and start its H2D transfers."""
+        import jax
+        import time
+
+        kind, size, _cap, k = bucket
+        bound = _pad_to(len(requests), int(bound))
+        device = self.next_device()
+        staged = StagedBatch(bucket=bucket, requests=list(requests),
+                             device=device,
+                             padded_slots=bound - len(requests))
+
+        if kind == "heads":
+            self._stage_heads(staged, bound, size, k, device)
+        else:
+            images = np.zeros((bound, size, size, 3), np.float32)
+            exemplars = np.tile(
+                np.asarray(_PAD_BOX, np.float32), (bound, k, 1)
+            )
+            for i, r in enumerate(requests):
+                images[i] = r.image
+                exemplars[i] = r.exemplars
+            staged.images = jax.device_put(images, device)
+            staged.exemplars = jax.device_put(exemplars, device)
+            if kind == "multi":
+                k_real = np.ones((bound,), np.int32)
+                for i, r in enumerate(requests):
+                    k_real[i] = r.k_real
+                staged.k_real = jax.device_put(k_real, device)
+        staged.t_staged = time.perf_counter()
+        return staged
+
+    def _stage_heads(self, staged: StagedBatch, bound: int, size: int,
+                     k: int, device) -> None:
+        """Heads-path staging: requests with cached features move only
+        their (tiny) exemplars; promotion fills move their image so the
+        dispatch thread can run the encoder for them. Cached features may
+        live on a different device (round-robin) — device_put moves them,
+        a no-op when already resident."""
+        import jax
+
+        requests = staged.requests
+        exemplars = np.tile(
+            np.asarray(_PAD_BOX, np.float32), (bound, k, 1)
+        )
+        for i, r in enumerate(requests):
+            exemplars[i] = r.exemplars
+        staged.exemplars = jax.device_put(exemplars, device)
+        staged.fill_index = [
+            i for i, r in enumerate(requests) if r.features is None
+        ]
+        if staged.fill_index:
+            # fills pad to a power-of-two sub-bucket like every other
+            # batch shape: the backbone program must compile at log2(bound)
+            # shapes, not once per distinct fill count — an encoder
+            # retrace at serving time is seconds of injected latency
+            n_fill = _pad_to(len(staged.fill_index), bound)
+            images = np.zeros((n_fill, size, size, 3), np.float32)
+            for j, i in enumerate(staged.fill_index):
+                images[j] = requests[i].image
+            staged.images = jax.device_put(images, device)
+        # hits: move each (1, h, w, C) feature to this batch's device
+        staged.features = [
+            None if r.features is None else jax.device_put(r.features,
+                                                           device)
+            for r in requests
+        ]

@@ -506,6 +506,31 @@ def bench_batch_cache_key(device_kind: str, image_size: int) -> str:
     return f"{device_kind}|bench_batch|{image_size}"
 
 
+def measured_bench_batch(
+    image_size: int, device_kind: Optional[str] = None
+) -> Optional[int]:
+    """The persisted throughput-optimal batch from bench_extra's batch
+    sweep for (device kind, image size), or None when never measured — the
+    shared reader behind bench.py's headline default and the serving
+    layer's coalescing bound (FastFlow's lesson: measured batch picks over
+    static guesses). Best-effort: any backend/cache problem reads as
+    "not measured"."""
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    picked = _cache_load().get(
+        bench_batch_cache_key(device_kind, int(image_size)), {}
+    ).get("TMR_BENCH_BATCH")
+    try:
+        return int(picked) if picked is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 CACHE_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "tmr_tpu", "autotune.json"
 )

@@ -2,27 +2,57 @@
 
 First compiles of the ViT-H/B programs cost tens of seconds to minutes;
 the jax persistent cache makes every later process on the same machine
-reuse them. Enabled by the CLIs (main.py, bench.py, demo.py,
-extract_feature.py) — library code never mutates global jax config.
+reuse them. Enabled uniformly by the CLIs and scripts that compile
+programs (main.py, bench.py, demo.py, extract_feature.py,
+scripts/bench_extra.py, scripts/serve_bench.py,
+scripts/profile_breakdown.py, scripts/gate_probe.py,
+scripts/chaos_probe.py, scripts/ckpt_probe.py,
+scripts/make_bench_ckpt.py) — library code never mutates global jax
+config.
+
+``TMR_COMPILATION_CACHE`` doubles as the knob: a directory path relocates
+the cache, and ``0``/``off``/``false`` opts out entirely (e.g. a CI job
+whose workdir must stay pristine, or when a corrupt cache is suspected).
+Failures to enable (read-only home, jax missing/ancient) degrade to a
+warning + None instead of raising, so the uniform call sites never turn a
+benchmark into a crash over a cache nicety.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 
 DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "tmr_tpu", "xla"
 )
 
+#: TMR_COMPILATION_CACHE values that mean "don't enable" rather than a path
+_OPT_OUT = ("0", "off", "false", "no")
 
-def enable_compilation_cache(path: str | None = None) -> str:
-    """Turn on the persistent compilation cache (idempotent)."""
-    import jax
 
-    path = path or os.environ.get("TMR_COMPILATION_CACHE", DEFAULT_DIR)
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    # cache every program regardless of size/compile time
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Turn on the persistent compilation cache (idempotent).
+
+    Returns the cache directory, or None when opted out
+    (``TMR_COMPILATION_CACHE=0``) or when enabling failed — failures warn
+    instead of raising so library/CLI callers can enable unconditionally.
+    """
+    env = os.environ.get("TMR_COMPILATION_CACHE", "")
+    if env.strip().lower() in _OPT_OUT:
+        return None
+    path = path or env or DEFAULT_DIR
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every program regardless of size/compile time
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception as e:  # cache is a nicety; never a crash
+        warnings.warn(
+            f"persistent compilation cache disabled: {type(e).__name__}: {e}"
+        )
+        return None
     return path
