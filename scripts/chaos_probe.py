@@ -211,6 +211,15 @@ def main(argv=None) -> int:
         check(point in fired_points, f"transient: fault at {point} fired")
     doc = t["report"]
     check(validate_map_report(doc) == [], "transient: map_report/v1 valid")
+    from tmr_tpu.diagnostics import validate_metrics_report
+
+    # the report document carries the registry snapshot (metrics key,
+    # schema-versioned) — counter state rides the same document
+    check(
+        validate_metrics_report(doc.get("metrics", {})) == []
+        and doc["metrics"]["counters"].get("map.retries", 0) >= 5,
+        "transient: metrics snapshot attached and counting retries",
+    )
     check(t["table"] == base["table"],
           "transient: reducer table identical to fault-free run")
     check(t["manifest"] == base["manifest"],

@@ -370,6 +370,10 @@ def _run(cancel_watchdog, argv=None) -> int:
         "cache_hit": bool(cache_hits > 0),
     }
     report["stats"] = engine.stats()
+    # the engine's metrics registry as one metrics_report/v1 document —
+    # latency AND counter state travel in the same JSON line (validated
+    # as part of validate_serve_report)
+    report["metrics"] = engine.metrics_snapshot()
     engine.close()
     report["wall_s"] = round(time.perf_counter() - wall0, 1)
     problems = validate_serve_report(report)

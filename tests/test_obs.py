@@ -1,0 +1,401 @@
+"""The unified telemetry subsystem (tmr_tpu/obs): span tracing, metrics
+registry, compile-event accounting — plus the contracts it must keep with
+the serving layer (ServeEngine.stats() shape-compatible with its PR 3
+form, LRUCache counters registry-backed, PhaseTimer thread-safe).
+
+The tracer's load-bearing contract is COST: disabled (TMR_TRACE=0) span
+enter/exit must stay at a few hundred ns amortized — the serve/map/train
+hot paths are instrumented unconditionally, so a regression here taxes
+every request in production.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    """Every test leaves tracing disabled and the rings drained — obs
+    state is process-global, test order must not matter."""
+    yield
+    obs.configure(enabled=False, annotate=True)
+    obs.clear()
+
+
+@pytest.fixture(scope="module")
+def pred64():
+    """One tiny Predictor for the integration tests (64² keeps the jitted
+    init + backbone compile to seconds on CPU)."""
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=64,
+                 compute_dtype="float32", batch_size=1)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=64)
+    return pred
+
+
+# ---------------------------------------------------------------- metrics
+def test_counter_gauge_histogram_basics():
+    reg = obs.MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    assert reg.counter("c").value == 5
+    reg.gauge("g").set(2.5)
+    assert reg.gauge("g").value == 2.5
+    h = reg.histogram("h")
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.observe(v)
+    assert h.count == 4 and h.min == 0.001 and h.max == 0.008
+    assert abs(h.sum - 0.015) < 1e-12
+    assert 0.0 < h.quantile(0.5) <= h.quantile(0.99) <= 0.008
+
+
+def test_registry_snapshot_is_valid_metrics_report():
+    from tmr_tpu.diagnostics import (
+        METRICS_REPORT_SCHEMA,
+        validate_metrics_report,
+    )
+
+    reg = obs.MetricsRegistry()
+    reg.counter("serve.submitted").inc(3)
+    reg.gauge("pool.depth").set(2)
+    reg.histogram("lat").observe(0.01)
+    snap = reg.snapshot()
+    assert snap["schema"] == METRICS_REPORT_SCHEMA
+    assert validate_metrics_report(snap) == []
+    assert snap["counters"]["serve.submitted"] == 3
+    hist = snap["histograms"]["lat"]
+    assert len(hist["counts"]) == len(hist["buckets_le"]) + 1
+    assert {"p50", "p95", "p99"} <= set(hist)
+    # snapshot round-trips JSON (the report-attachment contract)
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_registry_rejects_instrument_kind_clash():
+    reg = obs.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    with pytest.raises(TypeError):
+        reg.histogram("x")
+
+
+def test_validate_metrics_report_rejects_broken_docs():
+    from tmr_tpu.diagnostics import validate_metrics_report
+
+    good = obs.MetricsRegistry().snapshot()
+    assert validate_metrics_report(good) == []
+    assert validate_metrics_report({"schema": "bogus"})
+    bad = obs.MetricsRegistry()
+    bad.histogram("h").observe(1.0)
+    doc = bad.snapshot()
+    doc["histograms"]["h"]["counts"] = [1]  # wrong length
+    assert any("overflow" in p for p in validate_metrics_report(doc))
+    doc2 = obs.MetricsRegistry().snapshot()
+    doc2["counters"] = {"c": "three"}
+    assert any("not a number" in p for p in validate_metrics_report(doc2))
+
+
+def test_histogram_merge_and_reset():
+    a = obs.Histogram()
+    b = obs.Histogram()
+    for v in (0.001, 0.01):
+        a.observe(v)
+    b.observe(0.1)
+    a.merge(b)
+    assert a.count == 3 and a.max == 0.1
+    with pytest.raises(ValueError):
+        a.merge(obs.Histogram(buckets=(1.0, 2.0)))
+    a.reset()
+    assert a.count == 0 and a.min is None
+
+
+# ---------------------------------------------------------------- tracing
+def test_disabled_span_is_noop_and_cheap():
+    """TMR_TRACE=0 contract: span() returns the shared no-op (no
+    allocation, nothing recorded) at a few hundred ns amortized."""
+    obs.configure(enabled=False)
+    obs.clear()
+    s1 = obs.span("a")
+    s2 = obs.span("b", key="value")
+    assert s1 is s2  # the singleton: nothing allocated per call
+    with obs.span("x"):
+        pass
+    assert obs.spans() == []
+
+    span = obs.span
+    best = float("inf")
+    for _ in range(5):
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("x"):
+                pass
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best * 1e9 < 1500, f"disabled span cost {best * 1e9:.0f} ns"
+
+
+def test_spans_nest_within_and_across_threads():
+    obs.configure(enabled=True, annotate=False)
+    obs.clear()
+    with obs.span("outer", role="parent"):
+        with obs.span("inner"):
+            pass
+
+    def worker():
+        with obs.span("w_outer", trace_id="req-42"):
+            with obs.span("w_inner"):
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    by = {s["name"]: s for s in obs.spans()}
+    assert set(by) == {"outer", "inner", "w_outer", "w_inner"}
+    # nesting: child points at parent, inherits its trace id
+    assert by["inner"]["parent"] == by["outer"]["span"]
+    assert by["inner"]["trace"] == by["outer"]["trace"]
+    # explicit trace ids propagate to children; threads have distinct tids
+    assert by["w_inner"]["trace"] == "req-42"
+    assert by["w_outer"]["tid"] != by["outer"]["tid"]
+    assert by["outer"]["attrs"] == {"role": "parent"}
+    # thread rings don't leak nesting across threads
+    assert by["w_outer"]["parent"] == 0
+
+
+def test_chrome_trace_roundtrips_json():
+    obs.configure(enabled=True, annotate=False)
+    obs.clear()
+    with obs.span("stage_a"):
+        pass
+    obs.add_span("stage_b", 10.0, 10.5, trace_id="tid", custom="attr")
+    doc = json.loads(json.dumps(obs.chrome_trace()))
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(events) == 2
+    by = {e["name"]: e for e in events}
+    assert by["stage_b"]["dur"] == pytest.approx(0.5e6)  # microseconds
+    assert by["stage_b"]["args"]["trace"] == "tid"
+    assert by["stage_b"]["args"]["custom"] == "attr"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "thread_name" for e in meta)
+
+
+def test_ring_buffer_bounds_memory():
+    obs.configure(enabled=True, annotate=False, ring=16)
+    try:
+        obs.clear()
+        # a fresh thread gets the new ring size
+        def worker():
+            for i in range(50):
+                with obs.span(f"s{i}"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        names = [s["name"] for s in obs.spans()]
+        assert len(names) == 16  # oldest rolled off
+        assert names[-1] == "s49" and "s0" not in names
+        assert obs.dropped_spans() >= 34
+    finally:
+        obs.configure(ring=8192)
+
+
+def test_clear_while_recording_never_raises():
+    """clear() (any thread, the drain-before-measure protocol) racing a
+    recording thread must never crash the recorder — a pipeline thread
+    dying on telemetry would hang every pending request."""
+    obs.configure(enabled=True, annotate=False, ring=16)
+    try:
+        obs.clear()
+        errors = []
+
+        def recorder():
+            try:
+                for i in range(5000):
+                    obs.add_span("race", 0.0, 1.0)
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        t = threading.Thread(target=recorder)
+        t.start()
+        for _ in range(2000):
+            obs.clear()
+        t.join()
+        assert errors == []
+    finally:
+        obs.configure(ring=8192)
+
+
+def test_trace_annotation_enters_jax_region():
+    """annotate=True mirrors spans into jax.profiler.TraceAnnotation —
+    entering must compose with jit without error (the xprof alignment
+    path; content is only observable in a real capture)."""
+    import jax
+    import jax.numpy as jnp
+
+    obs.configure(enabled=True, annotate=True)
+    obs.clear()
+    f = jax.jit(lambda x: x * 2)
+    with obs.span("jitted_region"):
+        out = f(jnp.arange(4.0))
+    assert out.shape == (4,)
+    assert [s["name"] for s in obs.spans()] == ["jitted_region"]
+
+
+# ---------------------------------------------------------------- compile
+def test_track_compile_records_cold_then_key_change():
+    from tmr_tpu.diagnostics import COMPILE_EVENT_CAUSES
+
+    obs.drain_compile_events()
+    kind = "test_kind_obs_unit"
+    f1 = obs.track_compile(lambda x: x + 1, kind, ("k", 1),
+                           bucket={"capacity": 9})
+    assert f1(1) == 2 and f1(5) == 6  # second call: no second event
+    f2 = obs.track_compile(lambda x: x * 2, kind, ("k", 2))
+    assert f2(3) == 6
+    # a SECOND instance re-compiling an already-seen (kind, key) is
+    # expected warmup, not a storm: cause stays "cold"
+    f3 = obs.track_compile(lambda x: x - 1, kind, ("k", 1))
+    assert f3(1) == 0
+    events = [e for e in obs.compile_events() if e["kind"] == kind]
+    assert [e["cause"] for e in events] == ["cold", "key-change", "cold"]
+    assert all(e["cause"] in COMPILE_EVENT_CAUSES for e in events)
+    assert events[0]["key"] == repr(("k", 1))
+    assert events[0]["bucket"] == {"capacity": 9}
+    assert all(e["wall_s"] >= 0 for e in events)
+    reg = obs.get_registry()
+    assert reg.counter("compile.total").value >= 2
+    # drain clears the log but not the cold/key-change kind memory
+    assert obs.drain_compile_events()
+    assert obs.compile_events() == []
+
+
+def test_predictor_compile_cache_reports_events(pred64):
+    """Integration: a Predictor _compiled miss + first call records one
+    event; a cache hit records none (the no-recompile pin's telemetry
+    side). Uses the backbone-only program — the cheapest real compile."""
+    pred = pred64
+    obs.drain_compile_events()
+    bb = pred._get_backbone_fn()
+    img = np.zeros((1, 64, 64, 3), np.float32)
+    np.asarray(bb(pred.params, img))
+    events = [e for e in obs.compile_events() if e["kind"] == "backbone"]
+    assert len(events) == 1 and events[0]["wall_s"] > 0
+    # cache hit: same wrapped fn, no new event
+    assert pred._get_backbone_fn() is bb
+    np.asarray(bb(pred.params, img))
+    assert len([e for e in obs.compile_events()
+                if e["kind"] == "backbone"]) == 1
+
+
+# --------------------------------------------------------- phase timer
+def test_phase_timer_is_thread_safe():
+    from tmr_tpu.utils.profiling import PhaseTimer
+
+    t = PhaseTimer()
+
+    def worker():
+        for _ in range(200):
+            with t.phase("hot"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.counts["hot"] == 800  # no lost updates
+
+
+def test_phase_timer_feeds_registry():
+    from tmr_tpu.utils.profiling import PhaseTimer
+
+    reg = obs.MetricsRegistry()
+    t = PhaseTimer()
+    for _ in range(3):
+        with t.phase("step"):
+            pass
+    rep = t.report(registry=reg)
+    assert "PHASE" in rep and "step" in rep
+    snap = reg.snapshot()
+    assert snap["histograms"]["time/step"]["count"] == 3
+    assert t.as_dict() == {"time/step": pytest.approx(t.totals["step"])}
+
+
+def test_phase_timer_opens_spans_when_tracing():
+    from tmr_tpu.utils.profiling import PhaseTimer
+
+    obs.configure(enabled=True, annotate=False)
+    obs.clear()
+    t = PhaseTimer(span_prefix="train.")
+    with t.phase("step"):
+        pass
+    assert [s["name"] for s in obs.spans()] == ["train.step"]
+
+
+# ------------------------------------------------- serving-layer contracts
+def test_lru_cache_counters_live_in_registry():
+    from tmr_tpu.serve import LRUCache
+
+    reg = obs.MetricsRegistry()
+    c = LRUCache(2, registry=reg, name="serve.cache.result")
+    c.put("a", 1)
+    c.get("a")
+    c.get("missing")
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.cache.result.hits"] == 1
+    assert snap["counters"]["serve.cache.result.misses"] == 1
+    assert snap["counters"]["serve.cache.result.inserts"] == 1
+    # the stats() shape is byte-for-byte the PR 3 one
+    assert set(c.stats()) == {"capacity", "size", "hits", "misses",
+                              "evictions", "inserts", "hit_rate"}
+
+
+def test_serve_engine_stats_shape_is_pr3_compatible(pred64):
+    """ServeEngine.stats() must keep its exact PR 3 shape (keys and value
+    types) now that it reads from the metrics registry — consumers
+    (serve_bench, dashboards) parse it as-is."""
+    from tmr_tpu.serve import ServeEngine
+
+    with ServeEngine(pred64, batch=2, max_wait_ms=5) as eng:
+        stats = eng.stats()
+        counters = eng.counters
+        snap = eng.metrics_snapshot()
+    assert set(stats) == {
+        "submitted", "completed", "errors", "rejected", "coalesced",
+        "batches", "padded_slots", "batch_fallbacks", "heads_batches",
+        "feature_fills", "batch_occupancy", "pending", "result_cache",
+        "feature_cache", "devices", "per_device_batches", "max_wait_ms",
+        "batch_bounds", "donate",
+    }
+    for key in ("submitted", "completed", "errors", "rejected",
+                "coalesced", "batches", "padded_slots", "batch_fallbacks",
+                "heads_batches", "feature_fills"):
+        assert isinstance(stats[key], int), key
+    for which in ("result_cache", "feature_cache"):
+        assert set(stats[which]) == {"capacity", "size", "hits", "misses",
+                                     "evictions", "inserts", "hit_rate"}
+    assert isinstance(stats["batch_occupancy"], dict)
+    assert isinstance(stats["devices"], list)
+    assert isinstance(stats["donate"], bool)
+    # the counters dict attribute keeps its PR 3 keys
+    assert set(counters) == {
+        "submitted", "completed", "errors", "rejected", "coalesced",
+        "batches", "padded_slots", "batch_fallbacks", "heads_batches",
+        "feature_fills",
+    }
+    # and the same numbers travel in the engine's metrics_report/v1
+    from tmr_tpu.diagnostics import validate_metrics_report
+
+    assert validate_metrics_report(snap) == []
+    assert snap["counters"]["serve.submitted"] == stats["submitted"]
+    assert "serve.cache.result.hits" in snap["counters"]

@@ -1,11 +1,84 @@
-"""Direct unit coverage for small leaf modules (bench_guard, COCOIndex)."""
+"""Direct unit coverage for small leaf modules (bench_guard, COCOIndex)
+plus repo-wide hygiene lints (report-schema/validator parity, stdout
+discipline under tmr_tpu/)."""
 
+import ast
 import json
+import os
+import re
 
 import pytest
 
 from tmr_tpu.data.coco_index import COCOIndex
 from tmr_tpu.utils.bench_guard import run_guarded, scrub_cpu_tunnel_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------ report-protocol hygiene
+def test_every_report_schema_has_a_validator():
+    """Parity pin: every ``*_report/v1`` schema constant declared in
+    diagnostics.py must ship a matching ``validate_*`` function — a new
+    report format cannot drift in unvalidated."""
+    import tmr_tpu.diagnostics as diag
+
+    src = open(os.path.join(REPO, "tmr_tpu", "diagnostics.py")).read()
+    schemas = re.findall(
+        r'^([A-Z][A-Z_]*)_SCHEMA\s*=\s*"(\w+_report)/v\d+"', src, re.M
+    )
+    assert schemas, "no *_report schema constants found in diagnostics.py"
+    for const, tag in schemas:
+        validator = f"validate_{tag}"
+        assert callable(getattr(diag, validator, None)), (
+            f"{const}_SCHEMA ({tag}) has no diagnostics.{validator}()"
+        )
+
+
+def test_report_emitting_scripts_call_their_validator():
+    """Grep-driven pin: any scripts/*.py that references a
+    ``*_REPORT_SCHEMA`` constant (i.e. emits that report) must also
+    reference the matching ``validate_*_report`` — the self-check-before-
+    print discipline serve_bench established."""
+    import glob
+
+    checked = 0
+    for path in sorted(glob.glob(os.path.join(REPO, "scripts", "*.py"))):
+        src = open(path).read()
+        for const in set(re.findall(r"\b([A-Z][A-Z_]*?)_REPORT_SCHEMA\b",
+                                    src)):
+            validator = f"validate_{const.lower()}_report"
+            assert validator in src, (
+                f"{os.path.basename(path)} emits {const}_REPORT_SCHEMA "
+                f"but never calls {validator}()"
+            )
+            checked += 1
+    assert checked >= 2  # serve_bench + obs_probe at minimum
+
+
+def test_no_bare_stdout_prints_under_tmr_tpu():
+    """Stdout under tmr_tpu/ is reserved for machine-readable protocol
+    output (one-JSON-line reports, the Hadoop-streaming records — written
+    via sys.stdout.write); human-readable lines go to stderr through
+    profiling.log_* or ``print(..., file=sys.stderr)``. A bare ``print``
+    in library code corrupts whatever pipeline is parsing stdout."""
+    import glob
+
+    offenders = []
+    for path in sorted(glob.glob(os.path.join(REPO, "tmr_tpu", "**",
+                                              "*.py"), recursive=True)):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and not any(kw.arg == "file" for kw in node.keywords)
+            ):
+                rel = os.path.relpath(path, REPO)
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "bare print() to stdout in library code: " + ", ".join(offenders)
+    )
 
 
 def test_scrub_cpu_tunnel_env_strips_only_cpu_intent():
@@ -49,6 +122,7 @@ def test_scrub_cpu_tunnel_env_wired_into_entry_points():
         os.path.join(repo, "scripts", "gate_probe.py"),
         os.path.join(repo, "scripts", "make_bench_ckpt.py"),
         os.path.join(repo, "scripts", "serve_bench.py"),
+        os.path.join(repo, "scripts", "obs_probe.py"),
     ]
     for path in entries:
         src = open(path).read()

@@ -99,7 +99,9 @@ def main(argv=None):
     names = write_synthetic_fscd147(
         a.out, a.n_train, a.n_val, a.image_size, square=a.square, seed=a.seed
     )
-    print(f"[INFO] wrote {len(names)} images to {a.out}")
+    from tmr_tpu.utils.profiling import log_info
+
+    log_info(f"wrote {len(names)} images to {a.out}")
 
 
 if __name__ == "__main__":

@@ -48,11 +48,66 @@ MAP_SHARD_STATUSES = ("ok", "quarantined", "resumed")
 MAP_FAILURE_CAUSES = ("timeout", "exception")
 
 
+#: schema tag of a metrics-registry snapshot (tmr_tpu/obs/metrics.py
+#: ``MetricsRegistry.snapshot()``): every named counter/gauge/histogram at
+#: one instant. Report emitters attach it under a ``metrics`` key so one
+#: JSON line carries latency AND counter state; ``validate_map_report`` /
+#: ``validate_serve_report`` validate the attachment when present.
+METRICS_REPORT_SCHEMA = "metrics_report/v1"
+
+
+def validate_metrics_report(doc: dict) -> List[str]:
+    """Structural check of a metrics_report/v1 document; returns a list
+    of problems (empty == valid). Dependency-free like the others."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != METRICS_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {METRICS_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    for section in ("counters", "gauges", "histograms"):
+        if not isinstance(doc.get(section), dict):
+            problems.append(f"{section}: not a dict")
+    for name, v in (doc.get("counters") or {}).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"counters[{name!r}]: not a number")
+    for name, v in (doc.get("gauges") or {}).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"gauges[{name!r}]: not a number")
+    for name, h in (doc.get("histograms") or {}).items():
+        where = f"histograms[{name!r}]"
+        if not isinstance(h, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("buckets_le", "counts", "count", "sum",
+                    "p50", "p95", "p99"):
+            if key not in h:
+                problems.append(f"{where}: missing {key!r}")
+        bounds, counts = h.get("buckets_le"), h.get("counts")
+        if isinstance(bounds, list) and isinstance(counts, list) \
+                and len(counts) != len(bounds) + 1:
+            problems.append(
+                f"{where}: counts must have len(buckets_le)+1 entries "
+                "(overflow bucket)"
+            )
+    return problems
+
+
+def _validate_metrics_attachment(doc: dict) -> List[str]:
+    """Shared rule for report documents carrying an optional ``metrics``
+    key: when present it must be a valid metrics_report/v1."""
+    if "metrics" not in doc:
+        return []
+    return [f"metrics: {p}" for p in validate_metrics_report(doc["metrics"])]
+
+
 def validate_map_report(doc: dict) -> List[str]:
     """Structural check of a map_report/v1 document; returns a list of
     problems (empty == valid). Dependency-free so CI harnesses can gate on
     the report without importing the extraction stack."""
     problems: List[str] = []
+    problems += _validate_metrics_attachment(doc)
     if doc.get("schema") != MAP_REPORT_SCHEMA:
         problems.append(f"schema != {MAP_REPORT_SCHEMA}: {doc.get('schema')!r}")
     shards = doc.get("shards")
@@ -112,6 +167,7 @@ def validate_serve_report(doc: dict) -> List[str]:
     the report without importing the serving stack. An error record
     ({"schema": ..., "error": str}) is contractually valid."""
     problems: List[str] = []
+    problems += _validate_metrics_attachment(doc)
     if doc.get("schema") != SERVE_REPORT_SCHEMA:
         problems.append(
             f"schema != {SERVE_REPORT_SCHEMA}: {doc.get('schema')!r}"
@@ -173,6 +229,100 @@ def validate_serve_report(doc: dict) -> List[str]:
     else:
         for key in ("speedup_vs_sequential", "speedup_ok", "exact_match",
                     "p99_bounded", "cache_hit"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
+#: schema tag of the telemetry probe document emitted by
+#: scripts/obs_probe.py: per-stage span counts and span-derived
+#: p50/p95/p99 for the serve pipeline and the map phase, the compile
+#: events observed (kind/key/wall/cause), a metrics_report/v1 registry
+#: snapshot, and the measured disabled-mode tracing overhead — the
+#: before/after instrument every later perf PR reads. bench_guard wraps
+#: the probe, so an error record ({"schema": ..., "error": str}) is
+#: contractually valid here too.
+TRACE_REPORT_SCHEMA = "trace_report/v1"
+
+#: the serve pipeline stages obs_probe requires as spans, in pipeline
+#: order — submit through future resolution, one trace id per request
+TRACE_SERVE_STAGES = (
+    "serve.submit",
+    "serve.queue_wait",
+    "serve.batch_assemble",
+    "serve.stage",
+    "serve.execute",
+    "serve.postprocess",
+    "serve.resolve",
+)
+
+#: closed compile-cause vocabulary (obs/compile.py): "cold" = first
+#: program of its kind this process, "key-change" = the recompile-storm
+#: signature (same kind, new key)
+COMPILE_EVENT_CAUSES = ("cold", "key-change")
+
+
+def validate_trace_report(doc: dict) -> List[str]:
+    """Structural check of a trace_report/v1 document; returns a list of
+    problems (empty == valid). An error record is contractually valid."""
+    problems: List[str] = []
+    if doc.get("schema") != TRACE_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {TRACE_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    problems += _validate_metrics_attachment(doc)
+    if "metrics" not in doc:
+        problems.append("metrics: missing")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("config: not a dict")
+    for section in ("serve", "map"):
+        sec = doc.get(section)
+        if not isinstance(sec, dict):
+            problems.append(f"{section}: not a dict")
+            continue
+        stages = sec.get("stages")
+        if not isinstance(stages, dict):
+            problems.append(f"{section}.stages: not a dict")
+            continue
+        for name, rec in stages.items():
+            where = f"{section}.stages[{name!r}]"
+            if not isinstance(rec, dict):
+                problems.append(f"{where}: not a dict")
+                continue
+            for key in ("count", "p50_ms", "p95_ms", "p99_ms"):
+                if not isinstance(rec.get(key), (int, float)):
+                    problems.append(f"{where}: missing {key!r}")
+    events = doc.get("compile_events")
+    if not isinstance(events, list):
+        problems.append("compile_events: not a list")
+    else:
+        for i, e in enumerate(events):
+            where = f"compile_events[{i}]"
+            if not isinstance(e, dict):
+                problems.append(f"{where}: not a dict")
+                continue
+            for key in ("kind", "key", "wall_s", "cause"):
+                if key not in e:
+                    problems.append(f"{where}: missing {key!r}")
+            if e.get("cause") not in COMPILE_EVENT_CAUSES:
+                problems.append(f"{where}: bad cause {e.get('cause')!r}")
+    overhead = doc.get("overhead")
+    if not isinstance(overhead, dict):
+        problems.append("overhead: not a dict")
+    else:
+        for key in ("disabled_ns_per_span", "overhead_disabled_pct"):
+            if not isinstance(overhead.get(key), (int, float)):
+                problems.append(f"overhead: missing {key!r}")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("stages_complete", "compile_event_recorded",
+                    "trace_roundtrip", "overhead_ok"):
             if key not in checks:
                 problems.append(f"checks: missing {key!r}")
     return problems

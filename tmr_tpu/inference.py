@@ -27,6 +27,7 @@ import flax.linen as nn
 
 from tmr_tpu.models import build_model
 from tmr_tpu.models.matching_net import select_capacity_bucket
+from tmr_tpu.obs import track_compile
 from tmr_tpu.ops.postprocess import batched_nms, decode_detections
 
 
@@ -163,6 +164,11 @@ class Predictor:
                 return dets, fb
             return dets
 
+        # compile-event accounting (obs/compile.py): the first call of
+        # every fresh cache entry records (key, wall, cold|key-change) —
+        # recompile storms become visible events instead of latency cliffs
+        run = track_compile(run, "single", key,
+                            bucket={"capacity": capacity})
         self._compiled[key] = run
         return run
 
@@ -311,6 +317,9 @@ class Predictor:
             )
             return losses, final
 
+        run = track_compile(run, "multi", key,
+                            bucket={"capacity": capacity,
+                                    "k_bucket": k_bucket})
         self._compiled[key] = run
         return run
 
@@ -409,6 +418,9 @@ class Predictor:
                 refiner_params, refine,
             )
 
+        run = track_compile(run, "multi_batched", key,
+                            bucket={"capacity": capacity,
+                                    "k_bucket": k_bucket})
         self._compiled[key] = run
         return run
 
@@ -450,6 +462,7 @@ class Predictor:
                 f = f[0]
             return f
 
+        run = track_compile(run, "backbone", key)
         self._compiled[key] = run
         return run
 
@@ -486,6 +499,9 @@ class Predictor:
                 refiner_params, refine,
             )
 
+        run = track_compile(run, "heads", key,
+                            bucket={"capacity": capacity,
+                                    "image_size": image_size})
         self._compiled[key] = run
         return run
 

@@ -1,0 +1,66 @@
+"""Unified telemetry: span tracing, metrics registry, compile accounting.
+
+The observability layer every serving/map/train component records into —
+see tracing.py (request-scoped spans -> Chrome trace JSON + xprof
+TraceAnnotations, zero-cost under ``TMR_TRACE=0``), metrics.py (named
+counters/gauges/histograms, ``metrics_report/v1`` snapshots), and
+compile.py (per-trace/compile events with cold vs key-change causes).
+``scripts/obs_probe.py`` is the measured proof; QUICKSTART_RUN.md
+"Observability" documents the knobs. Import-light on purpose: nothing
+here imports jax at module load, so any layer (ops, data, utils) can
+instrument itself.
+"""
+
+from tmr_tpu.obs.compile import (
+    compile_events,
+    drain_compile_events,
+    record_compile_event,
+    track_compile,
+)
+from tmr_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from tmr_tpu.obs.tracing import (
+    add_span,
+    chrome_trace,
+    clear,
+    configure,
+    dropped_spans,
+    new_trace_id,
+    save_chrome_trace,
+    span,
+    spans,
+    tracing_enabled,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "add_span",
+    "chrome_trace",
+    "clear",
+    "compile_events",
+    "configure",
+    "counter",
+    "drain_compile_events",
+    "dropped_spans",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "new_trace_id",
+    "record_compile_event",
+    "save_chrome_trace",
+    "span",
+    "spans",
+    "tracing_enabled",
+    "track_compile",
+]

@@ -1,0 +1,117 @@
+"""Compile-event accounting for the bucketed-jit program caches.
+
+A recompile storm is invisible in the counters PRs 2-3 kept: it shows up
+only as a latency cliff. This module makes every ``_compiled``-cache miss
+in tmr_tpu/inference.py an explicit, attributable event: the compile key,
+the program kind, wall time of the first (trace + XLA compile) call, and
+a cause —
+
+- ``cold``: this (kind, key) was never compiled in this process — first
+  program of a kind, or a fresh Predictor re-compiling a key an earlier
+  instance already paid for (expected: warmup);
+- ``key-change``: this kind compiled before but never under THIS key —
+  the signature of a storm (numpy-int key drift, an unexpected new
+  bucket, a fresh donate/loss_fn flavor) that should be a cache hit.
+
+Events land in three places at once: a bounded in-process log
+(:func:`compile_events` / :func:`drain_compile_events`, the gate-refusal
+registry pattern), the process-wide metrics registry (``compile.total``,
+``compile.cold``, ``compile.key_change`` counters + ``compile.wall_s``
+histogram), and — when tracing is on — a ``compile`` span on the thread
+that paid the wall time.
+
+The wall time is measured on the wrapped program's FIRST call, not at
+cache-insert: jit wrappers are lazy, and the first call is where trace +
+compile (the seconds that matter) actually happen. A program that is
+built but never called records nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+from tmr_tpu.obs import metrics as _metrics
+from tmr_tpu.obs import tracing as _tracing
+
+#: bounded like diagnostics._GATE_REFUSALS: a long-lived server that
+#: never drains must not grow without bound
+_MAX_EVENTS = 512
+
+_LOCK = threading.Lock()
+_EVENTS: List[dict] = []
+#: kind -> set of key reprs ever compiled: the cause is decided per
+#: (kind, key) — a second Predictor re-compiling an already-seen key is
+#: "cold" (expected instance warmup), only a genuinely NEW key of a
+#: known kind is "key-change" (the storm signature)
+_SEEN_KEYS: dict = {}
+
+
+def record_compile_event(kind: str, key: Any, t0: float, t1: float,
+                         bucket: Optional[dict] = None) -> dict:
+    """Record one trace/compile occurrence; returns the event record."""
+    key_repr = repr(key)
+    with _LOCK:
+        seen = _SEEN_KEYS.setdefault(kind, set())
+        cause = "key-change" if (seen and key_repr not in seen) else "cold"
+        seen.add(key_repr)
+        rec = {
+            "kind": kind,
+            "key": key_repr,
+            "bucket": dict(bucket or {}),
+            "wall_s": t1 - t0,
+            "cause": cause,
+        }
+        _EVENTS.append(rec)
+        if len(_EVENTS) > _MAX_EVENTS:
+            del _EVENTS[:-_MAX_EVENTS]
+    reg = _metrics.get_registry()
+    reg.counter("compile.total").inc()
+    reg.counter("compile.cold" if cause == "cold"
+                else "compile.key_change").inc()
+    reg.histogram("compile.wall_s").observe(rec["wall_s"])
+    _tracing.add_span("compile", t0, t1, kind=kind, key=rec["key"],
+                      cause=cause)
+    return rec
+
+
+def compile_events() -> List[dict]:
+    """Snapshot of recorded events (oldest first), not cleared."""
+    with _LOCK:
+        return [dict(e) for e in _EVENTS]
+
+
+def drain_compile_events() -> List[dict]:
+    """Return and clear — the harness drain-before/after protocol. The
+    (kind, key) cause memory is NOT cleared (it is process history,
+    not measurement state)."""
+    with _LOCK:
+        out = list(_EVENTS)
+        _EVENTS.clear()
+    return out
+
+
+def track_compile(fn, kind: str, key: Any,
+                  bucket: Optional[dict] = None):
+    """Wrap a freshly built jitted program so its first call records a
+    compile event. Later calls pay one list check. The wrapped callable
+    is what goes into the ``_compiled`` cache, so every consumer sees
+    the same accounting exactly once per cache entry."""
+    done: List[bool] = []
+    lock = threading.Lock()
+
+    def wrapped(*args, **kw):
+        if done:
+            return fn(*args, **kw)
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        t1 = time.perf_counter()
+        with lock:
+            if not done:
+                done.append(True)
+                record_compile_event(kind, key, t0, t1, bucket=bucket)
+        return out
+
+    wrapped.__wrapped__ = fn
+    return wrapped

@@ -1,0 +1,330 @@
+"""Request-scoped host-side span tracing.
+
+``span("stage", **attrs)`` is a context manager that records one complete
+event (name, start, duration, thread, trace/span/parent IDs, attributes)
+into a per-thread ring buffer; ``add_span`` records an event with explicit
+timestamps for stages whose boundaries were stamped elsewhere (the
+batcher's queue-wait window, a batch-level stage attributed to each
+request in it). Exports:
+
+- :func:`chrome_trace` — Chrome trace-event JSON (open in Perfetto /
+  ``chrome://tracing``): one ``ph: "X"`` event per span plus thread-name
+  metadata, trace/span/parent IDs under ``args``.
+- every entered span also enters ``jax.profiler.TraceAnnotation``, so the
+  SAME host spans appear on the TPU timeline inside an xprof capture —
+  host-side stage boundaries line up against device execution.
+
+Cost model (the load-bearing contract, pinned by tests/test_obs.py):
+
+- ``TMR_TRACE=0`` (the default): ``span()`` is one module-global bool
+  check returning a shared no-op context manager — a few hundred ns per
+  enter/exit, nothing allocated, nothing locked. Hot paths that would pay
+  even for building kwargs guard on :func:`tracing_enabled` first.
+- ``TMR_TRACE=1``: each thread appends to its OWN ring buffer (no
+  cross-thread locking on the record path; the global lock is touched
+  once per thread lifetime, at ring registration) and the ring overwrites
+  its oldest events rather than growing — a long-lived traced server is
+  memory-bounded by ``TMR_TRACE_RING`` events per thread.
+
+Trace IDs: a request's trace id is minted at submit
+(:func:`new_trace_id`), travels WITH the request object through queueing,
+coalescing, staging, execution and resolution, and every stage span
+carries it — "where did this request's 40 ms go" is one filter in
+Perfetto. Spans opened without an explicit trace id inherit the enclosing
+span's (per-thread stack), so nested host phases group naturally.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "off", "false", "no")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: module-global fast path: the ONLY thing a disabled span() touches
+_ENABLED: bool = _env_flag("TMR_TRACE")
+_ANNOTATE_WANTED: bool = _env_flag("TMR_TRACE_ANNOTATE", True)
+_RING: int = max(_env_int("TMR_TRACE_RING", 8192), 16)
+
+_REG_LOCK = threading.Lock()
+_ALL_BUFS: List["_Buf"] = []
+_SPAN_IDS = itertools.count(1)  # .__next__ is atomic under the GIL
+
+#: resolved jax.profiler.TraceAnnotation class, None = not yet resolved,
+#: False = unavailable/disabled
+_ANN_CLS: Any = None
+
+
+def _annotation_cls():
+    global _ANN_CLS
+    if _ANN_CLS is None:
+        if not _ANNOTATE_WANTED:
+            _ANN_CLS = False
+        else:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                _ANN_CLS = TraceAnnotation
+            except Exception:
+                _ANN_CLS = False
+    return _ANN_CLS
+
+
+class _Buf:
+    """One thread's span ring. Only its owner thread writes; readers
+    snapshot under the registry lock at export time (a torn read of the
+    newest slot is possible and acceptable — exports are diagnostics,
+    the write path must never wait)."""
+
+    __slots__ = ("tid", "thread_name", "cap", "events", "write", "stack")
+
+    def __init__(self, cap: int) -> None:
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.thread_name = t.name
+        self.cap = cap
+        self.events: List[dict] = []
+        self.write = 0
+        self.stack: List[tuple] = []  # (span_id, trace_id) of open spans
+
+    def record(self, rec: dict) -> None:
+        # one local reference for the whole operation: clear() (any
+        # thread, the drain-before-measure protocol) swaps self.events
+        # for a fresh list — a check-then-index against the attribute
+        # could len() the full old list and index the new empty one
+        # (IndexError on the RECORDING thread, which may be a pipeline
+        # thread that must never die). With the local ref the racing
+        # record lands entirely in the old list and is simply dropped
+        # with it.
+        events = self.events
+        if len(events) < self.cap:
+            events.append(rec)
+        else:
+            events[self.write % self.cap] = rec
+        self.write += 1
+
+    def snapshot(self) -> List[dict]:
+        n = len(self.events)
+        if n < self.cap or self.write <= n:
+            return list(self.events)
+        i = self.write % self.cap
+        return self.events[i:] + self.events[:i]
+
+    def dropped(self) -> int:
+        return max(0, self.write - self.cap)
+
+
+class _Local(threading.local):
+    buf: Optional[_Buf] = None
+
+
+_TLS = _Local()
+
+
+def _buf() -> _Buf:
+    b = _TLS.buf
+    if b is None:
+        b = _Buf(_RING)
+        _TLS.buf = b
+        with _REG_LOCK:
+            _ALL_BUFS.append(b)
+    return b
+
+
+def tracing_enabled() -> bool:
+    return _ENABLED
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def configure(enabled: Optional[bool] = None,
+              annotate: Optional[bool] = None,
+              ring: Optional[int] = None) -> None:
+    """Programmatic override of the TMR_TRACE / TMR_TRACE_ANNOTATE /
+    TMR_TRACE_RING env knobs (probes and tests flip tracing without
+    re-execing). ``ring`` applies to rings created after the call."""
+    global _ENABLED, _ANNOTATE_WANTED, _ANN_CLS, _RING
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if annotate is not None:
+        _ANNOTATE_WANTED = bool(annotate)
+        _ANN_CLS = None  # re-resolve lazily
+    if ring is not None:
+        _RING = max(int(ring), 16)
+
+
+class _NoopSpan:
+    """The shared disabled-mode span: enter/exit do nothing, one instance
+    serves every call site — zero allocation on the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id",
+                 "t0", "_ann", "_b")
+
+    def __init__(self, name: str, trace_id: Optional[str],
+                 attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.attrs = attrs
+
+    def __enter__(self) -> "_Span":
+        b = _buf()
+        self._b = b
+        parent = b.stack[-1] if b.stack else None
+        if self.trace_id is None:
+            self.trace_id = parent[1] if parent else new_trace_id()
+        self.span_id = next(_SPAN_IDS)
+        self.parent_id = parent[0] if parent else 0
+        b.stack.append((self.span_id, self.trace_id))
+        ann_cls = _annotation_cls()
+        self._ann = ann_cls(self.name) if ann_cls else None
+        if self._ann is not None:
+            self._ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def set_attr(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __exit__(self, exc_type=None, exc=None, tb=None) -> bool:
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        b = self._b
+        if b.stack and b.stack[-1][0] == self.span_id:
+            b.stack.pop()
+        b.record({
+            "name": self.name,
+            "ts": self.t0,
+            "dur": t1 - self.t0,
+            "tid": b.tid,
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "attrs": self.attrs,
+        })
+        return False
+
+
+def span(name: str, trace_id: Optional[str] = None, **attrs):
+    """Context manager timing one named stage. No-op (shared singleton)
+    when tracing is disabled; otherwise records a complete event on exit
+    and mirrors the region into ``jax.profiler.TraceAnnotation``."""
+    if not _ENABLED:
+        return _NOOP
+    return _Span(name, trace_id, attrs)
+
+
+def add_span(name: str, t0: float, t1: float,
+             trace_id: Optional[str] = None, parent: int = 0,
+             **attrs) -> None:
+    """Record a complete event whose boundaries were stamped elsewhere
+    (``time.perf_counter`` values) — queue-wait windows, batch-level
+    stages attributed per request. Does not touch the nesting stack."""
+    if not _ENABLED:
+        return
+    b = _buf()
+    b.record({
+        "name": name,
+        "ts": t0,
+        "dur": max(t1 - t0, 0.0),
+        "tid": b.tid,
+        "trace": trace_id or "",
+        "span": next(_SPAN_IDS),
+        "parent": parent,
+        "attrs": attrs,
+    })
+
+
+def spans() -> List[dict]:
+    """Every recorded span (all threads), oldest first."""
+    with _REG_LOCK:
+        bufs = list(_ALL_BUFS)
+    out: List[dict] = []
+    for b in bufs:
+        out.extend(b.snapshot())
+    out.sort(key=lambda r: r["ts"])
+    return out
+
+
+def dropped_spans() -> int:
+    with _REG_LOCK:
+        return sum(b.dropped() for b in _ALL_BUFS)
+
+
+def clear() -> None:
+    """Discard recorded spans (rings stay registered; open spans keep
+    nesting state) — the drain-before-measure harness protocol."""
+    with _REG_LOCK:
+        for b in _ALL_BUFS:
+            b.events = []
+            b.write = 0
+
+
+def chrome_trace() -> dict:
+    """Chrome trace-event JSON (the ``traceEvents`` array format) —
+    ``json.dump`` the result and load it in Perfetto. Timestamps are
+    perf_counter microseconds (a shared monotonic base; only relative
+    placement is meaningful)."""
+    pid = os.getpid()
+    events: List[dict] = []
+    with _REG_LOCK:
+        bufs = list(_ALL_BUFS)
+    for b in bufs:
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": b.tid,
+            "args": {"name": b.thread_name},
+        })
+    for rec in spans():
+        args = {"trace": rec["trace"], "span": rec["span"],
+                "parent": rec["parent"]}
+        args.update(rec["attrs"])
+        events.append({
+            "ph": "X",
+            "name": rec["name"],
+            "pid": pid,
+            "tid": rec["tid"],
+            "ts": rec["ts"] * 1e6,
+            "dur": rec["dur"] * 1e6,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def save_chrome_trace(path: str) -> str:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(chrome_trace(), f)
+    return path

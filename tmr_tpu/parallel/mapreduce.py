@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tmr_tpu import obs
 from tmr_tpu.diagnostics import MAP_REPORT_SCHEMA
 from tmr_tpu.utils import faults
 from tmr_tpu.utils.atomicio import atomic_write
@@ -328,6 +329,10 @@ class MapReport:
         self.shards.append(record)
 
     def document(self) -> dict:
+        """The map_report/v1 document. Carries a ``metrics`` key — the
+        process-wide registry snapshot (metrics_report/v1) at document
+        time — so one report line holds shard accounting AND counter
+        state (validated together by ``validate_map_report``)."""
         shards = sorted(self.shards, key=lambda r: r.get("index", 0))
         totals = {
             "shards": len(shards),
@@ -355,6 +360,7 @@ class MapReport:
                 r["shard"] for r in shards if r["status"] == "resumed"
             ],
             "totals": totals,
+            "metrics": obs.get_registry().snapshot(),
         }
 
     def write(self, path: str) -> None:
@@ -422,6 +428,7 @@ class _LoadBox:
 
 def _spawn_load(task: _ShardTask, loader: Callable, image_size: int) -> _LoadBox:
     box = _LoadBox()
+    box.t0 = time.perf_counter()  # attempt-span anchor (obs tracing)
 
     def run():
         try:
@@ -623,6 +630,7 @@ def _run_stream_impl(
             journal is not None and resume
         ) else None
         if entry is not None:
+            obs.get_registry().counter("map.shards_resumed").inc()
             contributions.append((index, entry["category"], entry["sums"]))
             log_progress(
                 f"shard {os.path.basename(path)}: resumed from journal "
@@ -662,6 +670,7 @@ def _run_stream_impl(
     # crash semantics deterministic — the journal is always a prefix of
     # the shard list (minus quarantines), so "resume re-does only
     # in-flight work" is an exact statement rather than a race.
+    reg = obs.get_registry()
     while inflight:
         task, box = inflight.popleft()
         t_start = time.monotonic()
@@ -670,6 +679,7 @@ def _run_stream_impl(
         counts = {"skipped_members": 0, "skipped_images": 0}
         nonfinite = 0
         n_images = 0
+        shard_base = os.path.basename(task.path)
         while True:
             failure: Optional[dict] = None
             if not _wait_or_stall(box, retry.shard_timeout):
@@ -681,6 +691,9 @@ def _run_stream_impl(
                         f"{retry.shard_timeout}s"
                     ),
                 }
+                # the stalled window as a span: load start -> stall verdict
+                obs.add_span("map.stall", box.t0, time.perf_counter(),
+                             shard=shard_base, attempt=task.attempt)
             elif box.error is not None:
                 err = box.error
                 if isinstance(err, (KeyboardInterrupt, SystemExit)):
@@ -700,10 +713,12 @@ def _run_stream_impl(
                     f"attempt {task.attempt + 1})"
                 )
                 try:
-                    sums, nonfinite = _encode_shard(
-                        task, images, encode_stats_fn, batch_size,
-                        save_features,
-                    )
+                    with obs.span("map.encode", shard=shard_base,
+                                  attempt=task.attempt):
+                        sums, nonfinite = _encode_shard(
+                            task, images, encode_stats_fn, batch_size,
+                            save_features,
+                        )
                     n_images = int(sums[4])
                     if journal is not None:
                         if sync_features is not None:
@@ -724,6 +739,9 @@ def _run_stream_impl(
                                 wall_s=time.monotonic() - t_start,
                             )
                     status = "ok"
+                    obs.add_span("map.attempt", box.t0,
+                                 time.perf_counter(), shard=shard_base,
+                                 attempt=task.attempt, status="ok")
                     break
                 except Exception as e:
                     failure = {
@@ -736,6 +754,9 @@ def _run_stream_impl(
                         # errors too (features_out on an unmounted volume)
                         failure["retryable"] = False
 
+            obs.add_span("map.attempt", box.t0, time.perf_counter(),
+                         shard=shard_base, attempt=task.attempt,
+                         status=failure["cause"])
             task.causes.append(failure)
             task.attempt += 1
             retryable = failure.pop("retryable", True)
@@ -745,10 +766,19 @@ def _run_stream_impl(
                     f"after {task.attempt} attempt(s): {failure['error']}"
                 )
                 break
-            time.sleep(retry.delay(task.index, task.attempt))
+            reg.counter("map.retries").inc()
+            with obs.span("map.backoff", shard=shard_base,
+                          attempt=task.attempt):
+                time.sleep(retry.delay(task.index, task.attempt))
             box = _spawn_load(task, loader, image_size)
 
+        wall = time.monotonic() - t_start
+        reg.counter("map.shards_ok" if status == "ok"
+                    else "map.shards_quarantined").inc()
+        reg.histogram("map.shard_wall_s").observe(wall)
         if status == "ok":
+            reg.counter("map.images").inc(n_images)
+            reg.counter("map.nonfinite_images").inc(nonfinite)
             contributions.append((task.index, task.category, sums))
         elif status == "quarantined":
             if journal is not None:
@@ -994,7 +1024,11 @@ def _cli_map(args) -> int:
     if args.report_out:
         report.write(args.report_out)
     for line in acc.emit_lines():
-        print(line)
+        # stdout IS the Hadoop-streaming record protocol here; explicit
+        # writes keep the tier-1 stdout-hygiene lint's meaning (no bare
+        # print) without touching the record format
+        sys.stdout.write(line + "\n")
+    sys.stdout.flush()
     return 0
 
 
@@ -1002,7 +1036,8 @@ def _cli_reduce(_args) -> int:
     import sys
 
     sums = reduce_lines(sys.stdin)
-    print(format_stats_table(sums))
+    sys.stdout.write(format_stats_table(sums) + "\n")
+    sys.stdout.flush()
     return 0
 
 

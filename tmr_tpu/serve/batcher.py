@@ -24,6 +24,8 @@ from collections import Counter, OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tmr_tpu import obs
+
 
 @dataclass
 class Request:
@@ -39,6 +41,7 @@ class Request:
     result_key: Optional[tuple] = None  # exemplar/result-cache key
     features: Any = None  # cached device features (heads path, hit)
     needs_features: bool = False  # heads path, promotion fill
+    trace_id: str = ""  # per-request span correlation (obs.tracing)
 
     def resolve(self, value) -> None:
         for f in self.futures:
@@ -97,6 +100,20 @@ class MicroBatcher:
             # monopolize rule 2's full-bucket scan while siblings queue
             self._pending.move_to_end(bucket)
         self.occupancy[len(out)] += 1
+        if obs.tracing_enabled():
+            # queue wait = submit -> release, per request: the window was
+            # stamped at submit, so it is recorded retroactively here.
+            # Guarded: this runs on the consumer thread OUTSIDE the
+            # engine's isolation try blocks — telemetry must never kill
+            # the thread that forms batches.
+            try:
+                now = time.perf_counter()
+                for r in out:
+                    obs.add_span("serve.queue_wait", r.t_submit, now,
+                                 trace_id=r.trace_id or None,
+                                 bucket=str(bucket))
+            except Exception:
+                pass
         return bucket, out
 
     def next_batch(self) -> Optional[Tuple[tuple, List[Request]]]:

@@ -14,7 +14,10 @@ Two instances back the engine (tmr_tpu/serve/engine.py):
 
 Both expose hit/miss/eviction/insert counters (``stats()``) — the serve
 report's cache section — and are thread-safe: the engine's submit path and
-its completion thread touch them concurrently.
+its completion thread touch them concurrently. The counters live in the
+obs metrics registry when one is passed (the engine passes its own, so a
+``metrics_report/v1`` snapshot carries cache state under
+``<name>.hits/...``); a bare ``LRUCache(n)`` keeps standalone counters.
 """
 
 from __future__ import annotations
@@ -25,6 +28,8 @@ from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
 import numpy as np
+
+from tmr_tpu.obs.metrics import Counter, MetricsRegistry
 
 
 def array_digest(*arrays) -> str:
@@ -45,24 +50,54 @@ class LRUCache:
 
     ``capacity <= 0`` constructs a disabled cache: every ``get`` misses,
     ``put`` is a no-op — callers never need an "is caching on" branch.
+
+    ``registry``/``name``: when given, the hit/miss/eviction/insert
+    counters are registered as ``<name>.hits`` etc. in that
+    MetricsRegistry (they then travel in its ``snapshot()``); otherwise
+    the cache keeps private Counter instances. ``stats()`` reads the same
+    shape either way.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = ""):
         self.capacity = int(capacity)
         self._data: "OrderedDict[Hashable, Any]" = OrderedDict()
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.inserts = 0
+        if registry is not None and name:
+            make = lambda which: registry.counter(f"{name}.{which}")  # noqa: E731
+        else:
+            make = lambda which: Counter()  # noqa: E731
+        self._hits = make("hits")
+        self._misses = make("misses")
+        self._evictions = make("evictions")
+        self._inserts = make("inserts")
+
+    # counter VALUES as attributes, back-compat with the PR 3 plain-int
+    # fields (diagnostic consumers read cache.hits directly)
+    @property
+    def hits(self) -> int:
+        return self._hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._misses.value
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions.value
+
+    @property
+    def inserts(self) -> int:
+        return self._inserts.value
 
     def get(self, key: Hashable) -> Optional[Any]:
         with self._lock:
             if key in self._data:
                 self._data.move_to_end(key)
-                self.hits += 1
+                self._hits.inc()
                 return self._data[key]
-            self.misses += 1
+            self._misses.inc()
             return None
 
     def put(self, key: Hashable, value: Any) -> None:
@@ -72,10 +107,10 @@ class LRUCache:
             if key in self._data:
                 self._data.move_to_end(key)
             self._data[key] = value
-            self.inserts += 1
+            self._inserts.inc()
             while len(self._data) > self.capacity:
                 self._data.popitem(last=False)
-                self.evictions += 1
+                self._evictions.inc()
 
     def __len__(self) -> int:
         with self._lock:
@@ -89,13 +124,14 @@ class LRUCache:
 
     def stats(self) -> dict:
         with self._lock:
-            total = self.hits + self.misses
+            hits, misses = self._hits.value, self._misses.value
+            total = hits + misses
             return {
                 "capacity": self.capacity,
                 "size": len(self._data),
-                "hits": self.hits,
-                "misses": self.misses,
-                "evictions": self.evictions,
-                "inserts": self.inserts,
-                "hit_rate": (self.hits / total) if total else 0.0,
+                "hits": hits,
+                "misses": misses,
+                "evictions": self._evictions.value,
+                "inserts": self._inserts.value,
+                "hit_rate": (hits / total) if total else 0.0,
             }

@@ -28,6 +28,12 @@ Contracts:
   throughput-optimal batch persisted by bench_extra's sweep
   (utils/autotune.measured_bench_batch), then ``TMR_SERVE_BATCH``/the
   constructor argument override it.
+- **Observable**: every counter lives in a per-engine obs metrics
+  registry (``stats()`` keeps its original shape; ``metrics_snapshot()``
+  is the metrics_report/v1 view), and with ``TMR_TRACE=1`` each request's
+  trace id follows it through spans for all seven pipeline stages
+  (submit, queue_wait, batch_assemble, stage, execute, postprocess,
+  resolve) — scripts/obs_probe.py is the measured proof.
 """
 
 from __future__ import annotations
@@ -35,16 +41,28 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tmr_tpu import obs
+from tmr_tpu.obs.metrics import MetricsRegistry
 from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
 _DET_FIELDS = ("boxes", "scores", "refs", "valid")
+
+#: the engine's counter names — the PR 3 ``counters`` dict keys, now
+#: backed by the per-engine metrics registry as ``serve.<name>`` (the
+#: ``stats()`` shape is unchanged; tests/test_obs.py pins it)
+_COUNTER_NAMES = (
+    "submitted", "completed", "errors", "rejected", "coalesced",
+    "batches", "padded_slots", "batch_fallbacks", "heads_batches",
+    "feature_fills",
+)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -110,13 +128,19 @@ class ServeEngine:
         self.donate = (
             backend in ("tpu", "gpu") if donate is None else bool(donate)
         )
+        #: per-engine metrics registry: every counter the engine (and its
+        #: caches) keeps, snapshot()-able as one metrics_report/v1 — each
+        #: engine gets its own so concurrent engines never cross-count
+        self.metrics = MetricsRegistry()
         self.result_cache = LRUCache(
             _env_int("TMR_SERVE_EXEMPLAR_CACHE", 256)
-            if exemplar_cache is None else exemplar_cache
+            if exemplar_cache is None else exemplar_cache,
+            registry=self.metrics, name="serve.cache.result",
         )
         self.feature_cache = LRUCache(
             _env_int("TMR_SERVE_FEATURE_CACHE", 8)
-            if feature_cache is None else feature_cache
+            if feature_cache is None else feature_cache,
+            registry=self.metrics, name="serve.cache.feature",
         )
         # image digests seen once: the second sighting promotes the image
         # into the feature cache (cold traffic stays on the bitwise-exact
@@ -127,11 +151,11 @@ class ServeEngine:
         self._lock = threading.Lock()
         self._inflight: Dict[tuple, Request] = {}
         self._closed = False
-        self.counters = {
-            "submitted": 0, "completed": 0, "errors": 0, "rejected": 0,
-            "coalesced": 0, "batches": 0, "padded_slots": 0,
-            "batch_fallbacks": 0, "heads_batches": 0, "feature_fills": 0,
+        self._m = {
+            name: self.metrics.counter(f"serve.{name}")
+            for name in _COUNTER_NAMES
         }
+        self._lat = self.metrics.histogram("serve.request_latency_s")
         self._per_device: Dict[str, int] = {}
 
         self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for)
@@ -184,25 +208,27 @@ class ServeEngine:
         if self._closed:
             fut.set_exception(RuntimeError("engine is closed"))
             return fut
-        try:
-            req = self._make_request(image, exemplars, multi, k_real, fut)
-        except Exception as e:  # isolation: reject this request alone
-            with self._lock:
-                self.counters["rejected"] += 1
-            fut.set_exception(e)
-            return fut
-        if req is None:  # resolved from cache / coalesced
-            return fut
-        try:
-            self._batcher.put(req)
-        except Exception as e:  # closed mid-submit: a rejection, not traffic
-            self._drop_inflight(req)
-            with self._lock:
-                self.counters["rejected"] += 1
-            fut.set_exception(e)
-            return fut
-        with self._lock:
-            self.counters["submitted"] += 1
+        # one trace id per request, minted here and carried through every
+        # pipeline stage's span (queue wait, staging, execute, resolve)
+        tid = obs.new_trace_id() if obs.tracing_enabled() else ""
+        with obs.span("serve.submit", trace_id=tid or None):
+            try:
+                req = self._make_request(image, exemplars, multi, k_real,
+                                         fut, tid)
+            except Exception as e:  # isolation: reject this request alone
+                self._m["rejected"].inc()
+                fut.set_exception(e)
+                return fut
+            if req is None:  # resolved from cache / coalesced
+                return fut
+            try:
+                self._batcher.put(req)
+            except Exception as e:  # closed mid-submit: a rejection, not
+                self._drop_inflight(req)  # traffic
+                self._m["rejected"].inc()
+                fut.set_exception(e)
+                return fut
+            self._m["submitted"].inc()
         return fut
 
     def predict(self, image, exemplars, **kw) -> dict:
@@ -210,7 +236,7 @@ class ServeEngine:
         return self.submit(image, exemplars, **kw).result()
 
     def _make_request(self, image, exemplars, multi, k_real,
-                      fut) -> Optional[Request]:
+                      fut, trace_id: str = "") -> Optional[Request]:
         image = np.asarray(image, np.float32)
         if image.ndim == 4 and image.shape[0] == 1:
             image = image[0]
@@ -240,14 +266,13 @@ class ServeEngine:
         cached = self.result_cache.get(result_key)
         if cached is not None:
             fut.set_result(cached)
-            with self._lock:
-                self.counters["submitted"] += 1
-                self.counters["completed"] += 1
+            self._m["submitted"].inc()
+            self._m["completed"].inc()
             return None
 
         req = Request(image=image, exemplars=ex, bucket=bucket,
                       futures=[fut], k_real=k, image_digest=digest,
-                      result_key=result_key)
+                      result_key=result_key, trace_id=trace_id)
         if not multi and self.feature_cache.capacity > 0:
             feat = self.feature_cache.get((digest, size))
             if feat is not None:
@@ -266,8 +291,8 @@ class ServeEngine:
             live = self._inflight.get(result_key)
             if live is not None:
                 live.futures.append(fut)
-                self.counters["submitted"] += 1
-                self.counters["coalesced"] += 1
+                self._m["submitted"].inc()
+                self._m["coalesced"].inc()
                 return None
             self._inflight[result_key] = req
         return req
@@ -284,9 +309,9 @@ class ServeEngine:
                 staged = self._stager.stage(
                     bucket, reqs, self._bound_for(bucket)
                 )
+                self._m["batches"].inc()
+                self._m["padded_slots"].inc(staged.padded_slots)
                 with self._lock:
-                    self.counters["batches"] += 1
-                    self.counters["padded_slots"] += staged.padded_slots
                     dev = str(staged.device)
                     self._per_device[dev] = self._per_device.get(dev, 0) + 1
                 self._staged_q.put(staged)
@@ -300,7 +325,15 @@ class ServeEngine:
                 self._done_q.put(None)
                 return
             try:
+                t0 = time.perf_counter()
                 out, fill_feats = self._run_batch(staged)
+                if obs.tracing_enabled():
+                    t1 = time.perf_counter()
+                    for r in staged.requests:
+                        obs.add_span("serve.execute", t0, t1,
+                                     trace_id=r.trace_id or None,
+                                     bucket=str(staged.bucket),
+                                     device=str(staged.device))
                 self._done_q.put((staged, out, fill_feats))
             except Exception as e:
                 self._isolate(staged.requests, e, batch_level=True)
@@ -338,14 +371,12 @@ class ServeEngine:
     def _run_heads(self, staged: StagedBatch, params, rparams, size, cap):
         import jax.numpy as jnp
 
-        with self._lock:
-            self.counters["heads_batches"] += 1
+        self._m["heads_batches"].inc()
         fill_feats = None
         if staged.fill_index:
             bb = self._pred._get_backbone_fn()
             fill_feats = bb(params, staged.images)
-            with self._lock:
-                self.counters["feature_fills"] += len(staged.fill_index)
+            self._m["feature_fills"].inc(len(staged.fill_index))
         rows: List[Any] = []
         fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
         for i in range(len(staged.requests)):
@@ -363,9 +394,17 @@ class ServeEngine:
 
     # ---------------------------------------------------------- completion
     def _finish(self, staged: StagedBatch, out: dict, fill_feats) -> None:
+        t_post0 = time.perf_counter()
         host = {name: np.asarray(out[name]) for name in _DET_FIELDS}
+        # the device fetch above is the batch's postprocess cost; stamp
+        # its END here so the per-rider span is the same shared window
+        # (like batch_assemble/stage/execute) — anchoring each rider's
+        # span at its own resolve time instead would fold every EARLIER
+        # rider's unpad+resolve into the later riders' spans
+        t_fetch1 = time.perf_counter()
         kind, size = staged.bucket[0], staged.bucket[1]
         fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
+        traced = obs.tracing_enabled()
         for i, req in enumerate(staged.requests):
             try:
                 # .copy(): a 1-row slice VIEW would pin the whole padded
@@ -384,18 +423,25 @@ class ServeEngine:
                         fill_feats[fill_pos[i]:fill_pos[i] + 1],
                     )
                 self._drop_inflight(req)
+                t_res0 = time.perf_counter()
                 req.resolve(result)
-                with self._lock:
-                    # per FUTURE, not per request: coalesced duplicates
-                    # counted into `submitted` must land in a terminal
-                    # bucket too, or submitted - (completed+errors+rejected)
-                    # reads as phantom backlog forever
-                    self.counters["completed"] += len(req.futures)
+                t_res1 = time.perf_counter()
+                if traced:
+                    tid = req.trace_id or None
+                    obs.add_span("serve.postprocess", t_post0, t_fetch1,
+                                 trace_id=tid)
+                    obs.add_span("serve.resolve", t_res0, t_res1,
+                                 trace_id=tid, futures=len(req.futures))
+                self._lat.observe(t_res1 - req.t_submit)
+                # per FUTURE, not per request: coalesced duplicates
+                # counted into `submitted` must land in a terminal
+                # bucket too, or submitted - (completed+errors+rejected)
+                # reads as phantom backlog forever
+                self._m["completed"].inc(len(req.futures))
             except Exception as e:  # isolation: this request alone
                 self._drop_inflight(req)
                 req.fail(e)
-                with self._lock:
-                    self.counters["errors"] += len(req.futures)
+                self._m["errors"].inc(len(req.futures))
 
     # ------------------------------------------------------ error fallback
     def _isolate(self, requests: List[Request], exc: BaseException,
@@ -404,20 +450,18 @@ class ServeEngine:
         re-runs alone through the predictor, so one poison request fails
         alone while its batch-mates still get served."""
         if batch_level:
-            with self._lock:
-                self.counters["batch_fallbacks"] += 1
+            self._m["batch_fallbacks"].inc()
         for req in requests:
             try:
                 result = self._run_single(req)
                 self._drop_inflight(req)
                 req.resolve(result)
-                with self._lock:
-                    self.counters["completed"] += len(req.futures)
+                self._lat.observe(time.perf_counter() - req.t_submit)
+                self._m["completed"].inc(len(req.futures))
             except Exception as e:
                 self._drop_inflight(req)
                 req.fail(e)
-                with self._lock:
-                    self.counters["errors"] += len(req.futures)
+                self._m["errors"].inc(len(req.futures))
 
     def _run_single(self, req: Request) -> dict:
         kind = req.bucket[0]
@@ -456,10 +500,22 @@ class ServeEngine:
         self.close()
 
     # ------------------------------------------------------------- metrics
+    @property
+    def counters(self) -> Dict[str, int]:
+        """The PR 3 ad-hoc counters dict, now a registry read — same keys
+        and values, for any consumer that grabbed ``engine.counters``."""
+        return {name: c.value for name, c in self._m.items()}
+
+    def metrics_snapshot(self) -> dict:
+        """This engine's registry as one ``metrics_report/v1`` document
+        (counters + cache counters + the request-latency histogram) — what
+        serve_bench attaches under its report's ``metrics`` key."""
+        return self.metrics.snapshot()
+
     def stats(self) -> dict:
         with self._lock:
-            counters = dict(self.counters)
             per_device = dict(self._per_device)
+        counters = self.counters
         return {
             **counters,
             "batch_occupancy": {

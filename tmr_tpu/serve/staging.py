@@ -25,6 +25,7 @@ from typing import Any, List, Sequence
 
 import numpy as np
 
+from tmr_tpu import obs
 from tmr_tpu.serve.batcher import Request
 
 #: dummy exemplar box for padded slots — any in-range box works (the rows
@@ -100,8 +101,9 @@ class DeviceStager:
                              device=device,
                              padded_slots=bound - len(requests))
 
+        t_assemble = time.perf_counter()
         if kind == "heads":
-            self._stage_heads(staged, bound, size, k, device)
+            t_put = self._stage_heads(staged, bound, size, k, device)
         else:
             images = np.zeros((bound, size, size, 3), np.float32)
             exemplars = np.tile(
@@ -110,24 +112,39 @@ class DeviceStager:
             for i, r in enumerate(requests):
                 images[i] = r.image
                 exemplars[i] = r.exemplars
-            staged.images = jax.device_put(images, device)
-            staged.exemplars = jax.device_put(exemplars, device)
             if kind == "multi":
                 k_real = np.ones((bound,), np.int32)
                 for i, r in enumerate(requests):
                     k_real[i] = r.k_real
+            t_put = time.perf_counter()
+            staged.images = jax.device_put(images, device)
+            staged.exemplars = jax.device_put(exemplars, device)
+            if kind == "multi":
                 staged.k_real = jax.device_put(k_real, device)
         staged.t_staged = time.perf_counter()
+        if obs.tracing_enabled():
+            # batch-level windows attributed to each rider: host pad/stack
+            # (assemble) then the H2D transfers (stage), same trace id the
+            # request carried from submit
+            for r in requests:
+                tid = r.trace_id or None
+                obs.add_span("serve.batch_assemble", t_assemble, t_put,
+                             trace_id=tid, bucket=str(bucket),
+                             batch=len(requests), padded=staged.padded_slots)
+                obs.add_span("serve.stage", t_put, staged.t_staged,
+                             trace_id=tid, device=str(device))
         return staged
 
     def _stage_heads(self, staged: StagedBatch, bound: int, size: int,
-                     k: int, device) -> None:
+                     k: int, device) -> float:
         """Heads-path staging: requests with cached features move only
         their (tiny) exemplars; promotion fills move their image so the
         dispatch thread can run the encoder for them. Cached features may
         live on a different device (round-robin) — device_put moves them,
-        a no-op when already resident."""
+        a no-op when already resident. Returns the host-assembly ->
+        device-transfer boundary timestamp (the stage-span split)."""
         import jax
+        import time
 
         requests = staged.requests
         exemplars = np.tile(
@@ -135,10 +152,10 @@ class DeviceStager:
         )
         for i, r in enumerate(requests):
             exemplars[i] = r.exemplars
-        staged.exemplars = jax.device_put(exemplars, device)
         staged.fill_index = [
             i for i, r in enumerate(requests) if r.features is None
         ]
+        images = None
         if staged.fill_index:
             # fills pad to a power-of-two sub-bucket like every other
             # batch shape: the backbone program must compile at log2(bound)
@@ -148,6 +165,9 @@ class DeviceStager:
             images = np.zeros((n_fill, size, size, 3), np.float32)
             for j, i in enumerate(staged.fill_index):
                 images[j] = requests[i].image
+        t_put = time.perf_counter()
+        staged.exemplars = jax.device_put(exemplars, device)
+        if images is not None:
             staged.images = jax.device_put(images, device)
         # hits: move each (1, h, w, C) feature to this batch's device
         staged.features = [
@@ -155,3 +175,4 @@ class DeviceStager:
                                                            device)
             for r in requests
         ]
+        return t_put

@@ -30,8 +30,10 @@ from tmr_tpu.train.state import (
     make_train_step,
 )
 from tmr_tpu.utils.checkpoint import CheckpointManager
+from tmr_tpu.obs import get_registry, span
 from tmr_tpu.utils.profiling import (
     PhaseTimer,
+    log_info,
     log_warning,
     step_annotation,
     trace,
@@ -322,14 +324,17 @@ class Trainer:
             self.state = self.ckpt.restore(self.ckpt.last_path(), self.state)
             self._restage_state()
             start_epoch = self.ckpt.meta["last_epoch"] + 1
-            print(f"resumed from epoch {start_epoch}")
+            log_info(f"resumed from epoch {start_epoch}")
 
         for epoch in range(start_epoch, cfg.max_epochs):
             train.set_epoch(epoch)
             t0 = time.time()
             sums = None  # device-scalar pytree, fetched once per epoch
             n = 0
-            timers = PhaseTimer()
+            # per-epoch timer; phases also open obs spans ("train.data" /
+            # "train.step" / "train.metrics") when TMR_TRACE=1 so the step
+            # loop lands on the same trace as serve/map
+            timers = PhaseTimer(span_prefix="train.")
             # capture an xprof trace of the first post-resume epoch
             profile = cfg.profile_dir if epoch == start_epoch else None
             with trace(profile):
@@ -379,6 +384,10 @@ class Trainer:
             row["epoch"] = epoch
             row["train/sec"] = time.time() - t0
             row.update(timers.as_dict())
+            # fold the epoch's phase distributions into the process-wide
+            # registry (train/time/<phase> histograms) — once per timer,
+            # so epochs accumulate without double-counting
+            timers.to_registry(get_registry(), prefix="train/time/")
 
             ap_epoch = epoch == 0 or (epoch % cfg.AP_term == cfg.AP_term - 1)
             if ap_epoch:
@@ -389,7 +398,9 @@ class Trainer:
             line = f"Epoch {epoch}: | " + " | ".join(
                 f"{k}: {v:.4f}" for k, v in sorted(row.items()) if k != "epoch"
             )
-            print(line)
+            # stderr protocol line: stdout stays reserved for machine-
+            # readable report output (the stdout-hygiene tier-1 lint)
+            log_info(line)
             self.ckpt.save_epoch(self.state, epoch, row)
         self.ckpt.wait()
         if self.wandb is not None:
@@ -449,7 +460,8 @@ class Trainer:
             else:
                 sub_batches = [full_batch]
             for batch in sub_batches:
-                losses, dets = self._eval_batch(batch)  # async dispatch
+                with span("eval.batch", stage=stage):
+                    losses, dets = self._eval_batch(batch)  # async dispatch
                 if pending is not None:
                     collect(pending)
                 pending = (
@@ -536,7 +548,7 @@ class Trainer:
              f"{stage}/MAE": mae, f"{stage}/RMSE": rmse}
         )
         if jax.process_index() == 0:
-            print(
+            log_info(
                 f"{stage}/AP: {ap:.2f} | {stage}/AP50: {ap50:.2f} | "
                 f"{stage}/AP75: {ap75:.2f} | {stage}/MAE: {mae:.2f} | "
                 f"{stage}/RMSE: {rmse:.2f}"

@@ -383,7 +383,9 @@ def main(argv=None):
     import jax
 
     n = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    print(f"[INFO] {kind}: {n / 1e6:.1f}M params -> {path}")
+    from tmr_tpu.utils.profiling import log_info
+
+    log_info(f"{kind}: {n / 1e6:.1f}M params -> {path}")
 
 
 if __name__ == "__main__":

@@ -39,7 +39,9 @@ def main(argv=None):
     p.add_argument("-o", "--output", default="Package_Modules.zip")
     args = p.parse_args(argv)
     out = package_modules(args.output)
-    print(f"wrote {out} ({os.path.getsize(out) / 1e3:.0f} kB)")
+    from tmr_tpu.utils.profiling import log_info
+
+    log_info(f"wrote {out} ({os.path.getsize(out) / 1e3:.0f} kB)")
 
 
 if __name__ == "__main__":

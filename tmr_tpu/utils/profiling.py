@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import sys
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
@@ -150,44 +151,100 @@ class PhaseTimer:
             batch = next(it)
         with timers.phase("step"):
             state, losses = train_step(state, batch)
-        print(timers.report())
+        print(timers.report(), file=sys.stderr)
+
+    Thread-safe: serve and map time phases from worker threads, so each
+    phase is an obs.metrics Histogram (locked instruments) rather than
+    the old private float dict; ``totals``/``counts`` remain readable as
+    dict snapshots. ``report(registry=...)`` renders the table AND folds
+    the aggregates into a metrics registry (``time/<phase>`` histograms)
+    so per-epoch timers land in the process-wide ``metrics_report/v1``.
+    With ``span_prefix`` set, every phase also opens an obs tracing span
+    (``<span_prefix><name>``) — free when ``TMR_TRACE=0``.
 
     Device work is async under jit; a phase that must include device time
     should block (e.g. ``jax.block_until_ready``) before exiting — the train
     loop's loss readback already does this implicitly.
     """
 
-    def __init__(self) -> None:
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+    def __init__(self, span_prefix: Optional[str] = None) -> None:
+        from tmr_tpu.obs.metrics import Histogram
+
+        self._Histogram = Histogram
+        self._lock = threading.Lock()
+        self._hist: Dict[str, Histogram] = {}
+        self._span_prefix = span_prefix
+
+    def _h(self, name: str):
+        with self._lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = self._Histogram()
+                self._hist[name] = h
+            return h
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
+        span_cm = None
+        if self._span_prefix is not None:
+            from tmr_tpu import obs
+
+            if obs.tracing_enabled():
+                span_cm = obs.span(self._span_prefix + name)
+                span_cm.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+            self._h(name).observe(dt)
+
+    # dict-shaped views, back-compat with the pre-registry PhaseTimer
+    @property
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return {n: h.sum for n, h in self._hist.items() if h.count}
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: h.count for n, h in self._hist.items() if h.count}
 
     def mean(self, name: str) -> float:
-        return self.totals[name] / max(self.counts.get(name, 0), 1)
+        h = self._h(name)
+        return h.sum / max(h.count, 1)
 
     def as_dict(self, prefix: str = "time/") -> Dict[str, float]:
         """Totals keyed for the metrics CSV (``time/<phase>`` seconds)."""
         return {f"{prefix}{k}": v for k, v in self.totals.items()}
 
-    def report(self) -> str:
+    def to_registry(self, registry, prefix: str = "time/") -> None:
+        """Fold every phase's distribution into ``registry`` histograms
+        (``<prefix><phase>``). Call once per timer lifetime (a fresh
+        per-epoch timer merged at epoch end) — merging twice would
+        double-count."""
+        with self._lock:
+            items = list(self._hist.items())
+        for name, h in items:
+            registry.histogram(f"{prefix}{name}").merge(h)
+
+    def report(self, registry=None, prefix: str = "time/") -> str:
+        """Aggregate table (and, with ``registry``, a to_registry flush)."""
+        if registry is not None:
+            self.to_registry(registry, prefix=prefix)
+        totals, counts = self.totals, self.counts
         rows = [f"{'PHASE':<16} | {'CALLS':>6} | {'TOTAL_S':>9} | {'MEAN_MS':>9}"]
         rows.append("-" * 51)
-        for name in sorted(self.totals):
+        for name in sorted(totals):
+            mean = totals[name] / max(counts[name], 1)
             rows.append(
-                f"{name:<16} | {self.counts[name]:>6} | "
-                f"{self.totals[name]:>9.3f} | {self.mean(name) * 1e3:>9.2f}"
+                f"{name:<16} | {counts[name]:>6} | "
+                f"{totals[name]:>9.3f} | {mean * 1e3:>9.2f}"
             )
         return "\n".join(rows)
 
     def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
+        with self._lock:
+            self._hist.clear()
