@@ -389,7 +389,8 @@ def _run(cancel_watchdog) -> None:
 
         snap_keys = ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_XCORR_IMPL",
                      "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION",
-                     "TMR_GLOBAL_SCORES_DTYPE")
+                     "TMR_GLOBAL_SCORES_DTYPE", "TMR_DECODER_IMPL",
+                     "TMR_QUANT")
         before = {k: os.environ.get(k) for k in snap_keys}
         tune = {**tune, **autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)}
         if {k: os.environ.get(k) for k in snap_keys} != before:
@@ -414,6 +415,34 @@ def _run(cancel_watchdog) -> None:
         # elected ANY winner changes the env; an unchanged env means every
         # picker came back empty and rec's bookkeeping already stands)
         _PRELIM_REC = None  # a final record exists; never emit the prelim
+
+    # per-stage tail timings (decoder_heads / decode_tail via the SAME
+    # stage programs profile_breakdown.py measures — utils/stage_bench):
+    # the MFU push is per-stage work, and the headline alone can't show
+    # which stage moved. Banked first so a wedge mid-stage still emits
+    # the real headline; TMR_BENCH_STAGES=0 skips. The record is
+    # validated (diagnostics.validate_stage_breakdown) before it lands.
+    if os.environ.get("TMR_BENCH_STAGES", "1").lower() not in (
+        "0", "false", "no", "off"
+    ):
+        from tmr_tpu.diagnostics import validate_stage_breakdown
+        from tmr_tpu.utils.stage_bench import measure_stage_breakdown
+
+        _PRELIM_REC = dict(rec)
+        try:
+            sb = measure_stage_breakdown(
+                cfg, BATCH, IMAGE_SIZE,
+                rec.get("rtt_floor_ms", 0.0) / 1000.0, log=_progress,
+            )
+            problems = validate_stage_breakdown(sb)
+            if problems:
+                raise ValueError(f"invalid stage_breakdown: {problems}")
+            rec["stage_breakdown"] = sb
+        except Exception as e:
+            rec["stage_breakdown"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
+        _PRELIM_REC = None
 
     # TMR_AUTOTUNE_EXPORT=<file>: persist the winners as K=V lines so a
     # follow-up bench process (e.g. the watcher's trained-weights run at
@@ -557,7 +586,8 @@ def _build_and_measure(cfg, tune) -> dict:
                       "TMR_PALLAS_ATTN_BK", "TMR_PALLAS_WIN_GROUP",
                       "TMR_GLOBAL_BANDS_UNROLL",
                       "TMR_GLOBAL_SCORES_DTYPE", "TMR_WIN_SCORES_DTYPE",
-                      "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK")
+                      "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK",
+                      "TMR_DECODER_IMPL", "TMR_QUANT", "TMR_DECODE_TAIL")
             if k in os.environ
         },
     }

@@ -200,6 +200,30 @@ def main(argv=None):
         autotune(cfg, cfg.image_size, tune_batch, log=log_info,
                  tune_precision=bool(cfg.eval), train=not cfg.eval)
 
+    import os
+
+    if not cfg.eval and os.environ.get("TMR_QUANT", "off") == "int8":
+        # quantized weights are inference-only: fake_quant's rounding has
+        # (near-)zero gradient, so a training trace inheriting int8 (e.g.
+        # from a sourced TMR_AUTOTUNE_EXPORT file) would train the decoder
+        # against a quantization-noise floor. Enforce the invariant at the
+        # consumption point, not just at autotune election.
+        from tmr_tpu.utils.profiling import log_info
+
+        log_info("TMR_QUANT=int8 ignored for training (inference-only "
+                 "knob); running exact weights")
+        os.environ["TMR_QUANT"] = "off"
+    if not cfg.eval and os.environ.get("TMR_DECODER_IMPL") == "fused":
+        # unlike int8 the fused tail is gradient-valid and oracle-pinned,
+        # so an explicit pin is honored — but its election evidence is
+        # forward-only (autotune sweeps it for inference runs only), so a
+        # pin inherited from a sourced TMR_AUTOTUNE_EXPORT file deserves
+        # a visible notice before it shapes the training program
+        from tmr_tpu.utils.profiling import log_info
+
+        log_info("TMR_DECODER_IMPL=fused pinned for training: backward "
+                 "cost was never swept (inference-only election); unset "
+                 "to use the XLA module stack")
     trainer = Trainer(cfg, mesh=mesh)
     if cfg.eval:
         trainer.test()

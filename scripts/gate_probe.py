@@ -214,6 +214,39 @@ def main(argv=None) -> int:
         finally:
             os.environ.pop("TMR_GLOBAL_SCORES_DTYPE", None)
 
+    # 4. the decoder-tail gates (PR-6 surface: fused decoder heads, int8
+    # quant tiers, device decode tail) at the production geometry — the
+    # 2x-upsampled 128^2 grid with c_cat 1024 (emb_dim 512, fusion
+    # doubles it), decoder_num_layer 1, kernel 3. These gates key their
+    # own dict caches (not lru_cache), so clear those the same way for a
+    # recorded cause even when another trace already cached the verdict.
+    from tmr_tpu.ops import fused_heads as _fh
+    from tmr_tpu.ops import postprocess as _pp
+    from tmr_tpu.ops import quant as _q
+
+    _fh._OK_CACHE.clear()
+    _q._OK_CACHE.clear()
+    _pp._TAIL_OK.clear()
+    # production geometry on the TPU; the off-accelerator contract run
+    # (tests/test_bench_cli.py) probes the same code path at a geometry a
+    # CPU can turn around — the verdict is per-geometry either way
+    ph, pc = (128, 1024) if jax.default_backend() == "tpu" else (32, 256)
+    for name, fn in {
+        f"fused_heads_{ph}x{ph}_c{pc}": lambda: _fh.fused_heads_ok(
+            ph, ph, pc, pc, 1, 3, "bfloat16"),
+        f"quant_int8_{ph}x{ph}_c{pc}": lambda: _q.quant_ok(
+            ph, ph, pc, pc, 1, 3),
+        "quant_xcorr_c256_64_t17": lambda: _q.quant_xcorr_ok(
+            256, 64, 64, 17),
+        "device_decode_tail": lambda: _pp.device_tail_ok(),
+    }.items():
+        try:
+            emit(probe=name, ok=bool(fn()), refusals=drain_gate_refusals())
+        except Exception as e:
+            traceback.print_exc()
+            emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}",
+                 refusals=drain_gate_refusals())
+
     doc = {
         "schema": GATE_PROBE_SCHEMA,
         "backend": backend,

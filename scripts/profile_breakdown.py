@@ -278,80 +278,49 @@ def main():
         _restore(prev_xc, "TMR_XCORR_IMPL")
         _restore(prev_pr, "TMR_XCORR_PRECISION")
 
-    # 5. decode + NMS tail in isolation (objectness/regressions -> boxes),
-    # via the Predictor's own _decode/_refine_nms so config flags (box_reg,
-    # regression scaling, max_detections) stay the production ones. The
-    # greedy-NMS fixpoint's iteration count is data-dependent (suppression-
-    # chain depth), so the synthetic boxes are exemplar-sized (~10 grid
-    # cells wide): neighbors overlap heavily and the chains run deep, like
-    # clustered production detections — tiny boxes would let the while_loop
-    # converge immediately and flatter the tail.
-    obj = jnp.asarray(
-        rng.standard_normal((BATCH, up_hw, up_hw)), jnp.float32
+    # 5 + 6. the post-attention tail stages, via the SHARED stage
+    # programs in utils/stage_bench — one definition feeds this
+    # breakdown, bench.py's per-round ``stage_breakdown`` record, and the
+    # autotune sweeps electing TMR_DECODER_IMPL / TMR_QUANT, so the three
+    # surfaces can never measure different programs. Both builders read
+    # the tail knobs (TMR_DECODER_IMPL, TMR_QUANT, TMR_DECODE_TAIL) at
+    # trace time exactly like production: pin a knob and re-run the
+    # breakdown to time that formulation — the fused-vs-xla /
+    # int8-vs-exact / device-vs-host deltas the MFU push is after. The
+    # decode-tail rationale (exemplar-sized synthetic boxes so the greedy
+    # NMS suppression chains run production-deep) lives with the builder.
+    from tmr_tpu.inference import decode_tail_mode
+    from tmr_tpu.ops.fused_heads import decoder_impl
+    from tmr_tpu.utils.stage_bench import (
+        build_decode_tail_step,
+        build_decoder_tail_step,
     )
-    reg = jnp.abs(jnp.asarray(
-        rng.standard_normal((BATCH, up_hw, up_hw, 4)), jnp.float32
-    ))
 
     _progress("stage 5: decode+NMS tail")
-
-    @jax.jit
-    def tail_step(o, r, e, fb):
-        out = {"objectness": [o + fb], "regressions": [r]}
-        dets = pred._decode(out, e)
-        dets = pred._refine_nms(
-            dets, None, (SIZE, SIZE), None, False
-        )
-        return dets, jnp.sum(dets["scores"]) * 0.0
-
+    tail_step, tail_inputs = build_decode_tail_step(pred, BATCH, up_hw, SIZE)
     report[f"decode_nms_tail_n{cfg.max_detections}"] = chained(
-        tail_step, obj, reg, ex0, rtt=rtt
+        tail_step, *tail_inputs, rtt=rtt
     )
-
-    # 6. decoder conv stacks + prediction heads in isolation (PERF.md
-    # "known remaining candidates"): the two channel-preserving 1024-ch
-    # 3x3 conv stacks (fusion doubles emb_dim=512) on the 2x-upsampled
-    # 128^2 grid, plus the 1x1 objectness/ltrb heads — the never-measured
-    # post-attention budget, so the next hardware window can attribute the
-    # full_program - backbone - xcorr residual between decode/NMS (stage 5)
-    # and these convs.
-    from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
 
     c_cat = cfg.emb_dim * 2 if cfg.fusion else cfg.emb_dim
-    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
-    f_cat = jnp.asarray(
-        rng.standard_normal((BATCH, up_hw, up_hw, c_cat)), dtype
-    )
-    dec_o = Decoder(num_layers=cfg.decoder_num_layer,
-                    kernel_size=cfg.decoder_kernel_size, dtype=dtype)
-    dec_b = Decoder(num_layers=cfg.decoder_num_layer,
-                    kernel_size=cfg.decoder_kernel_size, dtype=dtype)
-    head_o = ObjectnessHead(dtype=dtype)
-    head_b = BboxesHead(dtype=dtype)
-
     _progress(f"stage 6: decoder_heads ({c_cat}ch @ {up_hw}^2)")
-    key6 = jax.random.key(2)
-    dp = {
-        "dec_o": jax.jit(dec_o.init)(key6, f_cat)["params"],
-        "dec_b": jax.jit(dec_b.init)(key6, f_cat)["params"],
-        "head_o": jax.jit(head_o.init)(key6, f_cat)["params"],
-        "head_b": jax.jit(head_b.init)(key6, f_cat)["params"],
-    }
-
-    @jax.jit
-    def dec_step(p, x, fb):
-        x = x + fb.astype(x.dtype)
-        o = head_o.apply({"params": p["head_o"]},
-                         dec_o.apply({"params": p["dec_o"]}, x))
-        b = head_b.apply({"params": p["head_b"]},
-                         dec_b.apply({"params": p["dec_b"]}, x))
-        s = jnp.sum(o).astype(jnp.float32) + jnp.sum(b).astype(jnp.float32)
-        return (o, b), s * 0.0
-
-    report["decoder_heads"] = chained(
-        lambda x, fb: dec_step(dp, x, fb), f_cat, rtt=rtt
+    dec_step, dec_inputs = build_decoder_tail_step(
+        BATCH, up_hw, c_cat, cfg.decoder_num_layer,
+        cfg.decoder_kernel_size, cfg.compute_dtype,
     )
+    report["decoder_heads"] = chained(dec_step, *dec_inputs, rtt=rtt)
     _progress(f"decoder_heads: {report['decoder_heads']*1000:.2f} ms")
+
+    # stamp which formulations the tail stages actually traced (a
+    # gate-refused request falls back silently at this layer — the stamp
+    # plus the gate_probe/v1 causes make the fallback attributable)
+    impl, quant = decoder_impl(
+        up_hw, up_hw, c_cat, c_cat, cfg.decoder_num_layer,
+        cfg.decoder_kernel_size, cfg.compute_dtype,
+    )
+    report["decoder_impl"] = impl
+    report["quant"] = "int8" if quant else "off"
+    report["decode_tail_mode"] = decode_tail_mode()
 
     report = {
         k: (round(v, 5) if isinstance(v, float) else v)

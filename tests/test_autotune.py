@@ -12,7 +12,7 @@ from tmr_tpu.utils import autotune as at
 
 KNOBS = ("TMR_XCORR_IMPL", "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN",
          "TMR_XCORR_PRECISION", "TMR_GLOBAL_ATTN",
-         "TMR_GLOBAL_SCORES_DTYPE")
+         "TMR_GLOBAL_SCORES_DTYPE", "TMR_DECODER_IMPL", "TMR_QUANT")
 
 
 @pytest.fixture
@@ -20,12 +20,26 @@ def clean_knobs(monkeypatch, tmp_path):
     """No knobs set on entry; anything autotune exports is popped on exit.
     The persistent winner cache is redirected to a per-test file so tests
     never read/pollute ~/.cache/tmr_tpu/autotune.json (a prior test's
-    winners would otherwise short-circuit later measurements)."""
+    winners would otherwise short-circuit later measurements).
+
+    The decoder-tail picks are stubbed by default (xla wins, so the quant
+    stage short-circuits to "off" without a sweep): a REAL
+    pick_decoder_impl at the production 128^2 x 1024 geometry is minutes
+    of CPU matmul, and the pre-existing autotune tests exercise the
+    attention/xcorr stages. Tail-election tests re-patch with their own
+    stubs (or call the picks directly at tiny geometry)."""
     for k in KNOBS:
         monkeypatch.delenv(k, raising=False)
     monkeypatch.setenv("TMR_AUTOTUNE_CACHE", str(tmp_path / "autotune.json"))
     monkeypatch.setenv("TMR_AUTOTUNE_SEED", str(tmp_path / "no_seed.json"))
     monkeypatch.delenv("TMR_AUTOTUNE_FORCE", raising=False)
+    monkeypatch.setattr(
+        at, "pick_decoder_impl",
+        lambda *a, **k: {"xla": 0.01, "fused": 0.02},
+    )
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: {"off": 0.01, "int8": 0.02},
+    )
     yield
     for k in KNOBS:
         os.environ.pop(k, None)
@@ -145,7 +159,8 @@ def test_autotune_sweep_false_exports_cached_and_reports_pending(
     assert report["TMR_GLOBAL_SCORES_DTYPE"] == {"picked": "f32",
                                                  "times": {}}
     assert set(report["_pending"]) == {
-        "TMR_WIN_ATTN", "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION"
+        "TMR_WIN_ATTN", "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION",
+        "TMR_DECODER_IMPL", "TMR_QUANT",
     }
 
 
@@ -154,6 +169,8 @@ def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     monkeypatch.setenv("TMR_WIN_ATTN", "dense")
     monkeypatch.setenv("TMR_XCORR_PRECISION", "highest")
     monkeypatch.setenv("TMR_GLOBAL_ATTN", "blockwise")
+    monkeypatch.setenv("TMR_DECODER_IMPL", "xla")
+    monkeypatch.setenv("TMR_QUANT", "off")
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
     called = []
@@ -168,6 +185,12 @@ def test_autotune_respects_explicit_knobs(clean_knobs, monkeypatch):
     )
     monkeypatch.setattr(
         at, "pick_global_attn_impl", lambda *a, **k: called.append("g") or {}
+    )
+    monkeypatch.setattr(
+        at, "pick_decoder_impl", lambda *a, **k: called.append("d") or {}
+    )
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: called.append("q") or {}
     )
     # the one unpinned knob (scores dtype) completes its cache entry as
     # the f32 no-op — no measurement runs (the pinned global formulation
@@ -940,3 +963,229 @@ def test_autotune_report_attaches_sweep_refusals(clean_knobs, monkeypatch):
     assert report["TMR_GLOBAL_ATTN"]["picked"] == "blockwise"
     ref = report["TMR_GLOBAL_ATTN"]["refusals"]
     assert ref == {"pallas" + at.FALLBACK_SUFFIX: [cause]}
+
+
+# ----------------------------------------------- decoder-tail elections
+def _stub_non_tail_picks(monkeypatch):
+    """The tail-election tests exercise the TMR_DECODER_IMPL/TMR_QUANT
+    stages only: every other sweep is stubbed (the real attention/xcorr
+    microbenchmarks at the 1024 geometry are minutes of CPU work)."""
+    monkeypatch.setattr(at, "measure_rtt_floor", lambda: 0.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        at, "pick_xcorr_impl", lambda *a, **k: {"conv": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_xcorr_precision", lambda *a, **k: {"highest": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_win_attn_impl", lambda *a, **k: {"dense": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_global_attn_impl", lambda *a, **k: {"blockwise": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_global_scores_dtype", lambda *a, **k: {"f32": 0.01}
+    )
+
+
+def test_decoder_tail_knobs_registered_and_rev_bumped():
+    """TMR_DECODER_IMPL / TMR_QUANT must be versioned sweep knobs with
+    their variant sets registered, under the bumped "decoder-tail"
+    revision so every pre-PR-6 formulation winner re-records at the next
+    hardware window (the tail changed shape under them)."""
+    assert at.DECODER_IMPL_VARIANTS == ("xla", "fused")
+    assert at.QUANT_VARIANTS == ("off", "int8")
+    assert "TMR_DECODER_IMPL" in at._VERSIONED_KNOBS
+    assert "TMR_QUANT" in at._VERSIONED_KNOBS
+    assert at._SWEEP_REV == "decoder-tail"
+    # formulation knob: revision-stamped; numerics knob: variants only
+    assert at._variants_sig("TMR_DECODER_IMPL").endswith(at._SWEEP_REV)
+
+
+def test_autotune_elects_decoder_impl_then_quant(clean_knobs, monkeypatch):
+    """The tail stages run AFTER the attention/xcorr stages: the impl
+    sweep elects plain-min (both formulations are oracle-pinned identical
+    numerics), then the quant sweep applies the decisive-win policy
+    against the exact baseline and stamps which impl its evidence was
+    measured under."""
+    _stub_non_tail_picks(monkeypatch)
+    monkeypatch.setattr(
+        at, "pick_decoder_impl",
+        lambda *a, **k: {"xla": 0.02, "fused": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: {"off": 0.02, "int8": 0.01},
+    )
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=True)
+    assert report["TMR_DECODER_IMPL"]["picked"] == "fused"
+    assert os.environ["TMR_DECODER_IMPL"] == "fused"
+    assert report["TMR_QUANT"]["picked"] == "int8"  # 2x: decisive
+    assert os.environ["TMR_QUANT"] == "int8"
+    cache = at._cache_load()
+    entry = cache[at._cache_key(_cfg(), 1024, 4, "vit_b", False)]
+    assert entry["_quant_decoder_impl"] == "fused"
+
+
+def test_quant_indecisive_win_keeps_exact(clean_knobs, monkeypatch):
+    _stub_non_tail_picks(monkeypatch)
+    monkeypatch.setattr(
+        at, "pick_decoder_impl",
+        lambda *a, **k: {"xla": 0.02, "fused": 0.01},
+    )
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: {"off": 0.0100, "int8": 0.0095},
+    )
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=True)
+    assert report["TMR_QUANT"]["picked"] == "off"  # <10%: not decisive
+    assert os.environ["TMR_QUANT"] == "off"
+
+
+def test_quant_sweep_skipped_when_xla_wins(clean_knobs, monkeypatch):
+    """int8 rides the fused formulation only: when xla wins the impl
+    sweep, the quant stage records "off" WITHOUT sweeping (the no-op
+    completes the cache entry so later runs skip)."""
+    _stub_non_tail_picks(monkeypatch)
+    monkeypatch.setattr(
+        at, "pick_decoder_impl",
+        lambda *a, **k: {"xla": 0.01, "fused": 0.02},
+    )
+    calls = []
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: calls.append(1) or {"off": 0.01},
+    )
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=True)
+    assert report["TMR_DECODER_IMPL"]["picked"] == "xla"
+    assert report["TMR_QUANT"] == {"picked": "off", "times": {}}
+    assert os.environ["TMR_QUANT"] == "off"
+    assert not calls
+
+
+def test_quant_not_swept_for_training(clean_knobs, monkeypatch):
+    """tune_precision=False (the training entry): quantized weights must
+    never be elected into a training program."""
+    _stub_non_tail_picks(monkeypatch)
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=False)
+    assert "TMR_QUANT" not in report
+    assert "TMR_QUANT" not in os.environ
+
+
+def test_cached_quant_dropped_when_impl_evidence_changes(
+    clean_knobs, monkeypatch
+):
+    """A cached int8 winner's decisive-win evidence is decoder-impl-
+    specific: when the active impl no longer matches the stamped
+    _quant_decoder_impl (or the impl is about to re-sweep), the cached
+    quant entry must be dropped and re-decided, not inherited."""
+    import json
+
+    _stub_non_tail_picks(monkeypatch)
+    monkeypatch.setattr(
+        at, "pick_decoder_impl",
+        lambda *a, **k: {"xla": 0.01, "fused": 0.02},
+    )
+    calls = []
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: calls.append(1) or {"off": 0.01},
+    )
+    cache_path = os.environ["TMR_AUTOTUNE_CACHE"]
+    key = at._cache_key(_cfg(), 1024, 4, "vit_b", False)
+    sig_impl = at._variants_sig("TMR_DECODER_IMPL")
+    sig_quant = at._variants_sig("TMR_QUANT")
+    with open(cache_path, "w") as f:
+        json.dump({key: {
+            "TMR_QUANT": "int8",
+            "_quant_decoder_impl": "fused",
+            "_variants_TMR_DECODER_IMPL": sig_impl,
+            "_variants_TMR_QUANT": sig_quant,
+        }}, f)
+    report = at.autotune(_cfg(), 1024, 4, tune_precision=True)
+    # the impl sweep ran (nothing cached for it), xla won -> the stale
+    # int8 entry was dropped, and the no-op "off" recorded in its place
+    assert os.environ["TMR_QUANT"] == "off"
+    assert report["TMR_QUANT"]["picked"] == "off"
+
+
+def test_tail_sweeps_skipped_for_no_boxreg_models(clean_knobs, monkeypatch):
+    """Single-stack (box-regression-ablated) models stay on the module
+    path: no TMR_DECODER_IMPL/TMR_QUANT sweep, nothing exported."""
+    _stub_non_tail_picks(monkeypatch)
+    cfg = _cfg()
+    cfg.ablation_no_box_regression = True
+    report = at.autotune(cfg, 1024, 4, tune_precision=True)
+    assert "TMR_DECODER_IMPL" not in report
+    assert "TMR_DECODER_IMPL" not in os.environ
+    assert "TMR_QUANT" not in os.environ
+
+
+@pytest.mark.slow
+def test_pick_decoder_impl_real_microbenchmark(monkeypatch, tmp_path):
+    """The real _sweep_tail_env harness at a tiny geometry: both
+    formulations time cleanly (no fallback annotation — the fused gate
+    passes at this shape), through the SAME stage program bench.py and
+    profile_breakdown measure."""
+    for k in KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    times = at.pick_decoder_impl(1, 8, 16, 1, 3, rtt=0.0)
+    assert set(times) == {"xla", "fused"}
+    assert all(v > 0 for v in times.values())
+    for k in KNOBS:
+        os.environ.pop(k, None)
+
+
+def test_pick_quant_sums_decoder_and_xcorr_stages(monkeypatch):
+    """With emb_dim given, pick_quant's evidence is the SUM of the two
+    surfaces the export flips (decoder tail + matcher correlation); a
+    fallback annotation in EITHER stage poisons the combined row, and the
+    tail stage's refusal causes survive the xcorr sweep's clear."""
+    monkeypatch.setattr(
+        at, "_sweep_tail_env",
+        lambda *a, **k: (
+            at.LAST_SWEEP_REFUSALS.setdefault("TMR_QUANT", {}).update(
+                {"int8" + at.FALLBACK_SUFFIX: [{"gate": "quant_ok"}]}
+            )
+            or {"off": 0.010, "int8" + at.FALLBACK_SUFFIX: 0.008}
+        ),
+    )
+    monkeypatch.setattr(
+        at, "_sweep_xcorr_env",
+        lambda env_var, *a, **k: (
+            at.LAST_SWEEP_REFUSALS.setdefault(env_var, {}).clear()
+            or {"off": 0.004, "int8": 0.003}
+        ),
+    )
+    times = at.pick_quant(1, 8, 16, 1, 3, emb_dim=16, rtt=0.0)
+    assert times == {"off": 0.014,
+                     "int8" + at.FALLBACK_SUFFIX: pytest.approx(0.011)}
+    assert at._electable(times) == {"off": 0.014}
+    # the decoder stage's structured causes were merged back
+    assert at.LAST_SWEEP_REFUSALS["TMR_QUANT"][
+        "int8" + at.FALLBACK_SUFFIX
+    ] == [{"gate": "quant_ok"}]
+
+
+@pytest.mark.slow
+def test_pick_quant_annotates_refused_rows(monkeypatch):
+    """A quant sweep run while the fused gate refuses (kill-switch) must
+    record the int8 row annotated as a fallback with its structured
+    causes — quantized timings never masquerade as exact-path evidence."""
+    for k in KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    monkeypatch.setenv("TMR_NO_FUSED_HEADS", "1")
+    from tmr_tpu.ops import fused_heads as fh
+
+    fh._OK_CACHE.clear()
+    try:
+        times = at.pick_quant(1, 8, 16, 1, 3, rtt=0.0)
+        # every row fell back (impl gate refused under both TMR_QUANT
+        # values), so each is annotated and none is electable
+        assert times
+        assert all(k.endswith(at.FALLBACK_SUFFIX) for k in times)
+        assert at._electable(times) == {}
+        refusals = at.LAST_SWEEP_REFUSALS.get("TMR_QUANT", {})
+        assert any(refusals.values())
+    finally:
+        fh._OK_CACHE.clear()
+        for k in KNOBS:
+            os.environ.pop(k, None)

@@ -27,6 +27,9 @@ def _bench_env(**extra):
         TMR_BENCH_CHAIN="2",
         **extra,
     )
+    # per-stage tail timings are exercised by their dedicated test below;
+    # the other subprocess runs skip them to stay in budget
+    env.setdefault("TMR_BENCH_STAGES", "0")
     return env
 
 
@@ -75,6 +78,32 @@ def _assert_outage_record(rec):
         assert rec["vs_baseline"] > 0
     else:
         assert rec["value"] == 0.0
+
+
+def test_bench_records_validated_stage_breakdown():
+    """With TMR_BENCH_STAGES on (the default), the bench record embeds a
+    ``stage_breakdown`` that passes diagnostics.validate_stage_breakdown:
+    seconds (or a recorded error) for the decoder_heads and decode_tail
+    stages plus the formulation stamps saying what actually traced — the
+    per-stage visibility the MFU push needs across rounds."""
+    from tmr_tpu.diagnostics import validate_stage_breakdown
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=_bench_env(TMR_BENCH_STAGES="1"),
+        capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    sb = rec["stage_breakdown"]
+    assert validate_stage_breakdown(sb) == [], sb
+    # off-TPU the knobs sit at their defaults; both stages must have
+    # actually measured (an error string here means the harness broke)
+    assert sb["decoder_impl"] == "xla"
+    assert sb["quant"] == "off"
+    assert sb["decode_tail"] == "host"
+    assert sb["decoder_heads_s"] > 0
+    assert sb["decode_tail_s"] > 0
 
 
 def test_bench_watchdog_emits_error_line(tmp_path):

@@ -1,0 +1,230 @@
+"""The device decode tail (TMR_DECODE_TAIL=device): on-device compaction
+semantics, the self-check gate, and the bitwise host/device contract —
+identical per-image detection lists, only dead-slot placement differs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.diagnostics import (
+    FormulationFallbackWarning,
+    drain_gate_refusals,
+)
+from tmr_tpu.inference import (
+    DECODE_TAIL_MODES,
+    decode_tail_mode,
+    detections_to_numpy,
+)
+from tmr_tpu.ops import postprocess as pp
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("TMR_DECODE_TAIL", "TMR_NO_DEVICE_TAIL"):
+        monkeypatch.delenv(k, raising=False)
+    pp._TAIL_OK.clear()
+    drain_gate_refusals()
+    yield
+    pp._TAIL_OK.clear()
+    drain_gate_refusals()
+
+
+def _dets(b=3, k=17, seed=0, valid_p=0.5):
+    rng = np.random.default_rng(seed)
+    return {
+        "boxes": jnp.asarray(rng.uniform(size=(b, k, 4)), jnp.float32),
+        "scores": jnp.asarray(rng.uniform(size=(b, k)), jnp.float32),
+        "refs": jnp.asarray(rng.uniform(size=(b, k, 2)), jnp.float32),
+        "valid": jnp.asarray(rng.uniform(size=(b, k)) < valid_p),
+    }
+
+
+def test_compact_is_stable_valid_first_and_padded_zero():
+    dets = _dets()
+    out = jax.jit(pp.compact_detections)(dets)
+    for i in range(3):
+        v = np.asarray(dets["valid"][i])
+        n = int(v.sum())
+        assert int(out["count"][i]) == n
+        # survivors keep their relative slot order, bitwise
+        np.testing.assert_array_equal(
+            np.asarray(out["boxes"][i])[:n], np.asarray(dets["boxes"][i])[v]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["scores"][i])[:n],
+            np.asarray(dets["scores"][i])[v],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["refs"][i])[:n], np.asarray(dets["refs"][i])[v]
+        )
+        # dead slots fully zeroed, valid rewritten as the prefix mask
+        assert (np.asarray(out["boxes"][i])[n:] == 0).all()
+        assert (np.asarray(out["scores"][i])[n:] == 0).all()
+        np.testing.assert_array_equal(
+            np.asarray(out["valid"][i]), np.arange(17) < n
+        )
+
+
+@pytest.mark.parametrize("valid_p", [0.0, 1.0])
+def test_compact_degenerate_all_or_none(valid_p):
+    dets = _dets(valid_p=valid_p)
+    out = pp.compact_detections(dets)
+    want = 0 if valid_p == 0.0 else 17
+    assert (np.asarray(out["count"]) == want).all()
+    if valid_p == 1.0:
+        np.testing.assert_array_equal(
+            np.asarray(out["boxes"]), np.asarray(dets["boxes"])
+        )
+
+
+def test_device_tail_gate_passes_and_caches():
+    assert pp.device_tail_ok()
+    assert drain_gate_refusals() == []
+    assert pp._TAIL_OK["ok"] is True
+
+
+def test_device_tail_kill_switch_records_cause(monkeypatch):
+    monkeypatch.setenv("TMR_NO_DEVICE_TAIL", "1")
+    assert not pp.device_tail_ok()
+    causes = drain_gate_refusals()
+    assert causes and causes[0]["gate"] == "device_tail_ok"
+    assert causes[0]["cause"] == "kill-switch"
+
+
+def test_decode_tail_mode_validates(monkeypatch):
+    assert decode_tail_mode() == "host"
+    assert set(DECODE_TAIL_MODES) == {"host", "device"}
+    monkeypatch.setenv("TMR_DECODE_TAIL", "gpu")
+    with pytest.raises(ValueError, match="TMR_DECODE_TAIL"):
+        decode_tail_mode()
+
+
+def test_decode_tail_mode_device_admitted_by_gate(monkeypatch):
+    monkeypatch.setenv("TMR_DECODE_TAIL", "device")
+    assert decode_tail_mode() == "device"
+
+
+def test_decode_tail_refusal_warns_and_runs_host(monkeypatch):
+    monkeypatch.setenv("TMR_DECODE_TAIL", "device")
+    monkeypatch.setenv("TMR_NO_DEVICE_TAIL", "1")
+    with pytest.warns(FormulationFallbackWarning) as rec:
+        assert decode_tail_mode() == "host"
+    assert rec[0].message.env_var == "TMR_DECODE_TAIL"
+
+
+def test_detections_to_numpy_host_device_bitwise_identical():
+    """The PR contract: after NMS, the host path's masked per-image lists
+    and the device path's compacted prefix slices are the SAME lists,
+    bitwise — only dead-slot placement inside the fixed arrays differs."""
+    dets = _dets(b=4, k=33, seed=7, valid_p=0.4)
+    nms = pp.batched_nms(dets, 0.5, backend="xla")
+    host_lists = detections_to_numpy(nms)
+    device_lists = detections_to_numpy(
+        jax.jit(pp.compact_detections)(nms)
+    )
+    assert len(host_lists) == len(device_lists) == 4
+    for h, d in zip(host_lists, device_lists):
+        for key in ("boxes", "scores", "refs"):
+            np.testing.assert_array_equal(h[key], d[key])
+
+
+# --------------------------------------------- shared peak-candidate slot
+def test_topk_peak_candidates_threshold_and_order():
+    from tmr_tpu.ops.peaks import topk_peak_candidates
+
+    scores = jnp.asarray([[0.9, 0.2, 0.8, 0.95, 0.5]], jnp.float32)
+    mask = jnp.asarray([[True, True, True, False, True]])
+    top, idx, valid = topk_peak_candidates(scores, mask, 0.5, 3)
+    # 0.95 is masked out (not a peak); 0.2 is below threshold
+    np.testing.assert_array_equal(np.asarray(idx[0])[:2], [0, 2])
+    np.testing.assert_allclose(np.asarray(top[0]), [0.9, 0.8, 0.5],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(valid[0]), [True, True, True])
+    # invalid slots carry score 0
+    top2, _, valid2 = topk_peak_candidates(scores, mask, 0.85, 3)
+    np.testing.assert_array_equal(np.asarray(valid2[0]), [True, False,
+                                                          False])
+    assert np.asarray(top2[0])[1:].max() == 0.0
+
+
+# ------------------------------------------------ Predictor integration
+@pytest.fixture(scope="module")
+def pred64():
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=64,
+                 compute_dtype="float32", batch_size=1, max_detections=64)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=64)
+    return pred
+
+
+@pytest.mark.slow
+def test_predict_device_tail_matches_host_bitwise(pred64, monkeypatch):
+    """End to end through the Predictor: the device decode tail's
+    per-image detections are bitwise-identical to the host path's on
+    fixed inputs (the acceptance criterion), with the compacted program
+    additionally exporting ``count``."""
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+    exemplars = np.array([[0.2, 0.2, 0.45, 0.5]], np.float32)
+
+    host = pred64.predict_multi_exemplar(image, exemplars)
+    monkeypatch.setenv("TMR_DECODE_TAIL", "device")
+    pred64._compiled.clear()  # the knob is read at trace time
+    device = pred64.predict_multi_exemplar(image, exemplars)
+
+    assert "count" in device and "count" not in host
+    for a, b in zip(detections_to_numpy(host),
+                    detections_to_numpy(device)):
+        for key in ("boxes", "scores", "refs"):
+            np.testing.assert_array_equal(a[key], b[key])
+
+
+@pytest.mark.slow
+def test_stage_breakdown_measures_both_stages(pred64):
+    """utils/stage_bench.measure_stage_breakdown emits a record that
+    validates (the same record bench.py embeds) with both tail stages
+    measured on the tiny geometry."""
+    from tmr_tpu.diagnostics import validate_stage_breakdown
+    from tmr_tpu.utils.stage_bench import measure_stage_breakdown
+
+    sb = measure_stage_breakdown(pred64.cfg, 1, 64, rtt=0.0, iters=2)
+    assert validate_stage_breakdown(sb) == [], sb
+    assert sb["decoder_heads_s"] > 0
+    assert sb["decode_tail_s"] > 0
+    assert sb["decoder_impl"] == "xla"
+    assert sb["decode_tail"] == "host"
+
+
+@pytest.mark.slow
+def test_serve_engine_preserves_count(pred64, monkeypatch):
+    """ServeEngine must carry the device tail's ``count`` through to the
+    per-request result (served AND cached) — dropping it would silently
+    put every served request back on the full valid-mask scan the knob
+    exists to eliminate (engine._det_fields; regression pin)."""
+    from tmr_tpu.serve import ServeEngine
+
+    monkeypatch.setenv("TMR_DECODE_TAIL", "device")
+    pred64._compiled.clear()  # the knob is read at trace time
+    try:
+        rng = np.random.default_rng(1)
+        img = rng.standard_normal((64, 64, 3)).astype(np.float32)
+        ex = np.array([[0.2, 0.2, 0.45, 0.5]], np.float32)
+        seq = pred64(img[None], ex[None])
+        with ServeEngine(pred64, batch=1, max_wait_ms=5,
+                         feature_cache=0) as eng:
+            served = eng.submit(img, ex).result(timeout=600)
+            cached = eng.submit(img, ex).result(timeout=600)
+        assert "count" in seq
+        assert "count" in served, list(served)
+        assert "count" in cached, list(cached)
+        for a, b in zip(detections_to_numpy(seq),
+                        detections_to_numpy(served)):
+            for key in ("boxes", "scores", "refs"):
+                np.testing.assert_array_equal(a[key], b[key])
+    finally:
+        pred64._compiled.clear()  # later fixture users retrace host-path

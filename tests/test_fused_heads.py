@@ -1,0 +1,196 @@
+"""Fused decoder-head formulation (ops/fused_heads.py, TMR_DECODER_IMPL):
+conv-as-matmul parity, the oracle gate's verdicts and recorded refusal
+causes, and the MatchingNet trace-time dispatch — same param tree, same
+outputs, knob-selected formulation."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tmr_tpu.diagnostics import (
+    FormulationFallbackWarning,
+    drain_gate_refusals,
+)
+from tmr_tpu.ops import fused_heads as fh
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("TMR_DECODER_IMPL", "TMR_QUANT", "TMR_NO_FUSED_HEADS"):
+        monkeypatch.delenv(k, raising=False)
+    fh._OK_CACHE.clear()
+    drain_gate_refusals()
+    yield
+    fh._OK_CACHE.clear()
+    drain_gate_refusals()
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_conv_mm_matches_lax_conv(k):
+    """The k^2-tap matmul formulation IS a SAME conv: parity against
+    lax.conv_general_dilated at f32 (tight — identical math, different
+    association only)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 9, 11, 8)), jnp.float32)
+    kern = jnp.asarray(rng.standard_normal((k, k, 8, 16)) * 0.1,
+                       jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((16,)), jnp.float32)
+    got = fh.conv_mm(x, kern, bias, dtype=jnp.float32)
+    want = lax.conv_general_dilated(
+        x, kern, window_strides=(1, 1),
+        padding=[(k // 2, k // 2)] * 2,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=lax.Precision.HIGHEST,
+    ) + bias
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype_name,layers", [
+    ("float32", 1), ("float32", 2), ("bfloat16", 1),
+])
+def test_oracle_gate_admits_small_geometries(dtype_name, layers):
+    """fused_heads_ok pins the fused tail against the real flax module
+    stack (Decoder + ObjectnessHead + BboxesHead) at the geometry — the
+    f32 tier must pass tightly, the bf16 tier inside its rounding bound."""
+    assert fh.fused_heads_ok(8, 8, 16, 16, num_layers=layers,
+                             kernel_size=3, dtype_name=dtype_name)
+    assert drain_gate_refusals() == []
+
+
+def test_oracle_verdict_cached_per_geometry():
+    assert fh.fused_heads_ok(8, 8, 16, 16, dtype_name="float32")
+    key_count = len(fh._OK_CACHE)
+    assert fh.fused_heads_ok(8, 8, 16, 16, dtype_name="float32")
+    assert len(fh._OK_CACHE) == key_count  # second call was a cache hit
+
+
+def test_kill_switch_refuses_with_recorded_cause(monkeypatch):
+    monkeypatch.setenv("TMR_NO_FUSED_HEADS", "1")
+    assert not fh.fused_heads_ok(8, 8, 16, 16)
+    causes = drain_gate_refusals()
+    assert causes and causes[0]["gate"] == "fused_heads_ok"
+    assert causes[0]["cause"] == "kill-switch"
+    assert causes[0]["config"]["H"] == 8
+
+
+def test_decoder_impl_validates_knob(monkeypatch):
+    monkeypatch.setenv("TMR_DECODER_IMPL", "nope")
+    with pytest.raises(ValueError, match="TMR_DECODER_IMPL"):
+        fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32")
+
+
+def test_decoder_impl_auto_defaults_to_xla():
+    assert fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32") == ("xla", False)
+
+
+def test_decoder_impl_fused_elects_when_gate_passes(monkeypatch):
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    assert fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32") == ("fused",
+                                                              False)
+
+
+def test_decoder_impl_refusal_warns_and_falls_back(monkeypatch):
+    """An explicitly requested fused formulation whose gate refuses must
+    fall back to xla WITH the FormulationFallbackWarning contract (so
+    autotune sweeps annotate the mislabeled timing) — never silently."""
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    monkeypatch.setattr(fh, "fused_heads_ok", lambda *a, **k: False)
+    with pytest.warns(FormulationFallbackWarning) as rec:
+        impl, quant = fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32")
+    assert (impl, quant) == ("xla", False)
+    assert rec[0].message.env_var == "TMR_DECODER_IMPL"
+
+
+def test_quant_rides_fused_only(monkeypatch):
+    """TMR_QUANT=int8 under an xla decoder impl warns and runs exact —
+    the int8 weights exist only in the fused formulation."""
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    with pytest.warns(FormulationFallbackWarning) as rec:
+        impl, quant = fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32")
+    assert (impl, quant) == ("xla", False)
+    assert rec[0].message.env_var == "TMR_QUANT"
+
+
+def test_quant_elects_under_fused_when_tiers_pass(monkeypatch):
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    impl, quant = fh.decoder_impl(8, 8, 16, 16, 1, 3, "float32")
+    assert impl == "fused"
+    assert quant  # small synthetic geometry: both tiers pass
+
+
+# --------------------------------------------------- MatchingNet dispatch
+def _tiny_model(**over):
+    from tmr_tpu.models.matching_net import MatchingNet
+    from tmr_tpu.models.vit import SamViT
+
+    kwargs = dict(
+        backbone=SamViT(embed_dim=32, depth=2, num_heads=2,
+                        global_attn_indexes=(1,), patch_size=8,
+                        window_size=3, out_chans=16,
+                        pretrain_img_size=64),
+        emb_dim=24,
+        fusion=True,
+        feature_upsample=True,
+        template_capacity=9,
+    )
+    kwargs.update(over)
+    return MatchingNet(**kwargs)
+
+
+def _data(b=2, s=64):
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((b, s, s, 3)).astype(np.float32)
+    exemplars = np.tile(np.array([[0.2, 0.2, 0.4, 0.45]], np.float32),
+                        (b, 1))[:, None, :]
+    return jnp.array(image), jnp.array(exemplars)
+
+
+@pytest.mark.slow
+def test_matching_net_fused_param_tree_and_outputs_match(monkeypatch):
+    """The tentpole contract: TMR_DECODER_IMPL=fused consumes the SAME
+    flax param tree (checkpoints never fork) and reproduces the module
+    stack's outputs at the model geometry."""
+    model = _tiny_model()
+    image, exemplars = _data()
+
+    params_xla = model.init(jax.random.key(0), image, exemplars)["params"]
+    out_xla = jax.jit(
+        lambda p, i, e: model.apply({"params": p}, i, e)
+    )(params_xla, image, exemplars)
+
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    params_fused = model.init(jax.random.key(0), image, exemplars)["params"]
+    # identical tree: same paths, same shapes, same initializer draws
+    assert jax.tree_util.tree_structure(params_xla) == \
+        jax.tree_util.tree_structure(params_fused)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params_xla, params_fused,
+    )
+    out_fused = jax.jit(
+        lambda p, i, e: model.apply({"params": p}, i, e)
+    )(params_xla, image, exemplars)
+
+    for key in ("objectness", "regressions", "f_tm"):
+        a = np.asarray(out_xla[key][0], np.float32)
+        b = np.asarray(out_fused[key][0], np.float32)
+        scale = max(np.abs(a).max(), 1e-6)
+        assert np.abs(a - b).max() / scale < 5e-4, key
+
+
+@pytest.mark.slow
+def test_production_geometry_oracle_pin():
+    """Acceptance criterion: the fused path is oracle-pinned at the
+    production 128^2 x 1024 geometry (emb_dim 512, fusion-doubled, the
+    2x-upsampled grid) — the exact shapes the bench program traces."""
+    assert fh.fused_heads_ok(128, 128, 1024, 1024, num_layers=1,
+                             kernel_size=3, dtype_name="bfloat16")
+    assert drain_gate_refusals() == []

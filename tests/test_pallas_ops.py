@@ -444,3 +444,120 @@ def test_pallas_xcorr_big_bucket_falls_back_to_fft(monkeypatch):
     # nonzero rounding proves the FFT path ran (a conv would be exact)
     np.testing.assert_allclose(np.asarray(got), np.asarray(feat), atol=1e-4)
     assert abs(np.asarray(got) - np.asarray(feat)).max() > 0
+
+
+# ------------------------------------------- nms_topk padded-output tail
+def _batched_rand(b, n, seed, spread=0.6):
+    boxes = jnp.stack([rand_boxes(n, seed + i, spread)[0]
+                       for i in range(b)])
+    scores = jnp.stack([rand_boxes(n, seed + i, spread)[1]
+                        for i in range(b)])
+    return boxes, scores
+
+
+def _topk_reference(boxes, scores, thr, valid, k):
+    """Per-image numpy reference: XLA keep mask -> survivors sorted by
+    (-score, slot) -> compacted into k padded slots."""
+    from tmr_tpu.ops.pallas_nms import nms_topk  # noqa: F401  (under test)
+
+    out = {"count": [], "boxes": [], "scores": [], "index": []}
+    for i in range(scores.shape[0]):
+        keep = np.asarray(nms_keep_mask(boxes[i], scores[i], thr,
+                                        valid=valid[i]))
+        idx = np.nonzero(keep)[0]
+        idx = idx[np.lexsort((idx, -np.asarray(scores[i])[idx]))][:k]
+        n = len(idx)
+        bx = np.zeros((k, 4), np.float32)
+        sc = np.zeros((k,), np.float32)
+        ix = np.full((k,), -1, np.int64)
+        bx[:n] = np.asarray(boxes[i])[idx]
+        sc[:n] = np.asarray(scores[i])[idx]
+        ix[:n] = idx
+        out["count"].append(n)
+        out["boxes"].append(bx)
+        out["scores"].append(sc)
+        out["index"].append(ix)
+    return {k_: np.stack(v) for k_, v in out.items()}
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+@pytest.mark.parametrize("k", [4, 16, 64])
+def test_nms_topk_matches_reference(backend, k):
+    """Batched/padded semantics against the per-image float32 reference,
+    on both backends (pallas in interpret mode — the satellite's
+    interpret-parity requirement)."""
+    from tmr_tpu.ops.pallas_nms import nms_topk
+
+    boxes, scores = _batched_rand(3, 64, seed=10)
+    valid = jnp.ones(scores.shape, bool)
+    got = nms_topk(boxes, scores, 0.4, valid=valid, k=k,
+                   backend=backend, interpret=True)
+    want = _topk_reference(boxes, scores, 0.4, valid, k)
+    np.testing.assert_array_equal(np.asarray(got["count"]), want["count"])
+    np.testing.assert_array_equal(np.asarray(got["index"]), want["index"])
+    np.testing.assert_array_equal(np.asarray(got["boxes"]), want["boxes"])
+    np.testing.assert_array_equal(np.asarray(got["scores"]),
+                                  want["scores"])
+
+
+def test_nms_topk_degenerate_boxes():
+    """Zero-area and inverted boxes must not poison the IoU math: they
+    survive as their own detections (IoU 0 against everything) and the
+    output stays finite."""
+    from tmr_tpu.ops.pallas_nms import nms_topk
+
+    boxes = jnp.asarray([[[0.1, 0.1, 0.1, 0.1],     # zero-area point
+                          [0.5, 0.5, 0.4, 0.4],     # inverted
+                          [0.2, 0.2, 0.4, 0.4]]], jnp.float32)
+    scores = jnp.asarray([[0.9, 0.8, 0.7]], jnp.float32)
+    out = nms_topk(boxes, scores, 0.5, backend="xla")
+    assert int(out["count"][0]) == 3
+    assert np.isfinite(np.asarray(out["boxes"])).all()
+    np.testing.assert_array_equal(np.asarray(out["index"][0]), [0, 1, 2])
+
+
+def test_nms_topk_all_suppressed_to_one():
+    """N copies of one box: exactly the top scorer survives; the other
+    slots are zeroed with index -1."""
+    from tmr_tpu.ops.pallas_nms import nms_topk
+
+    boxes = jnp.tile(jnp.asarray([[[0.2, 0.2, 0.6, 0.6]]], jnp.float32),
+                     (1, 8, 1))
+    scores = jnp.asarray([[0.1, 0.3, 0.95, 0.2, 0.5, 0.4, 0.6, 0.7]],
+                         jnp.float32)
+    out = nms_topk(boxes, scores, 0.5, backend="xla", k=8)
+    assert int(out["count"][0]) == 1
+    assert int(out["index"][0][0]) == 2
+    assert float(out["scores"][0][0]) == pytest.approx(0.95)
+    assert (np.asarray(out["index"][0][1:]) == -1).all()
+    assert (np.asarray(out["scores"][0][1:]) == 0).all()
+    assert (np.asarray(out["boxes"][0][1:]) == 0).all()
+
+
+def test_nms_topk_k_beyond_valid_count_pads():
+    """k larger than the input (and than the survivor count) pads: count
+    reports the real survivors, slots past it are zero/-1."""
+    from tmr_tpu.ops.pallas_nms import nms_topk
+
+    boxes, scores = _batched_rand(1, 6, seed=20, spread=4.0)  # sparse
+    valid = jnp.asarray([[True, True, True, False, False, False]])
+    out = nms_topk(boxes, scores, 0.5, valid=valid, k=10, backend="xla")
+    n = int(out["count"][0])
+    assert n <= 3
+    assert out["boxes"].shape == (1, 10, 4)
+    assert (np.asarray(out["index"][0][n:]) == -1).all()
+    assert (np.asarray(out["scores"][0][n:]) == 0).all()
+    # the surviving prefix is score-descending
+    sc = np.asarray(out["scores"][0][:n])
+    assert (np.diff(sc) <= 0).all()
+
+
+def test_nms_topk_empty_valid():
+    from tmr_tpu.ops.pallas_nms import nms_topk
+
+    boxes, scores = _batched_rand(2, 8, seed=30)
+    valid = jnp.zeros(scores.shape, bool)
+    out = nms_topk(boxes, scores, 0.5, valid=valid, backend="xla")
+    assert (np.asarray(out["count"]) == 0).all()
+    assert (np.asarray(out["index"]) == -1).all()
+    assert (np.asarray(out["boxes"]) == 0).all()

@@ -1,0 +1,192 @@
+"""int8-weight quantization (ops/quant.py, TMR_QUANT): the round-trip
+bound the weights tier pins, the tiered oracle gate's verdicts + recorded
+causes, and the matcher-arm integration through ops/xcorr.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tmr_tpu.diagnostics import (
+    FormulationFallbackWarning,
+    drain_gate_refusals,
+)
+from tmr_tpu.ops import quant as q
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("TMR_QUANT", "TMR_DECODER_IMPL", "TMR_XCORR_IMPL",
+              "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION"):
+        monkeypatch.delenv(k, raising=False)
+    q._OK_CACHE.clear()
+    drain_gate_refusals()
+    yield
+    q._OK_CACHE.clear()
+    drain_gate_refusals()
+
+
+def test_quantize_int8_round_trip_within_grid_bound():
+    """Per-channel symmetric int8: reconstruction error <= scale/2 per
+    element, i.e. half of 1/127 of the channel amax — the analytic bound
+    the weights tier enforces."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((3, 3, 8, 16)), jnp.float32)
+    qw, scale = q.quantize_int8(w, axis=-1)
+    assert qw.dtype == jnp.int8
+    assert scale.shape == (3, 3, 8, 1)
+    rec = np.asarray(q.dequantize(qw, scale, dtype=jnp.float32))
+    err = np.abs(rec - np.asarray(w))
+    bound = np.asarray(scale) / 2 + 1e-7
+    assert (err <= bound).all()
+    assert int(np.abs(np.asarray(qw)).max()) <= 127
+
+
+def test_quantize_int8_zero_channel_is_exact():
+    w = jnp.zeros((2, 4), jnp.float32)
+    qw, scale = q.quantize_int8(w)
+    assert np.asarray(q.dequantize(qw, scale, jnp.float32)).max() == 0.0
+    assert (np.asarray(scale) == 1.0).all()  # not 0/0
+
+
+def test_fake_quant_is_quantize_then_dequantize():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    qw, s = q.quantize_int8(w)
+    np.testing.assert_array_equal(
+        np.asarray(q.fake_quant(w, dtype=jnp.float32)),
+        np.asarray(q.dequantize(qw, s, jnp.float32)),
+    )
+
+
+def test_quant_mode_validates_and_auto_means_off(monkeypatch):
+    assert q.quant_mode() == "off"
+    monkeypatch.setenv("TMR_QUANT", "auto")
+    assert q.quant_mode() == "off"  # unelected auto must never quantize
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    assert q.quant_mode() == "int8"
+    monkeypatch.setenv("TMR_QUANT", "fp4")
+    with pytest.raises(ValueError, match="TMR_QUANT"):
+        q.quant_mode()
+
+
+def test_quant_ok_passes_and_caches_small_geometry():
+    assert q.quant_ok(8, 8, 16, 16, num_layers=1, kernel_size=3)
+    assert drain_gate_refusals() == []
+    n = len(q._OK_CACHE)
+    assert q.quant_ok(8, 8, 16, 16, num_layers=1, kernel_size=3)
+    assert len(q._OK_CACHE) == n
+
+
+def test_quant_ok_channel_changing_first_layer_multi_depth():
+    """c_in != c with num_layers > 1: only the first kernel sees c_in
+    (the stacks are channel-preserving past layer 0) — the gate must
+    model that instead of crashing and mis-recording a refusal."""
+    assert q.quant_ok(8, 8, 8, 16, num_layers=2, kernel_size=3)
+    assert drain_gate_refusals() == []
+
+
+def test_quant_ok_weights_tier_refusal_is_cached(monkeypatch):
+    """A weights-tier refusal must cache its verdict like every other
+    outcome: the gate runs at every bucket trace, and an uncached
+    negative would re-run the compiled probe and append a duplicate
+    refusal record each time."""
+    monkeypatch.setattr(q, "WEIGHT_TIER_REL", -1.0)
+    assert not q.quant_ok(9, 9, 16, 16)
+    causes = drain_gate_refusals()
+    assert len(causes) == 1 and causes[0]["config"]["tier"] == "weights"
+    assert not q.quant_ok(9, 9, 16, 16)  # cached: no re-probe,
+    assert drain_gate_refusals() == []   # no duplicate cause
+
+
+def test_quant_ok_output_tier_refusal_records_cause(monkeypatch):
+    """Force the output tier to fail (zero tolerance): the refusal must
+    carry the gate name, the forward-mismatch cause, and which tier."""
+    monkeypatch.setattr(q, "OUTPUT_TIER_REL", 0.0)
+    assert not q.quant_ok(8, 8, 16, 16)
+    causes = drain_gate_refusals()
+    assert causes and causes[-1]["gate"] == "quant_ok"
+    assert causes[-1]["cause"] == "forward-mismatch"
+    assert causes[-1]["config"]["tier"] == "output"
+
+
+def test_quant_xcorr_ok_small_geometry():
+    assert q.quant_xcorr_ok(8, 12, 12, 5)
+    assert drain_gate_refusals() == []
+
+
+def test_quantize_template_shape_and_error_bound():
+    rng = np.random.default_rng(2)
+    t = jnp.asarray(rng.standard_normal((2, 8, 5, 5)), jnp.float32)
+    tq = q.quantize_template(t, dtype=jnp.float32)
+    assert tq.shape == t.shape
+    # per-(image, channel) bound: half-step of amax/127 plus fp slack
+    amax = np.abs(np.asarray(t)).reshape(2, 8, 25).max(-1)
+    err = np.abs(np.asarray(tq) - np.asarray(t)).reshape(2, 8, 25).max(-1)
+    assert (err <= amax / 127.0 + 1e-6).all()
+
+
+def test_xcorr_quant_arm_close_to_exact(monkeypatch):
+    """TMR_QUANT=int8 through cross_correlation: same shape, within the
+    output-tier tolerance of the exact correlation; off -> bitwise the
+    exact path."""
+    from tmr_tpu.ops.xcorr import cross_correlation
+
+    rng = np.random.default_rng(3)
+    f = jnp.asarray(rng.standard_normal((1, 8, 12, 12)), jnp.float32)
+    t = jnp.asarray(rng.standard_normal((1, 8, 5, 5)), jnp.float32)
+    thw = jnp.full((1, 2), 5, jnp.int32)
+    want = np.asarray(cross_correlation(f, t, thw), np.float32)
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    got = np.asarray(cross_correlation(f, t, thw), np.float32)
+    assert got.shape == want.shape
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    assert rel < q.OUTPUT_TIER_REL
+
+
+def test_xcorr_quant_refusal_warns_and_runs_exact(monkeypatch):
+    """A refused quant_xcorr_ok must fall back to the exact correlation
+    under the FormulationFallbackWarning contract."""
+    import tmr_tpu.ops.xcorr as xc
+
+    rng = np.random.default_rng(4)
+    f = jnp.asarray(rng.standard_normal((1, 4, 10, 10)), jnp.float32)
+    t = jnp.asarray(rng.standard_normal((1, 4, 5, 5)), jnp.float32)
+    thw = jnp.full((1, 2), 5, jnp.int32)
+    want = np.asarray(xc.cross_correlation(f, t, thw), np.float32)
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    monkeypatch.setattr(q, "quant_xcorr_ok", lambda *a: False)
+    with pytest.warns(FormulationFallbackWarning) as rec:
+        got = np.asarray(xc.cross_correlation(f, t, thw), np.float32)
+    assert rec[0].message.env_var == "TMR_QUANT"
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_tail_quant_within_output_tier():
+    """fused_decoder_heads(quant=True) stays inside OUTPUT_TIER_REL of
+    its exact-weight output — the end-to-end error inference pays is the
+    error the gate pinned."""
+    from tmr_tpu.ops.fused_heads import fused_decoder_heads
+
+    rng = np.random.default_rng(5)
+    c = 16
+    x = jnp.asarray(rng.standard_normal((1, 8, 8, c)), jnp.float32)
+    mk = lambda seed: (
+        jnp.asarray(rng.standard_normal((3, 3, c, c)) * 0.05, jnp.float32),
+        jnp.asarray(rng.standard_normal((c,)) * 0.01, jnp.float32),
+    )
+    dec_o, dec_b = [mk(0)], [mk(1)]
+    ho = (jnp.asarray(rng.standard_normal((1, 1, c, 1)) * 0.05,
+                      jnp.float32), jnp.zeros((1,), jnp.float32))
+    hb = (jnp.asarray(rng.standard_normal((1, 1, c, 4)) * 0.05,
+                      jnp.float32), jnp.zeros((4,), jnp.float32))
+    o_e, r_e = fused_decoder_heads(x, dec_o, dec_b, ho, hb,
+                                   dtype=jnp.float32, quant=False)
+    o_q, r_q = fused_decoder_heads(x, dec_o, dec_b, ho, hb,
+                                   dtype=jnp.float32, quant=True)
+    scale = max(float(jnp.max(jnp.abs(o_e))), float(jnp.max(jnp.abs(r_e))),
+                1e-6)
+    rel = max(float(jnp.max(jnp.abs(o_q - o_e))),
+              float(jnp.max(jnp.abs(r_q - r_e)))) / scale
+    assert 0 < rel < q.OUTPUT_TIER_REL  # quantized (changed) but bounded

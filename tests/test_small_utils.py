@@ -55,6 +55,81 @@ def test_report_emitting_scripts_call_their_validator():
     assert checked >= 2  # serve_bench + obs_probe at minimum
 
 
+def _env_knob_reads(path: str) -> set:
+    """AST scan of one file for TMR_* env-knob consumption: literal keys
+    of ``os.environ`` subscripts (reads AND the autotune winner-export
+    writes — same surface) and of ``environ.get/pop/setdefault`` /
+    ``os.getenv`` calls."""
+
+    def lit(node):
+        return (node.value if isinstance(node, ast.Constant)
+                and isinstance(node.value, str) else None)
+
+    def is_environ(node):
+        return ("environ" in ast.dump(node)) or (
+            isinstance(node, ast.Attribute) and node.attr == "getenv"
+        ) or (isinstance(node, ast.Name) and node.id == "getenv")
+
+    knobs = set()
+    for node in ast.walk(ast.parse(open(path).read(), filename=path)):
+        key = None
+        if isinstance(node, ast.Subscript) and is_environ(node.value):
+            key = lit(node.slice)
+        elif isinstance(node, ast.Call) and (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop", "setdefault", "getenv")
+            and is_environ(node.func)
+        ) and node.args:
+            key = lit(node.args[0])
+        if key and key.startswith("TMR_"):
+            knobs.add(key)
+    return knobs
+
+
+def test_env_knob_registry_parity():
+    """Every TMR_* env knob consumed under tmr_tpu/ must be documented in
+    the ``config.ENV_KNOBS`` registry, and every registry entry must be
+    consumed somewhere in the repo (tmr_tpu/, bench.py, scripts/) — the
+    knob surface grew across 4 PRs with no single source of truth, and a
+    registry that can silently go stale in either direction documents
+    nothing."""
+    import glob
+
+    from tmr_tpu.config import ENV_KNOBS
+
+    lib_files = sorted(glob.glob(os.path.join(REPO, "tmr_tpu", "**",
+                                              "*.py"), recursive=True))
+    consumed_lib = set().union(*(_env_knob_reads(p) for p in lib_files))
+    assert consumed_lib, "AST scan found no TMR_ knob reads — scanner broke"
+
+    undocumented = consumed_lib - set(ENV_KNOBS)
+    assert not undocumented, (
+        f"TMR_ knobs consumed under tmr_tpu/ but missing from "
+        f"config.ENV_KNOBS: {sorted(undocumented)} — add each with a "
+        "one-line description"
+    )
+
+    # reverse: a documented knob nothing consumes is a stale entry.
+    # Driver knobs live in bench.py / scripts/, so the reverse scan is
+    # repo-wide (string-literal match is enough for existence).
+    surface = "\n".join(
+        open(p).read() for p in lib_files
+        + [os.path.join(REPO, "bench.py")]
+        + sorted(glob.glob(os.path.join(REPO, "scripts", "*.py")))
+    )
+    stale = [k for k in ENV_KNOBS if f'"{k}"' not in surface
+             and f"'{k}'" not in surface]
+    assert not stale, (
+        f"config.ENV_KNOBS entries no code consumes: {stale} — delete "
+        "them or wire them up"
+    )
+
+    for knob, doc in ENV_KNOBS.items():
+        assert isinstance(doc, str) and doc.strip(), (
+            f"ENV_KNOBS[{knob!r}]: empty description"
+        )
+
+
 def test_no_bare_stdout_prints_under_tmr_tpu():
     """Stdout under tmr_tpu/ is reserved for machine-readable protocol
     output (one-JSON-line reports, the Hadoop-streaming records — written

@@ -160,3 +160,81 @@ def preset(name: str, **overrides) -> Config:
     base.update(presets[name])
     base.update(overrides)
     return Config(**base)
+
+
+#: The TMR_* environment-knob registry — the single source of truth for
+#: every env knob consumed anywhere under ``tmr_tpu/``. The knob surface
+#: grew across PRs 1-5 with no one place saying what exists; tier-1 now
+#: enforces (tests/test_small_utils.py, AST scan of every ``os.environ``
+#: / ``os.getenv`` read) that a knob consumed in code appears here and a
+#: knob listed here is actually consumed — documentation that cannot go
+#: stale. Values are one-line summaries; QUICKSTART_RUN.md carries the
+#: long-form usage for the user-facing ones.
+ENV_KNOBS = {
+    # formulation dispatch (trace-time; autotune exports winners here)
+    "TMR_GLOBAL_ATTN": "global ViT attention formulation: auto|blockwise|"
+        "blockfolded|densefolded|flash|xlaflash|pallas|fused",
+    "TMR_WIN_ATTN": "windowed ViT attention formulation: auto|dense|"
+        "flash|pallas",
+    "TMR_XCORR_IMPL": "template-correlation formulation: auto|conv|"
+        "convnhwc|vmap|fft|pallas",
+    "TMR_XCORR_IMPL_SMALL": "small-bucket override of TMR_XCORR_IMPL",
+    "TMR_XCORR_PRECISION": "correlation MXU precision: highest|default|"
+        "bf16 (decisive-win elected)",
+    "TMR_GLOBAL_SCORES_DTYPE": "global-attention score-tile dtype: "
+        "f32|bf16 (decisive-win elected)",
+    "TMR_WIN_SCORES_DTYPE": "windowed-attention score-tile dtype: f32|bf16",
+    "TMR_DECODER_IMPL": "decoder-tail formulation: auto|xla|fused "
+        "(ops/fused_heads.py, oracle-gated)",
+    "TMR_QUANT": "int8-weight quantized tail: off|int8|auto "
+        "(ops/quant.py, tiered-oracle-gated)",
+    "TMR_DECODE_TAIL": "detection decode tail: host|device "
+        "(device = on-device compaction, self-check-gated)",
+    # kernel tile / schedule parameters (validated, pinnable)
+    "TMR_PALLAS_ATTN_BQ": "Pallas global-attention query-tile rows",
+    "TMR_PALLAS_ATTN_BK": "Pallas global-attention key-tile rows",
+    "TMR_PALLAS_WIN_GROUP": "Pallas windowed-attention window group size",
+    "TMR_XLA_FLASH_BQ": "XLA flash-attention query-block rows",
+    "TMR_XLA_FLASH_BK": "XLA flash-attention key-block rows",
+    "TMR_GLOBAL_BANDS_UNROLL": "global-attention band-scan unroll factor",
+    # kill-switches (gates refuse with a recorded cause)
+    "TMR_NO_FLASH_ATTN": "force-disable the flash attention family",
+    "TMR_NO_PALLAS_XCORR": "force-disable the Pallas correlation kernel",
+    "TMR_NO_FUSED_HEADS": "force-disable the fused decoder-head path",
+    "TMR_NO_DEVICE_TAIL": "force-disable the device decode tail",
+    # autotune / bench machinery
+    "TMR_AUTOTUNE_CACHE": "autotune winner-cache path (0/off disables)",
+    "TMR_AUTOTUNE_FORCE": "re-sweep even when cached winners exist",
+    "TMR_AUTOTUNE_SEED": "seed-cache path promoted into a fresh cache",
+    "TMR_BENCH_BATCH": "bench.py batch-size override",
+    "TMR_BENCH_ALARM": "bench.py watchdog timeout seconds",
+    "TMR_BENCH_STAGES": "bench.py per-stage tail timings (0 skips)",
+    "TMR_COMPILATION_CACHE": "persistent XLA compilation cache (0 opts "
+        "out)",
+    # serving layer
+    "TMR_SERVE_BATCH": "ServeEngine release-batch override",
+    "TMR_SERVE_MAX_WAIT_MS": "ServeEngine micro-batch wait bound",
+    "TMR_SERVE_EXEMPLAR_CACHE": "result-cache capacity (entries)",
+    "TMR_SERVE_FEATURE_CACHE": "device feature-cache capacity (entries)",
+    # observability
+    "TMR_TRACE": "span tracing on/off (default off)",
+    "TMR_TRACE_RING": "per-thread span ring-buffer capacity",
+    "TMR_TRACE_ANNOTATE": "mirror spans as jax.profiler annotations",
+    "TMR_GATE_DEBUG": "print gate refusals to stderr as they happen",
+    # fault injection (tests/chaos probe)
+    "TMR_FAULTS": "deterministic fault-injection schedule",
+    "TMR_FAULTS_SEED": "fault-schedule RNG seed",
+    # bench.py driver knobs (consumed outside tmr_tpu/ but part of the
+    # same surface; the parity test scans bench.py + scripts/ for these)
+    "TMR_AUTOTUNE": "bench.py: run the autotune sweep (0 skips)",
+    "TMR_AUTOTUNE_EXPORT": "bench.py: write elected winners as K=V lines",
+    "TMR_BENCH_CHAIN": "bench.py: chained-iteration count override",
+    "TMR_BENCH_CKPT": "bench.py: trained-checkpoint path to measure",
+    "TMR_BENCH_INIT_RETRIES": "bench.py: device-init retry count",
+    "TMR_BENCH_INIT_TIMEOUT": "bench.py: device-init timeout seconds",
+    "TMR_BENCH_PROFILE": "bench.py: capture an xprof trace directory",
+    "TMR_BENCH_SELFTEST_FAIL": "bench.py self-test: force a failed probe",
+    "TMR_BENCH_SELFTEST_PRELIM": "bench.py self-test: force prelim emit",
+    "TMR_BENCH_SIZE": "bench.py: image-size override",
+    "TMR_BENCH_TINY": "bench.py: tiny CPU-geometry smoke mode",
+}

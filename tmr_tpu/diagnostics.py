@@ -328,6 +328,53 @@ def validate_trace_report(doc: dict) -> List[str]:
     return problems
 
 
+#: keys a valid ``stage_breakdown`` record (bench.py embeds one per
+#: round; utils/stage_bench.measure_stage_breakdown emits it): the
+#: formulations that actually traced plus one ``<stage>_s`` seconds/iter
+#: or ``<stage>_error`` string per tail stage. Not a standalone
+#: ``*_REPORT_SCHEMA`` document — it rides inside the bench record, so it
+#: carries no schema tag of its own.
+STAGE_BREAKDOWN_STAGES = ("decoder_heads", "decode_tail")
+
+
+def validate_stage_breakdown(doc: dict) -> List[str]:
+    """Structural check of a bench ``stage_breakdown`` record; returns a
+    list of problems (empty == valid). Each stage must carry EITHER its
+    measured ``<stage>_s`` seconds (non-negative number) or a
+    ``<stage>_error`` string — never both, never neither — alongside the
+    formulation stamp (decoder_impl/quant/decode_tail) that says what the
+    timing measured. A bare ``{"error": str}`` record is also valid: the
+    whole harness failed before any stage could stamp (bench.py's
+    fallback — the headline must survive a mid-stage wedge), so there is
+    nothing stage-wise to check. Dependency-free like the report
+    validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if set(doc) == {"error"}:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            return ["error: not a non-empty string"]
+        return []
+    for key, legal in (("decoder_impl", ("xla", "fused")),
+                       ("quant", ("off", "int8")),
+                       ("decode_tail", ("host", "device"))):
+        if doc.get(key) not in legal:
+            problems.append(f"{key}: {doc.get(key)!r} not in {legal}")
+    for stage in STAGE_BREAKDOWN_STAGES:
+        sec, err = doc.get(f"{stage}_s"), doc.get(f"{stage}_error")
+        if sec is None and err is None:
+            problems.append(f"{stage}: neither {stage}_s nor {stage}_error")
+        elif sec is not None and err is not None:
+            problems.append(f"{stage}: both {stage}_s and {stage}_error")
+        elif err is None:
+            if not isinstance(sec, (int, float)) or isinstance(sec, bool) \
+                    or sec < 0:
+                problems.append(f"{stage}_s: not a non-negative number")
+        elif not isinstance(err, str) or not err:
+            problems.append(f"{stage}_error: not a non-empty string")
+    return problems
+
+
 #: registry bound: the attention gates are lru_cached (one record per
 #: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
 #: backend / shape) record on EVERY call — a long-lived process that
@@ -393,6 +440,29 @@ def record_gate_refusal(
     if len(_GATE_REFUSALS) > _MAX_GATE_REFUSALS:
         del _GATE_REFUSALS[:-_MAX_GATE_REFUSALS]
     return rec
+
+
+def gate_refused(
+    gate: str,
+    reason: str,
+    cause: str,
+    config: Optional[Dict[str, object]] = None,
+    exception: Optional[str] = None,
+) -> bool:
+    """record_gate_refusal + the TMR_GATE_DEBUG stderr line, returning
+    False so gate checks can ``return gate_refused(...)`` — the one
+    definition of the refuse-and-say-why move every oracle gate makes
+    (fused_heads / quant / postprocess use it; the older attention and
+    xcorr gates predate it)."""
+    import os
+
+    record_gate_refusal(gate, cause, message=reason, exception=exception,
+                        config=config)
+    if os.environ.get("TMR_GATE_DEBUG"):
+        import sys
+
+        print(f"[gate] {gate}: refused — {reason}", file=sys.stderr)
+    return False
 
 
 def gate_refusals() -> List[dict]:

@@ -28,7 +28,42 @@ import flax.linen as nn
 from tmr_tpu.models import build_model
 from tmr_tpu.models.matching_net import select_capacity_bucket
 from tmr_tpu.obs import track_compile
-from tmr_tpu.ops.postprocess import batched_nms, decode_detections
+from tmr_tpu.ops.postprocess import (
+    batched_nms,
+    compact_detections,
+    decode_detections,
+    device_tail_ok,
+)
+
+#: legal TMR_DECODE_TAIL values (config registry imports this)
+DECODE_TAIL_MODES = ("host", "device")
+
+
+def decode_tail_mode() -> str:
+    """Resolve TMR_DECODE_TAIL at trace time. "device" is admitted only
+    through the ops/postprocess.device_tail_ok self-check — a refusal
+    records its gate_probe/v1 cause and runs the host path, never a
+    silent reorder."""
+    import os
+
+    mode = os.environ.get("TMR_DECODE_TAIL", "host")
+    if mode not in DECODE_TAIL_MODES:
+        raise ValueError(
+            f"TMR_DECODE_TAIL={mode!r}: expected "
+            + "|".join(DECODE_TAIL_MODES)
+        )
+    if mode == "device" and not device_tail_ok():
+        import warnings
+
+        from tmr_tpu.diagnostics import FormulationFallbackWarning
+
+        warnings.warn(FormulationFallbackWarning(
+            "TMR_DECODE_TAIL",
+            "TMR_DECODE_TAIL=device: compaction self-check refused; "
+            "running the host decode tail"
+        ))
+        return "host"
+    return mode
 
 
 class _PassthroughBackbone(nn.Module):
@@ -95,12 +130,21 @@ class Predictor:
     def _refine_nms(self, dets: dict, feature, image_hw, refiner_params,
                     refine: bool) -> dict:
         """[refine ->] NMS tail (reference test-step order trainer.py:143-150,
-        shared by the single- and multi-exemplar programs)."""
+        shared by the single- and multi-exemplar programs). Under
+        TMR_DECODE_TAIL=device the survivors are additionally compacted to
+        the leading slots on device with a ``count`` vector
+        (ops/postprocess.compact_detections) — same fixed output shape,
+        host postprocess becomes a prefix slice instead of a 2000-slot
+        boolean scan, per-image results bitwise-identical to the host
+        path (tests/test_decode_tail.py)."""
         if refine:
             dets = self.refiner.refine(
                 refiner_params, feature, dets, image_hw
             )
-        return batched_nms(dets, self.cfg.NMS_iou_threshold)
+        dets = batched_nms(dets, self.cfg.NMS_iou_threshold)
+        if decode_tail_mode() == "device":
+            dets = compact_detections(dets)
+        return dets
 
     def _get_fn(self, capacity: int, loss_fn=None,
                 chain_feedback: bool = False, donate: bool = False):
@@ -508,12 +552,29 @@ class Predictor:
 
 def detections_to_numpy(dets: dict) -> list:
     """Fixed-slot device detections -> per-image ragged numpy dicts
-    (the reference's pred_logits/pred_boxes/ref_points lists)."""
+    (the reference's pred_logits/pred_boxes/ref_points lists).
+
+    Device-compacted detections (TMR_DECODE_TAIL=device: survivors in the
+    leading ``count`` slots) take the prefix-slice fast path; the host
+    form scans the validity mask. Both yield identical lists."""
     boxes = np.asarray(dets["boxes"])
     scores = np.asarray(dets["scores"])
     refs = np.asarray(dets["refs"])
-    valid = np.asarray(dets["valid"])
     out = []
+    if "count" in dets:
+        count = np.asarray(dets["count"])
+        for b in range(boxes.shape[0]):
+            n = int(count[b])
+            # .copy(): a prefix-slice VIEW would pin the whole padded
+            # (B, max_detections, ...) batch alive for as long as the
+            # caller keeps the per-image dict — the retention hazard
+            # serve/engine.py's _finish documents; the host path's
+            # boolean indexing below copies inherently
+            out.append({"boxes": boxes[b][:n].copy(),
+                        "scores": scores[b][:n].copy(),
+                        "refs": refs[b][:n].copy()})
+        return out
+    valid = np.asarray(dets["valid"])
     for b in range(boxes.shape[0]):
         v = valid[b]
         out.append(

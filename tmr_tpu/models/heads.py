@@ -4,6 +4,17 @@ All convs initialize weight ~ N(0, 0.01), bias = 0, matching
 regression_head.py:19-24 — the objectness head's near-zero init sets the
 initial sigmoid to ~0.5, which the BCE normalization scheme expects.
 NHWC layout; LeakyReLU uses torch's default negative slope 0.01.
+
+Each module's ``__call__`` additionally accepts ``return_params=True``:
+instead of running its convs it declares the SAME parameter tree (same
+nested names, shapes, initializers — checkpoint- and golden-compatible
+by construction) through lightweight param-holder children and returns
+the (kernel, bias) values. This is how the fused decoder-head
+formulation (ops/fused_heads.py, TMR_DECODER_IMPL=fused) consumes the
+modules' weights from inside MatchingNet without forking the param tree:
+flax scopes parameters by module path, so a ``_ConvParams`` child named
+``conv_0`` inside ``decoder_o_0`` owns exactly the
+``decoder_o_0/conv_0/{kernel,bias}`` leaves ``nn.Conv`` would.
 """
 
 from __future__ import annotations
@@ -12,6 +23,27 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 _INIT = nn.initializers.normal(stddev=0.01)
+
+
+class _ConvParams(nn.Module):
+    """Param-holder twin of one ``nn.Conv``: declares kernel/bias with
+    nn.Conv's names, shapes, dtypes and inits, returns the values."""
+
+    features: int
+    kernel_size: tuple
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param(
+            "kernel", _INIT,
+            tuple(self.kernel_size) + (in_features, self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,),
+            jnp.float32,
+        )
+        return kernel, bias
 
 
 class Decoder(nn.Module):
@@ -23,8 +55,14 @@ class Decoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, return_params: bool = False):
         c = x.shape[-1]
+        if return_params:
+            k = (self.kernel_size, self.kernel_size)
+            return [
+                _ConvParams(c, k, name=f"conv_{i}")(c)
+                for i in range(self.num_layers)
+            ]
         for i in range(self.num_layers):
             x = nn.Conv(
                 c,
@@ -44,7 +82,9 @@ class ObjectnessHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, return_params: bool = False):
+        if return_params:
+            return _ConvParams(1, (1, 1), name="conv")(x.shape[-1])
         return nn.Conv(1, (1, 1), kernel_init=_INIT, dtype=self.dtype,
                        name="conv")(x)
 
@@ -55,6 +95,8 @@ class BboxesHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, return_params: bool = False):
+        if return_params:
+            return _ConvParams(4, (1, 1), name="conv")(x.shape[-1])
         return nn.Conv(4, (1, 1), kernel_init=_INIT, dtype=self.dtype,
                        name="conv")(x)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
+from tmr_tpu.ops.fused_heads import decoder_impl, fused_decoder_heads
 from tmr_tpu.ops.xcorr import cross_correlation, extract_prototype, extract_template
 
 
@@ -134,26 +135,98 @@ class MatchingNet(nn.Module):
 
             f_cat = jnp.concatenate([fp, f_tm], axis=-1) if self.fusion else f_tm
 
+            # decoder-tail formulation dispatch (TMR_DECODER_IMPL /
+            # TMR_QUANT, read at trace time like the attention knobs):
+            # "fused" runs both conv stacks + both 1x1 heads as
+            # channel-tiled matmuls (ops/fused_heads.py) on the SAME param
+            # tree — the modules declare their parameters either way, so
+            # checkpoints and goldens never fork. box_reg=False has a
+            # single stack and stays on the module path.
+            impl, quant = "xla", False
             if self.box_reg:
-                f_box = Decoder(
+                impl, quant = decoder_impl(
+                    f_cat.shape[1], f_cat.shape[2], f_cat.shape[-1],
+                    f_cat.shape[-1], self.decoder_num_layer,
+                    self.decoder_kernel_size,
+                    "bfloat16" if self.dtype == jnp.bfloat16 else "float32",
+                )
+            else:
+                import os
+
+                if os.environ.get("TMR_DECODER_IMPL") == "fused":
+                    # the refusal contract holds even where decoder_impl
+                    # is never consulted: a pinned fused request on a
+                    # single-stack (box_reg=False) model must warn and
+                    # record why, not silently run the module stack
+                    import warnings
+
+                    from tmr_tpu.diagnostics import (
+                        FormulationFallbackWarning,
+                        gate_refused,
+                    )
+
+                    gate_refused(
+                        "fused_heads_ok",
+                        "box_reg=False: the fused tail covers the "
+                        "two-stack formulation only",
+                        "unsupported-shape",
+                        config={"box_reg": False},
+                    )
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_DECODER_IMPL",
+                        "TMR_DECODER_IMPL=fused: single-stack "
+                        "(box_reg=False) model; running the XLA module "
+                        "stack"
+                    ))
+
+            if impl == "fused":
+                dec_b_p = Decoder(
                     num_layers=self.decoder_num_layer,
                     kernel_size=self.decoder_kernel_size,
                     dtype=self.dtype,
                     name=f"decoder_b_{i}",
-                )(f_cat)
-                b = BboxesHead(dtype=self.dtype, name=f"ltrbs_head_{i}")(f_box)
-                out["regressions"].append(b.astype(jnp.float32))
+                )(f_cat, return_params=True)
+                head_b_p = BboxesHead(
+                    dtype=self.dtype, name=f"ltrbs_head_{i}"
+                )(f_cat, return_params=True)
+                dec_o_p = Decoder(
+                    num_layers=self.decoder_num_layer,
+                    kernel_size=self.decoder_kernel_size,
+                    dtype=self.dtype,
+                    name=f"decoder_o_{i}",
+                )(f_cat, return_params=True)
+                head_o_p = ObjectnessHead(
+                    dtype=self.dtype, name=f"objectness_head_{i}"
+                )(f_cat, return_params=True)
+                o, b = fused_decoder_heads(
+                    f_cat, dec_o_p, dec_b_p, head_o_p, head_b_p,
+                    dtype=self.dtype, quant=quant,
+                )
+                out["regressions"].append(b)  # already float32
+                out["objectness"].append(o[..., 0])
             else:
-                out["regressions"].append(None)
+                if self.box_reg:
+                    f_box = Decoder(
+                        num_layers=self.decoder_num_layer,
+                        kernel_size=self.decoder_kernel_size,
+                        dtype=self.dtype,
+                        name=f"decoder_b_{i}",
+                    )(f_cat)
+                    b = BboxesHead(dtype=self.dtype,
+                                   name=f"ltrbs_head_{i}")(f_box)
+                    out["regressions"].append(b.astype(jnp.float32))
+                else:
+                    out["regressions"].append(None)
 
-            f_obj = Decoder(
-                num_layers=self.decoder_num_layer,
-                kernel_size=self.decoder_kernel_size,
-                dtype=self.dtype,
-                name=f"decoder_o_{i}",
-            )(f_cat)
-            o = ObjectnessHead(dtype=self.dtype, name=f"objectness_head_{i}")(f_obj)
-            out["objectness"].append(o[..., 0].astype(jnp.float32))
+                f_obj = Decoder(
+                    num_layers=self.decoder_num_layer,
+                    kernel_size=self.decoder_kernel_size,
+                    dtype=self.dtype,
+                    name=f"decoder_o_{i}",
+                )(f_cat)
+                o = ObjectnessHead(dtype=self.dtype,
+                                   name=f"objectness_head_{i}")(f_obj)
+                out["objectness"].append(o[..., 0].astype(jnp.float32))
             out["f_tm"].append(nn.relu(f_tm).astype(jnp.float32))
         return out
 

@@ -1,0 +1,311 @@
+"""Fused decoder-conv + prediction-head tail (TMR_DECODER_IMPL=fused).
+
+Why: after PR 1 took the ViT attention off the critical path, the
+remaining single-chip budget hides in the tail — two channel-preserving
+1024-ch 3x3 conv stacks + 1x1 heads on the 2x-upsampled 128^2 grid
+(`decoder_heads` in profile_breakdown.py, the stage PR 3 added precisely
+because it was never measured). XLA lowers those convs through generic
+conv machinery; on TPU that pays layout canonicalization and spatial
+im2col-style windowing for what is, at kernel 3 and C >= 1024, pure
+matmul work: a 3x3 SAME conv is exactly nine (H*W, C_in) x (C_in, C_out)
+matmuls at shifted spatial offsets, every operand 128-lane aligned in
+NHWC as-is.
+
+This module expresses the tail that way — the "channel-tiled matmul"
+formulation shaped for v5e:
+
+- the two decoder stacks consume the SAME f_cat input, so their first
+  layers run as ONE conv with the output channels concatenated
+  ((C_in, 2C) per tap — identical FLOPs to the two separate convs, one
+  pass over the activations instead of two);
+- each 3x3 tap is a `lax.dot_general` over the channel dim with an f32
+  accumulator carried across taps (ONE rounding at the end instead of
+  XLA's per-conv output rounding — numerically at least as tight);
+- the trailing 1x1 objectness/ltrb heads fold into a single
+  block-diagonal (2C, 5) matmul over the combined activation.
+
+The formulation is pure XLA (no Mosaic gate to refuse), so it runs on
+every backend; election is by measurement (utils/autotune.py sweeps
+TMR_DECODER_IMPL) under the `fused_heads_ok` oracle gate, which pins the
+fused output against the flax module stack at the exact geometry about
+to trace — production 128^2 x 1024 included — and records a
+gate_probe/v1 cause on any refusal.
+
+The int8 weight variant (TMR_QUANT, ops/quant.py) rides the same
+formulation: each matmul's weight operand is round-tripped through the
+int8 grid with a per-output-channel scale next to its dot_general (the
+fake-quant formulation — int8 numerics pinned exactly, int8 storage a
+follow-up; see the quant module docstring); admitted only through
+quant.quant_ok's tiered oracle.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: legal TMR_DECODER_IMPL values (autotune + config registry import this)
+DECODER_IMPLS = ("auto", "xla", "fused")
+
+ParamPair = Tuple[jnp.ndarray, jnp.ndarray]  # (kernel, bias)
+
+
+def _maybe_quant(w: jnp.ndarray, dtype, quant: bool) -> jnp.ndarray:
+    """Weight operand for one matmul: bf16/f32 cast, or the int8
+    quantize-dequantize round trip under TMR_QUANT. Every operand here is
+    a 2D (C_in, C_out) matrix (a conv tap or the block-diagonal head), so
+    reducing over axis 0 yields one scale per OUTPUT channel — the
+    grouping the quant_ok weights tier bounds; a shared-across-outputs
+    scale would let one large sibling channel crush small channels'
+    weights to zero."""
+    if quant:
+        from tmr_tpu.ops.quant import fake_quant
+
+        return fake_quant(w, axis=0, dtype=dtype)
+    return w.astype(dtype)
+
+
+def conv_mm(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray,
+            dtype=jnp.bfloat16, quant: bool = False) -> jnp.ndarray:
+    """k x k conv as k^2 channel-contracted matmuls, f32 accumulator,
+    with the module stack's torch-style symmetric padding (k-1)//2 — the
+    heads.py nn.Conv contract, which the oracle compares against. Odd k
+    keeps the grid (SAME); even k shrinks it by one, exactly like the
+    modules do.
+
+    x: (B, H, W, C_in) NHWC; kernel: (k, k, C_in, C_out) (the nn.Conv
+    layout, so module params feed in unchanged); bias: (C_out,).
+    Returns (B, H', W', C_out) float32 — callers round once, after the
+    nonlinearity, instead of per conv.
+    """
+    k = kernel.shape[0]
+    p = (k - 1) // 2
+    b, h, w, _ = x.shape
+    oh, ow = h + 2 * p - k + 1, w + 2 * p - k + 1
+    xp = jnp.pad(x.astype(dtype), ((0, 0), (p, p), (p, p), (0, 0)))
+    acc = None
+    for dy in range(k):
+        for dx in range(k):
+            tap = lax.dot_general(
+                xp[:, dy : dy + oh, dx : dx + ow, :],
+                _maybe_quant(kernel[dy, dx], dtype, quant),
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc = tap if acc is None else acc + tap
+    return acc + bias.astype(jnp.float32)
+
+
+def fused_decoder_heads(
+    f_cat: jnp.ndarray,
+    dec_o: Sequence[ParamPair],
+    dec_b: Sequence[ParamPair],
+    head_o: ParamPair,
+    head_b: ParamPair,
+    dtype=jnp.bfloat16,
+    negative_slope: float = 0.01,
+    quant: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The full decoder tail as channel-tiled matmuls.
+
+    f_cat: (B, H, W, C_in); dec_o/dec_b: per-layer (kernel, bias) of the
+    objectness/bbox decoder stacks (channel-preserving, C out each);
+    head_o/head_b: the 1x1 head (kernel (1, 1, C, 1|4), bias). Returns
+    (objectness (B, H, W, 1), regressions (B, H, W, 4)) in f32 — the
+    dtypes matching_net.py exports.
+    """
+    assert len(dec_o) == len(dec_b), "stacks must have equal depth"
+    c = dec_o[0][0].shape[-1]
+
+    # layer 0 over the shared input: one conv, channels [obj | bbox]
+    w0 = jnp.concatenate([dec_o[0][0], dec_b[0][0]], axis=-1)
+    b0 = jnp.concatenate([dec_o[0][1], dec_b[0][1]], axis=-1)
+    act = conv_mm(f_cat, w0, b0, dtype=dtype, quant=quant)
+    act = jax.nn.leaky_relu(act, negative_slope)
+
+    # deeper layers are channel-preserving per stack: running them
+    # combined would need a block-diagonal (2C, 2C) kernel — 2x the
+    # FLOPs — so each stack proceeds on its half of the activation
+    for (wo, bo), (wb, bb) in zip(dec_o[1:], dec_b[1:]):
+        ao = conv_mm(act[..., :c].astype(dtype), wo, bo, dtype=dtype,
+                     quant=quant)
+        ab = conv_mm(act[..., c:].astype(dtype), wb, bb, dtype=dtype,
+                     quant=quant)
+        act = jax.nn.leaky_relu(jnp.concatenate([ao, ab], axis=-1),
+                                negative_slope)
+
+    # both 1x1 heads as one block-diagonal (2C, 5) matmul: column 0 reads
+    # the objectness half, columns 1..4 the bbox half
+    w1, b1 = head_o
+    w4, b4 = head_b
+    wh = jnp.zeros((2 * c, 5), jnp.float32)
+    wh = wh.at[:c, :1].set(w1.reshape(c, 1))
+    wh = wh.at[c:, 1:].set(w4.reshape(c, 4))
+    bh = jnp.concatenate([b1, b4])
+    out = lax.dot_general(
+        act.astype(dtype), _maybe_quant(wh, dtype, quant),
+        (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + bh.astype(jnp.float32)
+    return out[..., :1], out[..., 1:]
+
+
+_OK_CACHE: dict = {}
+
+
+def _refused(reason: str, cause: str, config: dict, exception=None) -> bool:
+    from tmr_tpu.diagnostics import gate_refused
+
+    return gate_refused("fused_heads_ok", reason, cause, config=config,
+                        exception=exception)
+
+
+def fused_heads_ok(h: int, w: int, c_in: int, c: int,
+                   num_layers: int = 1, kernel_size: int = 3,
+                   dtype_name: str = "bfloat16") -> bool:
+    """Per-geometry oracle pin of the fused tail against the flax module
+    stack (Decoder + ObjectnessHead + BboxesHead) — the production
+    numerics. B=1 at the REAL (h, w, c_in, c): the matmul shapes are what
+    a verdict keys on, batch only scales them. Tolerance is dtype-tiered:
+    bf16 activations round per-operation in the oracle but once per tap
+    chain here, so agreement is bounded by bf16 rounding, not exactness;
+    f32 runs pin tighter. TMR_NO_FUSED_HEADS=1 force-disables (the
+    kill-switch every gated formulation carries).
+    """
+    cfg = {"H": h, "W": w, "C_in": c_in, "C": c, "num_layers": num_layers,
+           "kernel_size": kernel_size, "dtype": dtype_name}
+    if os.environ.get("TMR_NO_FUSED_HEADS"):
+        return _refused("TMR_NO_FUSED_HEADS kill-switch", "kill-switch", cfg)
+    key = tuple(sorted(cfg.items()))
+    if key in _OK_CACHE:
+        return _OK_CACHE[key]
+    import numpy as np
+
+    ok = False
+    try:
+        with jax.ensure_compile_time_eval():
+            from tmr_tpu.models.heads import (
+                BboxesHead,
+                Decoder,
+                ObjectnessHead,
+            )
+
+            dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+            tol = 2e-2 if dtype_name == "bfloat16" else 5e-4
+            rng = np.random.default_rng(0)
+            x = jnp.asarray(rng.standard_normal((1, h, w, c_in)), dtype)
+
+            dec_o = Decoder(num_layers=num_layers, kernel_size=kernel_size,
+                            dtype=dtype)
+            dec_b = Decoder(num_layers=num_layers, kernel_size=kernel_size,
+                            dtype=dtype)
+            ho = ObjectnessHead(dtype=dtype)
+            hb = BboxesHead(dtype=dtype)
+            kk = jax.random.key(0)
+            # channel-preserving stacks: layer-0 params fix every shape
+            po = jax.jit(dec_o.init)(kk, x)["params"]
+            pb = jax.jit(dec_b.init)(jax.random.key(1), x)["params"]
+            xc = jnp.zeros((1, 1, 1, c), dtype)
+            pho = jax.jit(ho.init)(jax.random.key(2), xc)["params"]
+            phb = jax.jit(hb.init)(jax.random.key(3), xc)["params"]
+
+            @jax.jit
+            def oracle(po, pb, pho, phb, x):
+                o = ho.apply({"params": pho}, dec_o.apply({"params": po}, x))
+                r = hb.apply({"params": phb}, dec_b.apply({"params": pb}, x))
+                return (o.astype(jnp.float32), r.astype(jnp.float32))
+
+            @jax.jit
+            def fused(po, pb, pho, phb, x):
+                mk = lambda p: [
+                    (p[f"conv_{i}"]["kernel"], p[f"conv_{i}"]["bias"])
+                    for i in range(num_layers)
+                ]
+                return fused_decoder_heads(
+                    x, mk(po), mk(pb),
+                    (pho["conv"]["kernel"], pho["conv"]["bias"]),
+                    (phb["conv"]["kernel"], phb["conv"]["bias"]),
+                    dtype=dtype,
+                )
+
+            want_o, want_r = oracle(po, pb, pho, phb, x)
+            got_o, got_r = fused(po, pb, pho, phb, x)
+            scale = max(float(jnp.max(jnp.abs(want_o))),
+                        float(jnp.max(jnp.abs(want_r))), 1e-6)
+            rel = max(float(jnp.max(jnp.abs(got_o - want_o))),
+                      float(jnp.max(jnp.abs(got_r - want_r)))) / scale
+            ok = rel < tol
+            if not ok:
+                _refused(f"rel err {rel:.4g} >= {tol}", "forward-mismatch",
+                         cfg)
+    except Exception as e:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        _refused(f"{type(e).__name__}: {e}", "exception", cfg,
+                 exception=type(e).__name__)
+        ok = False
+    _OK_CACHE[key] = ok
+    return ok
+
+
+def decoder_impl(h: int, w: int, c_in: int, c: int,
+                 num_layers: int, kernel_size: int,
+                 dtype_name: str) -> Tuple[str, bool]:
+    """Resolve (impl, quant) for the decoder tail at trace time.
+
+    TMR_DECODER_IMPL: "xla" (the flax module stack — the parity default),
+    "fused" (this module's formulation, admitted by fused_heads_ok),
+    "auto" (xla until autotune exports a measured winner). TMR_QUANT=int8
+    additionally requests int8 weights — only meaningful on the fused
+    path, and only admitted when quant.quant_ok's tiered oracle passes;
+    every refusal warns (FormulationFallbackWarning, so autotune sweeps
+    annotate mislabeled timings) and records a gate_probe/v1 cause.
+    """
+    import warnings
+
+    from tmr_tpu.diagnostics import FormulationFallbackWarning
+    from tmr_tpu.ops.quant import quant_mode, quant_ok
+
+    impl = os.environ.get("TMR_DECODER_IMPL", "auto")
+    if impl not in DECODER_IMPLS:
+        raise ValueError(
+            f"TMR_DECODER_IMPL={impl!r}: expected " + "|".join(DECODER_IMPLS)
+        )
+    quant = quant_mode() == "int8"
+    if impl == "auto":
+        impl = "xla"
+    if impl == "fused" and not fused_heads_ok(
+        h, w, c_in, c, num_layers, kernel_size, dtype_name
+    ):
+        warnings.warn(FormulationFallbackWarning(
+            "TMR_DECODER_IMPL",
+            f"TMR_DECODER_IMPL=fused: oracle gate refused at "
+            f"({h}x{w}, {c_in}->{c}); running the XLA module stack"
+        ))
+        impl = "xla"
+    if quant:
+        if impl != "fused":
+            warnings.warn(FormulationFallbackWarning(
+                "TMR_QUANT",
+                "TMR_QUANT=int8: quantized decoder weights ride the fused "
+                f"formulation only (active impl {impl!r}); the DECODER arm "
+                "runs exact weights (the matcher correlation arm is gated "
+                "separately by quant_xcorr_ok)"
+            ))
+            quant = False
+        elif not quant_ok(h, w, c_in, c, num_layers, kernel_size):
+            warnings.warn(FormulationFallbackWarning(
+                "TMR_QUANT",
+                "TMR_QUANT=int8: tiered oracle refused at "
+                f"({h}x{w}, {c_in}->{c}); the DECODER arm runs exact "
+                "weights (the matcher correlation arm is gated separately "
+                "by quant_xcorr_ok)"
+            ))
+            quant = False
+    return impl, quant

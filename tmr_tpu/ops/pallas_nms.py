@@ -108,6 +108,77 @@ def nms_keep_mask_pallas(
     return jnp.zeros((n,), bool).at[order].set(keep_sorted)
 
 
+def nms_topk(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    valid: jnp.ndarray | None = None,
+    k: int | None = None,
+    interpret: bool | None = None,
+    backend: str = "auto",
+) -> dict:
+    """Batched greedy NMS with a fixed-size padded compact output — NMS +
+    top-k box gather in one call, sharing the device decode tail's
+    padded-output contract (``count`` + zeroed dead slots). NOTE the
+    Predictor's device tail itself compacts with
+    ops/postprocess.compact_detections — slot-order-preserving, which the
+    bitwise host-parity pin requires — while this primitive reorders
+    score-descending; it is the standalone building block for callers
+    that want ranked survivors (gallery/union-NMS style batch matching),
+    not a drop-in for _refine_nms.
+
+    boxes: (B, N, 4) xyxy; scores: (B, N); valid: optional (B, N) bool.
+    Returns {"count" (B,) int32, "boxes" (B, k, 4), "scores" (B, k),
+    "index" (B, k) int32}: the surviving boxes per image in descending
+    score order (ties break toward the lower input slot — lax.top_k is
+    index-stable, so the output is deterministic), compacted to the
+    leading ``count`` slots; everything past ``count`` is zeroed (boxes,
+    scores) with index -1. ``k`` defaults to N; ``k`` larger than the
+    survivor count simply pads (the degenerate cases — all-suppressed,
+    empty valid, k > survivors — are pinned by tests/test_pallas_ops.py).
+
+    backend: "auto" uses the Pallas sequential-greedy kernel where its
+    self-check admits it and the XLA fixpoint elsewhere (exact same keep
+    decisions, tests/test_pallas_ops.py); "pallas"/"xla" force.
+    """
+    b, n = scores.shape
+    k = n if k is None else int(k)
+    if valid is None:
+        valid = jnp.ones((b, n), bool)
+    if backend == "auto":
+        backend = (
+            "pallas"
+            if jax.default_backend() == "tpu" and pallas_nms_compiled_ok()
+            else "xla"
+        )
+    if backend == "pallas":
+        fn = lambda bx, s, v: nms_keep_mask_pallas(
+            bx, s, iou_threshold, v, interpret=interpret
+        )
+    else:
+        from tmr_tpu.ops.nms import nms_keep_mask
+
+        fn = lambda bx, s, v: nms_keep_mask(bx, s, iou_threshold, v)
+    keep = jax.vmap(fn)(boxes, scores, valid)
+
+    ranked = jnp.where(keep, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(ranked, min(k, n))
+    if k > n:  # more output slots than inputs: pad the gather itself
+        pad = k - n
+        top_scores = jnp.pad(top_scores, ((0, 0), (0, pad)),
+                             constant_values=-jnp.inf)
+        top_idx = jnp.pad(top_idx, ((0, 0), (0, pad)))
+    count = jnp.minimum(keep.sum(axis=1), k).astype(jnp.int32)
+    ok = jnp.arange(k)[None, :] < count[:, None]
+    gather = jax.vmap(lambda a, i: a[i])
+    return {
+        "count": count,
+        "boxes": jnp.where(ok[..., None], gather(boxes, top_idx), 0.0),
+        "scores": jnp.where(ok, top_scores, 0.0),
+        "index": jnp.where(ok, top_idx, -1),
+    }
+
+
 @functools.lru_cache(maxsize=1)
 def pallas_nms_compiled_ok() -> bool:
     """One-time self-check of the *compiled* kernel on this backend.

@@ -11,6 +11,7 @@ program serves every image.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # Kernel shapes of TM_utils.py:363-377, stacked [full, point, column, row, cross].
@@ -62,6 +63,29 @@ def masked_maxpool3x3(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
             use = mask[dy, dx] > 0
             out = jnp.maximum(out, jnp.where(use, shifted, -jnp.inf))
     return out
+
+
+def topk_peak_candidates(
+    scores: jnp.ndarray,
+    peak_mask: jnp.ndarray,
+    cls_threshold: float,
+    k: int,
+):
+    """Score-threshold + top-k candidate selection over flattened peak
+    maps — the slot-filling half of the decode tail, in one place so the
+    host and device decode paths (ops/postprocess.py, inference.py
+    TMR_DECODE_TAIL) can never drift.
+
+    scores: (B, L) post-sigmoid; peak_mask: (B, L) bool local-max mask.
+    Returns (top_scores (B, k), top_idx (B, k) int32, valid (B, k) bool):
+    the k best above-threshold peaks per image, score-descending
+    (jax.lax.top_k is index-stable, so ties break toward the lower flat
+    index — deterministic), invalid slots carrying score 0.
+    """
+    cand = jnp.where(peak_mask & (scores >= cls_threshold), scores, -1.0)
+    top_scores, top_idx = jax.lax.top_k(cand, k)
+    valid = top_scores > 0.0
+    return jnp.where(valid, top_scores, 0.0), top_idx, valid
 
 
 def local_peaks(

@@ -19,7 +19,11 @@ import jax.numpy as jnp
 
 from tmr_tpu.ops.boxes import decode_regression
 from tmr_tpu.ops.nms import nms_keep_mask
-from tmr_tpu.ops.peaks import adaptive_kernel, masked_maxpool3x3
+from tmr_tpu.ops.peaks import (
+    adaptive_kernel,
+    masked_maxpool3x3,
+    topk_peak_candidates,
+)
 
 
 def decode_detections(
@@ -81,15 +85,14 @@ def decode_detections(
     boxes = jnp.concatenate(all_boxes, axis=1)
     refs = jnp.concatenate(all_refs, axis=1)
 
-    cand = jnp.where(peaks & (scores >= cls_threshold), scores, -1.0)
-    k = min(max_detections, cand.shape[1])
-    top_scores, top_idx = jax.lax.top_k(cand, k)  # (B, K)
-    valid = top_scores > 0.0
+    k = min(max_detections, scores.shape[1])
+    out_scores, top_idx, valid = topk_peak_candidates(
+        scores, peaks, cls_threshold, k
+    )
 
     gather = jax.vmap(lambda a, i: a[i])
     out_boxes = gather(boxes, top_idx)
     out_refs = gather(refs, top_idx)
-    out_scores = jnp.where(valid, top_scores, 0.0)
     return {
         "boxes": out_boxes,
         "scores": out_scores,
@@ -125,3 +128,113 @@ def batched_nms(dets: dict, iou_threshold: float, backend: str = "auto") -> dict
     out["valid"] = dets["valid"] & keep
     out["scores"] = jnp.where(out["valid"], dets["scores"], 0.0)
     return out
+
+
+def compact_detections(dets: dict) -> dict:
+    """Compact surviving detections to the leading slots, on device.
+
+    The host decode path ships the full fixed-slot arrays and filters by
+    ``valid`` per image on the host; this is the device half of the
+    TMR_DECODE_TAIL=device contract — an order-preserving stable
+    compaction (valid slots first, their relative slot order — i.e.
+    score-descending from decode_detections — untouched) plus a ``count``
+    vector, still one fixed-size padded output so it stays inside the
+    jitted program. Padded slots are zeroed, so the output is fully
+    deterministic. The per-image detection LISTS are bitwise-identical to
+    the host path's (pinned by tests/test_decode_tail.py); only the
+    placement of dead slots differs.
+
+    Returns the dets dict with boxes/scores/refs compacted, ``valid``
+    rewritten as the prefix mask, and ``count`` (B,) int32 added.
+    """
+    valid = dets["valid"]
+    k = valid.shape[1]
+    idx = jnp.arange(k)[None, :]
+    # stable valid-first ordering: key = slot index, +k for dead slots
+    order = jnp.argsort(jnp.where(valid, idx, k + idx), axis=1)
+    gather = jax.vmap(lambda a, i: a[i])
+    count = valid.sum(axis=1).astype(jnp.int32)
+    prefix = idx < count[:, None]
+    out = dict(dets)
+    out["boxes"] = jnp.where(
+        prefix[..., None], gather(dets["boxes"], order), 0.0
+    )
+    out["scores"] = jnp.where(prefix, gather(dets["scores"], order), 0.0)
+    out["refs"] = jnp.where(
+        prefix[..., None], gather(dets["refs"], order), 0.0
+    )
+    out["valid"] = prefix
+    out["count"] = count
+    return out
+
+
+_TAIL_OK: dict = {}
+
+
+def device_tail_ok() -> bool:
+    """Self-check gate for the device decode tail: the compiled
+    compaction must reproduce a host-side numpy reference (stable
+    valid-first compaction) exactly on a randomized fixed-slot batch —
+    any exception or mismatch records a gate_probe/v1 cause and refuses,
+    so TMR_DECODE_TAIL=device falls back to the host path instead of
+    silently reordering detections. TMR_NO_DEVICE_TAIL=1 force-disables.
+    """
+    import os
+
+    def _refused(reason, cause="exception", exception=None):
+        from tmr_tpu.diagnostics import gate_refused
+
+        return gate_refused("device_tail_ok", reason, cause,
+                            exception=exception)
+
+    if os.environ.get("TMR_NO_DEVICE_TAIL"):
+        return _refused("TMR_NO_DEVICE_TAIL kill-switch", "kill-switch")
+    if "ok" in _TAIL_OK:
+        return _TAIL_OK["ok"]
+    import numpy as np
+
+    ok = False
+    try:
+        with jax.ensure_compile_time_eval():
+            rng = np.random.default_rng(0)
+            b, k = 3, 37
+            dets = {
+                "boxes": jnp.asarray(rng.uniform(size=(b, k, 4)),
+                                     jnp.float32),
+                "scores": jnp.asarray(rng.uniform(size=(b, k)), jnp.float32),
+                "refs": jnp.asarray(rng.uniform(size=(b, k, 2)),
+                                    jnp.float32),
+                "valid": jnp.asarray(rng.uniform(size=(b, k)) > 0.5),
+            }
+            got = jax.jit(compact_detections)(dets)
+            mismatch = None
+            for i in range(b):
+                v = np.asarray(dets["valid"][i])
+                n = int(v.sum())
+                if int(got["count"][i]) != n:
+                    mismatch = "count mismatch"
+                    break
+                for name in ("boxes", "scores", "refs"):
+                    want = np.asarray(dets[name][i])[v]
+                    have = np.asarray(got[name][i])[:n]
+                    if not np.array_equal(want, have):
+                        mismatch = f"{name} compaction mismatch"
+                        break
+                if mismatch is None and np.any(
+                    np.asarray(got["scores"][i])[n:] != 0.0
+                ):
+                    mismatch = "padded slots not zeroed"
+                if mismatch is not None:
+                    break
+            # a mismatch verdict is cached like any other (falling through
+            # to the _TAIL_OK store): the gate is consulted at every trace,
+            # and re-running the compiled probe per trace while appending a
+            # duplicate refusal record would grow the registry unboundedly
+            ok = (mismatch is None) or _refused(mismatch,
+                                                "forward-mismatch")
+    except Exception as e:
+        _refused(f"{type(e).__name__}: {e}", "exception",
+                 exception=type(e).__name__)
+        ok = False
+    _TAIL_OK["ok"] = ok
+    return ok

@@ -1,0 +1,323 @@
+"""int8-weight / bf16-activation quantization for the inference tail.
+
+The seed's relaxed-numerics playbook (TMR_XCORR_PRECISION=bf16,
+TMR_GLOBAL_SCORES_DTYPE) trades rounding error for MXU passes only when a
+measured, decisive win justifies it. This module extends that playbook one
+tier further for the post-attention tail — the matcher correlation and the
+decoder conv stacks + heads, the PR-6 MFU targets: weights are rounded to
+the **int8 grid with a per-output-channel f32 scale** (symmetric,
+round-to-nearest), activations stay bf16, accumulation stays f32.
+
+Honest scope: this is an IN-PROGRAM fake-quant formulation — the
+quantize-dequantize round trip runs at trace time next to each matmul on
+the full-precision params the program receives, so it pins the int8
+NUMERICS exactly but does not yet shrink HBM weight traffic (that needs
+an offline int8 param tree handed to the program, a follow-up; the
+quantize work itself is O(k^2 C_in C_out), ~1e-4 of the matmul FLOPs at
+the 128^2 grid). The dequantized operand feeds the same 128-lane matmuls
+as the bf16 path, so the program shape is unchanged — and because
+election is purely by measured decisive win (below), the knob can only
+ever engage where it is measured faster despite that.
+
+Election contract (the TMR_GLOBAL_SCORES_DTYPE pattern, one tier deeper):
+
+- ``TMR_QUANT=off`` (default) — exact path, knob inert.
+- ``TMR_QUANT=int8`` — explicit request; refused by the tiered oracle gate
+  with a recorded ``gate_probe/v1`` cause + FormulationFallbackWarning,
+  falling back to the exact path.
+- ``TMR_QUANT=auto`` — autotune-elected: exported as int8 only when the
+  on-device sweep measures a decisive win AND the tiered oracle passes at
+  the production geometry (utils/autotune.py pick_quant).
+
+Tolerance tiers (``quant_ok``): tier "weights" pins the quantization
+round-trip itself (per-channel int8 reconstruction error is bounded by
+construction: <= scale/2 per element, i.e. ~0.4% of the channel max);
+tier "output" pins the end-to-end tail output against the unquantized
+oracle at the geometry about to run. Both must pass for the gate to
+admit the path; each refusal records which tier failed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: legal TMR_QUANT values (autotune + config registry import this)
+QUANT_MODES = ("off", "int8", "auto")
+
+#: tier tolerances (max relative error): the weight round-trip is a pure
+#: rounding bound (int8 symmetric grid -> half-step of 1/127 of the
+#: channel max); the output tier allows the accumulated effect through
+#: one conv stack + head at bf16 activations. Measured slack over the
+#: analytic bounds, not guesses — see tests/test_quant.py.
+WEIGHT_TIER_REL = 1.0 / 127.0
+OUTPUT_TIER_REL = 5e-2
+
+
+def quant_mode() -> str:
+    """Resolve TMR_QUANT at trace time (autotune exports the elected
+    winner through the same env knob, the TMR_GLOBAL_SCORES_DTYPE
+    mechanism). "auto" without an autotune election means off: quantized
+    numerics must never be the accidental default."""
+    mode = os.environ.get("TMR_QUANT", "off")
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"TMR_QUANT={mode!r}: expected " + "|".join(QUANT_MODES)
+        )
+    return "off" if mode == "auto" else mode
+
+
+def quantize_int8(w: jnp.ndarray, axis=-1
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization, scales shared over the reduced
+    ``axis`` (int or tuple of ints) and distinct over the kept axes.
+
+    Decoder/head WEIGHTS reduce over their input axes so each OUTPUT
+    channel gets its own scale (fused_heads._maybe_quant axis=0, the
+    weights-tier grouping quant_ok bounds); the dynamic template bank
+    reduces over the tap axis for one scale per (image, channel).
+
+    Returns (q int8 same shape, scale f32 with the reduced axes kept as
+    1). scale = amax/127 per group; all-zero groups quantize to scale 1
+    so dequantization is exact (all-zero) instead of 0/0.
+    """
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
+               dtype=jnp.bfloat16) -> jnp.ndarray:
+    """int8 + per-group scale -> ``dtype`` operand for the matmul,
+    emitted adjacent to the consuming dot_general so XLA fuses it into
+    the operand read instead of materializing a dequantized copy."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def fake_quant(w: jnp.ndarray, axis=-1,
+               dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Quantize-dequantize in one step: the value the quantized program
+    actually multiplies by. The oracle gates compare THIS against the
+    exact weights, so the pinned error is the error inference pays.
+
+    Straight-through gradient: the rounding is wrapped so d(out)/d(w) is
+    identity instead of zero. Inference (the only elected consumer —
+    main.py scrubs TMR_QUANT for training) never differentiates this,
+    but a stray grad trace through a quantized program must degrade to
+    QAT semantics, not silently dead weights. Forward value is bitwise
+    ``dequantize(quantize_int8(w))`` (the +0 identity folds away)."""
+    q, s = quantize_int8(w, axis=axis)
+    deq = jax.lax.stop_gradient(dequantize(q, s, dtype=dtype))
+    wc = w.astype(dtype)
+    return deq + (wc - jax.lax.stop_gradient(wc))
+
+
+def _refused(gate: str, reason: str, cause: str, config: dict,
+             exception=None) -> bool:
+    from tmr_tpu.diagnostics import gate_refused
+
+    return gate_refused(gate, reason, cause, config=config,
+                        exception=exception)
+
+
+_OK_CACHE: dict = {}
+
+
+def quant_ok(h: int, w: int, c_in: int, c: int,
+             num_layers: int = 1, kernel_size: int = 3) -> bool:
+    """Tolerance-tiered oracle gate for the int8 decoder/head path at one
+    geometry. Runs the two tiers on synthetic weights at the shapes about
+    to trace:
+
+    - tier "weights": per-channel int8 round-trip of a (k, k, c_in, c)
+      kernel must stay inside WEIGHT_TIER_REL of the channel max — a
+      construction bound; failing it means the quantizer itself is broken
+      (grid asymmetry, scale underflow), not that the model is sensitive.
+    - tier "output": the fused tail (ops/fused_heads) run with
+      fake-quantized weights must stay inside OUTPUT_TIER_REL of its
+      exact-weight output on random activations.
+
+    Pure XLA both sides, so the gate is backend-agnostic; any exception
+    or tier failure records a gate_probe/v1 cause and refuses.
+
+    Scope: both tiers run on SYNTHETIC N(0, 0.01) weights at the real
+    geometry — they pin the formulation and the quantizer, not the
+    trained checkpoint's weight distribution (outlier-heavy channels can
+    amplify output-tier error beyond what iid weights show). Accuracy on
+    real weights is the eval harness's job; the election contract is
+    gate + measured decisive win, with quality regression checked by the
+    operator before exporting TMR_QUANT=int8 into production.
+    """
+    cfg = {"H": h, "W": w, "C_in": c_in, "C": c,
+           "num_layers": num_layers, "kernel_size": kernel_size}
+    key = (h, w, c_in, c, num_layers, kernel_size)
+    if key in _OK_CACHE:
+        return _OK_CACHE[key]
+    import numpy as np
+
+    ok = False
+    try:
+        with jax.ensure_compile_time_eval():
+            rng = np.random.default_rng(0)
+            k = kernel_size
+            kern = jnp.asarray(
+                rng.standard_normal((k, k, c_in, c)) * 0.01, jnp.float32
+            )
+            # tier "weights": reconstruction inside the grid bound, at
+            # the production grouping — one scale per OUTPUT channel
+            # (reduce over the k, k, c_in input axes), the same grouping
+            # _maybe_quant applies per 2D tap (axis=0 there)
+            rec = fake_quant(kern, axis=(0, 1, 2), dtype=jnp.float32)
+            amax = jnp.max(jnp.abs(kern), axis=(0, 1, 2))
+            err = jnp.max(
+                jnp.abs(rec - kern) / jnp.maximum(amax, 1e-12)[None, None,
+                                                               None, :]
+            )
+            if not bool(err <= WEIGHT_TIER_REL):
+                _refused(
+                    "quant_ok", f"weights tier: rel err {float(err):.4g} > "
+                    f"{WEIGHT_TIER_REL:.4g}", "forward-mismatch",
+                    {**cfg, "tier": "weights"},
+                )
+                _OK_CACHE[key] = False
+                return False
+
+            # tier "output": end-to-end tail error at this geometry. The
+            # stacks are channel-preserving past layer 0 (only the first
+            # kernel sees c_in), matching fused_decoder_heads' contract.
+            from tmr_tpu.ops.fused_heads import fused_decoder_heads
+
+            x = jnp.asarray(
+                rng.standard_normal((1, h, w, c_in)), jnp.bfloat16
+            )
+
+            def stack():
+                return [jnp.asarray(
+                    rng.standard_normal((k, k, c_in if i == 0 else c, c))
+                    * 0.01, jnp.float32,
+                ) for i in range(num_layers)]
+
+            wo = stack()
+            wb = stack()
+            bo = [jnp.zeros((c,), jnp.float32) for _ in range(num_layers)]
+            bb = [jnp.zeros((c,), jnp.float32) for _ in range(num_layers)]
+            w1 = jnp.asarray(rng.standard_normal((1, 1, c, 1)) * 0.01,
+                             jnp.float32)
+            w4 = jnp.asarray(rng.standard_normal((1, 1, c, 4)) * 0.01,
+                             jnp.float32)
+            b1 = jnp.zeros((1,), jnp.float32)
+            b4 = jnp.zeros((4,), jnp.float32)
+
+            def run(quant):
+                return fused_decoder_heads(
+                    x, list(zip(wo, bo)), list(zip(wb, bb)),
+                    (w1, b1), (w4, b4), dtype=jnp.bfloat16, quant=quant,
+                )
+
+            o_exact, r_exact = run(False)
+            o_q, r_q = run(True)
+            scale = max(
+                float(jnp.max(jnp.abs(o_exact.astype(jnp.float32)))),
+                float(jnp.max(jnp.abs(r_exact.astype(jnp.float32)))), 1e-6,
+            )
+            rel = max(
+                float(jnp.max(jnp.abs(
+                    o_q.astype(jnp.float32) - o_exact.astype(jnp.float32)
+                ))),
+                float(jnp.max(jnp.abs(
+                    r_q.astype(jnp.float32) - r_exact.astype(jnp.float32)
+                ))),
+            ) / scale
+            ok = rel < OUTPUT_TIER_REL
+            if not ok:
+                _refused(
+                    "quant_ok", f"output tier: rel err {rel:.4g} >= "
+                    f"{OUTPUT_TIER_REL}", "forward-mismatch",
+                    {**cfg, "tier": "output"},
+                )
+    except Exception as e:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        _refused("quant_ok", f"{type(e).__name__}: {e}", "exception",
+                 cfg, exception=type(e).__name__)
+        ok = False
+    _OK_CACHE[key] = ok
+    return ok
+
+
+def quant_xcorr_ok(c: int, h: int, w: int, t: int) -> bool:
+    """Output-tier oracle gate for the int8-template correlation at one
+    geometry: the quantized matcher (int8 per-channel template, bf16
+    feature, f32 accumulation) must stay inside OUTPUT_TIER_REL of the
+    exact HIGHEST-precision correlation on random data. The template is
+    runtime data (extracted from the feature map), so this pins the
+    dynamic-quantization error path, not a fixed weight round trip.
+    """
+    cfg = {"C": c, "H": h, "W": w, "T": t}
+    key = ("xcorr", c, h, w, t)
+    if key in _OK_CACHE:
+        return _OK_CACHE[key]
+    import numpy as np
+
+    from jax import lax
+
+    ok = False
+    try:
+        with jax.ensure_compile_time_eval():
+            rng = np.random.default_rng(0)
+            f = jnp.asarray(rng.standard_normal((1, c, h, w)), jnp.float32)
+            tm = jnp.asarray(rng.standard_normal((1, c, t, t)), jnp.float32)
+            want = np.asarray(lax.conv_general_dilated(
+                f.reshape(1, c, h, w), tm.reshape(c, 1, t, t),
+                window_strides=(1, 1),
+                padding=[(t // 2, t // 2), (t // 2, t // 2)],
+                feature_group_count=c,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=lax.Precision.HIGHEST,
+            ))
+            tq = fake_quant(tm.reshape(1, c, t * t), axis=-1,
+                            dtype=jnp.bfloat16).reshape(1, c, t, t)
+            got = np.asarray(lax.conv_general_dilated(
+                f.astype(jnp.bfloat16).reshape(1, c, h, w),
+                tq.reshape(c, 1, t, t),
+                window_strides=(1, 1),
+                padding=[(t // 2, t // 2), (t // 2, t // 2)],
+                feature_group_count=c,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.float32,
+            ))
+            rel = float(np.abs(got - want).max() / (np.abs(want).max() + 1e-6))
+            ok = rel < OUTPUT_TIER_REL
+            if not ok:
+                _refused(
+                    "quant_xcorr_ok", f"output tier: rel err {rel:.4g} >= "
+                    f"{OUTPUT_TIER_REL}", "forward-mismatch", cfg,
+                )
+    except Exception as e:
+        if os.environ.get("TMR_GATE_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        _refused("quant_xcorr_ok", f"{type(e).__name__}: {e}", "exception",
+                 cfg, exception=type(e).__name__)
+        ok = False
+    _OK_CACHE[key] = ok
+    return ok
+
+
+def quantize_template(template: jnp.ndarray,
+                      dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Dynamic int8 round trip of a (B, C, T, T) template bank, scaled
+    per (image, channel) — the matcher-side TMR_QUANT arm. Returned in
+    ``dtype`` ready for the correlation's multiply."""
+    b, c, t, _ = template.shape
+    return fake_quant(
+        template.reshape(b, c, t * t), axis=-1, dtype=dtype
+    ).reshape(b, c, t, t)

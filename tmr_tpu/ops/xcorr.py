@@ -193,8 +193,10 @@ def _data_shard_map(fn, mesh):
     'data' dividing the batch; otherwise the caller falls back to the global
     formulation.
     """
+    from tmr_tpu.parallel.compat import shard_map
+
     P = jax.sharding.PartitionSpec
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(P("data"), P("data")),
@@ -275,49 +277,87 @@ def cross_correlation(
             impl_source = "TMR_XCORR_IMPL_SMALL"
     if impl == "auto":  # "auto" as the small-bucket value = backend default
         impl = small_impl_default()
+
+    # TMR_QUANT (ops/quant.py): the matcher arm of the quantized inner
+    # loop — dynamic int8 per-(image, channel) template + bf16 feature,
+    # f32 accumulation. Admitted per geometry by quant_xcorr_ok's
+    # output-tier oracle; refusals warn (FormulationFallbackWarning, so
+    # sweeps annotate mislabeled timings) and record a gate_probe/v1
+    # cause. Inert on the FFT path (f32 end to end; no MXU operand to
+    # shrink) and under TMR_QUANT=off/auto-unelected.
+    quant = False
+    if impl != "fft":
+        from tmr_tpu.ops.quant import quant_mode, quant_xcorr_ok
+
+        if quant_mode() == "int8":
+            if quant_xcorr_ok(C, H, W, T):
+                quant = True
+            else:
+                import warnings
+
+                from tmr_tpu.diagnostics import FormulationFallbackWarning
+
+                warnings.warn(FormulationFallbackWarning(
+                    "TMR_QUANT",
+                    f"TMR_QUANT=int8: xcorr oracle refused (C={C}, H={H}, "
+                    f"W={W}, T={T}); running the exact correlation"
+                ))
+
     def _compute(f, t):
         # local-shape island: b == B globally, or B/n_data under shard_map
         b = f.shape[0]
         use = impl
+        if use == "pallas":
+            from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok
+
+            if not pallas_xcorr_ok(C, H, W, T):
+                # self-check refused or capacity too big: fall back the
+                # way the auto dispatch would — a direct SAME conv at T in
+                # the 100s is O(H^2 T^2 C) (module docstring), so big
+                # buckets go to FFT. Say so at trace time: an A/B row (or
+                # cached autotune winner) labeled "pallas" must never
+                # silently record conv/FFT timings (the same contract as
+                # the attention formulations in vit.py). Resolved BEFORE
+                # the quant/bf16 casts below so an FFT fallback runs the
+                # exact f32 correlation those knobs are contractually
+                # inert on — never int8/bf16 operands through a numerics
+                # path no oracle validated.
+                import warnings
+
+                from tmr_tpu.diagnostics import FormulationFallbackWarning
+
+                fb = "fft" if T > FFT_CAPACITY_THRESHOLD else "conv"
+                warnings.warn(FormulationFallbackWarning(
+                    impl_source,
+                    f"{impl_source}=pallas: kernel self-check refused "
+                    f"(C={C}, H={H}, W={W}, T={T}); running {fb} fallback"
+                ))
+                use = fb
         if use == "fft":
             return _xcorr_fft(f, t)
         in_dtype = f.dtype
-        if prec_name == "bf16":
+        if quant:
+            from tmr_tpu.ops.quant import quantize_template
+
+            f = f.astype(jnp.bfloat16)
+            t = quantize_template(t, dtype=jnp.bfloat16)
+        elif prec_name == "bf16":
             f = f.astype(jnp.bfloat16)
             t = t.astype(jnp.bfloat16)
         # keep the f32 MXU accumulator in the result (the codebase's bf16-
         # matmul convention, e.g. models/vit.py): without this the conv
         # output would round to bf16 before the upcast below
-        acc = jnp.float32 if prec_name == "bf16" else None
+        acc = jnp.float32 if (prec_name == "bf16" or quant) else None
+        prec = lax.Precision.DEFAULT if quant else conv_prec
         if use == "pallas":
-            from tmr_tpu.ops.pallas_xcorr import pallas_xcorr_ok, xcorr_pallas
+            from tmr_tpu.ops.pallas_xcorr import xcorr_pallas
 
-            if pallas_xcorr_ok(C, H, W, T):
-                # the kernel upcasts to f32 and accumulates in f32, so it
-                # satisfies every TMR_XCORR_PRECISION contract: with f32
-                # inputs it equals the HIGHEST conv path (the VPU is true
-                # f32), and under the bf16 knob the inputs above already
-                # carry the rounding
-                return xcorr_pallas(f, t).astype(in_dtype)
-            # self-check refused or capacity too big: fall back the way the
-            # auto dispatch would — a direct SAME conv at T in the 100s is
-            # O(H^2 T^2 C) (module docstring), so big buckets go to FFT.
-            # Say so at trace time: an A/B row (or cached autotune winner)
-            # labeled "pallas" must never silently record conv/FFT timings
-            # (the same contract as the attention formulations in vit.py)
-            import warnings
-
-            from tmr_tpu.diagnostics import FormulationFallbackWarning
-
-            fb = "fft" if T > FFT_CAPACITY_THRESHOLD else "conv"
-            warnings.warn(FormulationFallbackWarning(
-                impl_source,
-                f"{impl_source}=pallas: kernel self-check refused "
-                f"(C={C}, H={H}, W={W}, T={T}); running {fb} fallback"
-            ))
-            if fb == "fft":
-                return _xcorr_fft(f, t).astype(in_dtype)
-            use = "conv"
+            # the kernel upcasts to f32 and accumulates in f32, so it
+            # satisfies every TMR_XCORR_PRECISION contract: with f32
+            # inputs it equals the HIGHEST conv path (the VPU is true
+            # f32), and under the bf16/quant knobs the inputs above
+            # already carry the rounding
+            return xcorr_pallas(f, t).astype(in_dtype)
         if use == "convnhwc":
             # same grouped conv in the TPU-native activation layout: XLA:TPU
             # canonicalizes NCHW convs by inserting layout transposes, so
@@ -334,7 +374,7 @@ def cross_correlation(
                 padding=[(T // 2, T // 2), (T // 2, T // 2)],
                 feature_group_count=b * C,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                precision=conv_prec,
+                precision=prec,
                 preferred_element_type=acc,
             ).transpose(0, 3, 1, 2).reshape(b, C, H, W).astype(in_dtype)
         if use == "vmap":
@@ -346,7 +386,7 @@ def cross_correlation(
                     padding=[(T // 2, T // 2), (T // 2, T // 2)],
                     feature_group_count=C,
                     dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                    precision=conv_prec,
+                    precision=prec,
                     preferred_element_type=acc,
                 )[0]
 
@@ -360,7 +400,7 @@ def cross_correlation(
             padding=[(T // 2, T // 2), (T // 2, T // 2)],
             feature_group_count=b * C,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            precision=conv_prec,
+            precision=prec,
             preferred_element_type=acc,
         ).reshape(b, C, H, W).astype(in_dtype)
 

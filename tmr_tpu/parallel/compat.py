@@ -1,0 +1,36 @@
+"""jax-version compat for the sharding surface (the
+``_tpu_compiler_params`` situation applied to ``shard_map``).
+
+jax moved ``shard_map`` out of ``jax.experimental`` into the top-level
+namespace and renamed its replication-check kwarg ``check_rep`` ->
+``check_vma`` along the way. The parallel modules and their tests target
+the new spelling; on a 0.4.x runtime the top-level import fails and the
+new kwarg is unknown — which is exactly how tests/test_ring.py carried a
+collection error from the seed until this shim. One definition here so
+every caller (ring, pipeline, xcorr's data island, the tests) resolves
+the API the same way on every installed jax.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: the supported top-level export
+    from jax import shard_map as _shard_map
+
+    _NEW_API = True
+except ImportError:  # jax 0.4.x/0.5.x: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the new-API signature on every jax.
+
+    ``check_vma`` maps onto the old API's ``check_rep`` (same meaning,
+    renamed): both toggle the static replication/varying-manual-axes
+    check that several of our islands disable (collectives whose
+    replication the checker cannot prove).
+    """
+    kw = {"check_vma" if _NEW_API else "check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

@@ -191,7 +191,9 @@ def pipeline_blocks_apply(
         return lax.psum(out, axis)
 
     x_spec = P(None, data_axis) if data_axis is not None else P()
-    island_sharded = jax.shard_map(
+    from tmr_tpu.parallel.compat import shard_map
+
+    island_sharded = shard_map(
         island,
         mesh=mesh,
         in_specs=(P(axis), x_spec),

@@ -230,8 +230,9 @@ def make_ring_attention_fn(
     With ``decomposed=True`` the callable takes (q, k, v, rel_h_table,
     rel_w_table) and applies the ViT decomposed rel-pos bias (``grid_w``
     required)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tmr_tpu.parallel.compat import shard_map
 
     spec = P(batch_axis, head_axis, axis_name, None)
     if decomposed:

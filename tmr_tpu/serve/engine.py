@@ -55,6 +55,16 @@ from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
 _DET_FIELDS = ("boxes", "scores", "refs", "valid")
 
+
+def _det_fields(dets: dict) -> tuple:
+    """The detection keys to copy host-side: the fixed four, plus the
+    device decode tail's ``count`` vector when the program exported one
+    (TMR_DECODE_TAIL=device) — dropping it would silently put every
+    served request back on the full valid-mask scan the knob exists to
+    eliminate (detections_to_numpy's prefix-slice fast path keys on it).
+    """
+    return _DET_FIELDS + (("count",) if "count" in dets else ())
+
 #: the engine's counter names — the PR 3 ``counters`` dict keys, now
 #: backed by the per-engine metrics registry as ``serve.<name>`` (the
 #: ``stats()`` shape is unchanged; tests/test_obs.py pins it)
@@ -395,7 +405,7 @@ class ServeEngine:
     # ---------------------------------------------------------- completion
     def _finish(self, staged: StagedBatch, out: dict, fill_feats) -> None:
         t_post0 = time.perf_counter()
-        host = {name: np.asarray(out[name]) for name in _DET_FIELDS}
+        host = {name: np.asarray(out[name]) for name in _det_fields(out)}
         # the device fetch above is the batch's postprocess cost; stamp
         # its END here so the per-rider span is the same shared window
         # (like batch_assemble/stage/execute) — anchoring each rider's
@@ -413,7 +423,7 @@ class ServeEngine:
                 # retention multiplier at production geometry
                 result = {
                     name: host[name][i:i + 1].copy()
-                    for name in _DET_FIELDS
+                    for name in _det_fields(host)
                 }
                 if req.result_key is not None:
                     self.result_cache.put(req.result_key, result)
@@ -471,7 +481,7 @@ class ServeEngine:
             )
         else:  # single and heads requests share __call__ semantics
             dets = self._pred(req.image[None], req.exemplars[None])
-        return {name: np.asarray(dets[name]) for name in _DET_FIELDS}
+        return {name: np.asarray(dets[name]) for name in _det_fields(dets)}
 
     def _drop_inflight(self, req: Request) -> None:
         if req.result_key is None:

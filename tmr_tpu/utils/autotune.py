@@ -35,6 +35,8 @@ GLOBAL_ATTN_VARIANTS = (
 )
 XCORR_PRECISIONS = ("highest", "default", "bf16")
 GLOBAL_SCORES_DTYPES = ("f32", "bf16")
+DECODER_IMPL_VARIANTS = ("xla", "fused")
+QUANT_VARIANTS = ("off", "int8")
 
 #: structured gate-refusal causes captured by the LAST sweep of each env
 #: knob, keyed {env_var: {annotated_row_label: [cause dicts]}} — populated
@@ -73,8 +75,12 @@ FALLBACK_SUFFIX = " (fallback)"
 #: GLOBAL_ATTN_VARIANTS, and the jax-version CompilerParams fix plus the
 #: off-trace gate repair (flash_attn._self_check) mean every previously
 #: refused kernel row may now genuinely compile: stale cached winners must
-#: re-record at the next hardware window.
-_SWEEP_REV = "fused-relpos"
+#: re-record at the next hardware window. "decoder-tail" — the decoder
+#: tail joined the swept surface (TMR_DECODER_IMPL fused formulation,
+#: TMR_QUANT int8 weights) and the full-program tail changed shape
+#: (device decode compaction): formulation winners recorded against the
+#: old tail must re-measure at the next hardware window.
+_SWEEP_REV = "decoder-tail"
 
 
 def _sweep_xcorr_env(
@@ -200,13 +206,16 @@ def _decisive_pick(
     return best
 
 
-def _reemit_unrelated(caught, env_var: str) -> None:
+def _reemit_unrelated(caught, env_var: str,
+                      also: tuple = ()) -> None:
     """Re-emit warnings the sweep's record=True capture swallowed, except
     the fallback markers for THE KNOB BEING SWEPT (those become the
     FALLBACK_SUFFIX annotation). Everything else must still reach the
     operator: a JAX transfer/deprecation warning that explains an anomalous
     timing, and fallback markers for a DIFFERENT knob (e.g. the user's
-    pinned TMR_XCORR_IMPL=pallas falling back during the precision sweep)."""
+    pinned TMR_XCORR_IMPL=pallas falling back during the precision sweep).
+    ``also`` names additional knobs whose fallbacks the sweep already
+    accounted for (the quant sweep annotates TMR_DECODER_IMPL refusals)."""
     import warnings
 
     from tmr_tpu.diagnostics import FormulationFallbackWarning
@@ -214,7 +223,7 @@ def _reemit_unrelated(caught, env_var: str) -> None:
     for w in caught:
         if (
             isinstance(w.message, FormulationFallbackWarning)
-            and w.message.env_var == env_var
+            and w.message.env_var in (env_var,) + tuple(also)
         ):
             continue
         warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
@@ -366,6 +375,139 @@ def _sweep_block_env(
     finally:
         _restore(prev, env_var)
     return times
+
+
+def _sweep_tail_env(
+    env_var: str, variants, batch: int, hw: int, c_cat: int,
+    num_layers: int, kernel_size: int, dtype_name: str,
+    rtt: Optional[float], log: Callable[[str], None],
+    also_fallback_envs: tuple = (),
+) -> Dict[str, float]:
+    """Shared microbenchmark harness for the decoder-tail knobs
+    (TMR_DECODER_IMPL, TMR_QUANT): pin ``env_var`` to each variant,
+    rebuild the tail stage program (utils/stage_bench — the SAME program
+    profile_breakdown and bench.py's stage_breakdown time), time it
+    chained. Fallback labeling matches _sweep_xcorr_env: a gate-refused
+    variant's timing is recorded annotated with its structured causes."""
+    import warnings
+
+    from tmr_tpu.diagnostics import (
+        FormulationFallbackWarning,
+        drain_gate_refusals,
+    )
+    from tmr_tpu.utils.stage_bench import build_decoder_tail_step
+
+    rtt = measure_rtt_floor() if rtt is None else rtt
+    times: Dict[str, float] = {}
+    refusals = LAST_SWEEP_REFUSALS.setdefault(env_var, {})
+    refusals.clear()
+    prev = os.environ.get(env_var)
+    try:
+        for variant in variants:
+            os.environ[env_var] = variant
+            drain_gate_refusals()  # discard causes from earlier traces
+            t = None
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                try:
+                    step, inputs = build_decoder_tail_step(
+                        batch, hw, c_cat, num_layers, kernel_size,
+                        dtype_name,
+                    )
+                    t = chained_seconds_per_iter(step, *inputs, rtt=rtt)
+                except Exception as e:
+                    log(f"autotune: {env_var}[{variant}] failed: "
+                        f"{type(e).__name__}: {e}")
+            _reemit_unrelated(caught, env_var, also=also_fallback_envs)
+            caused = drain_gate_refusals()
+            if t is None:
+                continue
+            fell_back = any(
+                isinstance(w.message, FormulationFallbackWarning)
+                and w.message.env_var in (env_var,) + tuple(also_fallback_envs)
+                for w in caught
+            )
+            if fell_back:
+                log(f"autotune: {env_var}[{variant}] gate-refused; timed "
+                    "the fallback formulation — recording annotated")
+                times[variant + FALLBACK_SUFFIX] = t
+                if caused:
+                    refusals[variant + FALLBACK_SUFFIX] = caused
+            else:
+                times[variant] = t
+    finally:
+        _restore(prev, env_var)
+    return times
+
+
+def pick_decoder_impl(
+    batch: int, hw: int, c_cat: int, num_layers: int, kernel_size: int,
+    dtype_name: str = "bfloat16",
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time the decoder_heads stage (both conv stacks + heads at the
+    production (hw, c_cat) geometry, in the model's ``dtype_name`` so the
+    evidence is about the program production traces) per TMR_DECODER_IMPL
+    formulation. Both are oracle-pinned identical numerics
+    (fused_heads_ok), so the caller elects plain-min.
+    Returns {variant: sec/iter}."""
+    return _sweep_tail_env(
+        "TMR_DECODER_IMPL", DECODER_IMPL_VARIANTS, batch, hw, c_cat,
+        num_layers, kernel_size, dtype_name, rtt, log,
+    )
+
+
+def pick_quant(
+    batch: int, hw: int, c_cat: int, num_layers: int, kernel_size: int,
+    dtype_name: str = "bfloat16",
+    emb_dim: Optional[int] = None, capacity: int = 17,
+    rtt: Optional[float] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Dict[str, float]:
+    """Time BOTH surfaces the TMR_QUANT export flips — the decoder_heads
+    stage and the matcher correlation — at each mode under the CURRENTLY
+    exported decoder/xcorr impls (run after those sweeps, the
+    precision-stage pattern), returning their per-variant SUM: the
+    decisive-win policy must judge the knob's whole flipped workload, not
+    just the decoder arm. int8 changes numerics, so the caller elects
+    against the exact "off" baseline, and a gate refusal in either stage
+    (TMR_DECODER_IMPL, TMR_QUANT decoder or xcorr oracle) annotates the
+    variant as a fallback row — quantized timings must never masquerade
+    as exact-path evidence or vice versa. ``emb_dim=None`` skips the
+    matcher arm (decoder-only callers, e.g. box_reg-ablated sweeps)."""
+    times = _sweep_tail_env(
+        "TMR_QUANT", QUANT_VARIANTS, batch, hw, c_cat,
+        num_layers, kernel_size, dtype_name, rtt, log,
+        also_fallback_envs=("TMR_DECODER_IMPL",),
+    )
+    if emb_dim is None:
+        return times
+    # both sweeps key LAST_SWEEP_REFUSALS["TMR_QUANT"] and the second
+    # clears it on entry: snapshot the tail stage's causes and merge
+    tail_refusals = dict(LAST_SWEEP_REFUSALS.get("TMR_QUANT", {}))
+    xtimes = _sweep_xcorr_env(
+        "TMR_QUANT", QUANT_VARIANTS, batch, emb_dim, hw, capacity,
+        rtt, log,
+    )
+    refusals = LAST_SWEEP_REFUSALS.setdefault("TMR_QUANT", {})
+    for label, causes in tail_refusals.items():
+        refusals.setdefault(label, []).extend(causes)
+    combined: Dict[str, float] = {}
+    for v in QUANT_VARIANTS:
+        t = times.get(v)
+        x = xtimes.get(v)
+        if t is not None and x is not None:
+            combined[v] = t + x
+            continue
+        # annotated (or failed) in either stage: the sum is evidence
+        # about a fallback formulation somewhere — never electable
+        tf = t if t is not None else times.get(v + FALLBACK_SUFFIX)
+        xf = x if x is not None else xtimes.get(v + FALLBACK_SUFFIX)
+        if tf is not None and xf is not None:
+            combined[v + FALLBACK_SUFFIX] = tf + xf
+    log(f"autotune: TMR_QUANT stages decoder={times} xcorr={xtimes}")
+    return combined
 
 
 def pick_win_attn_impl(
@@ -583,6 +725,7 @@ def _cache_load() -> Dict[str, dict]:
 _VERSIONED_KNOBS = (
     "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
     "TMR_XCORR_PRECISION", "TMR_GLOBAL_SCORES_DTYPE",
+    "TMR_DECODER_IMPL", "TMR_QUANT",
 )
 
 
@@ -593,9 +736,12 @@ def _variants_sig(knob: str) -> str:
         "TMR_GLOBAL_ATTN": GLOBAL_ATTN_VARIANTS,
         "TMR_XCORR_PRECISION": XCORR_PRECISIONS,
         "TMR_GLOBAL_SCORES_DTYPE": GLOBAL_SCORES_DTYPES,
+        "TMR_DECODER_IMPL": DECODER_IMPL_VARIANTS,
+        "TMR_QUANT": QUANT_VARIANTS,
     }
     sig = ",".join(sets[knob])
-    if knob in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_XCORR_IMPL_SMALL"):
+    if knob in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_XCORR_IMPL_SMALL",
+                "TMR_DECODER_IMPL"):
         # formulation-sweep winners are additionally versioned by the
         # harness revision: a winner picked by a pre-revision sweep may be
         # a mislabeled fallback timing (see _SWEEP_REV) and must go stale
@@ -623,6 +769,11 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         # metadata, not an env knob: which impl the precision winner was
         # measured under (its decisive-win evidence is impl-specific)
         "_precision_impl": set(XCORR_VARIANTS),
+        "TMR_DECODER_IMPL": set(DECODER_IMPL_VARIANTS) | {"auto"},
+        "TMR_QUANT": set(QUANT_VARIANTS) | {"auto"},
+        # metadata: which decoder formulation the quant winner's
+        # decisive-win evidence was measured under
+        "_quant_decoder_impl": set(DECODER_IMPL_VARIANTS) | {"auto"},
     }
     # measured throughput-optimal eval batch (bench_extra's batch sweep),
     # the Pallas windowed-kernel group, the band-scan unroll, and the XLA
@@ -817,6 +968,19 @@ def autotune(
         # sweeps only (tune_precision=False for training), bf16 models only
         # (the knob is inert elsewhere)
         wanted.add("TMR_GLOBAL_SCORES_DTYPE")
+    if not train and "TMR_DECODER_IMPL" not in os.environ and cfg.box_reg:
+        # the fused formulation covers the two-stack tail; single-stack
+        # (box-regression-ablated) models stay on the module path. The
+        # stage sweep times FORWARD only — training runs keep the parity
+        # default instead of electing from a fwd-only rank (the
+        # _sweep_xcorr_env train=True lesson: backward cost ranks
+        # formulations differently)
+        wanted.add("TMR_DECODER_IMPL")
+    if tune_precision and "TMR_QUANT" not in os.environ and cfg.box_reg:
+        # quantized weights are the relaxed-numerics tier below bf16
+        # scores: inference sweeps only, decisive-win policy, tiered
+        # oracle gate (ops/quant.py) — training must never inherit them
+        wanted.add("TMR_QUANT")
     if not wanted:
         return report  # everything pinned: skip even the rtt round trip
     if cached.get("TMR_XCORR_PRECISION", "highest") != "highest" and (
@@ -831,6 +995,17 @@ def autotune(
         # they were validated on (re-measured after the fresh pick instead)
         cached = {k: v for k, v in cached.items()
                   if k != "TMR_XCORR_PRECISION"}
+    if cached.get("TMR_QUANT", "off") != "off" and (
+        "TMR_DECODER_IMPL" in wanted
+        or cached.get("_quant_decoder_impl") != os.environ.get(
+            "TMR_DECODER_IMPL", cached.get("TMR_DECODER_IMPL", "auto")
+        )
+    ):
+        # an int8 winner's decisive-win evidence is decoder-impl-specific
+        # (the _precision_impl rule applied to the tail): drop it when the
+        # formulation it was measured under changes or is about to be
+        # re-swept — re-decided after the fresh pick instead
+        cached = {k: v for k, v in cached.items() if k != "TMR_QUANT"}
     active_global = os.environ.get(
         "TMR_GLOBAL_ATTN", cached.get("TMR_GLOBAL_ATTN")
     )
@@ -977,6 +1152,39 @@ def autotune(
                                                  "times": times}
             _attach_refusals(report, "TMR_GLOBAL_SCORES_DTYPE")
 
+    c_cat = cfg.emb_dim * 2 if cfg.fusion else cfg.emb_dim
+    if "TMR_DECODER_IMPL" in wanted:
+        times = pick_decoder_impl(
+            batch, up_hw, c_cat, cfg.decoder_num_layer,
+            cfg.decoder_kernel_size, cfg.compute_dtype, rtt=rtt, log=log,
+        )
+        pickable = _electable(times)
+        if pickable:
+            best = min(pickable, key=pickable.get)
+            os.environ["TMR_DECODER_IMPL"] = best
+            report["TMR_DECODER_IMPL"] = {"picked": best, "times": times}
+            _attach_refusals(report, "TMR_DECODER_IMPL")
+            log(f"autotune: TMR_DECODER_IMPL={best} {times}")
+
+    if "TMR_QUANT" in wanted:
+        # sweep AFTER the decoder-impl pick (int8 rides the fused
+        # formulation; its win is paired to the impl active now)
+        if os.environ.get("TMR_DECODER_IMPL", "auto") != "fused":
+            # quantized weights only ride the fused path: record the
+            # no-op so the cache entry is complete and later runs skip
+            os.environ["TMR_QUANT"] = "off"
+            report["TMR_QUANT"] = {"picked": "off", "times": {}}
+        else:
+            times = pick_quant(
+                batch, up_hw, c_cat, cfg.decoder_num_layer,
+                cfg.decoder_kernel_size, cfg.compute_dtype,
+                emb_dim=cfg.emb_dim, rtt=rtt, log=log,
+            )
+            best = _decisive_pick(times, "off", log, "TMR_QUANT")
+            os.environ["TMR_QUANT"] = best
+            report["TMR_QUANT"] = {"picked": best, "times": times}
+            _attach_refusals(report, "TMR_QUANT")
+
     if report:
         extra = {}
         if "TMR_XCORR_PRECISION" in report:
@@ -984,6 +1192,10 @@ def autotune(
         if "TMR_GLOBAL_SCORES_DTYPE" in report:
             extra["_scores_global_impl"] = os.environ.get(
                 "TMR_GLOBAL_ATTN", "auto"
+            )
+        if "TMR_QUANT" in report:
+            extra["_quant_decoder_impl"] = os.environ.get(
+                "TMR_DECODER_IMPL", "auto"
             )
         for knob in _VERSIONED_KNOBS:
             # stamp every exported winner — fresh sweeps beat the current

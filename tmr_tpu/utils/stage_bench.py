@@ -1,0 +1,166 @@
+"""Isolated stage programs for the post-attention tail, shared by every
+measurement surface.
+
+One definition of "the decoder_heads stage" and "the decode_tail stage"
+feeds three consumers — scripts/profile_breakdown.py's breakdown,
+bench.py's per-round ``stage_breakdown`` record, and the autotune sweeps
+that elect TMR_DECODER_IMPL / TMR_QUANT — so a formulation change can
+never make the breakdown, the bench JSON, and the election measure
+different programs (the _sweep_xcorr_env single-harness principle applied
+to the tail).
+
+Every builder returns a ``step(*inputs, fb) -> (out, fb')`` callable in
+the chained-timing contract of utils/profiling.chained_seconds_per_iter
+(device-staged inputs, scalar-chained iterations, one closing fetch). The
+programs read the tail knobs (TMR_DECODER_IMPL, TMR_QUANT,
+TMR_DECODE_TAIL) at trace time exactly like the production model, so
+pinning an env knob and rebuilding measures that formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def build_decoder_tail_step(
+    batch: int, hw: int, c_cat: int,
+    num_layers: int = 1, kernel_size: int = 3,
+    dtype_name: str = "bfloat16", seed: int = 0,
+) -> Tuple[callable, tuple]:
+    """The ``decoder_heads`` stage: both decoder conv stacks + both 1x1
+    heads at (batch, hw, hw, c_cat), dispatched through the SAME
+    trace-time impl resolution as MatchingNet (ops/fused_heads.
+    decoder_impl), so TMR_DECODER_IMPL/TMR_QUANT select the formulation.
+    Returns (jitted step, device inputs)."""
+    import numpy as np
+
+    from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
+    from tmr_tpu.ops.fused_heads import decoder_impl, fused_decoder_heads
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((batch, hw, hw, c_cat)), dtype)
+
+    dec_o = Decoder(num_layers=num_layers, kernel_size=kernel_size,
+                    dtype=dtype)
+    dec_b = Decoder(num_layers=num_layers, kernel_size=kernel_size,
+                    dtype=dtype)
+    head_o = ObjectnessHead(dtype=dtype)
+    head_b = BboxesHead(dtype=dtype)
+    key = jax.random.key(seed + 1)
+    xc = jnp.zeros((1, 1, 1, c_cat), dtype)
+    params = {
+        "dec_o": jax.jit(dec_o.init)(key, x)["params"],
+        "dec_b": jax.jit(dec_b.init)(jax.random.key(seed + 2), x)["params"],
+        "head_o": jax.jit(head_o.init)(jax.random.key(seed + 3),
+                                       xc)["params"],
+        "head_b": jax.jit(head_b.init)(jax.random.key(seed + 4),
+                                       xc)["params"],
+    }
+    impl, quant = decoder_impl(
+        hw, hw, c_cat, c_cat, num_layers, kernel_size, dtype_name
+    )
+
+    @jax.jit
+    def step(p, x, fb):
+        xi = x + fb.astype(x.dtype)
+        if impl == "fused":
+            mk = lambda q: [
+                (q[f"conv_{i}"]["kernel"], q[f"conv_{i}"]["bias"])
+                for i in range(num_layers)
+            ]
+            o, b = fused_decoder_heads(
+                xi, mk(p["dec_o"]), mk(p["dec_b"]),
+                (p["head_o"]["conv"]["kernel"], p["head_o"]["conv"]["bias"]),
+                (p["head_b"]["conv"]["kernel"], p["head_b"]["conv"]["bias"]),
+                dtype=dtype, quant=quant,
+            )
+        else:
+            o = head_o.apply({"params": p["head_o"]},
+                             dec_o.apply({"params": p["dec_o"]}, xi))
+            b = head_b.apply({"params": p["head_b"]},
+                             dec_b.apply({"params": p["dec_b"]}, xi))
+        s = jnp.sum(o).astype(jnp.float32) + jnp.sum(b).astype(jnp.float32)
+        return (o, b), s * 0.0
+
+    return (lambda x, fb: step(params, x, fb)), (x,)
+
+
+def build_decode_tail_step(
+    pred, batch: int, hw: int, image_size: int, seed: int = 0,
+) -> Tuple[callable, tuple]:
+    """The ``decode_tail`` stage: peak-pick -> threshold -> top-k decode
+    -> NMS [-> device compaction under TMR_DECODE_TAIL=device], through
+    the Predictor's own _decode/_refine_nms so config flags and the knob
+    dispatch stay the production ones. Synthetic boxes are exemplar-sized
+    (heavy overlap -> deep suppression chains), matching
+    profile_breakdown's rationale. Returns (jitted step, device inputs).
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    obj = jnp.asarray(rng.standard_normal((batch, hw, hw)), jnp.float32)
+    reg = jnp.abs(jnp.asarray(
+        rng.standard_normal((batch, hw, hw, 4)), jnp.float32
+    ))
+    ex = jnp.tile(jnp.asarray([[0.45, 0.45, 0.53, 0.55]], jnp.float32),
+                  (batch, 1))
+
+    @jax.jit
+    def step(o, r, e, fb):
+        out = {"objectness": [o + fb], "regressions": [r]}
+        dets = pred._decode(out, e)
+        dets = pred._refine_nms(dets, None, (image_size, image_size),
+                                None, False)
+        return dets, jnp.sum(dets["scores"]) * 0.0
+
+    return step, (obj, reg, ex)
+
+
+def measure_stage_breakdown(
+    cfg, batch: int, image_size: int, rtt: float,
+    iters: int = 10, log=lambda s: None,
+) -> dict:
+    """Measure the two tail stages under the CURRENT env knobs and return
+    the ``stage_breakdown`` record bench.py embeds in its JSON:
+    seconds/iter per stage plus the formulations that actually traced.
+    Best-effort per stage — a failed stage records an ``error`` string
+    instead of sinking the caller's headline."""
+    from tmr_tpu.inference import Predictor, decode_tail_mode
+    from tmr_tpu.ops.fused_heads import decoder_impl
+    from tmr_tpu.utils.profiling import chained_seconds_per_iter
+
+    pred = Predictor(cfg)
+    hw = pred.feature_hw(image_size)
+    c_cat = cfg.emb_dim * 2 if cfg.fusion else cfg.emb_dim
+    out: dict = {}
+    impl, quant = decoder_impl(
+        hw, hw, c_cat, c_cat, cfg.decoder_num_layer,
+        cfg.decoder_kernel_size, cfg.compute_dtype,
+    )
+    out["decoder_impl"] = impl
+    out["quant"] = "int8" if quant else "off"
+    out["decode_tail"] = decode_tail_mode()
+    try:
+        log("stage_breakdown: decoder_heads")
+        step, inputs = build_decoder_tail_step(
+            batch, hw, c_cat, cfg.decoder_num_layer,
+            cfg.decoder_kernel_size, cfg.compute_dtype,
+        )
+        out["decoder_heads_s"] = round(chained_seconds_per_iter(
+            step, *inputs, iters=iters, rtt=rtt
+        ), 5)
+    except Exception as e:
+        out["decoder_heads_error"] = f"{type(e).__name__}: {e}"
+    try:
+        log("stage_breakdown: decode_tail")
+        step, inputs = build_decode_tail_step(pred, batch, hw, image_size)
+        out["decode_tail_s"] = round(chained_seconds_per_iter(
+            step, *inputs, iters=iters, rtt=rtt
+        ), 5)
+    except Exception as e:
+        out["decode_tail_error"] = f"{type(e).__name__}: {e}"
+    return out
