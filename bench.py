@@ -444,6 +444,51 @@ def _run(cancel_watchdog) -> None:
             }
         _PRELIM_REC = None
 
+    # program-tier audit of the ELECTED configuration (tmr_tpu/analysis):
+    # trace the production programs under whatever env knobs autotune
+    # just exported and pin the jaxpr invariants (no-f64, quant-widen,
+    # transfer guard). Trace-only, so it costs seconds, not a tunnel
+    # round; an elected path that fails the audit records a structured
+    # program_audit refusal via diagnostics.gate_refused — the same
+    # contract as the kernel gates — and the causes ride the record.
+    # Banked like stage_breakdown: a wedge mid-audit still emits the
+    # headline. TMR_BENCH_AUDIT=0 skips.
+    if os.environ.get("TMR_BENCH_AUDIT", "1").lower() not in (
+        "0", "false", "no", "off"
+    ):
+        _PRELIM_REC = dict(rec)
+        try:
+            from tmr_tpu.analysis import Baseline, default_baseline_path
+            from tmr_tpu.analysis.program_audit import (
+                audit_production_programs,
+            )
+            from tmr_tpu.diagnostics import drain_gate_refusals
+
+            _progress("program_audit")
+            drain_gate_refusals()  # attribute fresh causes to the audit
+            audit = audit_production_programs(
+                # the committed baseline carries the per-platform
+                # transfer_guard pin overrides — without it a documented
+                # pin update would fix analyze.py but leave bench red
+                baseline=Baseline.load(default_baseline_path()),
+                image_size=IMAGE_SIZE, include_attention=False,
+                record_refusals=True,
+            )
+            rec["program_audit"] = {
+                "ok": audit["ok"],
+                "platform": audit["platform"],
+                "gate_state": audit["states"][0]["gate_state"],
+                "problems": audit["problems"],
+                "programs": {r["name"]: r["ok"]
+                             for r in audit["states"][0]["programs"]},
+                "refusals": drain_gate_refusals(),
+            }
+        except Exception as e:
+            rec["program_audit"] = {
+                "ok": False, "error": f"{type(e).__name__}: {e}"
+            }
+        _PRELIM_REC = None
+
     # TMR_AUTOTUNE_EXPORT=<file>: persist the winners as K=V lines so a
     # follow-up bench process (e.g. the watcher's trained-weights run at
     # identical shapes) can source them and skip the sweep — halves the

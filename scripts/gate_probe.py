@@ -247,6 +247,38 @@ def main(argv=None) -> int:
             emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}",
                  refusals=drain_gate_refusals())
 
+    # 5. the program-tier audit (tmr_tpu/analysis): the bucketed
+    # production programs traced to jaxprs under the CURRENT env knobs
+    # and checked structurally (no-S^2 attention, no-f64, quant-widen,
+    # transfer guard). Trace-only — no compile — so it is cheap even
+    # over the tunnel; production geometry on TPU, reduced on CPU, same
+    # split as the decoder-tail gates above. A failing audit records a
+    # program_audit cause through the same gate_refused contract, so the
+    # refusal travels with the probes like every kernel gate's.
+    try:
+        from tmr_tpu.analysis import Baseline, default_baseline_path
+        from tmr_tpu.analysis.program_audit import (
+            audit_production_programs,
+        )
+
+        audit = audit_production_programs(
+            # committed baseline: the per-platform transfer_guard pin
+            # overrides must apply here exactly as in analyze.py
+            baseline=Baseline.load(default_baseline_path()),
+            image_size=1024 if jax.default_backend() == "tpu" else 64,
+            attention_grids=((64, 64), (96, 96)),
+            record_refusals=True,
+        )
+        emit(probe="program_audit", ok=bool(audit["ok"]),
+             problems=audit["problems"],
+             gate_state=audit["states"][0]["gate_state"],
+             refusals=drain_gate_refusals())
+    except Exception as e:
+        traceback.print_exc()
+        emit(probe="program_audit", ok=False,
+             error=f"{type(e).__name__}: {e}",
+             refusals=drain_gate_refusals())
+
     doc = {
         "schema": GATE_PROBE_SCHEMA,
         "backend": backend,

@@ -25,6 +25,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Persistent XLA compilation cache for the suite (the same
+# utils/cache.enable_compilation_cache every CLI already calls;
+# TMR_COMPILATION_CACHE=0 still opts out, failures degrade to a
+# warning). The tier-1 run sits within seconds of its hard timeout and
+# most of that wall is XLA recompiling the same tiny-geometry programs
+# every run — a warm cache cuts repeat runs far below the limit.
+from tmr_tpu.utils.cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

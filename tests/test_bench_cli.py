@@ -27,9 +27,11 @@ def _bench_env(**extra):
         TMR_BENCH_CHAIN="2",
         **extra,
     )
-    # per-stage tail timings are exercised by their dedicated test below;
-    # the other subprocess runs skip them to stay in budget
+    # per-stage tail timings and the program-tier audit are exercised by
+    # their dedicated tests below; the other subprocess runs skip them
+    # to stay in budget
     env.setdefault("TMR_BENCH_STAGES", "0")
+    env.setdefault("TMR_BENCH_AUDIT", "0")
     return env
 
 
@@ -90,7 +92,7 @@ def test_bench_records_validated_stage_breakdown():
 
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        env=_bench_env(TMR_BENCH_STAGES="1"),
+        env=_bench_env(TMR_BENCH_STAGES="1", TMR_BENCH_AUDIT="1"),
         capture_output=True, text=True, timeout=540,
     )
     assert out.returncode == 0, out.stderr[-2000:]
@@ -104,6 +106,14 @@ def test_bench_records_validated_stage_breakdown():
     assert sb["decode_tail"] == "host"
     assert sb["decoder_heads_s"] > 0
     assert sb["decode_tail_s"] > 0
+    # the program-tier audit verdict rides the same record: the elected
+    # configuration's traced programs pass the jaxpr invariants, and a
+    # failure would carry structured program_audit refusal causes
+    # (diagnostics.gate_refused — the kernel-gate contract)
+    audit = rec["program_audit"]
+    assert audit["ok"] is True, audit
+    assert audit["refusals"] == []
+    assert audit["programs"]["match_heads"] is True
 
 
 def test_bench_watchdog_emits_error_line(tmp_path):
@@ -232,6 +242,14 @@ def test_gate_probe_json_contract(tmp_path):
     assert causes[0]["cause"] == "kill-switch"
     assert causes[0]["device_kind"]
     assert causes[0]["config"]["gh"] == 64
+    # the program-tier audit rides the probe document: the production
+    # programs traced under the ambient env pass the jaxpr invariants
+    # (reduced geometry off-TPU; the per-platform transfer pins make
+    # this hold under the CPU backend too)
+    audit = by_name["program_audit"]
+    assert audit["ok"] is True, audit
+    assert audit["problems"] == []
+    assert "gate_state" in audit
     # every refused gate row carries at least one cause record, and the
     # flat aggregate collects them all
     refused = [p for p in doc["probes"]

@@ -1,0 +1,38 @@
+"""Repo-wide static analysis & compiled-program audit.
+
+Two tiers of correctness tooling, one framework:
+
+- **AST tier** (:mod:`tmr_tpu.analysis.ast_passes`): file/AST walking
+  passes over the source tree — jit-hygiene (no Python side effects
+  captured under ``jax.jit``), lock-discipline (shared mutable state in
+  the serve/fault thread pools must be written under a lock), knob
+  discipline (ENV_KNOBS registry parity + no import-time knob reads),
+  report-schema parity, and stdout hygiene. The one-off lints that grew
+  in tests/test_small_utils.py across PRs 4-6 now live here as framework
+  passes; the tests are thin wrappers.
+- **Program tier** (:mod:`tmr_tpu.analysis.program_audit`): the bucketed
+  production programs (backbone, fused match+heads, heads-only,
+  nms_topk) traced to jaxprs and audited structurally — no S²
+  materialization in any no-S² attention formulation, no f64 anywhere,
+  no widening ``convert_element_type`` in the quantized path, and a
+  transfer guard pinning the ``device_put``/host-callback count per
+  program (per-platform: CPU staging differs from TPU).
+
+Entry points: :func:`run_analysis` (everything, one
+``analysis_report/v1`` document — what ``scripts/analyze.py`` emits),
+:func:`tmr_tpu.analysis.core.run_ast_passes` (AST tier only; what the
+tier-1 test wrappers call), and a committed suppression baseline
+(``analysis_baseline.json``) so pre-existing, documented exceptions
+don't drown new findings.
+"""
+
+from tmr_tpu.analysis.core import (  # noqa: F401
+    AnalysisContext,
+    Baseline,
+    Finding,
+    RULES,
+    build_report,
+    default_baseline_path,
+    run_analysis,
+    run_ast_passes,
+)

@@ -1,0 +1,803 @@
+"""The AST-tier analysis passes.
+
+Six rules over the source tree (registered in core.RULES):
+
+- ``jit-hygiene``      — no Python side effects lexically inside a
+  jit-compiled function body: ``time.*`` / ``np.random`` / stdlib
+  ``random`` calls, env reads, ``print``, and mutable-global writes all
+  execute ONCE at trace time and then silently never again (or worse,
+  leak host values into a cached program). Applies to functions
+  decorated ``jax.jit`` / ``pjit`` / ``shard_map`` (including through
+  ``functools.partial`` and local ``jit = ...`` aliases — the
+  Predictor._compiled programs) and to functions wrapped post-hoc via
+  ``jax.jit(fn)``.
+- ``lock-discipline``  — in the threaded modules (tmr_tpu/serve/*,
+  utils/faults.py, obs/metrics.py): an instance attribute accessed from
+  more than one thread entry point (public method, ``threading.Thread``
+  target, or a bound method whose reference escapes) must be WRITTEN
+  only under a held ``self._lock``/``self._cond``-style context, or be
+  a documented atomic in the baseline's ``lock_atomics`` whitelist.
+  Module-level mutable globals in those files get the same treatment.
+- ``knob-parity``      — every TMR_* env knob consumed under tmr_tpu/
+  must be documented in ``config.ENV_KNOBS``; every registry entry must
+  be consumed somewhere on the repo surface (tmr_tpu/ + bench.py +
+  scripts/); descriptions must be non-empty. The knob registry IS how a
+  knob read "goes through config.py" — an unregistered read is the bug.
+- ``knob-import-time`` — no TMR_* knob may be read at import time
+  outside config.py: a module-level read (direct, or through a helper
+  called at module level) freezes the knob before any consumer can set
+  it, which is how silently-dead knobs are born.
+- ``report-parity``    — every ``*_report/v1`` schema constant in
+  diagnostics.py ships a ``validate_*`` function, and every script
+  referencing a ``*_REPORT_SCHEMA`` constant calls its validator
+  (the self-check-before-print discipline).
+- ``stdout-hygiene``   — stdout under tmr_tpu/ is machine-readable
+  protocol output only; a bare ``print()`` in library code corrupts
+  whatever pipeline parses it.
+
+Pure ``ast``/``re`` — no jax import, cheap enough for tier-1 every run.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tmr_tpu.analysis.core import AnalysisContext, Finding, rule
+
+# --------------------------------------------------------------------------
+# shared helpers
+# --------------------------------------------------------------------------
+
+
+def _const_str(node) -> Optional[str]:
+    return (node.value if isinstance(node, ast.Constant)
+            and isinstance(node.value, str) else None)
+
+
+def _is_environ(node) -> bool:
+    """Does this expression denote ``os.environ`` / ``environ`` /
+    ``getenv``? (the test_small_utils detector, now framework-owned)."""
+    return ("environ" in ast.dump(node)) or (
+        isinstance(node, ast.Attribute) and node.attr == "getenv"
+    ) or (isinstance(node, ast.Name) and node.id == "getenv")
+
+
+def _env_read_key(node) -> Tuple[bool, Optional[str]]:
+    """(is an env read, literal key or None) for one AST node."""
+    if isinstance(node, ast.Subscript) and _is_environ(node.value):
+        return True, _const_str(node.slice)
+    if isinstance(node, ast.Call) and (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("get", "pop", "setdefault", "getenv")
+        and _is_environ(node.func)
+    ):
+        return True, _const_str(node.args[0]) if node.args else None
+    return False, None
+
+
+def env_knob_reads(tree: ast.AST, prefix: str = "TMR_") -> Dict[str, int]:
+    """Literal ``prefix``-keyed env reads in a tree: {knob: first line}."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        is_read, key = _env_read_key(node)
+        if is_read and key and key.startswith(prefix):
+            out.setdefault(key, node.lineno)
+    return out
+
+
+def _dotted(node) -> List[str]:
+    """Attribute/Name chain as a name list, outermost last:
+    ``np.random.default_rng`` -> ['np', 'random', 'default_rng']."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+# --------------------------------------------------------------------------
+# jit-hygiene
+# --------------------------------------------------------------------------
+
+#: names a jit-returning decorator resolves to locally (inference.py's
+#: ``jit = functools.partial(jax.jit, ...)`` alias pattern)
+_JIT_NAMES = ("jit", "pjit", "shard_map")
+
+
+def _is_jitish(node) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Name) and f.id == "partial") or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        ):
+            return any(_is_jitish(a) for a in node.args)
+        return _is_jitish(f)
+    return False
+
+
+def _jit_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Every function the file compiles under jit: decorator-marked, or
+    wrapped post-hoc by a ``jax.jit(fn)``-shaped call naming a local
+    def."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jitish(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                wrapped.add(node.args[0].id)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_jitish(d) for d in node.decorator_list):
+            out.append(node)
+        elif node.name in wrapped:
+            out.append(node)
+    return out
+
+
+def _module_mutable_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to mutable containers (literal or a
+    well-known constructor) — the things a traced function must never
+    write."""
+    ctors = {"dict", "list", "set", "OrderedDict", "defaultdict",
+             "Counter", "deque"}
+    out: Set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        if value is None:
+            continue
+        chain = _dotted(value.func) if isinstance(value, ast.Call) else []
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
+            bool(chain) and chain[-1] in ctors
+        )
+        if mutable:
+            out.update(t.id for t in targets)
+    return out
+
+
+#: container-mutating method names (instruments like Counter.inc are
+#: internally locked by contract and deliberately NOT listed)
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "update", "setdefault", "add",
+    "discard", "move_to_end",
+))
+
+
+@rule("jit-hygiene")
+def jit_hygiene(ctx: AnalysisContext) -> Iterable[Finding]:
+    for rel in ctx.lib_files():
+        tree = ctx.tree(rel)
+        mut_globals = _module_mutable_globals(tree)
+        for fn in _jit_functions(tree):
+            where = f"jit function {fn.name!r}"
+            declared_global: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+            for node in ast.walk(fn):
+                line = getattr(node, "lineno", fn.lineno)
+                is_read, key = _env_read_key(node)
+                if is_read:
+                    yield Finding(
+                        "jit-hygiene", rel, line,
+                        f"{where} reads the environment"
+                        f"{f' ({key})' if key else ''} — captured once at "
+                        "trace time, dead thereafter",
+                    )
+                    continue
+                if not isinstance(node, (ast.Call, ast.Assign,
+                                         ast.AugAssign)):
+                    continue
+                if isinstance(node, ast.Call):
+                    chain = _dotted(node.func)
+                    if chain[:1] == ["time"] and len(chain) > 1:
+                        yield Finding(
+                            "jit-hygiene", rel, line,
+                            f"{where} calls time.{chain[1]} — a host "
+                            "clock read inside a traced program is a "
+                            "trace-time constant",
+                        )
+                    elif "random" in chain[:-1] and chain[0] in (
+                        "np", "numpy", "random"
+                    ):
+                        yield Finding(
+                            "jit-hygiene", rel, line,
+                            f"{where} calls {'.'.join(chain)} — host "
+                            "randomness inside a traced program freezes "
+                            "at trace time (use jax.random)",
+                        )
+                    elif chain == ["print"]:
+                        yield Finding(
+                            "jit-hygiene", rel, line,
+                            f"{where} calls print — executes once at "
+                            "trace time, never per step",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in mut_globals
+                    ):
+                        yield Finding(
+                            "jit-hygiene", rel, line,
+                            f"{where} mutates module global "
+                            f"{node.func.value.id!r} — a side effect "
+                            "captured under jit runs once per trace",
+                        )
+                else:  # Assign / AugAssign
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        name = None
+                        if isinstance(t, ast.Name) and (
+                            t.id in declared_global
+                        ):
+                            name = t.id
+                        elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ) and t.value.id in mut_globals:
+                            name = t.value.id
+                        if name:
+                            yield Finding(
+                                "jit-hygiene", rel, line,
+                                f"{where} writes global {name!r} — a "
+                                "side effect captured under jit runs "
+                                "once per trace",
+                            )
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+#: the threaded modules the pass audits (the serve pipeline's three free-
+#: running thread pools, the fault-injection log the heartbeat threads
+#: write, and the metrics/cache layers they all share)
+LOCK_FILES = (
+    "tmr_tpu/serve/batcher.py",
+    "tmr_tpu/serve/staging.py",
+    "tmr_tpu/serve/engine.py",
+    "tmr_tpu/serve/caches.py",
+    "tmr_tpu/utils/faults.py",
+    "tmr_tpu/obs/metrics.py",
+)
+
+
+def _is_lock_ctx(expr) -> bool:
+    """Is a ``with`` context expression a lock/condition hold? Matches
+    ``self._lock`` / ``self._cond`` style attributes and module-level
+    ``_LOCK``-style names (substring match on lock/cond, any case)."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return _is_lock_ctx(expr.func)
+    if name is None:
+        return False
+    low = name.lower()
+    return "lock" in low or "cond" in low or "mutex" in low
+
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "line", "write", "locked")
+
+    def __init__(self, attr: str, line: int, write: bool, locked: bool):
+        self.attr, self.line = attr, line
+        self.write, self.locked = write, locked
+
+
+def _method_accesses(fn, target_names) -> Tuple[List[_Access], List[Tuple[
+        str, bool]], bool]:
+    """Walk one function: (attribute/global accesses with lock state,
+    intra-class call sites [(callee, locked)], has_any_lock)."""
+    accesses: List[_Access] = []
+    calls: List[Tuple[str, bool]] = []
+
+    def visit(node, locked: bool):
+        if isinstance(node, ast.With):
+            inner = locked or any(
+                _is_lock_ctx(item.context_expr) for item in node.items
+            )
+            for item in node.items:
+                visit(item.context_expr, locked)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # nested defs (thread bodies, callbacks) keep the enclosing
+            # lock state only if entered inline — conservatively treat
+            # their bodies as NOT lock-held
+            for child in ast.iter_child_nodes(node):
+                visit(child, False)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                name = _self_attr(t)
+                if name is None and isinstance(t, ast.Subscript):
+                    name = _self_attr(t.value)
+                    if name is None and isinstance(t.value, ast.Name) \
+                            and t.value.id in target_names:
+                        name = t.value.id
+                if name is None and isinstance(t, ast.Name) \
+                        and t.id in target_names:
+                    name = t.id
+                if name is not None:
+                    accesses.append(_Access(name, node.lineno, True, locked))
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                name = None
+                if isinstance(t, ast.Subscript):
+                    name = _self_attr(t.value)
+                    if name is None and isinstance(t.value, ast.Name) \
+                            and t.value.id in target_names:
+                        name = t.value.id
+                if name is not None:
+                    accesses.append(_Access(name, node.lineno, True, locked))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                owner = _self_attr(f.value)
+                if owner is None and isinstance(f.value, ast.Name) \
+                        and f.value.id in target_names:
+                    owner = f.value.id
+                if owner is not None and f.attr in _MUTATORS:
+                    accesses.append(
+                        _Access(owner, node.lineno, True, locked)
+                    )
+                method = _self_attr(f)
+                if method is not None:
+                    calls.append((method, locked))
+        name = _self_attr(node)
+        if name is not None and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            accesses.append(_Access(name, node.lineno, False, locked))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return accesses, calls, any(a.locked for a in accesses)
+
+
+def _class_findings(rel: str, cls: ast.ClassDef, ctx: AnalysisContext
+                    ) -> Iterable[Finding]:
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    if not methods:
+        return
+    # thread roots: threading.Thread(target=self.X) + escaped bound
+    # methods (self.X referenced outside a call position) + every public
+    # method (each its own root: two public methods may race from two
+    # caller threads)
+    roots: Dict[str, Set[str]] = {name: set() for name in methods}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if chain[-1:] == ["Thread"] or chain[-1:] == ["Timer"]:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        t = _self_attr(kw.value)
+                        if t in methods:
+                            roots[t].add(t)
+        name = _self_attr(node)
+        if name in methods and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            # bare bound-method reference (not the func of a Call — that
+            # case never reaches here because _dotted consumed it; a
+            # conservative check: any Load of self.<method> counts)
+            roots[name].add(name)
+    # the Load check above also catches `self.m()` call funcs; narrow:
+    # a method used strictly as call target everywhere is not "escaped".
+    called_only: Set[str] = set()
+    for name in methods:
+        loads, callfuncs = 0, 0
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and _self_attr(node.func) == name:
+                callfuncs += 1
+            elif _self_attr(node) == name:
+                loads += 1
+        if loads == 0 or loads == callfuncs:
+            called_only.add(name)
+    for name in called_only:
+        # keep explicit Thread targets as roots even when call-only
+        is_thread_target = any(
+            isinstance(node, ast.Call)
+            and _dotted(node.func)[-1:] in (["Thread"], ["Timer"])
+            and any(kw.arg == "target"
+                    and _self_attr(kw.value) == name
+                    for kw in node.keywords)
+            for node in ast.walk(cls)
+        )
+        if not is_thread_target:
+            roots[name].discard(name)
+    for name in methods:
+        if not name.startswith("_") or name in (
+            "__call__", "__enter__", "__exit__", "__len__",
+            "__contains__", "__iter__",
+        ):
+            roots[name].add(name)
+
+    # per-method accesses + intra-class call graph
+    acc: Dict[str, List[_Access]] = {}
+    calls: Dict[str, List[Tuple[str, bool]]] = {}
+    for name, fn in methods.items():
+        acc[name], calls[name], _ = _method_accesses(fn, frozenset())
+
+    # always-locked propagation: a private method whose every intra-class
+    # call site is lock-held runs under the caller's lock
+    always_locked: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if name in always_locked:
+                continue
+            sites = [
+                (caller, locked) for caller, cl in calls.items()
+                for callee, locked in cl if callee == name
+            ]
+            if not sites or roots[name]:
+                continue  # a root runs unlocked from outside by definition
+            if all(locked or caller in always_locked
+                   for caller, locked in sites):
+                always_locked.add(name)
+                changed = True
+
+    # reachability: propagate root labels through the call graph
+    reach: Dict[str, Set[str]] = {n: set(roots[n]) for n in methods}
+    changed = True
+    while changed:
+        changed = False
+        for caller in methods:
+            if caller == "__init__":
+                continue
+            for callee, _locked in calls[caller]:
+                if callee in reach and not reach[caller] <= reach[callee]:
+                    reach[callee] |= reach[caller]
+                    changed = True
+
+    # attribute -> union of accessing methods' roots (construction-time
+    # __init__ excluded)
+    attr_roots: Dict[str, Set[str]] = {}
+    for name, fn_acc in acc.items():
+        if name == "__init__":
+            continue
+        for a in fn_acc:
+            attr_roots.setdefault(a.attr, set()).update(reach[name])
+
+    for name, fn_acc in acc.items():
+        if name == "__init__":
+            continue
+        held = name in always_locked
+        for a in fn_acc:
+            if not a.write or a.locked or held:
+                continue
+            shared = attr_roots.get(a.attr, set())
+            if len(shared) < 2 or not reach[name]:
+                continue
+            if ctx.baseline.is_atomic(rel, f"{cls.name}.{a.attr}"):
+                continue
+            yield Finding(
+                "lock-discipline", rel, a.line,
+                f"{cls.name}.{name} writes self.{a.attr} without holding "
+                f"a lock, but the attribute is reachable from "
+                f"{len(shared)} thread entry points "
+                f"({', '.join(sorted(shared))}) — hold self._lock-style "
+                "context or whitelist it as a documented atomic in "
+                "analysis_baseline.json lock_atomics",
+            )
+
+
+def _module_global_findings(rel: str, tree: ast.Module,
+                            ctx: AnalysisContext) -> Iterable[Finding]:
+    """Module-level mutable globals in a threaded module must be mutated
+    under a lock (or be baseline-whitelisted documented atomics)."""
+    globals_ = _module_mutable_globals(tree)
+    declared: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            declared.update(node.names)
+    targets = frozenset(globals_ | declared)
+    if not targets:
+        return
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        accesses, _calls, _ = _method_accesses(node, targets)
+        for a in accesses:
+            if not a.write or a.locked:
+                continue
+            if a.attr not in targets:
+                continue
+            if ctx.baseline.is_atomic(rel, a.attr):
+                continue
+            yield Finding(
+                "lock-discipline", rel, a.line,
+                f"{node.name} mutates module global {a.attr!r} without a "
+                "lock in a threaded module — hold a module lock or "
+                "whitelist it as a documented atomic in "
+                "analysis_baseline.json lock_atomics",
+            )
+
+
+@rule("lock-discipline")
+def lock_discipline(ctx: AnalysisContext) -> Iterable[Finding]:
+    import os
+
+    for rel in LOCK_FILES:
+        if not os.path.exists(os.path.join(ctx.root, rel)):
+            continue
+        tree = ctx.tree(rel)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from _class_findings(rel, node, ctx)
+        yield from _module_global_findings(rel, tree, ctx)
+    # fixture/scan extension: any OTHER lib file that spawns threads
+    # from inside a class is audited the same way (new thread pools must
+    # not dodge the pass by living in a new file)
+    for rel in ctx.lib_files():
+        if rel in LOCK_FILES:
+            continue
+        src = ctx.source(rel)
+        if "threading.Thread(" not in src:
+            continue
+        for node in ctx.tree(rel).body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            seg = ast.get_source_segment(src, node) or ""
+            if "threading.Thread(" in seg:
+                yield from _class_findings(rel, node, ctx)
+
+
+# --------------------------------------------------------------------------
+# knob-parity / knob-import-time
+# --------------------------------------------------------------------------
+
+
+def _registry_entries(ctx: AnalysisContext) -> Dict[str, Tuple[int, str]]:
+    """Parse config.py's ENV_KNOBS dict literal without importing:
+    {knob: (line, description)}."""
+    tree = ctx.tree("tmr_tpu/config.py")
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets, value = [node.target.id], node.value
+        else:
+            continue
+        if "ENV_KNOBS" in targets and isinstance(value, ast.Dict):
+            out = {}
+            for k, v in zip(value.keys, value.values):
+                key = _const_str(k)
+                if key is not None:
+                    out[key] = (k.lineno, _const_str(v) or "")
+            return out
+    raise AssertionError(
+        "tmr_tpu/config.py: ENV_KNOBS dict literal not found — the knob "
+        "registry moved or broke"
+    )
+
+
+@rule("knob-parity")
+def knob_parity(ctx: AnalysisContext) -> Iterable[Finding]:
+    registry = _registry_entries(ctx)
+    consumed: Dict[str, Tuple[str, int]] = {}
+    for rel in ctx.lib_files():
+        for knob, line in env_knob_reads(ctx.tree(rel)).items():
+            consumed.setdefault(knob, (rel, line))
+    if not consumed:
+        yield Finding(
+            "knob-parity", "tmr_tpu/config.py", 1,
+            "AST scan found no TMR_ knob reads under tmr_tpu/ — the "
+            "scanner itself broke (there are dozens)",
+        )
+        return
+    for knob, (rel, line) in sorted(consumed.items()):
+        if knob not in registry:
+            yield Finding(
+                "knob-parity", rel, line,
+                f"TMR_ knob {knob!r} is consumed but missing from "
+                "config.ENV_KNOBS — add it with a one-line description",
+            )
+    # reverse: a documented knob nothing consumes is a stale entry
+    # (driver knobs live in bench.py / scripts/, so scan repo-wide;
+    # string-literal presence is enough for existence). config.py is
+    # EXCLUDED from the surface — the registry dict itself contains
+    # every knob name as a literal, which made the pre-framework
+    # test_small_utils version of this check unable to ever fire.
+    surface = "\n".join(
+        ctx.source(rel)
+        for rel in ctx.lib_files() + ctx.driver_files()
+        if rel != "tmr_tpu/config.py"
+    )
+    for knob, (line, desc) in sorted(registry.items()):
+        if f'"{knob}"' not in surface and f"'{knob}'" not in surface:
+            yield Finding(
+                "knob-parity", "tmr_tpu/config.py", line,
+                f"config.ENV_KNOBS entry {knob!r} is consumed by no code "
+                "on the repo surface — delete it or wire it up",
+            )
+        if not desc.strip():
+            yield Finding(
+                "knob-parity", "tmr_tpu/config.py", line,
+                f"config.ENV_KNOBS[{knob!r}]: empty description",
+            )
+
+
+def _env_reading_functions(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Module functions that read the environment: {name: literal TMR_
+    keys read directly inside (possibly empty)}."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        keys: Set[str] = set()
+        reads = False
+        for sub in ast.walk(node):
+            is_read, key = _env_read_key(sub)
+            if is_read:
+                reads = True
+                if key and key.startswith("TMR_"):
+                    keys.add(key)
+        if reads:
+            out[node.name] = keys
+    return out
+
+
+@rule("knob-import-time")
+def knob_import_time(ctx: AnalysisContext) -> Iterable[Finding]:
+    for rel in ctx.lib_files():
+        if rel == "tmr_tpu/config.py":
+            continue  # the registry module is the one legal home
+        tree = ctx.tree(rel)
+        readers = _env_reading_functions(tree)
+
+        def walk_skip_functions(node):
+            """Import-time-reachable nodes only: function/lambda bodies
+            execute later, class bodies execute at import."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk_skip_functions(child)
+
+        for node in walk_skip_functions(tree):
+            is_read, key = _env_read_key(node)
+            if is_read and key and key.startswith("TMR_"):
+                yield Finding(
+                    "knob-import-time", rel, node.lineno,
+                    f"TMR_ knob {key!r} read at import time — consumers "
+                    "that set it after import silently see nothing; read "
+                    "lazily at call/trace time",
+                )
+                continue
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id in readers:
+                keys = {
+                    a.value for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                    and a.value.startswith("TMR_")
+                } | readers[node.func.id]
+                if keys:
+                    yield Finding(
+                        "knob-import-time", rel, node.lineno,
+                        f"TMR_ knob(s) {sorted(keys)} read at import time "
+                        f"via {node.func.id}() — consumers that set them "
+                        "after import silently see nothing; resolve "
+                        "lazily",
+                    )
+
+
+# --------------------------------------------------------------------------
+# report-parity
+# --------------------------------------------------------------------------
+
+_SCHEMA_CONST_RE = re.compile(
+    r'^([A-Z][A-Z_]*)_SCHEMA\s*=\s*"(\w+_report)/v\d+"', re.M
+)
+_SCHEMA_REF_RE = re.compile(r"\b([A-Z][A-Z_]*?)_REPORT_SCHEMA\b")
+
+
+@rule("report-parity")
+def report_parity(ctx: AnalysisContext) -> Iterable[Finding]:
+    diag_rel = "tmr_tpu/diagnostics.py"
+    diag_src = ctx.source(diag_rel)
+    schemas = list(_SCHEMA_CONST_RE.finditer(diag_src))
+    if not any(m.group(2).endswith("_report") for m in schemas):
+        yield Finding(
+            "report-parity", diag_rel, 1,
+            "no *_report schema constants found in diagnostics.py — the "
+            "scanner or the report protocol broke",
+        )
+        return
+    for m in schemas:
+        const, tag = m.group(1), m.group(2)
+        validator = f"validate_{tag}"
+        if f"def {validator}" not in diag_src:
+            yield Finding(
+                "report-parity", diag_rel,
+                diag_src.count("\n", 0, m.start()) + 1,
+                f"{const}_SCHEMA ({tag}/v*) has no diagnostics."
+                f"{validator}() — a report format cannot drift in "
+                "unvalidated",
+            )
+    for rel in ctx.driver_files():
+        src = ctx.source(rel)
+        for const in sorted(set(_SCHEMA_REF_RE.findall(src))):
+            validator = f"validate_{const.lower()}_report"
+            if validator not in src:
+                line = src.count(
+                    "\n", 0, src.find(f"{const}_REPORT_SCHEMA")
+                ) + 1
+                yield Finding(
+                    "report-parity", rel, line,
+                    f"references {const}_REPORT_SCHEMA but never calls "
+                    f"{validator}() — emit-then-validate is the report "
+                    "contract",
+                )
+
+
+# --------------------------------------------------------------------------
+# stdout-hygiene
+# --------------------------------------------------------------------------
+
+
+@rule("stdout-hygiene")
+def stdout_hygiene(ctx: AnalysisContext) -> Iterable[Finding]:
+    for rel in ctx.lib_files():
+        for node in ast.walk(ctx.tree(rel)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and not any(kw.arg == "file" for kw in node.keywords)
+            ):
+                yield Finding(
+                    "stdout-hygiene", rel, node.lineno,
+                    "bare print() to stdout in library code — stdout is "
+                    "machine-readable protocol output; use "
+                    "profiling.log_* or print(..., file=sys.stderr)",
+                )

@@ -1,0 +1,310 @@
+"""Checker framework: file/AST walking, findings, the suppression
+baseline, and the ``analysis_report/v1`` document builder.
+
+Dependency-light on purpose — the AST tier imports nothing heavier than
+``ast`` (no jax), so ``run_ast_passes`` is cheap enough to ride tier-1
+on every run. The program tier (program_audit.py) is imported lazily by
+:func:`run_analysis` only when requested.
+
+Vocabulary:
+
+- a **rule** is a named pass (``@rule("jit-hygiene")``) taking an
+  :class:`AnalysisContext` and yielding :class:`Finding` objects;
+- a **finding** is one defect claim: rule id + repo-relative file +
+  line + message;
+- the **baseline** (``analysis_baseline.json``, committed) suppresses
+  documented exceptions: each suppression names a rule, a file, an
+  optional message substring, and a REQUIRED human reason — a
+  suppression without a why is a finding waiting to rot. It also
+  carries the lock-discipline atomic whitelist and the per-platform
+  transfer-guard pins the program tier reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, Iterable, List, Optional
+
+#: baseline document schema tag (the report schema lives in
+#: tmr_tpu.diagnostics as ANALYSIS_REPORT_SCHEMA with its validator)
+BASELINE_SCHEMA = "analysis_baseline/v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect claim from one rule at one source location."""
+
+    rule: str
+    file: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:  # the human-readable grep-able form
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Baseline:
+    """The committed suppression set + pass-specific whitelists.
+
+    ``allows(finding)`` is the one question the framework asks: does a
+    suppression entry match this finding's rule AND file AND (when the
+    entry carries ``match``) message substring? Line numbers are
+    deliberately NOT part of the key — a baseline pinned to line numbers
+    would churn on every unrelated edit above it.
+    """
+
+    def __init__(self, doc: Optional[dict] = None, path: str = ""):
+        doc = doc or {}
+        self.path = path
+        self.suppressions: List[dict] = list(doc.get("suppressions", ()))
+        #: lock-discipline documented atomics: [{"file", "attr", "reason"}]
+        self.lock_atomics: List[dict] = list(doc.get("lock_atomics", ()))
+        #: program-tier transfer pins: {platform: {program: {kind: n}}}
+        self.transfer_guard: Dict[str, dict] = dict(
+            doc.get("transfer_guard", {})
+        )
+        for i, s in enumerate(self.suppressions):
+            for req in ("rule", "file", "reason"):
+                if not s.get(req):
+                    raise ValueError(
+                        f"baseline suppression[{i}] missing {req!r}: {s}"
+                    )
+        for i, a in enumerate(self.lock_atomics):
+            for req in ("file", "attr", "reason"):
+                if not a.get(req):
+                    raise ValueError(
+                        f"baseline lock_atomics[{i}] missing {req!r}: {a}"
+                    )
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls({}, path=path)
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") != BASELINE_SCHEMA:
+            raise ValueError(
+                f"{path}: schema != {BASELINE_SCHEMA}: {doc.get('schema')!r}"
+            )
+        return cls(doc, path=path)
+
+    def allows(self, finding: Finding) -> bool:
+        for s in self.suppressions:
+            if s["rule"] != finding.rule or s["file"] != finding.file:
+                continue
+            if s.get("match") and s["match"] not in finding.message:
+                continue
+            return True
+        return False
+
+    def is_atomic(self, file: str, attr: str) -> bool:
+        return any(
+            a["file"] == file and a["attr"] == attr
+            for a in self.lock_atomics
+        )
+
+    def transfer_pin(self, platform: str, program: str) -> Optional[dict]:
+        plat = self.transfer_guard.get(platform)
+        if plat is None:
+            return None
+        return plat.get(program, plat.get("*"))
+
+    def document(self) -> dict:
+        return {
+            "schema": BASELINE_SCHEMA,
+            "suppressions": self.suppressions,
+            "lock_atomics": self.lock_atomics,
+            "transfer_guard": self.transfer_guard,
+        }
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        with open(path, "w") as f:
+            json.dump(self.document(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+def default_repo_root() -> str:
+    """The repo root this installed tree lives in (two levels above
+    this file: tmr_tpu/analysis/core.py)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or default_repo_root(),
+                        "analysis_baseline.json")
+
+
+class AnalysisContext:
+    """Shared state every pass reads: the file list and a parse cache
+    (each file is read + AST-parsed at most once per run)."""
+
+    #: directories (repo-relative) the AST tier walks for library code
+    LIB_DIRS = ("tmr_tpu",)
+    #: extra top-level surface files/dirs passes may scan (driver code)
+    DRIVER = ("bench.py", "scripts")
+
+    def __init__(self, root: Optional[str] = None,
+                 baseline: Optional[Baseline] = None):
+        self.root = os.path.abspath(root or default_repo_root())
+        self.baseline = baseline or Baseline()
+        self._src: Dict[str, str] = {}
+        self._ast: Dict[str, ast.Module] = {}
+
+    # ----------------------------------------------------------- file sets
+    def _walk(self, *relpaths: str) -> List[str]:
+        out: List[str] = []
+        for rel in relpaths:
+            path = os.path.join(self.root, rel)
+            if os.path.isfile(path) and rel.endswith(".py"):
+                out.append(rel)
+                continue
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        out.append(
+                            os.path.relpath(full, self.root).replace(
+                                os.sep, "/"
+                            )
+                        )
+        return sorted(out)
+
+    def lib_files(self) -> List[str]:
+        """Library sources (tmr_tpu/**/*.py), repo-relative."""
+        return self._walk(*self.LIB_DIRS)
+
+    def driver_files(self) -> List[str]:
+        """Driver surface (bench.py + scripts/*.py), repo-relative."""
+        return self._walk(*self.DRIVER)
+
+    # --------------------------------------------------------- parse cache
+    def source(self, rel: str) -> str:
+        if rel not in self._src:
+            with open(os.path.join(self.root, rel)) as f:
+                self._src[rel] = f.read()
+        return self._src[rel]
+
+    def tree(self, rel: str) -> ast.Module:
+        if rel not in self._ast:
+            self._ast[rel] = ast.parse(self.source(rel), filename=rel)
+        return self._ast[rel]
+
+
+#: rule id -> pass callable(ctx) -> iterable[Finding]
+RULES: Dict[str, Callable[[AnalysisContext], Iterable[Finding]]] = {}
+
+
+def rule(rule_id: str):
+    """Register a pass under ``rule_id`` (its findings must carry it)."""
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate analysis rule {rule_id!r}")
+        RULES[rule_id] = fn
+        fn.rule_id = rule_id
+        return fn
+
+    return deco
+
+
+def run_ast_passes(
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+    baseline: Optional[Baseline] = None,
+) -> List[Finding]:
+    """Run the AST-tier passes and return EVERY finding (baselined ones
+    included — callers split with ``baseline.allows``). ``rules`` names a
+    subset; default all registered."""
+    import tmr_tpu.analysis.ast_passes  # noqa: F401 — registers RULES
+
+    ctx = AnalysisContext(root=root, baseline=baseline)
+    wanted = list(rules) if rules is not None else sorted(RULES)
+    unknown = [r for r in wanted if r not in RULES]
+    if unknown:
+        raise KeyError(
+            f"unknown analysis rules {unknown}; registered: {sorted(RULES)}"
+        )
+    findings: List[Finding] = []
+    for rule_id in wanted:
+        for f in RULES[rule_id](ctx):
+            if f.rule != rule_id:
+                raise AssertionError(
+                    f"pass {rule_id!r} emitted a finding tagged {f.rule!r}"
+                )
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule,
+                                           f.message))
+
+
+def build_report(
+    findings: List[Finding],
+    baseline: Baseline,
+    program_audit: Optional[dict] = None,
+    root: str = "",
+) -> dict:
+    """Assemble the ``analysis_report/v1`` document (schema + validator
+    in tmr_tpu.diagnostics): unbaselined findings in full, baselined ones
+    as a count, per-rule tallies, the program-tier record when one ran,
+    and the one verdict CI gates on (``checks.clean``)."""
+    from tmr_tpu.diagnostics import ANALYSIS_REPORT_SCHEMA
+
+    new = [f for f in findings if not baseline.allows(f)]
+    suppressed = len(findings) - len(new)
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    program_ok = (program_audit or {}).get("ok", True)
+    return {
+        "schema": ANALYSIS_REPORT_SCHEMA,
+        "root": root,
+        "rules": sorted(RULES),
+        "findings": [f.to_dict() for f in new],
+        "baselined_count": suppressed,
+        "counts_by_rule": by_rule,
+        "program_audit": program_audit,
+        "checks": {
+            "ast_clean": not new,
+            "program_ok": bool(program_ok),
+            "clean": not new and bool(program_ok),
+        },
+    }
+
+
+def run_analysis(
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    with_program_audit: bool = True,
+    program_kwargs: Optional[dict] = None,
+) -> dict:
+    """The full pass: AST tier + (optionally) the program-tier audit,
+    returned as one validated ``analysis_report/v1`` document. This is
+    what ``scripts/analyze.py`` emits and what CI gates on."""
+    root = os.path.abspath(root or default_repo_root())
+    baseline = Baseline.load(baseline_path or default_baseline_path(root))
+    findings = run_ast_passes(root=root, baseline=baseline)
+    program = None
+    if with_program_audit:
+        from tmr_tpu.analysis.program_audit import audit_production_programs
+
+        program = audit_production_programs(
+            baseline=baseline, **(program_kwargs or {})
+        )
+    doc = build_report(findings, baseline, program_audit=program, root=root)
+    from tmr_tpu.diagnostics import validate_analysis_report
+
+    problems = validate_analysis_report(doc)
+    if problems:  # the emitter self-check discipline (serve_bench's rule)
+        raise AssertionError(f"invalid analysis_report/v1: {problems}")
+    return doc

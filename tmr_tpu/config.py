@@ -227,6 +227,8 @@ ENV_KNOBS = {
     # bench.py driver knobs (consumed outside tmr_tpu/ but part of the
     # same surface; the parity test scans bench.py + scripts/ for these)
     "TMR_AUTOTUNE": "bench.py: run the autotune sweep (0 skips)",
+    "TMR_BENCH_AUDIT": "bench.py: program-tier audit of the elected "
+        "configuration (0 skips)",
     "TMR_AUTOTUNE_EXPORT": "bench.py: write elected winners as K=V lines",
     "TMR_BENCH_CHAIN": "bench.py: chained-iteration count override",
     "TMR_BENCH_CKPT": "bench.py: trained-checkpoint path to measure",

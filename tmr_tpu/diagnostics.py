@@ -375,6 +375,91 @@ def validate_stage_breakdown(doc: dict) -> List[str]:
     return problems
 
 
+#: schema tag of the static-analysis + program-audit document emitted by
+#: scripts/analyze.py (tmr_tpu/analysis): AST-tier findings (rule id +
+#: file:line + message, suppression-baseline applied), per-rule tallies,
+#: and the program-tier audit record (jaxpr invariants of the bucketed
+#: production programs: no-S² attention, no-f64, quant-widen, transfer
+#: guard). CI gates on ``checks.clean``.
+ANALYSIS_REPORT_SCHEMA = "analysis_report/v1"
+
+
+def validate_analysis_report(doc: dict) -> List[str]:
+    """Structural check of an analysis_report/v1 document; returns a
+    list of problems (empty == valid). An error record
+    ({"schema": ..., "error": str}) is contractually valid (the
+    bench_guard wrapper's wedge path). Dependency-free like the other
+    validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != ANALYSIS_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {ANALYSIS_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    rules = doc.get("rules")
+    if not isinstance(rules, list) or not all(
+        isinstance(r, str) for r in rules
+    ) or not rules:
+        problems.append("rules: not a non-empty list of rule ids")
+    findings = doc.get("findings")
+    if not isinstance(findings, list):
+        problems.append("findings: not a list")
+        findings = []
+    for i, f in enumerate(findings):
+        where = f"findings[{i}]"
+        if not isinstance(f, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("rule", "file", "line", "message"):
+            if key not in f:
+                problems.append(f"{where}: missing {key!r}")
+        if isinstance(rules, list) and rules \
+                and f.get("rule") not in rules:
+            problems.append(f"{where}: unknown rule {f.get('rule')!r}")
+    if not isinstance(doc.get("baselined_count"), int):
+        problems.append("baselined_count: not an int")
+    if not isinstance(doc.get("counts_by_rule"), dict):
+        problems.append("counts_by_rule: not a dict")
+    prog = doc.get("program_audit")
+    if prog is not None:
+        if not isinstance(prog, dict):
+            problems.append("program_audit: not a dict")
+        else:
+            for key in ("platform", "states", "problems", "ok"):
+                if key not in prog:
+                    problems.append(f"program_audit: missing {key!r}")
+            for i, st in enumerate(prog.get("states") or ()):
+                where = f"program_audit.states[{i}]"
+                if not isinstance(st, dict) or "programs" not in st \
+                        or "gate_state" not in st:
+                    problems.append(
+                        f"{where}: missing gate_state/programs"
+                    )
+                    continue
+                for j, rec in enumerate(st["programs"]):
+                    if not isinstance(rec, dict) or not {
+                        "name", "ok", "problems", "device_put",
+                        "callbacks",
+                    } <= set(rec):
+                        problems.append(
+                            f"{where}.programs[{j}]: missing "
+                            "name/ok/problems/device_put/callbacks"
+                        )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("ast_clean", "program_ok", "clean"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 #: registry bound: the attention gates are lru_cached (one record per
 #: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
 #: backend / shape) record on EVERY call — a long-lived process that

@@ -58,10 +58,37 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
-#: module-global fast path: the ONLY thing a disabled span() touches
-_ENABLED: bool = _env_flag("TMR_TRACE")
-_ANNOTATE_WANTED: bool = _env_flag("TMR_TRACE_ANNOTATE", True)
-_RING: int = max(_env_int("TMR_TRACE_RING", 8192), 16)
+#: module-global fast path: the ONLY thing a disabled span() touches.
+#: None = not yet resolved — the TMR_TRACE* knobs are read LAZILY on
+#: first use (analysis rule knob-import-time: an import-time read would
+#: freeze the knobs before a consumer process could set them); after
+#: first resolution the disabled path stays one bool check.
+_ENABLED: Optional[bool] = None
+_ANNOTATE_WANTED: Optional[bool] = None
+_RING: Optional[int] = None
+
+
+def _resolve_env_unlocked() -> None:
+    """Fill any still-unset knob from the environment. Caller MUST hold
+    ``_REG_LOCK``: an unsynchronized first-span resolve racing a
+    ``configure(enabled=True)`` could re-check ``is None`` stale and
+    overwrite the explicit setting with the env default."""
+    global _ENABLED, _ANNOTATE_WANTED, _RING
+    if _ENABLED is None:
+        _ENABLED = _env_flag("TMR_TRACE")
+    if _ANNOTATE_WANTED is None:
+        _ANNOTATE_WANTED = _env_flag("TMR_TRACE_ANNOTATE", True)
+    if _RING is None:
+        _RING = max(_env_int("TMR_TRACE_RING", 8192), 16)
+
+
+def _resolve_env() -> None:
+    """Lazy first-use resolution (an explicit :func:`configure` value is
+    never overwritten). Cost: taken only while ``_ENABLED is None`` —
+    after the first resolution the disabled span path is back to one
+    global bool check."""
+    with _REG_LOCK:
+        _resolve_env_unlocked()
 
 _REG_LOCK = threading.Lock()
 _ALL_BUFS: List["_Buf"] = []
@@ -149,6 +176,8 @@ def _buf() -> _Buf:
 
 
 def tracing_enabled() -> bool:
+    if _ENABLED is None:
+        _resolve_env()
     return _ENABLED
 
 
@@ -163,13 +192,15 @@ def configure(enabled: Optional[bool] = None,
     TMR_TRACE_RING env knobs (probes and tests flip tracing without
     re-execing). ``ring`` applies to rings created after the call."""
     global _ENABLED, _ANNOTATE_WANTED, _ANN_CLS, _RING
-    if enabled is not None:
-        _ENABLED = bool(enabled)
-    if annotate is not None:
-        _ANNOTATE_WANTED = bool(annotate)
-        _ANN_CLS = None  # re-resolve lazily
-    if ring is not None:
-        _RING = max(int(ring), 16)
+    with _REG_LOCK:  # explicit settings and lazy env resolution must
+        if enabled is not None:  # never interleave (first-span race)
+            _ENABLED = bool(enabled)
+        if annotate is not None:
+            _ANNOTATE_WANTED = bool(annotate)
+            _ANN_CLS = None  # re-resolve lazily
+        if ring is not None:
+            _RING = max(int(ring), 16)
+        _resolve_env_unlocked()  # anything not explicitly set -> env
 
 
 class _NoopSpan:
@@ -241,6 +272,8 @@ def span(name: str, trace_id: Optional[str] = None, **attrs):
     """Context manager timing one named stage. No-op (shared singleton)
     when tracing is disabled; otherwise records a complete event on exit
     and mirrors the region into ``jax.profiler.TraceAnnotation``."""
+    if _ENABLED is None:
+        _resolve_env()
     if not _ENABLED:
         return _NOOP
     return _Span(name, trace_id, attrs)
@@ -252,6 +285,8 @@ def add_span(name: str, t0: float, t1: float,
     """Record a complete event whose boundaries were stamped elsewhere
     (``time.perf_counter`` values) — queue-wait windows, batch-level
     stages attributed per request. Does not touch the nesting stack."""
+    if _ENABLED is None:
+        _resolve_env()
     if not _ENABLED:
         return
     b = _buf()

@@ -188,10 +188,18 @@ class ServeEngine:
     # -------------------------------------------------------------- sizing
     def _bound_for(self, bucket: tuple) -> int:
         """Coalescing bound for a bucket: explicit arg > TMR_SERVE_BATCH >
-        measured bench_extra winner for this image size > 4."""
+        measured bench_extra winner for this image size > 4.
+
+        ``_batch_bounds`` is touched under ``self._lock``: this runs on
+        the batcher's consumer thread while ``stats()`` iterates the
+        dict from caller threads — an unlocked insert could blow up that
+        iteration mid-walk (the lock-discipline analysis finding this
+        method used to be). The resolve itself happens outside the lock;
+        it is idempotent, so a racing double-resolve is benign."""
         size = bucket[1]
-        if size in self._batch_bounds:
-            return self._batch_bounds[size]
+        with self._lock:
+            if size in self._batch_bounds:
+                return self._batch_bounds[size]
         if self._explicit_batch is not None:
             bound = int(self._explicit_batch)
         else:
@@ -201,7 +209,8 @@ class ServeEngine:
 
                 bound = measured_bench_batch(size) or 4
         bound = max(1, bound)
-        self._batch_bounds[size] = bound
+        with self._lock:
+            self._batch_bounds[size] = bound
         return bound
 
     # -------------------------------------------------------------- submit
@@ -525,6 +534,7 @@ class ServeEngine:
     def stats(self) -> dict:
         with self._lock:
             per_device = dict(self._per_device)
+            batch_bounds = dict(self._batch_bounds)
         counters = self.counters
         return {
             **counters,
@@ -540,7 +550,6 @@ class ServeEngine:
             "devices": [str(d) for d in self.devices],
             "per_device_batches": per_device,
             "max_wait_ms": self.max_wait_ms,
-            "batch_bounds": {str(k): v
-                             for k, v in self._batch_bounds.items()},
+            "batch_bounds": {str(k): v for k, v in batch_bounds.items()},
             "donate": self.donate,
         }

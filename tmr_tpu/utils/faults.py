@@ -96,6 +96,16 @@ _SCHEDULE: Dict[str, List[FaultSpec]] = {}
 _SEED = 0
 _FIRED: List[dict] = []
 
+#: guards the mutable module state above: ``_record`` appends from the
+#: executor's load/heartbeat threads while the main thread reads
+#: ``fired()`` (chaos_probe's accounting), and a racing ``configure``
+#: must never interleave with a half-applied schedule. RLock because
+#: ``configure`` calls ``clear`` under it. The hot-path contract is
+#: untouched: with no schedule installed every hook still exits on one
+#: falsy-dict READ before any lock is reached (the lock-discipline
+#: analysis pass whitelists nothing here — all writes hold it).
+_LOCK = threading.RLock()
+
 # ambient (shard, attempt) for the code currently running — set by the
 # executor around each shard attempt, on whichever thread does the work
 _TLS = threading.local()
@@ -149,15 +159,18 @@ def configure(text: str, seed: int = 0) -> None:
     """Install a schedule (replacing any current one) and reset the fired
     log. Empty/whitespace text clears."""
     global _SEED
-    clear()
-    _SEED = seed
-    for spec in parse_schedule(text):
-        _SCHEDULE.setdefault(spec.point, []).append(spec)
+    specs = parse_schedule(text)  # parse OUTSIDE the lock: a bad
+    with _LOCK:  # schedule must not leave a half-cleared state behind
+        clear()
+        _SEED = seed
+        for spec in specs:
+            _SCHEDULE.setdefault(spec.point, []).append(spec)
 
 
 def clear() -> None:
-    _SCHEDULE.clear()
-    _FIRED.clear()
+    with _LOCK:
+        _SCHEDULE.clear()
+        _FIRED.clear()
 
 
 def active() -> bool:
@@ -203,19 +216,20 @@ def _match(point: str) -> Optional[FaultSpec]:
 
 
 def _record(spec: FaultSpec, action: str) -> None:
-    _FIRED.append(
-        {
-            "point": spec.point,
-            "shard": getattr(_TLS, "shard", None),
-            "attempt": getattr(_TLS, "attempt", None),
-            "action": action,
-        }
-    )
+    rec = {
+        "point": spec.point,
+        "shard": getattr(_TLS, "shard", None),
+        "attempt": getattr(_TLS, "attempt", None),
+        "action": action,
+    }
+    with _LOCK:
+        _FIRED.append(rec)
 
 
 def fired() -> List[dict]:
     """Log of every applied fault action (oldest first), not cleared."""
-    return list(_FIRED)
+    with _LOCK:
+        return list(_FIRED)
 
 
 def fire(point: str) -> None:
