@@ -54,6 +54,12 @@ from tmr_tpu.utils.bench_guard import scrub_cpu_tunnel_env
 
 scrub_cpu_tunnel_env()
 
+# the analytic FLOPs model lives with the devtime attribution layer now
+# (tmr_tpu/obs/devtime.py) so the live MFU accounting and this offline
+# headline share ONE denominator; re-exported here for the callers that
+# always imported it from bench
+from tmr_tpu.obs.devtime import forward_tflops_per_image  # noqa: E402,F401
+
 BATCH = int(os.environ.get("TMR_BENCH_BATCH", 4))
 IMAGE_SIZE = int(os.environ.get("TMR_BENCH_SIZE", 1024))
 CHAIN = int(os.environ.get("TMR_BENCH_CHAIN", 20))
@@ -196,49 +202,6 @@ def _emit_error(msg: str):
     except Exception:
         pass  # the error record itself must never fail to print
     print(json.dumps(rec), flush=True)
-
-
-def forward_tflops_per_image(
-    image_size: int = 1024,
-    embed_dim: int = 768,
-    depth: int = 12,
-    num_heads: int = 12,
-    n_global: int = 4,
-    window: int = 14,
-    out_chans: int = 256,
-    emb_dim: int = 512,
-    template_cap: int = 17,
-    fusion: bool = True,
-    decoder_layers: int = 1,
-) -> float:
-    """Analytic forward FLOPs (multiply+add = 2) of the fused eval program."""
-    grid = image_size // 16
-    s = grid * grid
-    d = embed_dim
-
-    # patch embed: 16x16x3 conv to D
-    fl = s * (16 * 16 * 3) * d * 2
-    # transformer blocks: qkv(3D^2) + proj(D^2) + mlp(8D^2) per token
-    fl += depth * s * 12 * d * d * 2
-    # attention: windowed blocks see `window^2` keys, global blocks all S
-    pad_grid = ((grid + window - 1) // window) * window
-    s_pad = pad_grid * pad_grid
-    fl += (depth - n_global) * 2 * s_pad * (window * window) * d * 2
-    fl += n_global * 2 * s * s * d * 2
-    # decomposed rel-pos: q x rel_h + q x rel_w einsums
-    head_dim = d // num_heads
-    fl += (depth - n_global) * 2 * s_pad * window * num_heads * head_dim * 2
-    fl += n_global * 2 * s * grid * num_heads * head_dim * 2
-    # neck: 1x1 D->256 + 3x3 256->256
-    fl += s * d * out_chans * 2 + s * 9 * out_chans * out_chans * 2
-    # detector on the 2x-upsampled grid
-    s_up = (2 * grid) ** 2
-    fl += s_up * out_chans * emb_dim * 2  # input_proj 1x1
-    fl += s_up * emb_dim * template_cap * template_cap * 2  # depthwise x-corr
-    dec_ch = 2 * emb_dim if fusion else emb_dim
-    fl += 2 * decoder_layers * s_up * 9 * dec_ch * dec_ch * 2  # 2 stacks
-    fl += s_up * dec_ch * 5 * 2  # objectness + ltrb heads
-    return fl / 1e12
 
 
 def _wait_for_backend() -> str | None:
@@ -486,6 +449,38 @@ def _run(cancel_watchdog) -> None:
         except Exception as e:
             rec["program_audit"] = {
                 "ok": False, "error": f"{type(e).__name__}: {e}"
+            }
+        _PRELIM_REC = None
+
+    # TMR_BENCH_TREND=1: embed the bench-history trajectory (committed
+    # BENCH_r0*.json + live files) as one validated bench_trend/v1
+    # record, so this round's JSON line carries whether the headline/MFU
+    # regressed against the rounds before it. Banked like
+    # stage_breakdown: a reader wedge can never cost the headline.
+    if os.environ.get("TMR_BENCH_TREND", "").lower() in (
+        "1", "true", "yes", "on"
+    ):
+        _PRELIM_REC = dict(rec)
+        try:
+            from tmr_tpu.diagnostics import validate_bench_trend
+            from tmr_tpu.utils.bench_trend import collect_bench_trend
+
+            _progress("bench_trend")
+            trend = collect_bench_trend(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            problems = validate_bench_trend(trend)
+            if problems:
+                raise ValueError(f"invalid bench_trend: {problems}")
+            rec["bench_trend"] = trend
+        except Exception as e:
+            from tmr_tpu.diagnostics import BENCH_TREND_SCHEMA
+
+            # the contractual error-record shape (validate_bench_trend
+            # accepts it): schema + error, never a bare error dict
+            rec["bench_trend"] = {
+                "schema": BENCH_TREND_SCHEMA,
+                "error": f"{type(e).__name__}: {e}",
             }
         _PRELIM_REC = None
 

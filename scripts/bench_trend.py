@@ -1,0 +1,62 @@
+"""Bench-history trend reader: the committed ``BENCH_r0*.json`` driver
+records + the live bench files, reduced to ONE ``bench_trend/v1`` JSON
+line with headline/MFU regressions between rounds flagged.
+
+The BENCH trajectory had no reader — three rounds recorded rc!=0 / 0.0
+headlines while a committed 21.07 img/s measurement existed, and nothing
+mechanical would have flagged a real regression either. This script (and
+the same document embedded per round by bench.py under
+``TMR_BENCH_TREND=1``) makes the trajectory machine-checkable: per-round
+value/mfu with provenance (measured / carried / error) and a
+relative-threshold regression scan across consecutive usable rounds.
+
+Usage:  python scripts/bench_trend.py [--repo DIR] [--threshold PCT]
+                                      [--out FILE]
+
+Exit code 1 when a regression is flagged (CI-gateable), else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmr_tpu.diagnostics import validate_bench_trend  # noqa: E402
+from tmr_tpu.utils.bench_trend import (  # noqa: E402
+    DEFAULT_THRESHOLD,
+    collect_bench_trend,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repository root holding BENCH_r*.json (default: this repo)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative drop counting as a regression "
+                         f"(default {DEFAULT_THRESHOLD})")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document to this path")
+    args = ap.parse_args(argv)
+
+    doc = collect_bench_trend(args.repo, threshold=args.threshold)
+    problems = validate_bench_trend(doc)
+    if problems:  # self-check: the emitted document must validate
+        doc["validator_problems"] = problems
+    line = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if "error" in doc:
+        return 1
+    return 1 if doc["checks"]["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,20 @@ def chained(fn, *args, rtt: float = 0.0) -> float:
     return chained_seconds_per_iter(fn, *args, iters=CHAIN, rtt=rtt)
 
 
+def attributed(fn, *args, rtt: float = 0.0) -> dict:
+    """Device-attributed split of one stage step (obs/devtime.py): a few
+    blocking calls separating host dispatch (``dispatch_s``) from
+    post-dispatch device execution (``device_s``, RTT floor removed) —
+    the chained wall numbers above deliberately conflate the two, which
+    is right for throughput but wrong for 'where did the time go'."""
+    from tmr_tpu.obs.devtime import attribute_call
+
+    fb0 = jnp.zeros((), jnp.float32)
+    rec = attribute_call(lambda: fn(*args, fb0), iters=3, rtt=rtt)
+    return {k: (round(v, 5) if isinstance(v, float) else v)
+            for k, v in rec.items()}
+
+
 def main():
     from tmr_tpu.config import preset
     from tmr_tpu.inference import Predictor
@@ -86,12 +100,19 @@ def main():
     rtt = _rtt()
     report = {"rtt_floor_ms": round(rtt * 1000, 1)}
 
+    # device-attributed seconds per stage ride alongside the chained
+    # wall numbers (see `attributed`): {stage: {dispatch_s, device_s,
+    # wall_s}} — stage numbers stop conflating host dispatch with
+    # device execution
+    report["devtime"] = {}
+
     # 1. full fused program (the production pipeline via its bench hook)
     _progress("stage 1: full fused program")
     fused = pred._get_fn(17, chain_feedback=True)
-    report["full_program"] = chained(
-        lambda im, ex, fb: fused(params, None, im, ex, fb),
-        image, exemplars, rtt=rtt,
+    step1 = lambda im, ex, fb: fused(params, None, im, ex, fb)  # noqa: E731
+    report["full_program"] = chained(step1, image, exemplars, rtt=rtt)
+    report["devtime"]["full_program"] = attributed(
+        step1, image, exemplars, rtt=rtt
     )
     _progress(f"full_program: {report['full_program']*1000:.2f} ms")
 
@@ -106,9 +127,9 @@ def main():
         f = bb.apply({"params": p}, im + fb)
         return f, jnp.sum(f).astype(jnp.float32) * 0.0
 
-    report["backbone"] = chained(
-        lambda im, fb: bb_step(bb_params, im, fb), image, rtt=rtt
-    )
+    step2 = lambda im, fb: bb_step(bb_params, im, fb)  # noqa: E731
+    report["backbone"] = chained(step2, image, rtt=rtt)
+    report["devtime"]["backbone"] = attributed(step2, image, rtt=rtt)
     _progress(f"backbone: {report['backbone']*1000:.2f} ms")
 
     # 3. one global vs one windowed transformer block (768-d, real grid),
@@ -301,6 +322,9 @@ def main():
     report[f"decode_nms_tail_n{cfg.max_detections}"] = chained(
         tail_step, *tail_inputs, rtt=rtt
     )
+    report["devtime"]["decode_nms_tail"] = attributed(
+        tail_step, *tail_inputs, rtt=rtt
+    )
 
     c_cat = cfg.emb_dim * 2 if cfg.fusion else cfg.emb_dim
     _progress(f"stage 6: decoder_heads ({c_cat}ch @ {up_hw}^2)")
@@ -309,6 +333,9 @@ def main():
         cfg.decoder_kernel_size, cfg.compute_dtype,
     )
     report["decoder_heads"] = chained(dec_step, *dec_inputs, rtt=rtt)
+    report["devtime"]["decoder_heads"] = attributed(
+        dec_step, *dec_inputs, rtt=rtt
+    )
     _progress(f"decoder_heads: {report['decoder_heads']*1000:.2f} ms")
 
     # stamp which formulations the tail stages actually traced (a

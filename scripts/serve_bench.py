@@ -218,6 +218,7 @@ def _run(cancel_watchdog, argv=None) -> int:
 
     import jax
 
+    from tmr_tpu import obs
     from tmr_tpu.config import preset
     from tmr_tpu.diagnostics import (
         SERVE_REPORT_SCHEMA,
@@ -374,6 +375,11 @@ def _run(cancel_watchdog, argv=None) -> int:
     # latency AND counter state travel in the same JSON line (validated
     # as part of validate_serve_report)
     report["metrics"] = engine.metrics_snapshot()
+    if obs.flight_enabled():
+        # TMR_FLIGHT=1: the per-program device-time / MFU attribution
+        # for everything this bench executed rides the same line
+        # (mfu_report/v1; validate_serve_report checks the attachment)
+        report["mfu"] = obs.mfu_report()
     engine.close()
     report["wall_s"] = round(time.perf_counter() - wall0, 1)
     problems = validate_serve_report(report)

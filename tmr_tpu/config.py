@@ -221,6 +221,11 @@ ENV_KNOBS = {
     "TMR_TRACE_RING": "per-thread span ring-buffer capacity",
     "TMR_TRACE_ANNOTATE": "mirror spans as jax.profiler annotations",
     "TMR_GATE_DEBUG": "print gate refusals to stderr as they happen",
+    "TMR_FLIGHT": "performance flight recorder on/off (default off): "
+        "per-program device-time/MFU attribution + request/shard ring",
+    "TMR_FLIGHT_RING": "flight-recorder ring capacity (records)",
+    "TMR_HEALTH_INTERVAL_S": "health-heartbeat JSONL write interval "
+        "seconds",
     # fault injection (tests/chaos probe)
     "TMR_FAULTS": "deterministic fault-injection schedule",
     "TMR_FAULTS_SEED": "fault-schedule RNG seed",
@@ -239,4 +244,6 @@ ENV_KNOBS = {
     "TMR_BENCH_SELFTEST_PRELIM": "bench.py self-test: force prelim emit",
     "TMR_BENCH_SIZE": "bench.py: image-size override",
     "TMR_BENCH_TINY": "bench.py: tiny CPU-geometry smoke mode",
+    "TMR_BENCH_TREND": "bench.py: embed the bench_trend/v1 history "
+        "record (1 enables)",
 }

@@ -102,12 +102,337 @@ def _validate_metrics_attachment(doc: dict) -> List[str]:
     return [f"metrics: {p}" for p in validate_metrics_report(doc["metrics"])]
 
 
+def _validate_mfu_attachment(doc: dict) -> List[str]:
+    """Shared rule for report documents carrying an optional ``mfu`` key
+    (serve_report/map_report when the flight recorder is on): when
+    present it must be a valid mfu_report/v1."""
+    if "mfu" not in doc:
+        return []
+    return [f"mfu: {p}" for p in validate_mfu_report(doc["mfu"])]
+
+
+#: schema tag of the per-program device-time / MFU accounting document
+#: (tmr_tpu/obs/devtime.py ``mfu_report()``): for every executed
+#: ``Predictor._compiled`` program — achieved FLOP/s from attributed
+#: device seconds, MFU against the platform peak, and a compute- vs
+#: memory-bound roofline classification from the program's arithmetic
+#: intensity. Attached to serve_report/map_report under ``mfu`` when
+#: ``TMR_FLIGHT=1``; scripts/obs_watch.py is the measured proof.
+MFU_REPORT_SCHEMA = "mfu_report/v1"
+
+#: closed roofline-classification vocabulary in an mfu_report/v1
+#: program record ("unknown" = no bytes-accessed figure was available,
+#: so the intensity could not be placed against the ridge)
+ROOFLINE_BOUNDS = ("compute", "memory", "unknown")
+
+#: closed cost-model provenance vocabulary: "xla" = the compiled
+#: program's own ``cost_analysis()``, "analytic" = the
+#: devtime.forward_tflops_per_image model, "none" = neither applied
+MFU_COST_SOURCES = ("xla", "analytic", "none")
+
+
+def validate_mfu_report(doc: dict) -> List[str]:
+    """Structural check of an mfu_report/v1 document; returns a list of
+    problems (empty == valid). Dependency-free like the others."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != MFU_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {MFU_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    plat = doc.get("platform")
+    if not isinstance(plat, dict):
+        problems.append("platform: not a dict")
+    else:
+        for key in ("backend", "device_kind", "peak_tflops", "peak_gbps",
+                    "peak_source"):
+            if key not in plat:
+                problems.append(f"platform: missing {key!r}")
+        pk = plat.get("peak_tflops")
+        if not isinstance(pk, (int, float)) or isinstance(pk, bool) \
+                or pk <= 0:
+            problems.append("platform.peak_tflops: not a positive number")
+    programs = doc.get("programs")
+    if not isinstance(programs, list):
+        problems.append("programs: not a list")
+        programs = []
+    for i, p in enumerate(programs):
+        where = f"programs[{i}]"
+        if not isinstance(p, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("kind", "key", "bucket", "calls", "warmup_calls",
+                    "dispatch_s", "device_s", "wall_s", "cost_source",
+                    "mfu", "bound"):
+            if key not in p:
+                problems.append(f"{where}: missing {key!r}")
+        if p.get("bound") not in ROOFLINE_BOUNDS:
+            problems.append(f"{where}: bad bound {p.get('bound')!r}")
+        if p.get("cost_source") not in MFU_COST_SOURCES:
+            problems.append(
+                f"{where}: bad cost_source {p.get('cost_source')!r}"
+            )
+        mfu = p.get("mfu")
+        if mfu is not None and (
+            not isinstance(mfu, (int, float)) or isinstance(mfu, bool)
+        ):
+            problems.append(f"{where}.mfu: not a number or null")
+    totals = doc.get("totals")
+    if not isinstance(totals, dict):
+        problems.append("totals: not a dict")
+    else:
+        for key in ("device_s", "flops", "achieved_tflops", "mfu"):
+            if key not in totals:
+                problems.append(f"totals: missing {key!r}")
+    return problems
+
+
+#: closed anomaly vocabulary the flight recorder's health watch can emit
+#: (tmr_tpu/obs/flight.py HealthWatch): recompile_storm = key-change
+#: compile events over threshold in one window; latency_regression =
+#: window p99 beyond factor x rolling baseline; queue_saturation =
+#: batcher depth over threshold; cache_hit_collapse = window hit rate
+#: collapsed vs rolling baseline; mfu_drop = window achieved FLOP/s
+#: below factor x rolling baseline.
+ANOMALY_KINDS = (
+    "recompile_storm",
+    "latency_regression",
+    "queue_saturation",
+    "cache_hit_collapse",
+    "mfu_drop",
+)
+
+
+def validate_anomaly(rec: dict) -> List[str]:
+    """Structural check of one anomaly record (gate_refused-style cause
+    record: closed-vocabulary kind + message + numeric evidence)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"not a dict: {type(rec).__name__}"]
+    if rec.get("anomaly") not in ANOMALY_KINDS:
+        problems.append(f"anomaly: bad kind {rec.get('anomaly')!r}")
+    if not isinstance(rec.get("message"), str) or not rec.get("message"):
+        problems.append("message: not a non-empty string")
+    if not isinstance(rec.get("evidence"), dict):
+        problems.append("evidence: not a dict")
+    return problems
+
+
+#: schema tag of the serving-engine health document
+#: (``ServeEngine.health()``): queue depths, per-device occupancy, cache
+#: stats, compile-event tallies, and the anomalies the health watch
+#: fired this pass — the admission-control input ROADMAP item 3
+#: consumes. The heartbeat writer appends one per interval as JSONL.
+HEALTH_REPORT_SCHEMA = "health_report/v1"
+
+
+def validate_health_report(doc: dict) -> List[str]:
+    """Structural check of a health_report/v1 document; returns a list
+    of problems (empty == valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != HEALTH_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {HEALTH_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    for key, typ in (("ts", (int, float)), ("uptime_s", (int, float)),
+                     ("closed", bool), ("inflight", int)):
+        if not isinstance(doc.get(key), typ) or (
+            typ is int and isinstance(doc.get(key), bool)
+        ):
+            problems.append(f"{key}: not a {typ}")
+    queues = doc.get("queues")
+    if not isinstance(queues, dict) or not isinstance(
+        queues.get("pending"), int
+    ) or not isinstance(queues.get("per_bucket"), dict):
+        problems.append("queues: missing pending/per_bucket")
+    if not isinstance(doc.get("devices"), list):
+        problems.append("devices: not a list")
+    if not isinstance(doc.get("per_device_batches"), dict):
+        problems.append("per_device_batches: not a dict")
+    caches = doc.get("caches")
+    if not isinstance(caches, dict):
+        problems.append("caches: not a dict")
+    else:
+        for which in ("result", "feature"):
+            sub = caches.get(which)
+            if not isinstance(sub, dict) or not all(
+                k in sub for k in ("hits", "misses", "evictions")
+            ):
+                problems.append(
+                    f"caches.{which}: missing hits/misses/evictions"
+                )
+    counters = doc.get("counters")
+    if not isinstance(counters, dict) or not all(
+        isinstance(v, (int, float)) and not isinstance(v, bool)
+        for v in counters.values()
+    ):
+        problems.append("counters: not a dict of numbers")
+    compile_rec = doc.get("compile")
+    if not isinstance(compile_rec, dict) or not all(
+        isinstance(compile_rec.get(k), int)
+        for k in ("total", "cold", "key_change")
+    ):
+        problems.append("compile: missing total/cold/key_change ints")
+    anomalies = doc.get("anomalies")
+    if not isinstance(anomalies, list):
+        problems.append("anomalies: not a list")
+    else:
+        for i, rec in enumerate(anomalies):
+            problems += [f"anomalies[{i}]: {p}" for p in
+                         validate_anomaly(rec)]
+    return problems
+
+
+#: schema tag of the flight-recorder probe document emitted by
+#: scripts/obs_watch.py: the mfu_report from a measured tiny serve
+#: workload (analytic-vs-cost_analysis FLOPs envelope checked), a
+#: validated health_report + heartbeat JSONL round-trip, injected
+#: recompile-storm and queue-saturation anomaly firings, and the
+#: disabled-mode overhead of the whole flight layer. bench_guard wraps
+#: the probe, so an error record ({"schema": ..., "error": str}) is
+#: contractually valid.
+FLIGHT_REPORT_SCHEMA = "flight_report/v1"
+
+
+def validate_flight_report(doc: dict) -> List[str]:
+    """Structural check of a flight_report/v1 document; returns a list
+    of problems (empty == valid). An error record is contractually
+    valid (the bench_guard wedge path)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != FLIGHT_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {FLIGHT_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    if not isinstance(doc.get("config"), dict):
+        problems.append("config: not a dict")
+    problems += [f"mfu: {p}" for p in validate_mfu_report(
+        doc.get("mfu") or {}
+    )]
+    problems += [f"health: {p}" for p in validate_health_report(
+        doc.get("health") or {}
+    )]
+    anomalies = doc.get("anomalies")
+    if not isinstance(anomalies, dict):
+        problems.append("anomalies: not a dict")
+    else:
+        for section in ("recompile_storm", "queue_saturation"):
+            recs = anomalies.get(section)
+            if not isinstance(recs, list):
+                problems.append(f"anomalies.{section}: not a list")
+                continue
+            for i, rec in enumerate(recs):
+                problems += [f"anomalies.{section}[{i}]: {p}"
+                             for p in validate_anomaly(rec)]
+    overhead = doc.get("overhead")
+    if not isinstance(overhead, dict):
+        problems.append("overhead: not a dict")
+    else:
+        for key in ("disabled_ns_per_check", "overhead_disabled_pct"):
+            if not isinstance(overhead.get(key), (int, float)):
+                problems.append(f"overhead: missing {key!r}")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("mfu_finite", "flops_envelope_ok", "health_valid",
+                    "heartbeat_roundtrip", "storm_exact", "queue_exact",
+                    "overhead_ok"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
+#: schema tag of the bench-history trend document emitted by
+#: scripts/bench_trend.py (core reader in tmr_tpu/utils/bench_trend.py):
+#: the committed BENCH_r0*.json driver records plus the live bench
+#: files, reduced to one headline/MFU trajectory with regressions
+#: between measured rounds flagged. bench.py embeds one per round
+#: behind TMR_BENCH_TREND=1.
+BENCH_TREND_SCHEMA = "bench_trend/v1"
+
+#: closed per-round provenance vocabulary in a bench_trend/v1 document:
+#: "measured" = the round's probe produced its own number, "carried" =
+#: the record promoted an older committed measurement (bench.py's
+#: ``carried: true`` outage path), "error" = no usable number at all.
+BENCH_TREND_SOURCES = ("measured", "carried", "error")
+
+
+def validate_bench_trend(doc: dict) -> List[str]:
+    """Structural check of a bench_trend/v1 document; returns a list of
+    problems (empty == valid). An error record ({"schema": ...,
+    "error": str}) is contractually valid."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != BENCH_TREND_SCHEMA:
+        problems.append(
+            f"schema != {BENCH_TREND_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    rounds = doc.get("rounds")
+    if not isinstance(rounds, list) or not rounds:
+        problems.append("rounds: not a non-empty list")
+        rounds = []
+    for i, r in enumerate(rounds):
+        where = f"rounds[{i}]"
+        if not isinstance(r, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("label", "source", "value", "mfu"):
+            if key not in r:
+                problems.append(f"{where}: missing {key!r}")
+        if r.get("source") not in BENCH_TREND_SOURCES:
+            problems.append(f"{where}: bad source {r.get('source')!r}")
+        for key in ("value", "mfu"):
+            v = r.get(key)
+            if v is not None and (
+                not isinstance(v, (int, float)) or isinstance(v, bool)
+            ):
+                problems.append(f"{where}.{key}: not a number or null")
+    regs = doc.get("regressions")
+    if not isinstance(regs, list):
+        problems.append("regressions: not a list")
+        regs = []
+    for i, r in enumerate(regs):
+        where = f"regressions[{i}]"
+        if not isinstance(r, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for key in ("field", "from_label", "to_label", "before", "after",
+                    "drop_pct"):
+            if key not in r:
+                problems.append(f"{where}: missing {key!r}")
+        if r.get("field") not in ("value", "mfu"):
+            problems.append(f"{where}: bad field {r.get('field')!r}")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("measured_rounds", "regressed"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 def validate_map_report(doc: dict) -> List[str]:
     """Structural check of a map_report/v1 document; returns a list of
     problems (empty == valid). Dependency-free so CI harnesses can gate on
     the report without importing the extraction stack."""
     problems: List[str] = []
     problems += _validate_metrics_attachment(doc)
+    problems += _validate_mfu_attachment(doc)
     if doc.get("schema") != MAP_REPORT_SCHEMA:
         problems.append(f"schema != {MAP_REPORT_SCHEMA}: {doc.get('schema')!r}")
     shards = doc.get("shards")
@@ -168,6 +493,7 @@ def validate_serve_report(doc: dict) -> List[str]:
     ({"schema": ..., "error": str}) is contractually valid."""
     problems: List[str] = []
     problems += _validate_metrics_attachment(doc)
+    problems += _validate_mfu_attachment(doc)
     if doc.get("schema") != SERVE_REPORT_SCHEMA:
         problems.append(
             f"schema != {SERVE_REPORT_SCHEMA}: {doc.get('schema')!r}"

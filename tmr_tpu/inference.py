@@ -27,7 +27,7 @@ import flax.linen as nn
 
 from tmr_tpu.models import build_model
 from tmr_tpu.models.matching_net import select_capacity_bucket
-from tmr_tpu.obs import track_compile
+from tmr_tpu.obs import track_compile, track_devtime
 from tmr_tpu.ops.postprocess import (
     batched_nms,
     compact_detections,
@@ -210,9 +210,15 @@ class Predictor:
 
         # compile-event accounting (obs/compile.py): the first call of
         # every fresh cache entry records (key, wall, cold|key-change) —
-        # recompile storms become visible events instead of latency cliffs
-        run = track_compile(run, "single", key,
-                            bucket={"capacity": capacity})
+        # recompile storms become visible events instead of latency
+        # cliffs. The devtime wrapper outside it (obs/devtime.py) is the
+        # flight recorder's per-execution device-time attribution seam;
+        # with TMR_FLIGHT=0 (default) it is one bool check.
+        run = track_devtime(
+            track_compile(run, "single", key,
+                          bucket={"capacity": capacity}),
+            "single", key, bucket={"capacity": capacity},
+        )
         self._compiled[key] = run
         return run
 
@@ -361,9 +367,13 @@ class Predictor:
             )
             return losses, final
 
-        run = track_compile(run, "multi", key,
-                            bucket={"capacity": capacity,
-                                    "k_bucket": k_bucket})
+        run = track_devtime(
+            track_compile(run, "multi", key,
+                          bucket={"capacity": capacity,
+                                  "k_bucket": k_bucket}),
+            "multi", key, bucket={"capacity": capacity,
+                                  "k_bucket": k_bucket},
+        )
         self._compiled[key] = run
         return run
 
@@ -462,9 +472,13 @@ class Predictor:
                 refiner_params, refine,
             )
 
-        run = track_compile(run, "multi_batched", key,
-                            bucket={"capacity": capacity,
-                                    "k_bucket": k_bucket})
+        run = track_devtime(
+            track_compile(run, "multi_batched", key,
+                          bucket={"capacity": capacity,
+                                  "k_bucket": k_bucket}),
+            "multi_batched", key, bucket={"capacity": capacity,
+                                          "k_bucket": k_bucket},
+        )
         self._compiled[key] = run
         return run
 
@@ -506,7 +520,8 @@ class Predictor:
                 f = f[0]
             return f
 
-        run = track_compile(run, "backbone", key)
+        run = track_devtime(track_compile(run, "backbone", key),
+                            "backbone", key)
         self._compiled[key] = run
         return run
 
@@ -543,9 +558,13 @@ class Predictor:
                 refiner_params, refine,
             )
 
-        run = track_compile(run, "heads", key,
-                            bucket={"capacity": capacity,
-                                    "image_size": image_size})
+        run = track_devtime(
+            track_compile(run, "heads", key,
+                          bucket={"capacity": capacity,
+                                  "image_size": image_size}),
+            "heads", key, bucket={"capacity": capacity,
+                                  "image_size": image_size},
+        )
         self._compiled[key] = run
         return run
 

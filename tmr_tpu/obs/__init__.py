@@ -3,20 +3,42 @@
 The observability layer every serving/map/train component records into —
 see tracing.py (request-scoped spans -> Chrome trace JSON + xprof
 TraceAnnotations, zero-cost under ``TMR_TRACE=0``), metrics.py (named
-counters/gauges/histograms, ``metrics_report/v1`` snapshots), and
-compile.py (per-trace/compile events with cold vs key-change causes).
-``scripts/obs_probe.py`` is the measured proof; QUICKSTART_RUN.md
-"Observability" documents the knobs. Import-light on purpose: nothing
-here imports jax at module load, so any layer (ops, data, utils) can
-instrument itself.
+counters/gauges/histograms, ``metrics_report/v1`` snapshots),
+compile.py (per-trace/compile events with cold vs key-change causes),
+devtime.py (per-program device-time attribution + MFU/roofline
+accounting, ``mfu_report/v1``), and flight.py (the ``TMR_FLIGHT``
+recorder ring, the anomaly-detecting HealthWatch, and the health
+heartbeat). ``scripts/obs_probe.py`` and ``scripts/obs_watch.py`` are
+the measured proofs; QUICKSTART_RUN.md "Observability" and
+"Performance accounting & health watch" document the knobs.
+Import-light on purpose: nothing here imports jax at module load, so
+any layer (ops, data, utils) can instrument itself.
 """
 
 from tmr_tpu.obs.compile import (
+    compile_event_seq,
     compile_events,
+    compile_events_since,
     drain_compile_events,
     record_compile_event,
     track_compile,
 )
+from tmr_tpu.obs.devtime import (
+    attribute_call,
+    forward_tflops_per_image,
+    mfu_report,
+    platform_peak,
+    track_devtime,
+)
+from tmr_tpu.obs.flight import (
+    FlightRecorder,
+    Heartbeat,
+    HealthWatch,
+    flight_enabled,
+)
+from tmr_tpu.obs.flight import configure as flight_configure
+from tmr_tpu.obs.flight import get_recorder as flight_recorder
+from tmr_tpu.obs.flight import record as flight_record
 from tmr_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -42,25 +64,39 @@ from tmr_tpu.obs.tracing import (
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "HealthWatch",
+    "Heartbeat",
     "Histogram",
     "MetricsRegistry",
     "add_span",
+    "attribute_call",
     "chrome_trace",
     "clear",
+    "compile_event_seq",
     "compile_events",
+    "compile_events_since",
     "configure",
     "counter",
     "drain_compile_events",
     "dropped_spans",
+    "flight_configure",
+    "flight_enabled",
+    "flight_record",
+    "flight_recorder",
+    "forward_tflops_per_image",
     "gauge",
     "get_registry",
     "histogram",
+    "mfu_report",
     "new_trace_id",
+    "platform_peak",
     "record_compile_event",
     "save_chrome_trace",
     "span",
     "spans",
     "tracing_enabled",
     "track_compile",
+    "track_devtime",
 ]

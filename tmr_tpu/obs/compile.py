@@ -41,6 +41,11 @@ _MAX_EVENTS = 512
 
 _LOCK = threading.Lock()
 _EVENTS: List[dict] = []
+#: monotonic event sequence (never trimmed, never drained): consumers
+#: that window the log (ServeEngine.health's recompile-storm detector)
+#: key on it instead of list offsets — an absolute index goes blind the
+#: moment the bounded log trims or another harness drains it
+_SEQ = 0
 #: kind -> set of key reprs ever compiled: the cause is decided per
 #: (kind, key) — a second Predictor re-compiling an already-seen key is
 #: "cold" (expected instance warmup), only a genuinely NEW key of a
@@ -51,17 +56,20 @@ _SEEN_KEYS: dict = {}
 def record_compile_event(kind: str, key: Any, t0: float, t1: float,
                          bucket: Optional[dict] = None) -> dict:
     """Record one trace/compile occurrence; returns the event record."""
+    global _SEQ
     key_repr = repr(key)
     with _LOCK:
         seen = _SEEN_KEYS.setdefault(kind, set())
         cause = "key-change" if (seen and key_repr not in seen) else "cold"
         seen.add(key_repr)
+        _SEQ += 1
         rec = {
             "kind": kind,
             "key": key_repr,
             "bucket": dict(bucket or {}),
             "wall_s": t1 - t0,
             "cause": cause,
+            "seq": _SEQ,
         }
         _EVENTS.append(rec)
         if len(_EVENTS) > _MAX_EVENTS:
@@ -80,6 +88,23 @@ def compile_events() -> List[dict]:
     """Snapshot of recorded events (oldest first), not cleared."""
     with _LOCK:
         return [dict(e) for e in _EVENTS]
+
+
+def compile_event_seq() -> int:
+    """The latest event's monotonic sequence number (0 = none ever) —
+    the cursor a windowing consumer snapshots at construction."""
+    with _LOCK:
+        return _SEQ
+
+
+def compile_events_since(seq: int):
+    """``(events with .seq > seq, latest seq)`` — the cursor-based
+    window read. Unlike slicing :func:`compile_events` by offset, this
+    keeps working after the bounded log trims its head or a harness
+    drains it (events that rolled off before being read are simply
+    missed; the returned cursor still advances past them)."""
+    with _LOCK:
+        return [dict(e) for e in _EVENTS if e["seq"] > seq], _SEQ
 
 
 def drain_compile_events() -> List[dict]:

@@ -350,7 +350,7 @@ class MapReport:
             "retries": sum(max(r["attempts"] - 1, 0) for r in shards),
             "wall_s": sum(r["wall_s"] for r in shards),
         }
-        return {
+        doc = {
             "schema": MAP_REPORT_SCHEMA,
             "shards": shards,
             "quarantined": [
@@ -362,6 +362,13 @@ class MapReport:
             "totals": totals,
             "metrics": obs.get_registry().snapshot(),
         }
+        if obs.flight_enabled():
+            # the flight recorder's device-time attribution for every
+            # program this run executed, as one mfu_report/v1 — the map
+            # phase's achieved-FLOP/s accounting rides its own report
+            # (validate_map_report validates the attachment)
+            doc["mfu"] = obs.mfu_report()
+        return doc
 
     def write(self, path: str) -> None:
         doc = self.document()
@@ -776,6 +783,12 @@ def _run_stream_impl(
         reg.counter("map.shards_ok" if status == "ok"
                     else "map.shards_quarantined").inc()
         reg.histogram("map.shard_wall_s").observe(wall)
+        if obs.flight_enabled():  # one bool check when off
+            obs.flight_record(
+                "map.shard", shard=shard_base, status=status,
+                attempts=task.attempt + 1, images=n_images,
+                nonfinite_images=nonfinite, wall_s=round(wall, 6),
+            )
         if status == "ok":
             reg.counter("map.images").inc(n_images)
             reg.counter("map.nonfinite_images").inc(nonfinite)

@@ -157,6 +157,13 @@ class MicroBatcher:
         with self._cond:
             return sum(len(d) for d in self._pending.values())
 
+    def depth_snapshot(self) -> Dict[tuple, int]:
+        """Per-bucket queue depths right now — the health report's
+        queue evidence (``ServeEngine.health()``)."""
+        with self._cond:
+            return {bucket: len(dq)
+                    for bucket, dq in self._pending.items()}
+
     def occupancy_snapshot(self) -> Dict[int, int]:
         with self._cond:
             return dict(self.occupancy)

@@ -161,6 +161,18 @@ class ServeEngine:
         self._lock = threading.Lock()
         self._inflight: Dict[tuple, Request] = {}
         self._closed = False
+        self._t_start = time.time()
+        #: anomaly detector fed by health() passes (obs/flight.py);
+        #: default thresholds — probes construct their own HealthWatch
+        #: when they need injectable ones
+        self._watch = obs.HealthWatch()
+        # detection windows start NOW: compile events a warm process
+        # paid before this engine existed (autotune sweeps, a prior
+        # engine) must not fire a spurious storm on the first health()
+        # pass. A monotonic sequence cursor, not a list offset — the
+        # bounded event log trims and other harnesses drain it.
+        self._compile_seen = obs.compile_event_seq()
+        self._heartbeat = None
         self._m = {
             name: self.metrics.counter(f"serve.{name}")
             for name in _COUNTER_NAMES
@@ -452,6 +464,15 @@ class ServeEngine:
                     obs.add_span("serve.resolve", t_res0, t_res1,
                                  trace_id=tid, futures=len(req.futures))
                 self._lat.observe(t_res1 - req.t_submit)
+                if obs.flight_enabled():  # one bool check when off
+                    obs.flight_record(
+                        "serve.request", bucket=str(staged.bucket),
+                        latency_s=round(t_res1 - req.t_submit, 6),
+                        batch=len(staged.requests),
+                        padded=staged.padded_slots,
+                        device=str(staged.device),
+                        futures=len(req.futures),
+                    )
                 # per FUTURE, not per request: coalesced duplicates
                 # counted into `submitted` must land in a terminal
                 # bucket too, or submitted - (completed+errors+rejected)
@@ -499,6 +520,85 @@ class ServeEngine:
             if self._inflight.get(req.result_key) is req:
                 del self._inflight[req.result_key]
 
+    # --------------------------------------------------------------- health
+    def health(self) -> dict:
+        """One validated ``health_report/v1`` snapshot of the engine:
+        queue depths, per-device occupancy, cache stats, compile-event
+        tallies, and the anomalies the health watch fired on this pass
+        (detector state advances per call — the heartbeat's interval IS
+        the detection window). This is the admission-control input
+        ROADMAP item 3 consumes; ``start_heartbeat`` appends it to a
+        JSONL file on an interval."""
+        from tmr_tpu.diagnostics import HEALTH_REPORT_SCHEMA
+        from tmr_tpu.obs import devtime
+
+        with self._lock:
+            new_events, self._compile_seen = obs.compile_events_since(
+                self._compile_seen
+            )
+            per_device = dict(self._per_device)
+            batch_bounds = dict(self._batch_bounds)
+            inflight = len(self._inflight)
+            closed = self._closed
+        pending = self._batcher.pending()
+        anomalies = self._watch.observe(
+            self.metrics.snapshot(),
+            compile_events=new_events,
+            pending=pending,
+            mfu_totals=(devtime.totals() if obs.flight_enabled()
+                        else None),
+        )
+        now = time.time()
+        # lifetime tallies from the monotone registry counters (exact;
+        # the in-process event log is bounded and would undercount) —
+        # `recent` is the bounded log's tail, for human eyes
+        reg = obs.get_registry()
+        recent = obs.compile_events()[-8:]
+        return {
+            "schema": HEALTH_REPORT_SCHEMA,
+            "ts": now,
+            "uptime_s": round(now - self._t_start, 3),
+            "closed": closed,
+            "inflight": inflight,
+            "queues": {
+                "pending": pending,
+                "per_bucket": {
+                    str(k): v
+                    for k, v in self._batcher.depth_snapshot().items()
+                },
+            },
+            "devices": [str(d) for d in self.devices],
+            "per_device_batches": per_device,
+            "batch_bounds": {str(k): v for k, v in batch_bounds.items()},
+            "max_wait_ms": self.max_wait_ms,
+            "caches": {
+                "result": self.result_cache.stats(),
+                "feature": self.feature_cache.stats(),
+            },
+            "counters": self.counters,
+            "compile": {
+                "total": int(reg.counter("compile.total").value),
+                "cold": int(reg.counter("compile.cold").value),
+                "key_change": int(
+                    reg.counter("compile.key_change").value
+                ),
+                "recent": recent,
+            },
+            "anomalies": anomalies,
+        }
+
+    def start_heartbeat(self, path: str,
+                        interval_s: Optional[float] = None):
+        """Append :meth:`health` to ``path`` as JSONL every
+        ``interval_s`` seconds (default ``TMR_HEALTH_INTERVAL_S``).
+        Returns the obs.Heartbeat; :meth:`close` stops it."""
+        hb = obs.Heartbeat(self.health, path, interval_s=interval_s)
+        with self._lock:
+            old, self._heartbeat = self._heartbeat, hb
+        if old is not None:
+            old.stop()
+        return hb
+
     # ------------------------------------------------------------ lifecycle
     def close(self, timeout: float = 300.0) -> None:
         """Drain pending requests and stop the pipeline threads."""
@@ -506,6 +606,9 @@ class ServeEngine:
             if self._closed:
                 return
             self._closed = True
+            hb, self._heartbeat = self._heartbeat, None
+        if hb is not None:
+            hb.stop()
         self._batcher.close()
         for t in self._threads:
             t.join(timeout=timeout)

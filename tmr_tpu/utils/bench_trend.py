@@ -1,0 +1,147 @@
+"""Reader for the committed bench-history trajectory.
+
+The driver has appended one ``BENCH_r0N.json`` record per round since
+round 1, and the watcher commits ``BENCH_LIVE.json`` when the tunnel
+serves — but until this module the trajectory had no reader at all: a
+regression between rounds was something a human noticed (or did not).
+:func:`collect_bench_trend` reduces the history to one validated
+``bench_trend/v1`` document — per-round headline img/s + MFU with
+provenance (measured / carried / error, matching bench.py's
+``carried: true`` outage promotion) and regressions between consecutive
+usable rounds flagged against a relative threshold.
+
+``scripts/bench_trend.py`` is the CLI; bench.py embeds the document per
+round behind ``TMR_BENCH_TREND=1`` (banked like stage_breakdown, so a
+reader wedge can never cost the headline).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import List, Optional
+
+from tmr_tpu.diagnostics import BENCH_TREND_SCHEMA
+
+#: default relative drop between consecutive usable rounds that counts
+#: as a regression (a 21.1 -> 19.9 headline is a flag; measurement
+#: jitter at the chained-methodology noise floor is not)
+DEFAULT_THRESHOLD = 0.05
+
+
+def _round_entry(label: str, doc: Optional[dict]) -> dict:
+    """One trajectory entry from a driver record's ``parsed`` payload
+    (or a live bench record). Provenance: "measured" = the probe's own
+    number; "carried" = an older committed measurement promoted through
+    an outage record (bench.py ``carried: true`` / the pre-PR-1
+    ``last_committed_live`` shape); "error" = no usable number."""
+    rec = {"label": label, "value": None, "mfu": None, "source": "error",
+           "error": None}
+    if not isinstance(doc, dict):
+        return rec
+    rec["error"] = doc.get("error")
+    carried_rec = doc.get("last_committed_live") or doc.get(
+        "last_live_uncommitted"
+    )
+    value = doc.get("value")
+    if value:
+        rec["value"] = float(value)
+        rec["mfu"] = doc.get("mfu")
+        if doc.get("carried") or "error" in doc:
+            rec["source"] = "carried"
+        else:
+            rec["source"] = "measured"
+        if rec["mfu"] is None and isinstance(carried_rec, dict):
+            rec["mfu"] = carried_rec.get("mfu")
+        return rec
+    # pre-promotion outage shape: value 0.0 but a carried record exists
+    if isinstance(carried_rec, dict) and carried_rec.get("value"):
+        rec["value"] = float(carried_rec["value"])
+        rec["mfu"] = carried_rec.get("mfu")
+        rec["source"] = "carried"
+    return rec
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def collect_bench_trend(repo_dir: str,
+                        threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Read ``BENCH_r*.json`` + the live bench files under ``repo_dir``
+    and return the ``bench_trend/v1`` document."""
+    rounds: List[dict] = []
+    numbered = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        # strict name match: a stray BENCH_rerun.json must be skipped,
+        # not crash the one-JSON-line contract
+        m = re.fullmatch(r"BENCH_(r(\d+))\.json", os.path.basename(path))
+        if m:
+            numbered.append((int(m.group(2)), m.group(1), path))
+    for _n, label, path in sorted(numbered):
+        doc = _read_json(path)
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        entry = _round_entry(label, parsed)
+        if isinstance(doc, dict):
+            entry["rc"] = doc.get("rc")
+        rounds.append(entry)
+
+    live = None
+    # the watcher's working-tree bench_live.json (newest, uncommitted)
+    # wins over the committed BENCH_LIVE.json when both are readable —
+    # the same preference order bench.py's carry path applies
+    for name in ("bench_live.json", "BENCH_LIVE.json"):
+        doc = _read_json(os.path.join(repo_dir, name))
+        if isinstance(doc, dict) and doc.get("value") and \
+                "error" not in doc:
+            live = _round_entry(name, doc)
+            break
+    if live is not None:
+        rounds.append(live)
+
+    if not rounds:
+        return {
+            "schema": BENCH_TREND_SCHEMA,
+            "error": f"no BENCH_r*.json or live bench records under "
+                     f"{repo_dir}",
+        }
+
+    regressions: List[dict] = []
+    for field in ("value", "mfu"):
+        prev = None
+        for entry in rounds:
+            cur = entry.get(field)
+            if cur is None or entry["source"] == "error":
+                continue
+            if prev is not None and prev[1] > 0 \
+                    and cur < prev[1] * (1.0 - threshold):
+                regressions.append({
+                    "field": field,
+                    "from_label": prev[0],
+                    "to_label": entry["label"],
+                    "before": prev[1],
+                    "after": cur,
+                    "drop_pct": round(
+                        (prev[1] - cur) / prev[1] * 100.0, 2
+                    ),
+                })
+            prev = (entry["label"], cur)
+
+    measured = sum(1 for r in rounds if r["source"] == "measured")
+    return {
+        "schema": BENCH_TREND_SCHEMA,
+        "threshold": threshold,
+        "rounds": rounds,
+        "regressions": regressions,
+        "checks": {
+            "rounds_read": len(rounds),
+            "measured_rounds": measured,
+            "regressed": bool(regressions),
+        },
+    }
