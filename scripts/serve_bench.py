@@ -105,16 +105,19 @@ def _sequential_throughput(pred, requests, iters: int = 1) -> float:
     return len(requests) * iters / dt
 
 
-def _timed_submit(engine, img, ex, lat: list):
+def _timed_submit(engine, img, ex, lat: list, deadline_ms=None):
     """Submit with resolution-time latency capture: the done-callback
     stamps the clock WHEN the future resolves — awaiting futures in
     submission order afterwards would credit early requests with the whole
-    tail of the run."""
+    tail of the run. Only successful resolutions enter the latency
+    sample: a rejection/shed resolves in microseconds and would
+    deflate the percentiles of the traffic that was actually served."""
     ts = time.perf_counter()
-    f = engine.submit(img, ex)
-    f.add_done_callback(lambda _f, _ts=ts: lat.append(
-        time.perf_counter() - _ts
-    ))
+    f = engine.submit(img, ex, deadline_ms=deadline_ms)
+    f.add_done_callback(
+        lambda _f, _ts=ts: lat.append(time.perf_counter() - _ts)
+        if _f.exception() is None else None
+    )
     return f
 
 
@@ -132,8 +135,12 @@ def _closed_loop(engine, requests, waves: bool = False):
     return len(results) / dt, lat, results
 
 
-def _open_loop(engine, requests, rate: float):
-    """Fixed-rate arrivals at ``rate`` img/s; returns (tput, [latency_s])."""
+def _open_loop(engine, requests, rate: float, deadline_ms=None):
+    """Fixed-rate arrivals at ``rate`` img/s; returns (served_tput,
+    [latency_s], served_count). Open-loop clients are NOT infinitely
+    patient anymore: with admission/deadlines in play a future may
+    resolve with a structured RejectedError — tallied by the engine's
+    overload counters (attached to the workload record), not a crash."""
     period = 1.0 / rate
     lat: list = []
     futs = []
@@ -143,11 +150,17 @@ def _open_loop(engine, requests, rate: float):
         delay = target - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        futs.append(_timed_submit(engine, img, ex, lat))
+        futs.append(_timed_submit(engine, img, ex, lat,
+                                  deadline_ms=deadline_ms))
+    served = 0
     for f in futs:
-        f.result(timeout=600)
+        try:
+            f.result(timeout=600)
+            served += 1
+        except Exception:
+            pass  # rejection/shed: counted via engine.overload_counters
     dt = time.perf_counter() - t0
-    return len(futs) / dt, lat
+    return served / dt, lat, served
 
 
 def _workload_record(name, mode, n, tput, lat_s, engine, occ0, cache0):
@@ -208,6 +221,10 @@ def _run(cancel_watchdog, argv=None) -> int:
     ap.add_argument("--rates", default=None,
                     help="comma-separated open-loop offered loads (img/s); "
                          "default: 0.4x and 0.8x of measured closed-loop")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline for the open-loop sweep "
+                         "(finite patience; default: none, the PR 3 "
+                         "behavior)")
     args = ap.parse_args(argv)
 
     tiny = args.tiny or os.environ.get("TMR_BENCH_TINY", "") not in (
@@ -339,10 +356,23 @@ def _run(cancel_watchdog, argv=None) -> int:
                 small_ex,
             ))
         occ0, cache0 = _snapshots(engine)
-        o_tput, o_lat = _open_loop(engine, reqs, rate)
+        ov0 = engine.overload_counters()
+        o_tput, o_lat, served = _open_loop(engine, reqs, rate,
+                                           deadline_ms=args.deadline_ms)
         rec = _workload_record(f"open_rate_{rate}", "open", n_open, o_tput,
                                o_lat, engine, occ0, cache0)
         rec["offered_img_per_sec"] = rate
+        # admission/shed/degrade deltas for THIS round — overload rounds
+        # in a trend sweep stay interpretable (zeros with default knobs)
+        ov1 = engine.overload_counters()
+        rejected = ov1["admit_rejected"] - ov0["admit_rejected"]
+        rec["admission"] = {
+            "rejected": rejected,
+            "shed": ov1["shed"] - ov0["shed"],
+            "degraded": ov1["degraded"] - ov0["degraded"],
+            "served": served,
+            "reject_rate": round(rejected / max(n_open, 1), 4),
+        }
         report["workloads"].append(rec)
         if low_rate_p99 is None:
             low_rate_p99 = rec["latency_ms"]["p99"]

@@ -1,0 +1,557 @@
+"""Overload machinery (tmr_tpu/serve admission/degrade + engine wiring):
+bounded admission with structured rejections, class-weighted priority
+pops, deadline shedding before device work, the degrade ladder's
+exactness contract, and the bounded close() drain.
+
+Pipeline-behavior tests run against a stub predictor (instant host
+"programs", no jit): the mechanics under test are queues, locks, and
+accounting — the real-program path is proven end to end by
+scripts/overload_probe.py (tests/test_overload_probe.py smoke).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+SIZE = 32
+
+SMALL_EX = np.asarray([[0.45, 0.45, 0.53, 0.55]], np.float32)
+MULTI_EX = np.asarray(
+    [[0.45, 0.45, 0.53, 0.55], [0.2, 0.2, 0.28, 0.3],
+     [0.6, 0.55, 0.68, 0.66]], np.float32,
+)
+
+
+def _img(seed, size=SIZE):
+    return np.random.default_rng(seed).standard_normal(
+        (size, size, 3)
+    ).astype(np.float32)
+
+
+class _StubPredictor:
+    """Predictor stand-in: host-only bucket keys and instant tiny
+    'programs' — exercises the serve pipeline's threading/accounting
+    without any XLA compile. ``gate`` (a threading.Event) stalls the
+    single-path program until set: the wedged-device stand-in."""
+
+    def __init__(self, gate=None, delay_s: float = 0.0):
+        self.params = np.zeros((1,), np.float32)
+        self.refiner_params = None
+        self.gate = gate
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def bucket_key(self, size, ex, multi=False, k_real=None):
+        ex = np.asarray(ex, np.float32).reshape(-1, 4)
+        k = int(k_real) if k_real is not None else len(ex)
+        if multi:
+            return ("multi", int(size), 9, k)
+        return ("single", int(size), 9, len(ex))
+
+    def _dets(self, b):
+        return {"boxes": np.zeros((b, 8, 4), np.float32),
+                "scores": np.zeros((b, 8), np.float32),
+                "refs": np.zeros((b, 8, 2), np.float32),
+                "valid": np.zeros((b, 8), bool)}
+
+    def _run(self, b):
+        if self.gate is not None:
+            self.gate.wait()
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.calls += 1
+        return self._dets(b)
+
+    def _get_fn(self, capacity, donate=False):
+        return lambda p, rp, image, ex, *a: self._run(image.shape[0])
+
+    def _get_multi_batched_fn(self, capacity, k, donate=False):
+        return lambda p, rp, image, ex, k_real: self._run(image.shape[0])
+
+    def _get_backbone_fn(self):
+        return lambda p, image: np.zeros(
+            (image.shape[0], 2, 2, 4), np.float32
+        )
+
+    def _get_heads_fn(self, capacity, size):
+        return lambda p, rp, feats, ex: self._run(
+            np.asarray(feats).shape[0]
+        )
+
+    def __call__(self, image, exemplars):
+        return self._run(1)
+
+    def predict_multi_exemplar(self, image, exemplars, k_real=None):
+        return self._run(1)
+
+
+def _engine(pred=None, **kw):
+    from tmr_tpu.serve import ServeEngine
+
+    kw.setdefault("batch", 1)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("feature_cache", 0)
+    return ServeEngine(pred or _StubPredictor(), **kw)
+
+
+# ---------------------------------------------------------- RejectedError
+def test_rejected_error_fields_and_record():
+    from tmr_tpu.serve import REJECTION_CAUSES, RejectedError
+
+    e = RejectedError("queue_full", "full", priority=2,
+                      retry_after_s=1.23456)
+    assert e.cause == "queue_full" and e.priority == 2
+    assert e.retry_after_s == 1.235  # rounded hint
+    rec = e.record()
+    assert rec["cause"] in REJECTION_CAUSES
+    assert rec["retry_after_s"] == 1.235 and rec["message"] == "full"
+    assert isinstance(e, RuntimeError)  # catchable as a plain error
+    with pytest.raises(AssertionError):
+        RejectedError("bogus_cause", "x")
+
+
+# ----------------------------------------------------- admission controller
+def test_admission_bounds_trip_and_release():
+    from tmr_tpu.serve import AdmissionController
+
+    ctl = AdmissionController(enabled=True, max_pending=2)
+    assert ctl.try_admit(0) is None
+    assert ctl.try_admit(0) is None
+    rej = ctl.try_admit(0)
+    assert rej is not None and rej.cause == "queue_full"
+    assert rej.retry_after_s is not None and rej.retry_after_s > 0
+    ctl.release_class(0)
+    assert ctl.try_admit(0) is None  # the slot came back
+    s = ctl.stats()
+    assert s["in_system"] == 2 and s["rejected"]["queue_full"] == 1
+
+
+def test_admission_per_class_bounds_and_idempotent_release():
+    from tmr_tpu.serve import AdmissionController, Request
+
+    # class 0 bound 1, class >= 1 bound 4 (last entry reused)
+    ctl = AdmissionController(enabled=True, max_pending=8,
+                              class_pending=(1, 4))
+    assert ctl.try_admit(0) is None
+    rej = ctl.try_admit(0)
+    assert rej is not None and rej.cause == "class_limit"
+    assert rej.priority == 0
+    assert ctl.try_admit(1) is None  # its own class bound
+    req = Request(image=None, exemplars=None, bucket=("x",), priority=1)
+    req.admitted = True
+    ctl.release(req)
+    ctl.release(req)  # idempotent: a double terminal event is a no-op
+    assert ctl.stats()["in_system"] == 1
+    # disabled controller: always admits, never counts
+    off = AdmissionController(enabled=False, max_pending=0)
+    assert off.try_admit(0) is None
+    off.release(req)
+
+
+def test_admission_token_bucket_rate_limit():
+    from tmr_tpu.serve import AdmissionController
+
+    ctl = AdmissionController(enabled=True, max_pending=100,
+                              rate=0.001, burst=1)
+    assert ctl.try_admit(0) is None  # burst token
+    rej = ctl.try_admit(0)  # bucket dry, refill is ~forever
+    assert rej is not None and rej.cause == "rate_limited"
+    assert rej.retry_after_s > 0
+
+
+def test_class_weight_fn_parsing():
+    from tmr_tpu.serve import class_weight_fn
+    from tmr_tpu.serve.admission import parse_class_weights
+
+    w = class_weight_fn("")  # default doubling ladder
+    assert (w(0), w(1), w(3)) == (1.0, 2.0, 8.0)
+    assert w(99) == 8.0  # beyond the list reuses the last entry
+    assert parse_class_weights("1, 10") == (1.0, 10.0)
+    # garbage / non-positive specs fall back to the default
+    assert parse_class_weights("a,b") == (1.0, 2.0, 4.0, 8.0)
+    assert parse_class_weights("0,-1") == (1.0, 2.0, 4.0, 8.0)
+
+
+# ------------------------------------------------------ priority batching
+def test_batcher_pops_highest_class_first_fifo_within_class():
+    from tmr_tpu.serve import MicroBatcher, Request, class_weight_fn
+
+    b = MicroBatcher(max_wait_ms=5000, bound_for=lambda bucket: 3,
+                     class_weight=class_weight_fn(""))
+    lo1 = Request(image=1, exemplars=None, bucket=("x",), priority=0)
+    hi = Request(image=2, exemplars=None, bucket=("x",), priority=5)
+    lo2 = Request(image=3, exemplars=None, bucket=("x",), priority=0)
+    for r in (lo1, hi, lo2):
+        b.put(r)
+    bucket, reqs = b.next_batch()  # full at bound 3: all release...
+    assert [r.image for r in reqs] == [1, 2, 3]
+    # ...but a partial pop takes the high class first, FIFO within class
+    b2 = MicroBatcher(max_wait_ms=5000, bound_for=lambda bucket: 2,
+                      class_weight=class_weight_fn(""))
+    for i, p in enumerate((0, 0, 5)):
+        b2.put(Request(image=i, exemplars=None, bucket=("x",),
+                       priority=p))
+    bucket, reqs = b2.next_batch()
+    assert [r.image for r in reqs] == [0, 2]  # priority 5 + oldest 0
+    bucket, reqs = b2.next_batch()  # remainder drains in arrival order
+    assert [r.image for r in reqs] == [1]
+
+
+def test_batcher_full_bucket_selection_is_class_weighted():
+    from tmr_tpu.serve import MicroBatcher, Request, class_weight_fn
+
+    b = MicroBatcher(max_wait_ms=5000, bound_for=lambda bucket: 2,
+                     class_weight=class_weight_fn(""))
+    for i in range(2):  # bucket A fills first (first-use order)...
+        b.put(Request(image=f"a{i}", exemplars=None, bucket=("a",)))
+    for i in range(2):  # ...but bucket B holds the heavier class
+        b.put(Request(image=f"b{i}", exemplars=None, bucket=("b",),
+                      priority=2))
+    assert b.next_batch()[0] == ("b",)
+    assert b.next_batch()[0] == ("a",)
+
+
+# ------------------------------------------------------- degrade controller
+def test_degrade_controller_ladder_and_modes():
+    from tmr_tpu.serve import DEGRADE_STEPS, DegradeController
+
+    auto = DegradeController(mode="auto", cooldown=2, max_level=3)
+    storm = [{"anomaly": "queue_saturation", "message": "x",
+              "evidence": {}}]
+    calm = []
+    assert auto.level == 0 and auto.active_steps() == ()
+    assert auto.observe(storm) == 1
+    assert auto.active_steps() == DEGRADE_STEPS[:1]
+    assert auto.observe(storm) == 2
+    # non-overload anomalies must not shrink user results: this pass
+    # counts as calm #1 of the cooldown, holding the level
+    assert auto.observe([{"anomaly": "recompile_storm", "message": "x",
+                          "evidence": {}}]) == 2
+    assert auto.observe(calm) == 1  # calm #2 -> one step down
+    assert auto.observe(calm) == 1  # calm #1 again
+    assert auto.observe(calm) == 0  # calm #2 -> fully recovered
+
+    forced = DegradeController(mode="2")
+    assert forced.enabled and forced.level == 2
+    assert forced.observe(storm) == 2  # pinned: never moves
+    off = DegradeController(mode="off")
+    assert not off.enabled and off.active_steps() == ()
+    assert off.observe(storm) == 0
+    with pytest.raises(ValueError):
+        DegradeController(mode="sideways")
+
+
+def test_downscale_image_is_strided_subsample():
+    from tmr_tpu.serve.degrade import downscale_image
+
+    img = _img(0, 8)
+    half = downscale_image(img)
+    assert half.shape == (4, 4, 3)
+    assert np.array_equal(half, img[::2, ::2])
+
+
+# ------------------------------------------------- engine: default-off pin
+def test_default_knobs_keep_pr3_shapes_and_results():
+    """Admission/degrade off (the default): no overload keys in stats()
+    or health(), no degrade_steps on results — the PR 3 surface."""
+    eng = _engine()
+    try:
+        r = eng.submit(_img(1), SMALL_EX).result(timeout=60)
+        assert "degrade_steps" not in r
+        stats = eng.stats()
+        assert "overload" not in stats
+        health = eng.health()
+        assert "admission" not in health and "degrade" not in health
+        from tmr_tpu.diagnostics import validate_health_report
+
+        assert validate_health_report(health) == []
+    finally:
+        eng.close()
+
+
+# --------------------------------------------- engine: admission rejection
+def test_engine_admission_rejects_and_reconciles_exactly():
+    from tmr_tpu.serve import AdmissionController, RejectedError
+
+    gate = threading.Event()
+    pred = _StubPredictor(gate=gate)
+    eng = _engine(pred, admission=AdmissionController(enabled=True,
+                                                      max_pending=2))
+    try:
+        futs = [eng.submit(_img(10 + i), SMALL_EX) for i in range(6)]
+        rejected = [f for f in futs if f.done() and f.exception()]
+        assert len(rejected) == 4  # bound 2: the rest bounced instantly
+        for f in rejected:
+            e = f.exception()
+            assert isinstance(e, RejectedError)
+            assert e.cause in ("queue_full", "class_limit")
+            assert e.retry_after_s is not None
+        gate.set()
+        done = [f.result(timeout=60) for f in futs if f not in rejected]
+        assert len(done) == 2
+        c = eng.counters
+        ov = eng.overload_counters()
+        assert ov["admit_rejected"] == 4
+        assert c["submitted"] == 2 and c["completed"] == 2
+        assert c["submitted"] + ov["admit_rejected"] == 6  # exact
+        stats = eng.stats()
+        assert stats["overload"]["counters"]["admit_rejected"] == 4
+        assert "admission" in eng.health()
+    finally:
+        gate.set()
+        eng.close()
+
+
+# --------------------------------------------------- engine: deadline shed
+def test_expired_request_sheds_before_any_device_work():
+    """A request expired before dispatch must never reach the program:
+    zero stub calls, zero batches staged, zero compile events recorded
+    and an empty devtime table (the flight instruments agree nothing
+    executed)."""
+    from tmr_tpu import obs
+    from tmr_tpu.obs import devtime
+    from tmr_tpu.serve import RejectedError
+
+    pred = _StubPredictor()
+    eng = _engine(pred, batch=4, max_wait_ms=60)
+    obs.flight_configure(enabled=True)
+    devtime.reset()
+    seq0 = obs.compile_event_seq()
+    try:
+        futs = [eng.submit(_img(20 + i), SMALL_EX, deadline_ms=1.0)
+                for i in range(2)]  # 2 < bound 4: released by timeout
+        for f in futs:
+            with pytest.raises(RejectedError) as ei:
+                f.result(timeout=60)
+            assert ei.value.cause == "deadline"
+        assert pred.calls == 0
+        stats = eng.stats()
+        assert stats["batches"] == 0
+        assert stats["overload"]["counters"]["shed"] == 2
+        assert stats["overload"]["counters"]["shed.stage"] == 2
+        events, _seq = obs.compile_events_since(seq0)
+        assert events == []
+        assert devtime.totals() == {"flops": 0.0, "device_s": 0.0}
+    finally:
+        obs.flight_configure(enabled=False)
+        eng.close()
+
+
+def test_coalesced_duplicates_inherit_earliest_deadline():
+    """The group's single execution must satisfy every rider, so the
+    EARLIEST deadline (and highest class) governs the whole group."""
+    from tmr_tpu.serve import RejectedError
+
+    pred = _StubPredictor()
+    eng = _engine(pred, batch=4, max_wait_ms=60)
+    img = _img(30)
+    try:
+        f1 = eng.submit(img, SMALL_EX, deadline_ms=60_000.0)
+        f2 = eng.submit(img, SMALL_EX, deadline_ms=1.0)  # coalesces
+        for f in (f1, f2):
+            with pytest.raises(RejectedError) as ei:
+                f.result(timeout=60)
+            assert ei.value.cause == "deadline"
+        assert pred.calls == 0
+        assert eng.counters["coalesced"] == 1
+        # both riders counted shed — no phantom backlog
+        assert eng.overload_counters()["shed"] == 2
+    finally:
+        eng.close()
+
+
+def test_deadline_met_requests_still_complete():
+    pred = _StubPredictor()
+    eng = _engine(pred, batch=1, max_wait_ms=5)
+    try:
+        r = eng.submit(_img(31), SMALL_EX,
+                       deadline_ms=60_000.0).result(timeout=60)
+        assert r["boxes"].shape[0] == 1
+        assert eng.counters["completed"] == 1
+        assert "overload" not in eng.stats()  # nothing fired
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- engine: degrade wiring
+def test_forced_degrade_records_steps_and_cache_carries_them():
+    from tmr_tpu.serve import DegradeController
+
+    pred = _StubPredictor()
+    eng = _engine(pred, batch=1, max_wait_ms=5, feature_cache=4,
+                  degrade=DegradeController(mode="2"))
+    img = _img(40)
+    try:
+        r1 = eng.submit(img, SMALL_EX).result(timeout=60)
+        # level 2 = truncate_k (multi only) + prefer_heads: a cold
+        # single request promotes on FIRST sighting
+        assert r1["degrade_steps"] == ["prefer_heads"]
+        r2 = eng.submit(img, SMALL_EX).result(timeout=60)
+        assert r2["degrade_steps"] == ["prefer_heads"]  # cache hit says so
+        rm = eng.submit(_img(41), MULTI_EX, multi=True).result(timeout=60)
+        assert "truncate_k" in rm["degrade_steps"]
+        ov = eng.overload_counters()
+        assert ov["degraded"] >= 2
+        assert ov["degrade.prefer_heads"] >= 1
+        assert ov["degrade.truncate_k"] == 1
+        assert eng.stats()["overload"]["degrade"]["level"] == 2
+        assert "degrade" in eng.health()
+    finally:
+        eng.close()
+
+
+def test_forced_downscale_routes_to_half_resolution_bucket():
+    from tmr_tpu.serve import DegradeController
+
+    pred = _StubPredictor()
+    eng = _engine(pred, degrade=DegradeController(mode="3", min_size=8))
+    try:
+        r = eng.submit(_img(50), SMALL_EX).result(timeout=60)
+        assert "downscale" in r["degrade_steps"]
+        # the batcher saw the HALF-resolution bucket
+        occ = eng.stats()["batch_occupancy"]
+        assert occ  # a batch ran
+        bounds = eng.stats()["batch_bounds"]
+        assert str(SIZE // 2) in bounds
+    finally:
+        eng.close()
+
+
+def test_degrade_floor_blocks_downscale_below_min_size():
+    from tmr_tpu.serve import DegradeController
+
+    pred = _StubPredictor()
+    eng = _engine(pred,
+                  degrade=DegradeController(mode="3", min_size=SIZE))
+    try:
+        r = eng.submit(_img(51), SMALL_EX).result(timeout=60)
+        # 32 // 2 < min_size 32: the step must NOT fire
+        assert "downscale" not in r.get("degrade_steps", [])
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------- engine: bounded drain
+def test_close_bounded_drain_rejects_leftovers_on_stalled_device():
+    """Regression (satellite 1): close() under backlog used to hang on
+    the drain join while callers blocked on their futures forever. Now
+    the drain is bounded: past the timeout every leftover future fails
+    with a structured shutdown rejection and close() returns."""
+    from tmr_tpu.serve import RejectedError
+
+    gate = threading.Event()  # never set until cleanup: a wedged device
+    pred = _StubPredictor(gate=gate)
+    eng = _engine(pred, batch=1, max_wait_ms=5)
+    futs = [eng.submit(_img(60 + i), SMALL_EX) for i in range(3)]
+    t0 = time.perf_counter()
+    eng.close(timeout=0.5)
+    wall = time.perf_counter() - t0
+    assert wall < 5.0  # bounded, not the 300 s default join
+    for f in futs:
+        assert f.done()
+        exc = f.exception()
+        assert isinstance(exc, RejectedError) and exc.cause == "shutdown"
+    stats = eng.stats()
+    assert stats["overload"]["drain_timed_out"] is True
+    assert stats["overload"]["counters"]["shed.shutdown"] == 3
+    gate.set()  # release the stub so the daemon thread can exit
+
+
+def test_close_clean_drain_unchanged():
+    pred = _StubPredictor()
+    eng = _engine(pred)
+    f = eng.submit(_img(70), SMALL_EX)
+    f.result(timeout=60)
+    eng.close(timeout=30.0)  # drains normally: no rejections
+    assert "overload" not in eng.stats()
+
+
+# ------------------------------------------------------------- validators
+def _valid_overload_doc():
+    from tmr_tpu.diagnostics import OVERLOAD_REPORT_SCHEMA
+
+    return {
+        "schema": OVERLOAD_REPORT_SCHEMA,
+        "device": "cpu",
+        "config": {"image_size": 128, "batch": 4, "factor": 5.0},
+        "capacity": {"img_per_sec": 2.0, "requests": 12},
+        "overload": {
+            "offered": 48, "offered_img_per_sec": 10.0,
+            "completed": 20, "rejected": 28, "shed": 0, "errors": 0,
+            "degraded": 0,
+            "latency_ms": {"p50": 10.0, "p95": 20.0, "p99": 30.0},
+            "reject_causes": {"queue_full": 28},
+        },
+        "close": {"wall_s": 1.0, "timeout_s": 120.0},
+        "degrade": {"forced_level": 3, "steps_seen": ["downscale"]},
+        "checks": {
+            "p99_bounded": True, "accounting_exact": True,
+            "rejected_nonzero": True, "shed_before_device": True,
+            "degrade_steps_recorded": True, "degrade_auto_ladder": True,
+            "close_bounded": True,
+        },
+    }
+
+
+def test_validate_overload_report_accepts_valid_and_error_docs():
+    from tmr_tpu.diagnostics import (
+        OVERLOAD_REPORT_SCHEMA,
+        validate_overload_report,
+    )
+
+    assert validate_overload_report(_valid_overload_doc()) == []
+    assert validate_overload_report(
+        {"schema": OVERLOAD_REPORT_SCHEMA, "error": "watchdog: ..."}
+    ) == []
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda d: d.update(schema="bogus/v9"), "schema"),
+    (lambda d: d.pop("capacity"), "capacity"),
+    (lambda d: d["overload"].pop("rejected"), "rejected"),
+    (lambda d: d["overload"].update(completed="twenty"), "completed"),
+    (lambda d: d["overload"]["latency_ms"].pop("p99"), "latency_ms"),
+    (lambda d: d.pop("close"), "close"),
+    (lambda d: d["degrade"].update(steps_seen="downscale"), "steps_seen"),
+    (lambda d: d["checks"].pop("accounting_exact"), "accounting_exact"),
+    (lambda d: d.update(error=""), "error"),
+])
+def test_validate_overload_report_rejects_broken_docs(mutate, fragment):
+    from tmr_tpu.diagnostics import validate_overload_report
+
+    doc = _valid_overload_doc()
+    mutate(doc)
+    problems = validate_overload_report(doc)
+    assert problems, f"expected a problem for {fragment}"
+    assert any(fragment in p for p in problems), problems
+
+
+def test_serve_report_validator_checks_admission_attachment():
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    import tests.test_serve_bench as tsb
+
+    doc = tsb._valid_doc()
+    doc["workloads"][0]["admission"] = {
+        "rejected": 3, "shed": 1, "degraded": 0, "reject_rate": 0.27,
+    }
+    assert validate_serve_report(doc) == []
+    doc["workloads"][0]["admission"].pop("reject_rate")
+    assert any("reject_rate" in p for p in validate_serve_report(doc))
+
+
+def test_health_report_validator_checks_overload_sections():
+    from tmr_tpu.diagnostics import validate_health_report
+
+    eng = _engine()
+    try:
+        doc = eng.health()
+    finally:
+        eng.close()
+    doc["admission"] = {"enabled": True, "max_pending": 8, "in_system": 2}
+    doc["degrade"] = {"level": 1, "steps": ["truncate_k"]}
+    assert validate_health_report(doc) == []
+    doc["degrade"] = {"level": "one"}
+    assert any("degrade" in p for p in validate_health_report(doc))

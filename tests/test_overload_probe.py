@@ -1,0 +1,61 @@
+"""scripts/overload_probe.py: the overload_report/v1 contract, end to
+end on CPU in a clean-env subprocess (same discipline as the serve_bench
+smoke: no forced host-device count). One JSON line; every acceptance
+check true: >= 5x offered load yields bounded admitted-traffic p99 and
+EXACT reject/shed/complete accounting, deadline-expired requests shed
+before any device work, the degrade ladder records its steps and its
+auto trajectory, and close() mid-overload returns within its bound with
+every future terminal. Validator both-ways coverage lives in
+tests/test_overload.py — this module spends its wall budget on the one
+real-program run only.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_overload_probe_tiny_smoke(tmp_path):
+    out_file = tmp_path / "overload_report.json"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS")
+    }
+    env.update(JAX_PLATFORMS="cpu", TMR_BENCH_TINY="1",
+               TMR_BENCH_SIZE="128")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "overload_probe.py"),
+         "--tiny", "--batch", "4", "--out", str(out_file)],
+        env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    doc = json.loads(lines[0])
+
+    from tmr_tpu.diagnostics import validate_overload_report
+
+    assert validate_overload_report(doc) == []
+    assert "validator_problems" not in doc
+    checks = doc["checks"]
+    for key in ("p99_bounded", "accounting_exact", "rejected_nonzero",
+                "reject_causes_structured", "shed_before_device",
+                "degrade_steps_recorded", "degrade_auto_ladder",
+                "close_bounded"):
+        assert checks[key] is True, (key, checks)
+    over = doc["overload"]
+    # the reconciliation identity, re-derived from the document itself
+    assert (over["completed"] + over["rejected"] + over["shed"]
+            + over["errors"]) == over["offered"]
+    # rounded-field tolerance: both figures are stored at 3 decimals
+    assert over["offered_img_per_sec"] >= (
+        5 * doc["capacity"]["img_per_sec"] - 0.01
+    )
+    assert doc["shed_phase"]["shed"] == doc["shed_phase"]["offered"]
+    assert doc["shed_phase"]["batches"] == 0
+    assert doc["close"]["all_terminal"] is True
+    assert json.loads(out_file.read_text())["checks"] == checks
+    assert "[overload_probe]" in out.stderr  # progress on stderr only

@@ -228,6 +228,16 @@ def test_pinned_tile_knobs_round_trip_the_cache(tmp_path, monkeypatch):
     monkeypatch.setattr(
         at, "pick_global_attn_impl", lambda *a, **k: {"blockwise": 0.01}
     )
+    # the PR 6 decoder/quant stages are NOT what this test pins (tile
+    # knobs round-tripping the cache) — unmocked they compile real
+    # stage programs at the 1024 geometry and were silently charging
+    # ~5 minutes of tier-1 wall to an unrelated code path
+    monkeypatch.setattr(
+        at, "pick_decoder_impl", lambda *a, **k: {"xla": 0.01}
+    )
+    monkeypatch.setattr(
+        at, "pick_quant", lambda *a, **k: {"off": 0.01}
+    )
 
     class _Dev:
         device_kind = "cpu"

@@ -32,6 +32,21 @@ def pred():
     return _predictor()
 
 
+@pytest.fixture(scope="module")
+def engine(pred):
+    """ONE module-scoped bitwise-path engine shared by every test that
+    doesn't need special caching/devices (tier-1 budget: engines are
+    cheap but not free — three pipeline threads plus a stager each).
+    bound 1 + caches off: every submit executes the byte-identical B=1
+    program a sequential call runs, with no cross-test cache coupling."""
+    from tmr_tpu.serve import ServeEngine
+
+    eng = ServeEngine(pred, batch=1, max_wait_ms=5, feature_cache=0,
+                      exemplar_cache=0)
+    yield eng
+    eng.close()
+
+
 def _img(seed):
     return np.random.default_rng(seed).standard_normal(
         (SIZE, SIZE, 3)
@@ -199,21 +214,19 @@ def _sequential(pred, reqs):
 
 
 @pytest.mark.parametrize("n", [1, 4, 6])
-def test_ragged_tail_bitwise_exactness(pred, n):
+def test_ragged_tail_bitwise_exactness(pred, engine, n):
     """N serve requests == N sequential Predictor calls, BITWISE, with
     mixed capacities and a multi-exemplar request in the mix — the
-    unpad/re-order path must be invisible."""
-    from tmr_tpu.serve import ServeEngine
-
+    unpad/re-order path must be invisible. Runs on the shared module
+    engine (caches off there, so every parametrization executes)."""
+    errors0 = engine.stats()["errors"]
     reqs = _mixed_requests(n)
     seq = _sequential(pred, reqs)
-    with ServeEngine(pred, batch=1, max_wait_ms=5,
-                     feature_cache=0) as eng:
-        futs = [eng.submit(img, ex, multi=multi) for img, ex, multi in reqs]
-        results = [f.result(timeout=600) for f in futs]
+    futs = [engine.submit(img, ex, multi=multi) for img, ex, multi in reqs]
+    results = [f.result(timeout=600) for f in futs]
     for i, (a, b) in enumerate(zip(seq, results)):
         _assert_bitwise(a, b, ctx=f"request {i} of {n}")
-    assert eng.stats()["errors"] == 0
+    assert engine.stats()["errors"] == errors0
 
 
 @pytest.mark.parametrize("n", [5, 8])
@@ -302,24 +315,21 @@ def test_feature_cache_promotion_and_hit(pred):
 
 
 # -------------------------------------------------------- error isolation
-def test_malformed_request_fails_alone(pred):
-    from tmr_tpu.serve import ServeEngine
-
+def test_malformed_request_fails_alone(pred, engine):
     good_img = _img(20)
     bad_ex = np.asarray([0.2, 0.4, 0.5], np.float32)  # not (K, 4)
-    with ServeEngine(pred, batch=1, max_wait_ms=30,
-                     feature_cache=0) as eng:
-        f_good1 = eng.submit(good_img, SMALL_EX)
-        f_bad = eng.submit(_img(21), bad_ex)
-        f_shape = eng.submit(np.zeros((4, 5, 3), np.float32), SMALL_EX)
-        f_good2 = eng.submit(_img(22), SMALL_EX)
-        with pytest.raises(ValueError):
-            f_bad.result(timeout=60)
-        with pytest.raises(ValueError):
-            f_shape.result(timeout=60)
-        r1 = f_good1.result(timeout=600)
-        r2 = f_good2.result(timeout=600)
-        assert eng.stats()["rejected"] == 2
+    rejected0 = engine.stats()["rejected"]
+    f_good1 = engine.submit(good_img, SMALL_EX)
+    f_bad = engine.submit(_img(21), bad_ex)
+    f_shape = engine.submit(np.zeros((4, 5, 3), np.float32), SMALL_EX)
+    f_good2 = engine.submit(_img(22), SMALL_EX)
+    with pytest.raises(ValueError):
+        f_bad.result(timeout=60)
+    with pytest.raises(ValueError):
+        f_shape.result(timeout=60)
+    r1 = f_good1.result(timeout=600)
+    r2 = f_good2.result(timeout=600)
+    assert engine.stats()["rejected"] == rejected0 + 2
     _assert_bitwise(r1, _np(pred(good_img[None], SMALL_EX[None])))
     _assert_bitwise(r2, _np(pred(_img(22)[None], SMALL_EX[None])))
 

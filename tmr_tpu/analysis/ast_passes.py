@@ -276,6 +276,8 @@ LOCK_FILES = (
     "tmr_tpu/serve/staging.py",
     "tmr_tpu/serve/engine.py",
     "tmr_tpu/serve/caches.py",
+    "tmr_tpu/serve/admission.py",
+    "tmr_tpu/serve/degrade.py",
     "tmr_tpu/utils/faults.py",
     "tmr_tpu/obs/metrics.py",
 )

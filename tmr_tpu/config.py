@@ -216,6 +216,25 @@ ENV_KNOBS = {
     "TMR_SERVE_MAX_WAIT_MS": "ServeEngine micro-batch wait bound",
     "TMR_SERVE_EXEMPLAR_CACHE": "result-cache capacity (entries)",
     "TMR_SERVE_FEATURE_CACHE": "device feature-cache capacity (entries)",
+    "TMR_SERVE_DEADLINE_MS": "default per-request deadline; expired "
+        "requests shed before device work (0/unset = none)",
+    "TMR_SERVE_DRAIN_TIMEOUT_S": "close() drain bound; leftover futures "
+        "get structured shutdown rejections past it",
+    # admission control (serve/admission.py; default OFF = PR 3 behavior)
+    "TMR_ADMIT": "bounded admission on/off (default off)",
+    "TMR_ADMIT_MAX_PENDING": "total in-system request bound",
+    "TMR_ADMIT_CLASS_PENDING": "comma-separated per-priority-class "
+        "in-system bounds (class beyond list reuses last)",
+    "TMR_ADMIT_RATE": "token-bucket arrival-rate limit, req/s (0 = off)",
+    "TMR_ADMIT_BURST": "token-bucket burst capacity",
+    "TMR_ADMIT_CLASS_WEIGHTS": "comma-separated batcher pop weights per "
+        "priority class (default doubling ladder)",
+    # adaptive degradation (serve/degrade.py; default OFF)
+    "TMR_DEGRADE": "degrade ladder: off|auto|<forced level int>",
+    "TMR_DEGRADE_MAX_LEVEL": "ladder ceiling (1..3)",
+    "TMR_DEGRADE_COOLDOWN": "calm health passes before de-escalation",
+    "TMR_DEGRADE_MIN_SIZE": "downscale floor: images at/below never "
+        "route to the half-resolution bucket",
     # observability
     "TMR_TRACE": "span tracing on/off (default off)",
     "TMR_TRACE_RING": "per-thread span ring-buffer capacity",

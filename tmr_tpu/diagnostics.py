@@ -283,6 +283,23 @@ def validate_health_report(doc: dict) -> List[str]:
         for i, rec in enumerate(anomalies):
             problems += [f"anomalies[{i}]: {p}" for p in
                          validate_anomaly(rec)]
+    # optional overload-control sections (present only when the engine
+    # runs with admission/degradation enabled — the default-knobs shape
+    # is exactly the PR 8 one)
+    if "admission" in doc:
+        adm = doc["admission"]
+        if not isinstance(adm, dict) or not all(
+            k in adm for k in ("enabled", "max_pending", "in_system")
+        ):
+            problems.append(
+                "admission: missing enabled/max_pending/in_system"
+            )
+    if "degrade" in doc:
+        deg = doc["degrade"]
+        if not isinstance(deg, dict) or not isinstance(
+            deg.get("level"), int
+        ) or not isinstance(deg.get("steps"), list):
+            problems.append("degrade: missing level/steps")
     return problems
 
 
@@ -549,12 +566,106 @@ def validate_serve_report(doc: dict) -> List[str]:
                         f"{where}.cache.{which}: missing hits/misses/"
                         "evictions"
                     )
+        # optional per-workload admission/shed/degrade tallies (attached
+        # by serve_bench since the overload PR so open-loop rounds under
+        # pressure stay interpretable; absent on older documents)
+        if "admission" in w:
+            adm = w["admission"]
+            if not isinstance(adm, dict):
+                problems.append(f"{where}.admission: not a dict")
+            else:
+                for key in ("rejected", "shed", "degraded",
+                            "reject_rate"):
+                    v = adm.get(key)
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool):
+                        problems.append(
+                            f"{where}.admission: missing {key!r}"
+                        )
     checks = doc.get("checks")
     if not isinstance(checks, dict):
         problems.append("checks: not a dict")
     else:
         for key in ("speedup_vs_sequential", "speedup_ok", "exact_match",
                     "p99_bounded", "cache_hit"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
+#: schema tag of the overload-robustness probe document emitted by
+#: scripts/overload_probe.py: measured capacity, a >=5x offered-load
+#: round against a bounded-admission engine (admitted-traffic latency
+#: percentiles, exact reject/shed/complete accounting vs offers), a
+#: deterministic deadline-shed burst, the degrade ladder's recorded
+#: steps plus its auto escalation/cooldown trajectory, and a
+#: mid-overload close() timing. bench_guard wraps the probe, so an
+#: error record ({"schema": ..., "error": str}) is contractually valid.
+OVERLOAD_REPORT_SCHEMA = "overload_report/v1"
+
+
+def validate_overload_report(doc: dict) -> List[str]:
+    """Structural check of an overload_report/v1 document; returns a
+    list of problems (empty == valid). Dependency-free like the other
+    validators; an error record is contractually valid."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != OVERLOAD_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {OVERLOAD_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    if not isinstance(doc.get("config"), dict):
+        problems.append("config: not a dict")
+    cap = doc.get("capacity")
+    if not isinstance(cap, dict) or not isinstance(
+        cap.get("img_per_sec"), (int, float)
+    ):
+        problems.append("capacity: missing img_per_sec")
+    over = doc.get("overload")
+    if not isinstance(over, dict):
+        problems.append("overload: not a dict")
+    else:
+        for key in ("offered", "completed", "rejected", "shed",
+                    "errors", "degraded"):
+            v = over.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                problems.append(f"overload.{key}: not an int")
+        if not isinstance(over.get("offered_img_per_sec"), (int, float)):
+            problems.append("overload.offered_img_per_sec: not a number")
+        lat = over.get("latency_ms")
+        if not isinstance(lat, dict) or not all(
+            isinstance(lat.get(q), (int, float))
+            for q in ("p50", "p95", "p99")
+        ):
+            problems.append("overload.latency_ms: missing p50/p95/p99")
+        causes = over.get("reject_causes")
+        if causes is not None and not isinstance(causes, dict):
+            problems.append("overload.reject_causes: not a dict")
+    close_rec = doc.get("close")
+    if not isinstance(close_rec, dict) or not all(
+        isinstance(close_rec.get(k), (int, float))
+        for k in ("wall_s", "timeout_s")
+    ):
+        problems.append("close: missing wall_s/timeout_s")
+    deg = doc.get("degrade")
+    if not isinstance(deg, dict):
+        problems.append("degrade: not a dict")
+    else:
+        if not isinstance(deg.get("steps_seen"), list):
+            problems.append("degrade.steps_seen: not a list")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("p99_bounded", "accounting_exact",
+                    "rejected_nonzero", "shed_before_device",
+                    "degrade_steps_recorded", "degrade_auto_ladder",
+                    "close_bounded"):
             if key not in checks:
                 problems.append(f"checks: missing {key!r}")
     return problems

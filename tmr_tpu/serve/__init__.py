@@ -6,17 +6,30 @@ round-robin multi-device dispatch — see engine.py for the architecture and
 contracts, scripts/serve_bench.py for the measured proof.
 """
 
+from tmr_tpu.serve.admission import (
+    REJECTION_CAUSES,
+    AdmissionController,
+    RejectedError,
+    class_weight_fn,
+)
 from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
+from tmr_tpu.serve.degrade import DEGRADE_STEPS, DegradeController
 from tmr_tpu.serve.engine import ServeEngine
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
 __all__ = [
+    "AdmissionController",
+    "DEGRADE_STEPS",
+    "DegradeController",
     "DeviceStager",
     "LRUCache",
     "MicroBatcher",
+    "REJECTION_CAUSES",
+    "RejectedError",
     "Request",
     "ServeEngine",
     "StagedBatch",
     "array_digest",
+    "class_weight_fn",
 ]

@@ -42,6 +42,18 @@ class Request:
     features: Any = None  # cached device features (heads path, hit)
     needs_features: bool = False  # heads path, promotion fill
     trace_id: str = ""  # per-request span correlation (obs.tracing)
+    priority: int = 0  # class-weighted scheduling (higher = sooner)
+    deadline: Optional[float] = None  # absolute perf_counter seconds;
+    # coalesced duplicates inherit the EARLIEST deadline of the group
+    admitted: bool = False  # holds one admission slot until terminal
+    degrade_steps: tuple = ()  # ladder steps applied to THIS request
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Past its deadline? Expired requests are shed by the next
+        pipeline stage instead of burning device time."""
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
 
     def resolve(self, value) -> None:
         for f in self.futures:
@@ -64,12 +76,23 @@ class MicroBatcher:
     """
 
     def __init__(self, max_wait_ms: float,
-                 bound_for: Callable[[tuple], int]):
+                 bound_for: Callable[[tuple], int],
+                 class_weight: Optional[Callable[[int], float]] = None):
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.bound_for = bound_for
+        #: priority-class weight for pop ordering (serve/admission.py's
+        #: class_weight_fn in production); None -> all classes equal,
+        #: which reproduces the PR 3 discipline exactly
+        self.class_weight = class_weight
         # ordered so the flush scan visits buckets in first-use order —
         # no bucket can be starved behind a constantly-full sibling
         self._pending: "OrderedDict[tuple, deque]" = OrderedDict()
+        #: highest priority currently waiting per bucket (entries only
+        #: for nonzero priorities): the weighted full-bucket election
+        #: and the priority-pop guard read this in O(1) instead of
+        #: scanning the backlog — under overload the consumer thread
+        #: must not pay O(total pending) per released batch
+        self._maxp: Dict[tuple, int] = {}
         self._cond = threading.Condition()
         self._closed = False
         #: released-batch size histogram {occupied_slots: count} — the
@@ -81,6 +104,8 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             self._pending.setdefault(req.bucket, deque()).append(req)
+            if req.priority > self._maxp.get(req.bucket, 0):
+                self._maxp[req.bucket] = req.priority
             self._cond.notify()
 
     def close(self) -> None:
@@ -91,10 +116,41 @@ class MicroBatcher:
 
     def _pop(self, bucket: tuple, n: int) -> Tuple[tuple, List[Request]]:
         dq = self._pending[bucket]
-        out = [dq.popleft() for _ in range(min(n, len(dq)))]
+        n = min(n, len(dq))
+        if self._maxp.get(bucket, 0):
+            # class-weighted pop: release the n highest-priority
+            # requests (FIFO within a class). Queues stay arrival-
+            # ordered — put() is O(1) and rule 1's oldest-request
+            # deadline scan keeps reading dq[0] — so priority is a
+            # pop-side SELECTION, not an insertion order. The OLDEST
+            # request (dq[0]) always rides: rule 1's max_wait flush
+            # fires on ITS age, and leaving it behind for heavier
+            # classes would starve low classes indefinitely — priority
+            # reorders who ELSE fills the batch, never whether the
+            # contractual-maximum waiter finally goes.
+            picked = sorted(
+                range(1, len(dq)),
+                key=lambda i: (-dq[i].priority, dq[i].t_submit),
+            )[:n - 1]
+            picked_set = {0, *picked}
+            out = [dq[i] for i in sorted(picked_set)]
+            rest = [r for i, r in enumerate(dq) if i not in picked_set]
+            dq.clear()
+            dq.extend(rest)
+        else:
+            out = [dq.popleft() for _ in range(n)]
         if not dq:
             del self._pending[bucket]
+            self._maxp.pop(bucket, None)
         else:
+            if self._maxp.get(bucket, 0):
+                # leftover scan only during priority traffic (the
+                # default path never enters this branch)
+                mp = max(r.priority for r in dq)
+                if mp > 0:
+                    self._maxp[bucket] = mp
+                else:
+                    self._maxp.pop(bucket, None)
             # rotate a bucket that released but still holds requests to the
             # back of the scan order: a sustained-load bucket must not
             # monopolize rule 2's full-bucket scan while siblings queue
@@ -135,12 +191,30 @@ class MicroBatcher:
                     return self._pop(
                         due, max(1, int(self.bound_for(due)))
                     )
-                # 2. any full bucket releases immediately (first-use order,
-                # rotated by _pop so equals take turns)
+                # 2. any full bucket releases immediately. With a class
+                # weighting, the full bucket holding the heaviest-class
+                # request wins the slot (ties keep first-use order,
+                # rotated by _pop so equals take turns); priority can
+                # only reorder WHICH full bucket goes first — rule 1's
+                # expired-deadline preemption still bounds every
+                # class's wait at max_wait_ms, so no bucket starves.
+                best = None
+                best_bound = 0
+                best_w = 0.0
                 for bucket, dq in self._pending.items():
                     bound = max(1, int(self.bound_for(bucket)))
-                    if len(dq) >= bound:
+                    if len(dq) < bound:
+                        continue
+                    if self.class_weight is None:
                         return self._pop(bucket, bound)
+                    # O(1) per bucket via the tracked per-bucket max
+                    # priority (weights are monotone in class, default
+                    # ladder included) — never O(backlog) per release
+                    w = self.class_weight(self._maxp.get(bucket, 0))
+                    if best is None or w > best_w:
+                        best, best_bound, best_w = bucket, bound, w
+                if best is not None:
+                    return self._pop(best, best_bound)
                 if self._closed:
                     # drain: flush partial buckets oldest-first
                     for bucket in self._pending:

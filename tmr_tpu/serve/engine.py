@@ -49,8 +49,14 @@ import numpy as np
 
 from tmr_tpu import obs
 from tmr_tpu.obs.metrics import MetricsRegistry
+from tmr_tpu.serve.admission import (
+    AdmissionController,
+    RejectedError,
+    class_weight_fn,
+)
 from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
+from tmr_tpu.serve.degrade import DegradeController, downscale_image
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
 _DET_FIELDS = ("boxes", "scores", "refs", "valid")
@@ -116,7 +122,10 @@ class ServeEngine:
                  devices: Optional[Sequence[Any]] = None,
                  exemplar_cache: Optional[int] = None,
                  feature_cache: Optional[int] = None,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 admission: Optional[AdmissionController] = None,
+                 degrade: Optional[DegradeController] = None,
+                 watch: Optional[Any] = None):
         import jax
 
         if predictor.params is None:
@@ -163,9 +172,28 @@ class ServeEngine:
         self._closed = False
         self._t_start = time.time()
         #: anomaly detector fed by health() passes (obs/flight.py);
-        #: default thresholds — probes construct their own HealthWatch
-        #: when they need injectable ones
-        self._watch = obs.HealthWatch()
+        #: default thresholds — probes inject their own HealthWatch
+        #: (``watch=``) when they need deterministic ones
+        self._watch = obs.HealthWatch() if watch is None else watch
+        #: bounded admission (TMR_ADMIT* knobs; default disabled = the
+        #: PR 3 unbounded behavior) and the adaptive degrade ladder
+        #: (TMR_DEGRADE; default off). Probes pass their own controllers.
+        self._admission = AdmissionController() if admission is None \
+            else admission
+        self._degrade = DegradeController() if degrade is None else degrade
+        #: default per-request deadline (TMR_SERVE_DEADLINE_MS; 0/unset
+        #: = none) — submit(deadline_ms=...) overrides per request
+        self._default_deadline_ms = _env_float("TMR_SERVE_DEADLINE_MS", 0.0)
+        #: close() drain bound (TMR_SERVE_DRAIN_TIMEOUT_S): past it,
+        #: leftover futures resolve with a structured shutdown
+        #: rejection instead of hanging their callers
+        self._drain_timeout_s = _env_float("TMR_SERVE_DRAIN_TIMEOUT_S",
+                                           300.0)
+        self._drain_timed_out = False
+        #: overload counters (admission rejections, per-stage sheds,
+        #: degrade steps), created LAZILY on first event so the
+        #: default-off metrics/stats shapes stay byte-identical to PR 3
+        self._mx: Dict[str, Any] = {}
         # detection windows start NOW: compile events a warm process
         # paid before this engine existed (autotune sweeps, a prior
         # engine) must not fire a spurious storm on the first health()
@@ -180,7 +208,8 @@ class ServeEngine:
         self._lat = self.metrics.histogram("serve.request_latency_s")
         self._per_device: Dict[str, int] = {}
 
-        self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for)
+        self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for,
+                                     class_weight=class_weight_fn())
         self._stager = DeviceStager(
             self.devices, predictor.params, predictor.refiner_params
         )
@@ -225,19 +254,53 @@ class ServeEngine:
             self._batch_bounds[size] = bound
         return bound
 
+    def _count(self, name: str, n: int = 1) -> None:
+        """Lazily created overload counters (``serve.<name>``): the
+        admission/shed/degrade tallies exist in the registry only once
+        the first such event fires, so a default-knobs engine's
+        metrics snapshot and stats() stay byte-identical to PR 3."""
+        with self._lock:
+            c = self._mx.get(name)
+            if c is None:
+                c = self._mx[name] = self.metrics.counter(f"serve.{name}")
+        c.inc(n)
+
     # -------------------------------------------------------------- submit
     def submit(self, image, exemplars, multi: bool = False,
-               k_real: Optional[int] = None) -> Future:
+               k_real: Optional[int] = None,
+               priority: int = 0,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the
         fixed-slot detections dict (numpy, leading dim 1 — treat as
         read-only, results may be shared with the cache).
 
+        ``priority`` is the request's class (higher = scheduled sooner
+        under the class weighting; admission bounds apply per class).
+        ``deadline_ms`` bounds the request's useful lifetime from this
+        call: a request still unserved past it is SHED by the next
+        pipeline stage (its future raises RejectedError cause
+        "deadline") instead of burning device time on an answer nobody
+        is waiting for. None -> ``TMR_SERVE_DEADLINE_MS`` (unset = no
+        deadline, the PR 3 behavior). Identical concurrent requests
+        coalesce into ONE group that inherits the EARLIEST deadline of
+        its riders — a shed therefore fails every rider together, a
+        deadline-free rider included (one execution, one fate; a rider
+        that must not expire should not share a deadline-bearing
+        group's exact inputs mid-flight).
+
         A request that cannot be served (bad shapes, an exemplar needing a
         template bucket beyond cfg.template_buckets, ...) fails only its
-        own future."""
+        own future; a request the admission controller bounces fails with
+        a structured :class:`RejectedError` (cause, class, retry-after)."""
         fut: Future = Future()
         if self._closed:
             fut.set_exception(RuntimeError("engine is closed"))
+            return fut
+        rej = self._admission.try_admit(priority)
+        if rej is not None:
+            self._count("admit_rejected")
+            self._count(f"admit_rejected.{rej.cause}")
+            fut.set_exception(rej)
             return fut
         # one trace id per request, minted here and carried through every
         # pipeline stage's span (queue wait, staging, execute, resolve)
@@ -245,17 +308,21 @@ class ServeEngine:
         with obs.span("serve.submit", trace_id=tid or None):
             try:
                 req = self._make_request(image, exemplars, multi, k_real,
-                                         fut, tid)
+                                         fut, tid, priority, deadline_ms)
             except Exception as e:  # isolation: reject this request alone
+                self._admission.release_class(priority)
                 self._m["rejected"].inc()
                 fut.set_exception(e)
                 return fut
-            if req is None:  # resolved from cache / coalesced
+            if req is None:  # resolved from cache / coalesced: the slot
+                self._admission.release_class(priority)  # frees now
                 return fut
+            req.admitted = self._admission.enabled
             try:
                 self._batcher.put(req)
             except Exception as e:  # closed mid-submit: a rejection, not
                 self._drop_inflight(req)  # traffic
+                self._admission.release(req)
                 self._m["rejected"].inc()
                 fut.set_exception(e)
                 return fut
@@ -267,7 +334,9 @@ class ServeEngine:
         return self.submit(image, exemplars, **kw).result()
 
     def _make_request(self, image, exemplars, multi, k_real,
-                      fut, trace_id: str = "") -> Optional[Request]:
+                      fut, trace_id: str = "", priority: int = 0,
+                      deadline_ms: Optional[float] = None
+                      ) -> Optional[Request]:
         image = np.asarray(image, np.float32)
         if image.ndim == 4 and image.shape[0] == 1:
             image = image[0]
@@ -283,7 +352,22 @@ class ServeEngine:
             raise ValueError(
                 f"k_real={k} out of range for {len(ex)} exemplar rows"
             )
-        bucket = self._pred.bucket_key(size, ex, multi=multi, k_real=k_real)
+        # ---- adaptive degradation (serve/degrade.py; default OFF = the
+        # bitwise PR 3 path). Steps apply BEFORE the bucket/digest are
+        # computed, so the result-cache key describes exactly what ran —
+        # a degraded result can never be served to an undegraded query.
+        steps = self._degrade.active_steps()
+        applied = []
+        if "downscale" in steps and size // 2 >= self._degrade.min_size:
+            image = downscale_image(image)
+            size = int(image.shape[0])
+            applied.append("downscale")
+        if "truncate_k" in steps and multi and k > 1:
+            k = 1
+            k_real = 1
+            applied.append("truncate_k")
+        bucket = self._pred.bucket_key(size, ex[:k] if multi else ex,
+                                       multi=multi, k_real=k_real)
         if multi:
             ex = ex[:k]
             k_bucket = bucket[3]
@@ -293,6 +377,18 @@ class ServeEngine:
         digest = array_digest(image)
         result_key = (bucket, digest, array_digest(ex[:k] if multi else ex),
                       k if multi else None)
+        if applied:
+            # degraded traffic lives under its OWN cache/coalesce keys:
+            # sharing the honest key would let a degraded query hit an
+            # unlabeled honest result (silent degradation — the one
+            # thing the ladder contract forbids) or an honest query a
+            # degraded one. Counting happens HERE, before the lookup,
+            # so a cache-hit serve of a degraded request is still an
+            # exactly-accounted degraded serve.
+            result_key = result_key + (tuple(applied),)
+            self._count("degraded")
+            for step in applied:
+                self._count(f"degrade.{step}")
 
         cached = self.result_cache.get(result_key)
         if cached is not None:
@@ -301,9 +397,16 @@ class ServeEngine:
             self._m["completed"].inc()
             return None
 
+        deadline_ms = (
+            (self._default_deadline_ms or None)
+            if deadline_ms is None else float(deadline_ms)
+        )
         req = Request(image=image, exemplars=ex, bucket=bucket,
                       futures=[fut], k_real=k, image_digest=digest,
-                      result_key=result_key, trace_id=trace_id)
+                      result_key=result_key, trace_id=trace_id,
+                      priority=max(int(priority), 0))
+        if deadline_ms is not None:
+            req.deadline = req.t_submit + deadline_ms / 1000.0
         if not multi and self.feature_cache.capacity > 0:
             feat = self.feature_cache.get((digest, size))
             if feat is not None:
@@ -312,8 +415,23 @@ class ServeEngine:
             elif (digest, size) in self._seen:
                 req.needs_features = True
                 req.bucket = ("heads",) + bucket[1:]
+            elif "prefer_heads" in steps:
+                # degrade: promote on FIRST sighting — repeats reach the
+                # cached heads-only program one round-trip earlier. This
+                # is a ROUTING step (the heads-path ULP exception the
+                # engine already documents for second sightings), so it
+                # stays out of the result key; the stored result's
+                # degrade_steps is its provenance either way.
+                req.needs_features = True
+                req.bucket = ("heads",) + bucket[1:]
+                applied.append("prefer_heads")
+                if len(applied) == 1:  # not already counted pre-lookup
+                    self._count("degraded")
+                self._count("degrade.prefer_heads")
             else:
                 self._seen.put((digest, size), True)
+        if applied:
+            req.degrade_steps = tuple(applied)
         # lookup + registration under ONE lock hold: a second identical
         # submit racing this one must either see our registration or win
         # the slot itself — split critical sections would let both execute
@@ -322,6 +440,15 @@ class ServeEngine:
             live = self._inflight.get(result_key)
             if live is not None:
                 live.futures.append(fut)
+                # a coalesced group serves its MOST urgent rider: the
+                # earliest deadline and the highest class win (the
+                # group's single execution must satisfy every rider)
+                if req.deadline is not None and (
+                    live.deadline is None or req.deadline < live.deadline
+                ):
+                    live.deadline = req.deadline
+                if req.priority > live.priority:
+                    live.priority = req.priority
                 self._m["submitted"].inc()
                 self._m["coalesced"].inc()
                 return None
@@ -329,6 +456,34 @@ class ServeEngine:
         return req
 
     # ------------------------------------------------------------- threads
+    def _shed_expired(self, requests: List[Request],
+                      stage: str) -> List[Request]:
+        """Drop already-expired requests from a batch before the next
+        pipeline stage spends work on them: each sheds with a
+        structured deadline rejection, counted per stage
+        (``serve.shed.<stage>``). Returns the still-live remainder.
+        The common no-deadline path is one generator pass."""
+        if all(r.deadline is None for r in requests):
+            return requests
+        now = time.perf_counter()
+        live = []
+        for req in requests:
+            if not req.expired(now):
+                live.append(req)
+                continue
+            self._drop_inflight(req)
+            self._admission.release(req)
+            req.fail(RejectedError(
+                "deadline",
+                f"deadline expired before {stage} "
+                f"(waited {(now - req.t_submit) * 1000:.1f} ms)",
+                priority=req.priority,
+            ))
+            n = len(req.futures)
+            self._count("shed", n)
+            self._count(f"shed.{stage}", n)
+        return live
+
     def _stage_loop(self) -> None:
         while True:
             nb = self._batcher.next_batch()
@@ -336,6 +491,11 @@ class ServeEngine:
                 self._staged_q.put(None)
                 return
             bucket, reqs = nb
+            # deadline shed BEFORE staging: an expired request must
+            # never reach device_put, let alone execute
+            reqs = self._shed_expired(reqs, "stage")
+            if not reqs:
+                continue
             try:
                 staged = self._stager.stage(
                     bucket, reqs, self._bound_for(bucket)
@@ -355,6 +515,16 @@ class ServeEngine:
             if staged is None:
                 self._done_q.put(None)
                 return
+            # a batch whose EVERY rider expired while staged sheds here
+            # and skips the program call entirely; a mixed batch still
+            # runs (its rows are already staged — the expired riders
+            # shed at postprocess instead of paying host fetch/copy)
+            if staged.requests and all(
+                r.deadline is not None and r.expired()
+                for r in staged.requests
+            ):
+                self._shed_expired(staged.requests, "dispatch")
+                continue
             try:
                 t0 = time.perf_counter()
                 out, fill_feats = self._run_batch(staged)
@@ -436,7 +606,23 @@ class ServeEngine:
         kind, size = staged.bucket[0], staged.bucket[1]
         fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
         traced = obs.tracing_enabled()
+        now = time.perf_counter()
         for i, req in enumerate(staged.requests):
+            if req.expired(now):
+                # postprocess shed: the device seconds are sunk, but the
+                # per-request host copies + cache insert are not — and
+                # the caller stopped waiting at the deadline anyway
+                self._drop_inflight(req)
+                self._admission.release(req)
+                req.fail(RejectedError(
+                    "deadline",
+                    "deadline expired before postprocess",
+                    priority=req.priority,
+                ))
+                n = len(req.futures)
+                self._count("shed", n)
+                self._count("shed.postprocess", n)
+                continue
             try:
                 # .copy(): a 1-row slice VIEW would pin the whole padded
                 # batch's host arrays alive for as long as the result sits
@@ -446,6 +632,11 @@ class ServeEngine:
                     name: host[name][i:i + 1].copy()
                     for name in _det_fields(host)
                 }
+                if req.degrade_steps:
+                    # exactness contract: a degraded result SAYS so —
+                    # the cached copy carries the steps too, so a later
+                    # cache hit stays accountable
+                    result["degrade_steps"] = list(req.degrade_steps)
                 if req.result_key is not None:
                     self.result_cache.put(req.result_key, result)
                 if kind == "heads" and i in fill_pos:
@@ -454,6 +645,7 @@ class ServeEngine:
                         fill_feats[fill_pos[i]:fill_pos[i] + 1],
                     )
                 self._drop_inflight(req)
+                self._admission.release(req)
                 t_res0 = time.perf_counter()
                 req.resolve(result)
                 t_res1 = time.perf_counter()
@@ -480,6 +672,7 @@ class ServeEngine:
                 self._m["completed"].inc(len(req.futures))
             except Exception as e:  # isolation: this request alone
                 self._drop_inflight(req)
+                self._admission.release(req)
                 req.fail(e)
                 self._m["errors"].inc(len(req.futures))
 
@@ -495,11 +688,13 @@ class ServeEngine:
             try:
                 result = self._run_single(req)
                 self._drop_inflight(req)
+                self._admission.release(req)
                 req.resolve(result)
                 self._lat.observe(time.perf_counter() - req.t_submit)
                 self._m["completed"].inc(len(req.futures))
             except Exception as e:
                 self._drop_inflight(req)
+                self._admission.release(req)
                 req.fail(e)
                 self._m["errors"].inc(len(req.futures))
 
@@ -511,7 +706,10 @@ class ServeEngine:
             )
         else:  # single and heads requests share __call__ semantics
             dets = self._pred(req.image[None], req.exemplars[None])
-        return {name: np.asarray(dets[name]) for name in _det_fields(dets)}
+        out = {name: np.asarray(dets[name]) for name in _det_fields(dets)}
+        if req.degrade_steps:
+            out["degrade_steps"] = list(req.degrade_steps)
+        return out
 
     def _drop_inflight(self, req: Request) -> None:
         if req.result_key is None:
@@ -548,13 +746,18 @@ class ServeEngine:
             mfu_totals=(devtime.totals() if obs.flight_enabled()
                         else None),
         )
+        # the anomaly pass IS the degrade ladder's control input: each
+        # health() call (the heartbeat's interval in production) runs
+        # one escalation/cooldown step (serve/degrade.py)
+        if self._degrade.enabled:
+            self._degrade.observe(anomalies)
         now = time.time()
         # lifetime tallies from the monotone registry counters (exact;
         # the in-process event log is bounded and would undercount) —
         # `recent` is the bounded log's tail, for human eyes
         reg = obs.get_registry()
         recent = obs.compile_events()[-8:]
-        return {
+        doc = {
             "schema": HEALTH_REPORT_SCHEMA,
             "ts": now,
             "uptime_s": round(now - self._t_start, 3),
@@ -586,6 +789,14 @@ class ServeEngine:
             },
             "anomalies": anomalies,
         }
+        # the overload-control sections appear only when the features
+        # are on: a default-knobs engine's health_report shape stays
+        # byte-identical to PR 8 (acceptance-pinned)
+        if self._admission.enabled:
+            doc["admission"] = self._admission.stats()
+        if self._degrade.enabled:
+            doc["degrade"] = self._degrade.stats()
+        return doc
 
     def start_heartbeat(self, path: str,
                         interval_s: Optional[float] = None):
@@ -600,8 +811,17 @@ class ServeEngine:
         return hb
 
     # ------------------------------------------------------------ lifecycle
-    def close(self, timeout: float = 300.0) -> None:
-        """Drain pending requests and stop the pipeline threads."""
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain pending requests and stop the pipeline threads — within
+        a BOUND. ``timeout`` (None -> ``TMR_SERVE_DRAIN_TIMEOUT_S``,
+        default 300) caps the whole drain: past it, every still-
+        unresolved request's future fails with a structured shutdown
+        :class:`RejectedError` instead of leaving its caller hanging on
+        a wedged device (the pipeline threads are daemons, so an
+        abandoned drain cannot block process exit). A drain that
+        finishes in time is byte-for-byte the PR 3 behavior."""
+        timeout = self._drain_timeout_s if timeout is None \
+            else float(timeout)
         with self._lock:
             if self._closed:
                 return
@@ -610,10 +830,32 @@ class ServeEngine:
         if hb is not None:
             hb.stop()
         self._batcher.close()
+        deadline = time.perf_counter() + max(timeout, 0.0)
         for t in self._threads:
-            t.join(timeout=timeout)
-            if t.is_alive():
-                raise RuntimeError(f"serve thread {t.name} failed to drain")
+            t.join(timeout=max(deadline - time.perf_counter(), 0.0))
+        if not any(t.is_alive() for t in self._threads):
+            return
+        # bounded drain expired: resolve every leftover future with a
+        # shutdown rejection. The inflight registry is the complete set
+        # of unresolved requests (queued, staged, or dispatched — each
+        # registered at submit, deregistered at its terminal event), and
+        # Request.fail only touches not-done futures, so a straggler
+        # thread resolving late is a harmless no-op on both sides.
+        with self._lock:
+            leftovers = list(self._inflight.values())
+            self._inflight.clear()
+            self._drain_timed_out = True
+        for req in leftovers:
+            self._admission.release(req)
+            req.fail(RejectedError(
+                "shutdown",
+                f"engine closed; request unserved after the "
+                f"{timeout:.1f}s drain bound",
+                priority=req.priority,
+            ))
+            n = len(req.futures)
+            self._count("shed", n)
+            self._count("shed.shutdown", n)
 
     def __enter__(self) -> "ServeEngine":
         return self
@@ -634,12 +876,28 @@ class ServeEngine:
         serve_bench attaches under its report's ``metrics`` key."""
         return self.metrics.snapshot()
 
+    def overload_counters(self) -> Dict[str, int]:
+        """The admission/shed/degrade tallies as plain ints, zero when
+        nothing ever fired — always available (serve_bench and the
+        overload probe delta these per workload), but folded into
+        ``stats()`` only once an overload feature is in play so the
+        default shape stays PR 3."""
+        with self._lock:
+            live = {name: int(c.value) for name, c in self._mx.items()}
+        return {
+            "admit_rejected": live.get("admit_rejected", 0),
+            "shed": live.get("shed", 0),
+            "degraded": live.get("degraded", 0),
+            **{k: v for k, v in sorted(live.items())
+               if "." in k},  # per-cause / per-stage / per-step splits
+        }
+
     def stats(self) -> dict:
         with self._lock:
             per_device = dict(self._per_device)
             batch_bounds = dict(self._batch_bounds)
         counters = self.counters
-        return {
+        out = {
             **counters,
             "batch_occupancy": {
                 str(k): v
@@ -656,3 +914,14 @@ class ServeEngine:
             "batch_bounds": {str(k): v for k, v in batch_bounds.items()},
             "donate": self.donate,
         }
+        with self._lock:
+            any_fired = bool(self._mx)
+            drain_timed_out = self._drain_timed_out
+        if self._admission.enabled or self._degrade.enabled or any_fired:
+            out["overload"] = {
+                "counters": self.overload_counters(),
+                "admission": self._admission.stats(),
+                "degrade": self._degrade.stats(),
+                "drain_timed_out": drain_timed_out,
+            }
+        return out
