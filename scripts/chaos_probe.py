@@ -15,6 +15,17 @@ commits, crash + resume — and exits nonzero unless:
 - a crash mid-run + `--resume` yields a byte-identical table, re-encoding
   only unjournaled shards, with no partial `.npy` anywhere.
 
+`--elastic` runs the ELASTIC gauntlet instead (coordinator/worker lease
+execution, tmr_tpu/parallel/elastic.py): 3 worker processes over 8
+shards with one worker kill -9'd mid-shard and another SIGSTOPped past
+the heartbeat window (then SIGCONTed so its fenced commit is actually
+attempted and rejected), plus an in-process lease/heartbeat
+fault-injection round — and exits nonzero unless the run completes, the
+final stats table is byte-identical to the single-process run, the
+validated elastic_report/v1 reconciles exactly (every reassignment
+carries a closed-vocab cause; >= 1 fenced-commit rejection in the
+SIGSTOP scenario), and the feature tree matches byte-for-byte.
+
 Fast (seconds, tiny tensors, CPU): rides tier-1 via
 tests/test_chaos_probe.py.
 """
@@ -149,6 +160,305 @@ def _run(paths, encode, out_dir, *, resume=False, retry=None, expect_crash=False
     }
 
 
+# ------------------------------------------------------- elastic gauntlet
+ELASTIC_SHARDS = (  # 8 shards — index order is the fault 'shard=' key.
+    # Every shard has >=3 images so at batch 2 each worker spends >=2
+    # stub-delayed batches per shard — kills/stops land mid-shard.
+    ("Easy_0.tar", 4), ("Easy_1.tar", 3), ("Easy_2.tar", 3),
+    ("Normal_0.tar", 4), ("Normal_1.tar", 3), ("Normal_2.tar", 3),
+    ("Hard_0.tar", 3), ("Hard_1.tar", 3),
+)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poll(predicate, timeout_s, interval_s=0.02):
+    """Poll until predicate() is truthy; returns its value (falsy on
+    timeout)."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _spawn_stub_worker(wid, address, extra=()):
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TMR_FAULTS", None)  # process gauntlet runs fault-free
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "elastic_map.py"),
+         "worker", "--coordinator", f"{address[0]}:{address[1]}",
+         "--worker_id", wid, "--encoder", "stub",
+         "--shard_delay_s", "0.45", "--max_attempts", "2",
+         "--max_idle_s", "30", *extra],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _held_leases(coord):
+    """{worker_id: (index, epoch, hb)} for every currently held lease."""
+    state = coord.state()
+    out = {}
+    for index, leases in state["leases"].items():
+        for lease in leases:
+            out[lease["worker"]] = (int(index), lease["epoch"],
+                                    lease["hb"])
+    return out
+
+
+def _elastic_main(args) -> int:
+    """The elastic chaos gauntlet (see module docstring)."""
+    import signal
+    import threading
+    import time
+
+    from tmr_tpu.diagnostics import (
+        ELASTIC_REASSIGN_CAUSES,
+        validate_elastic_report,
+    )
+    from tmr_tpu.parallel.elastic import (
+        ElasticCoordinator,
+        ElasticPolicy,
+        run_worker,
+        stub_encode_stats_fn,
+    )
+    from tmr_tpu.parallel.mapreduce import (
+        RetryPolicy,
+        reducer_table,
+        run_stream,
+    )
+    from tmr_tpu.utils import faults
+
+    work = args.work_dir or tempfile.mkdtemp(prefix="chaos_elastic_")
+    os.makedirs(work, exist_ok=True)
+    problems = []
+
+    def check(ok, msg):
+        print(f"[{'ok' if ok else 'FAIL'}] {msg}", file=sys.stderr)
+        if not ok:
+            problems.append(msg)
+
+    data = os.path.join(work, "shards")
+    os.makedirs(data, exist_ok=True)
+    paths = [
+        _make_tar(data, name, n, seed=i)
+        for i, (name, n) in enumerate(ELASTIC_SHARDS)
+    ]
+
+    # ------------------------------------------- baseline: single process
+    faults.clear()
+    base_feats = os.path.join(work, "base_features")
+
+    def _save_into(features_dir):
+        from tmr_tpu.parallel.elastic import make_feature_sinks
+
+        return make_feature_sinks(features_dir)
+
+    save, cleanup, sync = _save_into(base_feats)
+    base_acc = run_stream(
+        paths, stub_encode_stats_fn(), batch_size=2, image_size=SIZE,
+        save_features=save, cleanup_features=cleanup, sync_features=sync,
+    )
+    base_table = reducer_table(base_acc.table)
+    base_manifest = _manifest(base_feats)
+    check(base_manifest, "elastic baseline: single-process run completed")
+
+    # ---------------- process gauntlet: 3 workers, kill -9 + SIGSTOP/CONT
+    feats = os.path.join(work, "features")
+    policy = ElasticPolicy(
+        lease_ttl_s=1.0, hb_interval_s=0.2, check_interval_s=0.05,
+        straggler_factor=0.0,
+    )
+    coord = ElasticCoordinator(
+        paths, os.path.join(feats, "_journal"), features_out=feats,
+        image_size=SIZE, batch_size=2, policy=policy,
+    )
+    address = coord.start()
+    workers = {
+        f"w{i}": _spawn_stub_worker(f"w{i}", address) for i in range(3)
+    }
+
+    # victims: two distinct workers holding FRESH leases (few heartbeats
+    # in), so the signals land mid-shard rather than racing the commit
+    held = _poll(
+        lambda: (lambda h: h if len(
+            [w for w, (_, _, hb) in h.items() if hb <= 2]
+        ) >= 2 else None)(_held_leases(coord)),
+        timeout_s=60.0,
+    )
+    check(bool(held), "elastic: >=2 workers leased shards concurrently")
+    victims = sorted(
+        w for w, (_, _, hb) in (held or {}).items() if hb <= 2
+    )[:2]
+    kill_wid = victims[0] if victims else None
+    stop_wid = victims[1] if len(victims) > 1 else None
+    kill_shard = held[kill_wid][0] if kill_wid else None
+    stop_shard = held[stop_wid][0] if stop_wid else None
+    if kill_wid:
+        os.kill(workers[kill_wid].pid, signal.SIGKILL)  # mid-shard
+    if stop_wid:
+        os.kill(workers[stop_wid].pid, signal.SIGSTOP)  # past hb window
+
+    def _cause_for(index, cause):
+        return lambda: any(
+            r["index"] == index and r["cause"] == cause
+            for r in coord.state()["reassignments"]
+        )
+
+    check(
+        bool(_poll(_cause_for(kill_shard, "worker_exit"), 20.0)),
+        "elastic: kill -9 worker reassigned with cause worker_exit",
+    )
+    check(
+        bool(_poll(_cause_for(stop_shard, "stale_heartbeat"), 20.0)),
+        "elastic: SIGSTOPped worker's lease revoked as stale_heartbeat",
+    )
+    if stop_wid:
+        os.kill(workers[stop_wid].pid, signal.SIGCONT)
+    check(
+        bool(_poll(
+            lambda: coord.state()["fenced_rejections"], 30.0
+        )),
+        "elastic: resumed (paused) worker's commit attempt was fenced",
+    )
+    check(coord.wait(timeout=90.0), "elastic: run settled")
+    for wid, proc in workers.items():
+        if wid == kill_wid:
+            proc.wait(timeout=10)
+            continue
+        try:
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+            check(False, f"elastic: worker {wid} had to be killed")
+    doc = coord.report()
+    table = reducer_table(coord.table())
+    coord.stop()
+
+    check(validate_elastic_report(doc) == [],
+          "elastic: elastic_report/v1 valid (totals reconcile exactly)")
+    check(table == base_table,
+          "elastic: stats table byte-identical to single-process run")
+    manifest = _manifest(feats)
+    check(manifest == base_manifest,
+          "elastic: feature files byte-identical to single-process run")
+    # the fenced loser must not have unlinked the winner's done-marker:
+    # a coordinator crash right now must be resumable from the journal
+    from tmr_tpu.parallel.journal import ShardJournal
+
+    journal = ShardJournal(os.path.join(feats, "_journal"))
+    missing = [
+        r["shard"] for r in doc["shards"]
+        if r["status"] == "committed"
+        and journal.done(r["shard"]) is None
+    ]
+    check(not missing,
+          f"elastic: every committed shard keeps a valid journal "
+          f"marker for crash-resume (missing: {missing})")
+    totals = doc["totals"]
+    check(
+        totals["committed"] + totals["resumed"] + totals["quarantined"]
+        == totals["shards"] == len(ELASTIC_SHARDS)
+        and totals["quarantined"] == 0,
+        "elastic: every shard settled exactly once (committed)",
+    )
+    check(
+        doc["reassignments"] and all(
+            r["cause"] in ELASTIC_REASSIGN_CAUSES
+            for r in doc["reassignments"]
+        ),
+        "elastic: every reassignment carries a closed-vocab cause",
+    )
+    check(totals["fenced_rejections"] >= 1,
+          "elastic: >=1 fenced-commit rejection in the SIGSTOP scenario")
+    killed_shard_rec = doc["shards"][kill_shard] if kill_shard is not None \
+        else None
+    check(
+        killed_shard_rec is not None
+        and killed_shard_rec["status"] == "committed"
+        and killed_shard_rec["worker"] != kill_wid,
+        "elastic: the killed worker's shard was committed by another "
+        "worker",
+    )
+    # kill -9 can orphan *.tmp.<pid> files mid-atomic-write; they must
+    # all belong to the two victim processes, never a healthy writer
+    victim_pids = {str(workers[w].pid) for w in victims if w}
+    stray = [
+        p for p in _tmp_leftovers(feats)
+        if p.rsplit(".", 1)[-1] not in victim_pids
+    ]
+    check(not stray, f"elastic: no orphan .tmp files from healthy "
+                     f"workers ({stray})")
+
+    # --------------- in-process round: lease + heartbeat fault injection
+    faults.configure(
+        # grant of shard 1 fails once (epoch 1), succeeds on re-grant
+        "lease:shard=1:attempts=2:raise=OSError;"
+        # shard 2's first holder stalls its heartbeats past the TTL
+        # (epoch 1 only) — the in-process SIGSTOP stand-in
+        "heartbeat:shard=2:attempts=2:latency=1.6"
+    )
+    feats2 = os.path.join(work, "features_faults")
+    coord2 = ElasticCoordinator(
+        paths, os.path.join(feats2, "_journal"), features_out=feats2,
+        image_size=SIZE, batch_size=2,
+        policy=ElasticPolicy(
+            lease_ttl_s=0.6, hb_interval_s=0.15, check_interval_s=0.05,
+            straggler_factor=0.0,
+        ),
+    )
+    address2 = coord2.start()
+    retry = RetryPolicy(max_attempts=2, backoff_base=0.01,
+                        backoff_jitter=0.0)
+    threads = [
+        threading.Thread(
+            target=run_worker,
+            args=(address2, f"t{i}", stub_encode_stats_fn()),
+            kwargs={"retry": retry, "max_idle_s": 20.0},
+            daemon=True,
+        )
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    check(coord2.wait(timeout=60.0), "faults: injected run settled")
+    for t in threads:
+        t.join(timeout=20)
+    doc2 = coord2.report()
+    table2 = reducer_table(coord2.table())
+    coord2.stop()
+    fired = {(f["point"], f["action"]) for f in faults.fired()}
+    check(("lease", "raise") in fired, "faults: lease grant fault fired")
+    check(("heartbeat", "latency") in fired,
+          "faults: heartbeat stall fault fired")
+    check(validate_elastic_report(doc2) == [],
+          "faults: elastic_report/v1 valid")
+    check(table2 == base_table,
+          "faults: stats table byte-identical under injected faults")
+    check(
+        any(r["index"] == 2 and r["cause"] == "stale_heartbeat"
+            for r in doc2["reassignments"]),
+        "faults: stalled-heartbeat lease revoked and reassigned",
+    )
+    faults.clear()
+
+    if problems:
+        print(f"chaos_probe --elastic: {len(problems)} FAILED check(s):",
+              file=sys.stderr)
+        for msg in problems:
+            print(f"  - {msg}", file=sys.stderr)
+        return 1
+    print("chaos_probe --elastic: all checks passed", file=sys.stderr)
+    if not args.keep and args.work_dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
 def main(argv=None) -> int:
     from tmr_tpu.diagnostics import validate_map_report
     from tmr_tpu.utils import faults
@@ -167,7 +477,13 @@ def main(argv=None) -> int:
                          "on success)")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir for inspection")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic coordinator/worker gauntlet "
+                         "(kill -9 / SIGSTOP / lease+heartbeat faults) "
+                         "instead of the single-process one")
     args = ap.parse_args(argv)
+    if args.elastic:
+        return _elastic_main(args)
 
     work = args.work_dir or tempfile.mkdtemp(prefix="chaos_probe_")
     os.makedirs(work, exist_ok=True)

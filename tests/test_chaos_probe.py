@@ -1,7 +1,13 @@
-"""The chaos gauntlet (scripts/chaos_probe.py) must pass on tier-1: every
-injected fault retried-to-success or quarantined with a recorded cause,
-tables and feature bytes identical to the fault-free run, crash+resume
-byte-identical."""
+"""The chaos gauntlets (scripts/chaos_probe.py) must pass on tier-1:
+
+- single-process: every injected fault retried-to-success or
+  quarantined with a recorded cause, tables and feature bytes identical
+  to the fault-free run, crash+resume byte-identical;
+- elastic (--elastic): 3 workers over 8 shards with one kill -9'd
+  mid-shard and one SIGSTOPped past the heartbeat window — run
+  completes, table byte-identical to the single-process run, the
+  elastic_report/v1 reconciles exactly, and the SIGSTOP scenario ends
+  in >= 1 fenced-commit rejection."""
 
 import importlib.util
 import os
@@ -20,11 +26,22 @@ def _clean_schedule():
     faults.clear()
 
 
-def test_chaos_probe_passes(tmp_path):
+def _load_probe():
     spec = importlib.util.spec_from_file_location(
         "chaos_probe", os.path.join(REPO, "scripts", "chaos_probe.py")
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_probe_passes(tmp_path):
+    mod = _load_probe()
     rc = mod.main(["--work_dir", str(tmp_path / "chaos")])
+    assert rc == 0
+
+
+def test_elastic_chaos_gauntlet_passes(tmp_path):
+    mod = _load_probe()
+    rc = mod.main(["--elastic", "--work_dir", str(tmp_path / "elastic")])
     assert rc == 0

@@ -1,0 +1,443 @@
+"""Elastic map phase (tmr_tpu/parallel/elastic.py): lease-based
+coordinator/worker execution on the no-XLA numpy stub encoder (the
+test_overload stub-predictor pattern applied to the map phase — the
+mechanics under test are leases, epochs, fencing, and accounting; the
+real-encoder path and the kill -9 / SIGSTOP process gauntlet are proven
+by scripts/chaos_probe.py --elastic, smoked via tests/test_chaos_probe).
+
+Covers: byte-identical tables across worker counts, dead-worker
+(worker_exit) reassignment, stale-heartbeat revocation + journal
+fencing, straggler duplicate leases with first-commit-wins, poison-
+worker drain, journal worker/epoch back-compat, resume folding old
+markers, fault-point parity, and the elastic_report/v1 validator.
+"""
+
+import io
+import os
+import re
+import socket
+import tarfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu.diagnostics import (
+    ELASTIC_REASSIGN_CAUSES,
+    validate_elastic_report,
+)
+from tmr_tpu.parallel import elastic
+from tmr_tpu.parallel.journal import ShardJournal, StaleLeaseError
+from tmr_tpu.parallel.mapreduce import (
+    RetryPolicy,
+    reducer_table,
+    run_stream,
+)
+from tmr_tpu.utils import faults
+
+SIZE = 16
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_tar(dirpath, name, n_images, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    path = os.path.join(dirpath, name)
+    with tarfile.open(path, "w") as tar:
+        for i in range(n_images):
+            img = Image.fromarray(
+                rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+            )
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img_{i}.png")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+@pytest.fixture
+def shards(tmp_path):
+    return [
+        _make_tar(str(tmp_path), "Easy_0.tar", 3, 0),
+        _make_tar(str(tmp_path), "Easy_1.tar", 4, 1),
+        _make_tar(str(tmp_path), "Normal_0.tar", 2, 2),
+        _make_tar(str(tmp_path), "Normal_1.tar", 3, 3),
+        _make_tar(str(tmp_path), "Hard_0.tar", 2, 4),
+    ]
+
+
+def _fast_policy(**kw):
+    kw.setdefault("lease_ttl_s", 0.6)
+    kw.setdefault("hb_interval_s", 0.15)
+    kw.setdefault("check_interval_s", 0.05)
+    kw.setdefault("straggler_factor", 0.0)
+    return elastic.ElasticPolicy(**kw)
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_jitter", 0.0)
+    return RetryPolicy(**kw)
+
+
+def _ref_table(shards):
+    return reducer_table(
+        run_stream(
+            shards, elastic.stub_encode_stats_fn(), batch_size=2,
+            image_size=SIZE,
+        ).table
+    )
+
+
+def _coordinator(shards, tmp_path, **kw):
+    kw.setdefault("policy", _fast_policy())
+    coord = elastic.ElasticCoordinator(
+        shards, str(tmp_path / "_journal"), image_size=SIZE,
+        batch_size=2, **kw,
+    )
+    coord.start()
+    return coord
+
+
+def _start_worker(coord, wid, fn=None, **kw):
+    kw.setdefault("retry", _fast_retry())
+    kw.setdefault("max_idle_s", 15.0)
+    t = threading.Thread(
+        target=elastic.run_worker,
+        args=(coord.address, wid, fn or elastic.stub_encode_stats_fn()),
+        kwargs=kw, daemon=True,
+    )
+    t.start()
+    return t
+
+
+def _poll(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _finish(coord, threads, timeout=30.0):
+    assert coord.wait(timeout=timeout), "elastic run did not settle"
+    for t in threads:
+        t.join(timeout=15.0)
+    doc = coord.report()
+    table = reducer_table(coord.table())
+    coord.stop()
+    assert validate_elastic_report(doc) == []
+    return doc, table
+
+
+# ------------------------------------------------------------- happy path
+def test_elastic_two_workers_byte_identical_table(shards, tmp_path):
+    ref = _ref_table(shards)
+    coord = _coordinator(shards, tmp_path)
+    threads = [_start_worker(coord, f"w{i}") for i in range(2)]
+    doc, table = _finish(coord, threads)
+    assert table == ref
+    t = doc["totals"]
+    assert t["committed"] == len(shards) and t["quarantined"] == 0
+    assert sum(
+        w["committed"] for w in doc["workers"].values()
+    ) == len(shards)
+    # every shard committed under a valid lease, exactly once
+    assert all(s["status"] == "committed" and s["worker"]
+               for s in doc["shards"])
+
+
+# -------------------------------------------------- dead worker (kill -9)
+def test_dead_worker_lease_reassigned_worker_exit(shards, tmp_path):
+    ref = _ref_table(shards)
+    coord = _coordinator(shards, tmp_path)
+    # a worker that leases a shard and dies without a word: dirty socket
+    # close while the lease is held — the kill -9 signature
+    fake = elastic.WorkerClient(coord.address, "casualty")
+    grant = fake.lease()
+    assert grant["shard"] is not None
+    fake._sock.shutdown(socket.SHUT_RDWR)  # no bye — EOF, lease held
+    fake._sock.close()
+    assert _poll(lambda: any(
+        r["cause"] == "worker_exit" and r["index"] == grant["index"]
+        for r in coord.state()["reassignments"]
+    )), "dirty disconnect did not trigger worker_exit reassignment"
+    threads = [_start_worker(coord, "survivor")]
+    doc, table = _finish(coord, threads)
+    assert table == ref
+    rec = doc["shards"][grant["index"]]
+    assert rec["status"] == "committed" and rec["worker"] == "survivor"
+    assert rec["epoch"] > grant["epoch"]  # re-run under a higher epoch
+    assert doc["workers"]["casualty"]["dead"] is True
+
+
+# ------------------------------------- stale heartbeat + journal fencing
+def test_stale_heartbeat_revokes_and_fences_commit(shards, tmp_path):
+    ref = _ref_table(shards)
+    coord = _coordinator(shards, tmp_path)
+    fake = elastic.WorkerClient(coord.address, "paused")
+    grant = fake.lease()
+    assert grant["shard"] is not None
+    fake.heartbeat(grant["index"], grant["epoch"])
+    assert _poll(lambda: any(
+        r["cause"] == "stale_heartbeat"
+        and r["index"] == grant["index"]
+        for r in coord.state()["reassignments"]
+    ), timeout_s=5.0), "silent lease was not revoked as stale_heartbeat"
+    # the paused worker resumes and tries to commit: the fenced journal
+    # must reject BEFORE any marker byte lands
+    journal = elastic.LeasedJournal(str(tmp_path / "_journal"), fake)
+    journal.set_lease(grant["index"], grant["epoch"])
+    shard_base = os.path.basename(grant["shard"])
+    with pytest.raises(StaleLeaseError):
+        journal.record(shard_base, category=0, sums=[1.0] * 5, images=3)
+    assert journal.done(shard_base) is None  # no marker on disk
+    threads = [_start_worker(coord, "healthy")]
+    doc, table = _finish(coord, threads)
+    assert table == ref
+    assert doc["totals"]["fenced_rejections"] >= 1
+    assert any(r["op"] == "precommit" and r["worker"] == "paused"
+               for r in doc["fenced_rejections"])
+    # a stale worker's local quarantine path calls journal.invalidate —
+    # which on a LeasedJournal must be a no-op, or the loser would
+    # unlink the WINNER's committed marker and break crash-resume
+    assert journal.done(shard_base) is not None
+    journal.invalidate(shard_base)
+    assert journal.done(shard_base) is not None
+    fake.close()
+
+
+# ------------------------------------- straggler: first committed wins
+def test_straggler_duplicate_lease_first_commit_wins(shards, tmp_path):
+    ref = _ref_table(shards)
+    coord = _coordinator(
+        shards, tmp_path,
+        policy=_fast_policy(straggler_factor=2.0, straggler_min_s=0.25,
+                            straggler_min_done=2),
+    )
+    # the slow worker starts alone so it owns Easy_0, then stalls on it
+    slow_fn = elastic.stub_encode_stats_fn(
+        slow_shards=("Easy_0",), slow_delay_s=1.2
+    )
+    slow = _start_worker(coord, "slow", fn=slow_fn)
+    assert _poll(lambda: 0 in coord.state()["leases"]), \
+        "slow worker never leased Easy_0"
+    fast = _start_worker(coord, "fast")
+    doc, table = _finish(coord, [slow, fast])
+    assert table == ref
+    dup = [r for r in doc["reassignments"] if r["cause"] == "straggler"]
+    assert dup and dup[0]["shard"] == "Easy_0.tar"
+    rec = doc["shards"][0]
+    assert rec["status"] == "committed" and rec["worker"] == "fast"
+    # the slow original was fenced off when it finally tried to commit
+    assert any(r["worker"] == "slow" for r in doc["fenced_rejections"])
+    assert doc["totals"]["committed"] == len(shards)
+
+
+# ----------------------------------------------- poison worker drained
+def test_poison_worker_drained_and_shards_redistributed(shards, tmp_path):
+    ref = _ref_table(shards)
+    coord = _coordinator(
+        shards, tmp_path, policy=_fast_policy(poison_failures=2),
+    )
+    healthy = _start_worker(coord, "healthy")
+    assert _poll(lambda: "healthy" in coord.state()["workers"])
+    poison_fn = elastic.stub_encode_stats_fn(fail_shards=(".tar",))
+    poison = _start_worker(
+        coord, "poison", fn=poison_fn, retry=_fast_retry(max_attempts=1),
+    )
+    doc, table = _finish(coord, [healthy, poison])
+    assert table == ref
+    assert doc["workers"]["poison"]["drained"] is True
+    assert doc["totals"]["drained_workers"] == 1
+    redistributed = [r for r in doc["reassignments"]
+                     if r["cause"] == "poison_worker"]
+    assert len(redistributed) >= 2  # each reported failure reassigned
+    assert doc["totals"]["committed"] == len(shards)
+    assert doc["workers"]["healthy"]["committed"] == len(shards)
+
+
+# --------------------------------------------------- journal satellites
+def test_journal_worker_epoch_fields_roundtrip_and_backcompat(tmp_path):
+    journal = ShardJournal(str(tmp_path))
+    # new-style marker: worker/epoch ride along, digest still validates
+    journal.record("Easy_0.tar", category=0, sums=[1, 2, 3, 4, 5],
+                   images=5, worker="w0", epoch=3)
+    entry = journal.done("Easy_0.tar")
+    assert entry is not None
+    assert entry["worker"] == "w0" and entry["epoch"] == 3
+    # old-style marker (no fields) still validates — resume folds it
+    journal.record("Easy_1.tar", category=0, sums=[1, 1, 1, 1, 2],
+                   images=2)
+    old = journal.done("Easy_1.tar")
+    assert old is not None and "worker" not in old and "epoch" not in old
+
+
+def test_stale_epoch_commit_rejected_leaves_no_marker(tmp_path):
+    journal = ShardJournal(str(tmp_path))
+
+    def fence():
+        raise StaleLeaseError("epoch 1 revoked")
+
+    with pytest.raises(StaleLeaseError):
+        journal.record("Easy_0.tar", category=0, sums=[1] * 5, images=3,
+                       worker="w0", epoch=1, fence=fence)
+    assert journal.done("Easy_0.tar") is None
+    assert os.listdir(str(tmp_path)) == []  # not even a tmp file
+
+
+def test_coordinator_resume_folds_old_markers_unchanged(shards, tmp_path):
+    ref = _ref_table(shards)
+    journal_dir = str(tmp_path / "_journal")
+    # journal every shard in the PRE-ELASTIC marker format (no
+    # worker/epoch) — exactly what a PR 2 run left behind
+    acc = run_stream(
+        shards, elastic.stub_encode_stats_fn(), batch_size=2,
+        image_size=SIZE, journal=ShardJournal(journal_dir),
+    )
+    assert reducer_table(acc.table) == ref
+    coord = elastic.ElasticCoordinator(
+        shards, journal_dir, image_size=SIZE, batch_size=2,
+        resume=True, policy=_fast_policy(),
+    )
+    coord.start()
+    assert coord.wait(timeout=5.0)  # settles with zero workers
+    doc = coord.report()
+    table = reducer_table(coord.table())
+    coord.stop()
+    assert validate_elastic_report(doc) == []
+    assert table == ref
+    assert doc["totals"]["resumed"] == len(shards)
+    assert doc["totals"]["committed"] == 0
+
+
+def test_stale_marker_race_rewrites_winner_not_unlink(shards, tmp_path):
+    """The straggler commit race: the loser's marker landed on disk
+    LAST, then its commit was rejected. The coordinator must re-stamp
+    the winner's marker (it holds the accepted entry) — unlinking would
+    leave a committed shard with no marker and break crash-resume."""
+    journal_dir = str(tmp_path / "_journal")
+    coord = elastic.ElasticCoordinator(
+        shards, journal_dir, image_size=SIZE, batch_size=2,
+        policy=_fast_policy(),
+    )
+    shard = coord._shards[0]
+    win = {"shard": "Easy_0.tar", "category": 0,
+           "sums": [1.0, 2.0, 3.0, 4.0, 3.0], "images": 3,
+           "skipped_images": 0, "skipped_members": 0,
+           "nonfinite_images": 0, "attempts": 1, "wall_s": 0.1}
+    shard.status = "committed"
+    shard.entry = win
+    shard.worker, shard.epoch = "winner", 2
+    # the loser's stale-epoch marker is what sits on disk
+    ShardJournal(journal_dir).record(
+        "Easy_0.tar", category=0, sums=win["sums"], images=3,
+        worker="loser", epoch=1,
+    )
+    coord._invalidate_stale_marker(0, 1)
+    entry = coord.journal.done("Easy_0.tar")
+    assert entry is not None, "committed shard lost its marker"
+    assert entry["worker"] == "winner" and entry["epoch"] == 2
+    # an UNSETTLED shard's stale marker is still dropped outright
+    shard2 = coord._shards[1]
+    ShardJournal(journal_dir).record(
+        "Easy_1.tar", category=0, sums=[1] * 5, images=4,
+        worker="loser", epoch=1,
+    )
+    shard2.next_epoch = 2  # epoch 1 was revoked
+    coord._invalidate_stale_marker(1, 1)
+    assert coord.journal.done("Easy_1.tar") is None
+
+
+# ------------------------------------------------- fault-point parity
+def test_fault_point_vocabulary_matches_fire_call_sites():
+    """The faults.POINTS table (and the module docstring documenting
+    it) must match the literal fire()/corrupt_bytes()/poison() call
+    sites in the library — the vocabulary cannot drift again."""
+    pattern = re.compile(
+        r"faults\.(?:fire|corrupt_bytes|poison)\(\s*[\"']([\w.]+)[\"']"
+    )
+    found = set()
+    for dirpath, dirnames, filenames in os.walk(
+        os.path.join(REPO, "tmr_tpu")
+    ):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn)) as f:
+                    found |= set(pattern.findall(f.read()))
+    assert found == set(faults.POINTS), (
+        f"faults.POINTS drifted from call sites: registry-only "
+        f"{set(faults.POINTS) - found}, unregistered {found - set(faults.POINTS)}"
+    )
+    for point in faults.POINTS:  # the docstring table names every point
+        assert point in (faults.__doc__ or ""), (
+            f"{point!r} missing from the faults.py docstring table"
+        )
+
+
+def test_new_fault_points_parse_and_fire():
+    faults.configure("lease:shard=1:attempts=2:raise=OSError;"
+                     "heartbeat:latency=0;steal:shard=0:raise=RuntimeError")
+    with faults.shard_scope(1, 1):
+        with pytest.raises(OSError):
+            faults.fire("lease")
+    with faults.shard_scope(1, 2):
+        faults.fire("lease")  # epoch 2: past the attempts bound
+    with faults.shard_scope(0, 1):
+        with pytest.raises(RuntimeError):
+            faults.fire("steal")
+    assert {f["point"] for f in faults.fired()} == {"lease", "steal"}
+
+
+# ---------------------------------------------------- report validator
+def test_elastic_report_validator_rejects_drift():
+    doc = {
+        "schema": "elastic_report/v1",
+        "shards": [{
+            "index": 0, "shard": "Easy_0.tar", "status": "committed",
+            "worker": "w0", "epoch": 1, "assignments": 1,
+            "failures": [], "images": 3, "wall_s": 0.1,
+        }],
+        "workers": {"w0": {"committed": 1, "failed_shards": [],
+                           "drained": False}},
+        "reassignments": [], "fenced_rejections": [],
+        "quarantined": [], "resumed": [],
+        "totals": {"shards": 1, "committed": 1, "resumed": 0,
+                   "quarantined": 0, "reassignments": 0,
+                   "fenced_rejections": 0, "workers": 1,
+                   "drained_workers": 0, "wall_s": 0.1},
+    }
+    assert validate_elastic_report(doc) == []
+    bad = dict(doc, reassignments=[{
+        "shard": "Easy_0.tar", "worker": "w0", "epoch": 1,
+        "cause": "cosmic_rays",
+    }])
+    bad["totals"] = dict(doc["totals"], reassignments=1)
+    assert any("bad cause" in p for p in validate_elastic_report(bad))
+    assert "cosmic_rays" not in ELASTIC_REASSIGN_CAUSES
+    # totals that do not reconcile are a validation failure, not a nit
+    bad2 = dict(doc, totals=dict(doc["totals"], committed=0, resumed=1))
+    assert any("committed" in p for p in validate_elastic_report(bad2))
+
+
+def test_worker_client_refuses_unknown_op(shards, tmp_path):
+    coord = _coordinator(shards[:1], tmp_path)
+    fake = elastic.WorkerClient(coord.address, "probe")
+    assert fake._call({"op": "frobnicate"})["ok"] is False
+    fake.close()
+    coord.stop()

@@ -278,6 +278,7 @@ LOCK_FILES = (
     "tmr_tpu/serve/caches.py",
     "tmr_tpu/serve/admission.py",
     "tmr_tpu/serve/degrade.py",
+    "tmr_tpu/parallel/elastic.py",
     "tmr_tpu/utils/faults.py",
     "tmr_tpu/obs/metrics.py",
 )

@@ -245,6 +245,20 @@ ENV_KNOBS = {
     "TMR_FLIGHT_RING": "flight-recorder ring capacity (records)",
     "TMR_HEALTH_INTERVAL_S": "health-heartbeat JSONL write interval "
         "seconds",
+    # elastic map phase (parallel/elastic.py coordinator/worker leases)
+    "TMR_ELASTIC_TTL_S": "lease heartbeat budget seconds: a lease not "
+        "beaten for this long is revoked and its shard reassigned",
+    "TMR_ELASTIC_HB_S": "worker heartbeat cadence seconds (default "
+        "TTL/4 so one dropped beat never revokes)",
+    "TMR_ELASTIC_CHECK_S": "coordinator liveness-check interval seconds",
+    "TMR_ELASTIC_STRAGGLER_FACTOR": "straggler bound as a multiple of "
+        "the rolling median shard wall time (0 disables speculative "
+        "duplicate leases)",
+    "TMR_ELASTIC_STRAGGLER_MIN_S": "straggler bound floor seconds",
+    "TMR_ELASTIC_MAX_REASSIGNS": "per-shard reassignment bound before "
+        "the shard is quarantined outright",
+    "TMR_ELASTIC_POISON_FAILURES": "distinct failed shards before a "
+        "worker is drained and its shards redistributed",
     # fault injection (tests/chaos probe)
     "TMR_FAULTS": "deterministic fault-injection schedule",
     "TMR_FAULTS_SEED": "fault-schedule RNG seed",

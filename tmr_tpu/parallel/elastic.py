@@ -1,0 +1,1271 @@
+"""Elastic map phase: lease-based coordinator/worker shard execution.
+
+PR 2's executor made the map phase crash-proof on ONE host walking a
+static shard list; the reference repo's Hadoop Streaming layer got more
+for free from the JobTracker — dead mappers reassigned, stragglers
+speculatively re-executed. This module is that story rebuilt TPU-native,
+layered on the durable journal so nothing about the single-process
+correctness contract changes:
+
+- the **coordinator** owns the shard queue as *leases*: an
+  atomically-written ``<journal>/_leases/<stem>.json`` record
+  (``atomicio.atomic_write``) carrying worker id, a monotonically
+  increasing per-shard **epoch**, and an expiry. It serves a tiny
+  JSON-lines TCP protocol (plain sockets — runs under
+  ``JAX_PLATFORMS=cpu`` in tier-1 and multi-host JAX in production);
+- **workers** (separate processes or threads) lease one shard at a
+  time, run the existing ``mapreduce._run_stream_impl`` shard-attempt
+  machinery unchanged (retry/backoff/stall-timeout/quarantine all
+  apply), heartbeat the lease on an interval
+  (``obs.flight.Heartbeat`` — the emit callable sends the beat), and
+  commit the journal done-marker before releasing;
+- **liveness** is PR 2's stall-timeout generalized across processes: a
+  lease whose heartbeat goes stale past the TTL is revoked and the
+  shard reassigned under an incremented epoch (cause
+  ``stale_heartbeat``); a worker whose control connection drops while
+  it holds a lease is reassigned immediately (``worker_exit``);
+- **fencing** is what makes all of that safe: every journal commit is
+  fenced on the CURRENT lease epoch (``journal.record(fence=...)`` →
+  a precommit round-trip). A paused-then-resumed worker whose lease was
+  revoked raises ``StaleLeaseError`` before its marker touches disk —
+  it can never corrupt the table — and the rejection is counted in the
+  report. The journal's digest check plus ``atomic_save_npy``
+  idempotence already make double-execution of the FEATURE writes
+  harmless;
+- **stragglers**: when a shard's runtime exceeds a rolling-median-based
+  bound, the coordinator duplicate-leases it (cause ``straggler``) —
+  first committed marker wins, the fencing rejects the loser;
+- **poison workers**: a worker that reports failures on N distinct
+  shards is drained (its lease requests refused, held leases
+  redistributed), mirroring PR 2's poison-shard quarantine at worker
+  granularity; a shard failed by several distinct workers is
+  quarantined like the single-process path would.
+
+The final stats table folds one float64 contribution per shard in
+shard-list order — exactly the single-process fold — so an elastic run
+over any number of workers, kills, and reassignments produces a
+**byte-identical** table (scripts/chaos_probe.py --elastic proves it
+under kill -9 and SIGSTOP). Everything is accounted in one validated
+``elastic_report/v1`` document (diagnostics.validate_elastic_report).
+
+Env knobs (all lazily read, registered in config.ENV_KNOBS):
+``TMR_ELASTIC_TTL_S``, ``TMR_ELASTIC_HB_S``, ``TMR_ELASTIC_CHECK_S``,
+``TMR_ELASTIC_STRAGGLER_FACTOR``, ``TMR_ELASTIC_STRAGGLER_MIN_S``,
+``TMR_ELASTIC_MAX_REASSIGNS``, ``TMR_ELASTIC_POISON_FAILURES``.
+
+Import-light on purpose: nothing here imports jax at module load — the
+worker pulls mapreduce (and through it jax) lazily, so the coordinator
+can run on a box with no accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tmr_tpu import obs
+from tmr_tpu.diagnostics import (
+    ELASTIC_REPORT_SCHEMA,
+    validate_elastic_report,
+)
+from tmr_tpu.parallel.journal import (
+    ShardJournal,
+    StaleLeaseError,
+    shard_stem,
+)
+from tmr_tpu.utils import faults
+from tmr_tpu.utils.atomicio import atomic_write
+
+#: schema tag stamped on every lease record under ``_leases/``
+LEASE_SCHEMA = "lease/v1"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPolicy:
+    """Liveness / straggler / poison knobs for one elastic run.
+
+    ``lease_ttl_s`` is the heartbeat budget: a lease not heartbeated for
+    this long is revoked and its shard reassigned. ``hb_interval_s`` is
+    the worker's beat cadence (default TTL/4 so one dropped beat never
+    revokes). ``straggler_factor`` scales the rolling median of
+    completed shard wall times into the speculative-re-execution bound
+    (0 disables); ``straggler_min_done`` completed shards are required
+    before the median means anything. ``max_reassigns`` bounds how many
+    times one shard may bounce before it is quarantined outright;
+    ``poison_failures`` distinct failed shards drain a worker;
+    ``shard_fail_workers`` distinct workers failing one shard quarantine
+    the shard (the deterministic-poison-data verdict)."""
+
+    lease_ttl_s: float = 10.0
+    hb_interval_s: float = 2.5
+    check_interval_s: float = 1.0
+    straggler_factor: float = 3.0
+    straggler_min_s: float = 5.0
+    straggler_min_done: int = 3
+    max_reassigns: int = 4
+    poison_failures: int = 3
+    shard_fail_workers: int = 2
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ElasticPolicy":
+        """Resolve defaults from the TMR_ELASTIC_* env knobs (read
+        lazily, at call time), then apply explicit overrides."""
+        ttl = _env_float("TMR_ELASTIC_TTL_S", 10.0)
+        base = dict(
+            lease_ttl_s=ttl,
+            hb_interval_s=_env_float("TMR_ELASTIC_HB_S", ttl / 4.0),
+            check_interval_s=_env_float("TMR_ELASTIC_CHECK_S", ttl / 10.0),
+            straggler_factor=_env_float("TMR_ELASTIC_STRAGGLER_FACTOR", 3.0),
+            straggler_min_s=_env_float("TMR_ELASTIC_STRAGGLER_MIN_S", 5.0),
+            max_reassigns=_env_int("TMR_ELASTIC_MAX_REASSIGNS", 4),
+            poison_failures=_env_int("TMR_ELASTIC_POISON_FAILURES", 3),
+        )
+        base.update(overrides)
+        return cls(**base)
+
+
+# ------------------------------------------------------------ wire protocol
+def _send_line(sock: socket.socket, doc: dict) -> None:
+    sock.sendall((json.dumps(doc) + "\n").encode())
+
+
+def _recv_line(f) -> Optional[dict]:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+def oneshot(address: Tuple[str, int], doc: dict,
+            timeout: float = 10.0) -> dict:
+    """One request/response on a fresh connection (heartbeats use this
+    so beats never interleave with the control channel)."""
+    with socket.create_connection(address, timeout=timeout) as sock:
+        _send_line(sock, doc)
+        with sock.makefile("rb") as f:
+            reply = _recv_line(f)
+    if reply is None:
+        raise ConnectionError("coordinator closed the connection")
+    return reply
+
+
+# --------------------------------------------------------- coordinator state
+class _Lease:
+    __slots__ = ("worker", "epoch", "granted_at", "expires_at", "hb")
+
+    def __init__(self, worker: str, epoch: int, granted_at: float,
+                 ttl_s: float):
+        self.worker = worker
+        self.epoch = epoch
+        self.granted_at = granted_at
+        self.expires_at = granted_at + ttl_s
+        self.hb = 0
+
+
+class _Shard:
+    __slots__ = (
+        "index", "path", "category", "stem", "status", "next_epoch",
+        "leases", "assignments", "failures", "failed_workers", "entry",
+        "worker", "epoch", "straggled", "first_granted_at", "wall_s",
+        "images", "cleaned",
+    )
+
+    def __init__(self, index: int, path: str, category: int):
+        self.index = index
+        self.path = path
+        self.category = category
+        self.stem = shard_stem(os.path.basename(path))
+        self.status = "pending"  # pending|leased|committed|resumed|quarantined
+        self.next_epoch = 1
+        self.leases: Dict[int, _Lease] = {}
+        self.assignments = 0
+        self.failures: List[dict] = []
+        self.failed_workers: set = set()
+        self.entry: Optional[dict] = None
+        self.worker: Optional[str] = None
+        self.epoch: Optional[int] = None
+        self.straggled = False
+        self.first_granted_at: Optional[float] = None
+        self.wall_s = 0.0
+        self.images = 0
+        self.cleaned = False
+
+    @property
+    def settled(self) -> bool:
+        return self.status in ("committed", "resumed", "quarantined")
+
+
+class _Worker:
+    __slots__ = ("wid", "committed", "failed", "drained", "dead", "bye")
+
+    def __init__(self, wid: str):
+        self.wid = wid
+        self.committed = 0
+        self.failed: set = set()
+        self.drained = False
+        self.dead = False
+        self.bye = False
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    """One thread per connection; JSON lines in, JSON lines out. The
+    first ``hello`` marks the connection as a worker's control channel —
+    EOF on a control channel with leases still held is the kill -9
+    signature and triggers immediate reassignment."""
+
+    def handle(self):  # noqa: D102 — protocol loop
+        coord = self.server.coordinator  # type: ignore[attr-defined]
+        control_worker = None
+        clean = False
+        try:
+            while True:
+                try:
+                    msg = _recv_line(self.rfile)
+                except (OSError, ValueError):
+                    break
+                if msg is None:
+                    break
+                if msg.get("op") == "hello":
+                    control_worker = msg.get("worker")
+                if msg.get("op") == "bye":
+                    clean = True
+                reply = coord.dispatch(msg)
+                try:
+                    _send_line(self.connection, reply)
+                except OSError:
+                    break
+                if clean:
+                    break
+        finally:
+            if control_worker is not None:
+                coord.control_closed(control_worker, clean=clean)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ElasticCoordinator:
+    """Owns the shard queue as epoch-fenced leases and serves the worker
+    protocol. All mutable run state lives behind ``self._lock``; socket
+    I/O and fault-point firing happen outside it."""
+
+    def __init__(
+        self,
+        shard_paths: Sequence[str],
+        journal_dir: str,
+        *,
+        features_out: Optional[str] = None,
+        data_dir: Optional[str] = None,
+        image_size: int = 1024,
+        batch_size: int = 8,
+        resume: bool = False,
+        policy: Optional[ElasticPolicy] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        from tmr_tpu.parallel.mapreduce import category_of
+
+        self.policy = policy or ElasticPolicy.from_env()
+        self.journal = ShardJournal(journal_dir)
+        self.lease_dir = os.path.join(self.journal.directory, "_leases")
+        os.makedirs(self.lease_dir, exist_ok=True)
+        # like the shard paths: workers resolve this from their own cwd,
+        # so a relative features tree would scatter across worker cwds
+        self.features_out = (
+            os.path.abspath(features_out) if features_out else None
+        )
+        self.data_dir = data_dir
+        self.image_size = int(image_size)
+        self.batch_size = int(batch_size)
+        self._host, self._port = host, int(port)
+        self._lock = threading.RLock()
+        # workers may run in any cwd on any host sharing the filesystem —
+        # a lease must hand them a path that resolves from anywhere
+        self._shards = [
+            _Shard(i, os.path.abspath(p), category_of(p))
+            for i, p in enumerate(shard_paths)
+        ]
+        stems = [s.stem for s in self._shards]
+        if len(set(stems)) != len(stems):
+            raise ValueError(
+                "duplicate shard journal keys cannot be leased "
+                "unambiguously; rename the shards"
+            )
+        self._pending: deque = deque()
+        self._workers: Dict[str, _Worker] = {}
+        self._reassignments: List[dict] = []
+        self._fenced: List[dict] = []
+        self._settled = 0
+        self._done_event = threading.Event()
+        self._stop_event = threading.Event()
+        self._server: Optional[_Server] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()
+        self._wall_s = 0.0
+        for shard in self._shards:
+            entry = self.journal.done(
+                os.path.basename(shard.path)
+            ) if resume else None
+            if entry is not None:
+                shard.status = "resumed"
+                shard.entry = entry
+                shard.worker = entry.get("worker")
+                shard.epoch = entry.get("epoch")
+                shard.images = int(entry.get("images", 0))
+                self._settled += 1
+            else:
+                self._pending.append(shard.index)
+        if self._settled == len(self._shards):
+            self._done_event.set()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> Tuple[str, int]:
+        """Bind the server + liveness monitor; returns (host, port)."""
+        server = _Server((self._host, self._port), _Handler)
+        server.coordinator = self  # type: ignore[attr-defined]
+        server_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            name="elastic-coordinator", daemon=True,
+        )
+        monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="elastic-monitor", daemon=True,
+        )
+        with self._lock:
+            self._server = server
+            self._server_thread = server_thread
+            self._monitor_thread = monitor_thread
+            self._t0 = time.monotonic()
+        server_thread.start()
+        monitor_thread.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        with self._lock:
+            assert self._server is not None, "coordinator not started"
+            return self._server.server_address[:2]
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every shard is settled (committed / resumed /
+        quarantined); True when it happened within ``timeout``. A
+        settled wait also runs the quarantine feature sweep, so disk
+        reconciles with the table before the caller reads either."""
+        done = self._done_event.wait(timeout)
+        if done:
+            self._sweep_quarantined()
+        return done
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        with self._lock:
+            server = self._server
+            monitor = self._monitor_thread
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if monitor is not None:
+            monitor.join(timeout=5.0)
+
+    # ------------------------------------------------------------- protocol
+    def dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        handler = {
+            "hello": self._op_hello,
+            "lease": self._op_lease,
+            "heartbeat": self._op_heartbeat,
+            "precommit": self._op_precommit,
+            "commit": self._op_commit,
+            "fail": self._op_fail,
+            "bye": self._op_bye,
+            "state": lambda m: self.state(),
+        }.get(op)
+        if handler is None:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        try:
+            return handler(msg)
+        except Exception as e:  # protocol must answer, never wedge
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _worker_rec(self, wid: str) -> _Worker:
+        rec = self._workers.get(wid)
+        if rec is None:
+            rec = self._workers[wid] = _Worker(wid)
+        return rec
+
+    def _op_hello(self, msg: dict) -> dict:
+        with self._lock:
+            self._worker_rec(str(msg.get("worker")))
+            return {
+                "ok": True,
+                "journal_dir": self.journal.directory,
+                "features_out": self.features_out,
+                "data_dir": self.data_dir,
+                "image_size": self.image_size,
+                "batch_size": self.batch_size,
+                "ttl_s": self.policy.lease_ttl_s,
+                "hb_interval_s": self.policy.hb_interval_s,
+                "shards": len(self._shards),
+            }
+
+    def _op_lease(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        wait = {"shard": None,
+                "wait_s": max(self.policy.check_interval_s, 0.05)}
+        with self._lock:
+            worker = self._worker_rec(wid)
+            if worker.drained:
+                return {"shard": None, "drained": True}
+            if self._done_event.is_set():
+                return {"shard": None, "done": True}
+            # a worker is not handed back a shard it already failed —
+            # UNLESS it is the only non-drained live worker left (the
+            # reassignment bound then ends the ping-pong in quarantine).
+            # Departed workers (clean bye included) are NOT alive: a
+            # sole survivor skipping its failed shard forever would
+            # leave the run unsettleable.
+            others_alive = any(
+                w.wid != wid and not w.drained and not w.dead
+                and not w.bye
+                for w in self._workers.values()
+            )
+            shard = None
+            for _ in range(len(self._pending)):
+                idx = self._pending.popleft()
+                cand = self._shards[idx]
+                if cand.settled:
+                    continue  # a straggler dup whose original won
+                if wid in cand.failed_workers and others_alive:
+                    self._pending.append(idx)  # someone else's to retry
+                    continue
+                shard = cand
+                break
+            if shard is None:
+                return wait
+            epoch = shard.next_epoch
+            shard.next_epoch += 1
+        # the lease fault point fires OUTSIDE the lock (latency specs
+        # sleep here); an injected grant failure re-queues the shard
+        try:
+            with faults.shard_scope(shard.index, epoch):
+                faults.fire("lease")
+        except Exception as e:
+            with self._lock:
+                if not shard.settled:
+                    self._pending.appendleft(shard.index)
+            wait = dict(wait)
+            wait["error"] = f"{type(e).__name__}: {e}"
+            return wait
+        now = time.monotonic()
+        with self._lock:
+            if shard.settled:  # committed while we were firing faults
+                return wait
+            lease = _Lease(wid, epoch, now, self.policy.lease_ttl_s)
+            shard.leases[epoch] = lease
+            shard.status = "leased"
+            shard.assignments += 1
+            if shard.first_granted_at is None:
+                shard.first_granted_at = now
+            self._write_lease(shard, lease, "held")
+            obs.get_registry().counter("elastic.leases_granted").inc()
+        return {
+            "shard": shard.path,
+            "index": shard.index,
+            "epoch": epoch,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+        }
+
+    def _op_heartbeat(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        with self._lock:
+            lease = self._current_lease(index, epoch, wid)
+            if lease is None:
+                return {"ok": False, "cause": "stale_epoch"}
+            # expiry extension is memory-only: the durable lease record
+            # is advisory (rewritten on grant/revoke/commit/fail
+            # transitions) and a per-beat tmp+rename under the protocol
+            # lock would serialize every worker's beat on disk latency
+            lease.expires_at = time.monotonic() + self.policy.lease_ttl_s
+            lease.hb += 1
+            return {"ok": True}
+
+    def _current_lease(self, index: int, epoch: int,
+                       wid: str) -> Optional[_Lease]:
+        if not (0 <= index < len(self._shards)):
+            return None
+        shard = self._shards[index]
+        if shard.settled:
+            return None
+        lease = shard.leases.get(epoch)
+        if lease is None or lease.worker != wid:
+            return None
+        return lease
+
+    def _op_precommit(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        with self._lock:
+            if self._current_lease(index, epoch, wid) is None:
+                self._record_fence(index, wid, epoch, "precommit")
+                return {"ok": False, "cause": "stale_epoch"}
+            return {"ok": True}
+
+    def _op_commit(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        entry = msg.get("entry")
+        with self._lock:
+            lease = self._current_lease(index, epoch, wid)
+            if lease is None or not isinstance(entry, dict):
+                self._record_fence(index, wid, epoch, "commit")
+                self._invalidate_stale_marker(index, epoch)
+                return {"ok": False, "cause": "stale_epoch"}
+            shard = self._shards[index]
+            shard.status = "committed"
+            shard.entry = entry
+            shard.worker = wid
+            shard.epoch = epoch
+            shard.images = int(entry.get("images", 0))
+            shard.wall_s = time.monotonic() - (
+                shard.first_granted_at or lease.granted_at
+            )
+            self._write_lease(shard, lease, "committed")
+            shard.leases.clear()
+            self._worker_rec(wid).committed += 1
+            obs.get_registry().counter("elastic.shards_committed").inc()
+            self._settle_locked()
+            return {"ok": True}
+
+    def _invalidate_stale_marker(self, index: int, epoch: int) -> None:
+        """A stale writer that slipped a marker to disk in the
+        precommit/commit race window must not leave it vouching. When
+        the shard IS committed, the fix is a rewrite, not an unlink: the
+        coordinator re-stamps the WINNER's accepted entry (it holds the
+        full payload) so a committed shard always keeps a valid marker
+        for crash-resume — unlinking would trade one corruption for
+        another. Only an unsettled shard's stale marker is dropped."""
+        if not (0 <= index < len(self._shards)):
+            return
+        shard = self._shards[index]
+        name = os.path.basename(shard.path)
+        entry = self.journal.done(name)
+        if entry is None or entry.get("epoch") != epoch \
+                or epoch == shard.epoch:
+            return
+        if shard.status == "committed" and shard.entry is not None:
+            win = shard.entry
+            self.journal.record(
+                name, category=win["category"], sums=win["sums"],
+                images=win.get("images", 0),
+                skipped_images=win.get("skipped_images", 0),
+                skipped_members=win.get("skipped_members", 0),
+                nonfinite_images=win.get("nonfinite_images", 0),
+                attempts=win.get("attempts", 1),
+                wall_s=win.get("wall_s", 0.0),
+                worker=shard.worker, epoch=shard.epoch,
+            )
+        else:
+            self.journal.invalidate(name)
+
+    def _op_fail(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        causes = msg.get("causes") or []
+        with self._lock:
+            lease = self._current_lease(index, epoch, wid)
+            if lease is None:
+                return {"ok": True, "stale": True}
+            shard = self._shards[index]
+            shard.leases.pop(epoch, None)
+            shard.failures.append({"worker": wid, "causes": causes})
+            shard.failed_workers.add(wid)
+            worker = self._worker_rec(wid)
+            worker.failed.add(index)
+            self._write_lease(shard, lease, "failed")
+            self._reassign_locked(shard, lease, "poison_worker")
+            if len(worker.failed) >= self.policy.poison_failures \
+                    and not worker.drained:
+                worker.drained = True
+                obs.get_registry().counter("elastic.workers_drained").inc()
+                self._revoke_worker_locked(wid, "poison_worker")
+            return {"ok": True, "drained": worker.drained}
+
+    def _op_bye(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        with self._lock:
+            self._worker_rec(wid).bye = True
+            return {"ok": True}
+
+    def control_closed(self, wid: str, clean: bool) -> None:
+        """The worker's control connection ended. A dirty close (no
+        ``bye``) with leases held is a crashed/killed worker — reassign
+        everything it was running immediately."""
+        with self._lock:
+            worker = self._worker_rec(str(wid))
+            if clean or worker.bye:
+                return
+            worker.dead = True
+            self._revoke_worker_locked(str(wid), "worker_exit")
+
+    # ------------------------------------------------------------- liveness
+    def _record_fence(self, index: int, wid: str, epoch: int,
+                      op: str) -> None:
+        shard_name = (
+            os.path.basename(self._shards[index].path)
+            if 0 <= index < len(self._shards) else f"#{index}"
+        )
+        self._fenced.append({
+            "shard": shard_name, "index": index, "worker": wid,
+            "epoch": epoch, "op": op,
+        })
+        obs.get_registry().counter("elastic.fenced_rejections").inc()
+
+    def _reassign_locked(self, shard: _Shard, lease: _Lease,
+                         cause: str) -> None:
+        """Record one reassignment and put the shard back in play (or
+        quarantine it once it has bounced past the policy bound)."""
+        self._reassignments.append({
+            "shard": os.path.basename(shard.path), "index": shard.index,
+            "worker": lease.worker, "epoch": lease.epoch, "cause": cause,
+        })
+        obs.get_registry().counter("elastic.reassignments").inc()
+        if shard.settled:
+            return
+        exhausted = (
+            len(self._reassignments_for(shard.index))
+            > self.policy.max_reassigns
+            or len(shard.failed_workers) >= self.policy.shard_fail_workers
+        )
+        if exhausted and not shard.leases:
+            shard.status = "quarantined"
+            obs.get_registry().counter("elastic.shards_quarantined").inc()
+            self.journal.invalidate(os.path.basename(shard.path))
+            # feature-tree removal is deferred to _sweep_quarantined —
+            # an rmtree here would hold the protocol lock through disk
+            # I/O and stall every worker's heartbeat
+            self._settle_locked()
+            return
+        if not shard.leases:
+            shard.status = "pending"
+        if shard.index not in self._pending and not exhausted:
+            self._pending.appendleft(shard.index)
+
+    def _reassignments_for(self, index: int) -> List[dict]:
+        return [r for r in self._reassignments if r["index"] == index]
+
+    def _revoke_worker_locked(self, wid: str, cause: str) -> None:
+        for shard in self._shards:
+            for epoch, lease in list(shard.leases.items()):
+                if lease.worker == wid:
+                    shard.leases.pop(epoch, None)
+                    shard.next_epoch = max(shard.next_epoch, epoch + 1)
+                    self._write_lease(shard, lease, "revoked")
+                    self._reassign_locked(shard, lease, cause)
+
+    def _sweep_quarantined(self) -> None:
+        """Remove quarantined shards' feature files — the coordinator is
+        the ONLY party allowed to do this (workers cannot tell their own
+        stale failure from another worker's success). Runs OUTSIDE the
+        protocol lock (rmtree on a big tree must not stall heartbeats);
+        the monitor calls it every pass and ``wait`` once more at
+        settle. Best-effort: feature writes are idempotent but unfenced,
+        so a paused writer resuming after the sweep can recreate files —
+        the journal fence keeps the TABLE exact regardless."""
+        with self._lock:
+            targets = [
+                s for s in self._shards
+                if s.status == "quarantined" and not s.cleaned
+            ]
+            for shard in targets:
+                shard.cleaned = True
+        if not targets:
+            return
+        _save, cleanup, _sync = make_feature_sinks(self.features_out)
+        if cleanup is None:
+            return
+        for shard in targets:
+            try:
+                cleanup(os.path.basename(shard.path))
+            except Exception:
+                pass
+
+    def _settle_locked(self) -> None:
+        self._settled = sum(1 for s in self._shards if s.settled)
+        if self._settled == len(self._shards):
+            self._wall_s = time.monotonic() - self._t0
+            self._done_event.set()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_event.wait(self.policy.check_interval_s):
+            if not self._done_event.is_set():
+                self._monitor_pass()
+            self._sweep_quarantined()  # outside the protocol lock
+
+    def _monitor_pass(self) -> None:
+        now = time.monotonic()
+        steal_candidate = None
+        with self._lock:
+            for shard in self._shards:
+                for epoch, lease in list(shard.leases.items()):
+                    if now > lease.expires_at:
+                        shard.leases.pop(epoch, None)
+                        self._write_lease(shard, lease, "revoked")
+                        self._reassign_locked(shard, lease,
+                                              "stale_heartbeat")
+            steal_candidate = self._elect_straggler_locked(now)
+        if steal_candidate is None:
+            return
+        shard, lease = steal_candidate
+        try:
+            # speculative duplicate election — its own fault point,
+            # fired outside the lock (latency specs sleep)
+            with faults.shard_scope(shard.index, lease.epoch):
+                faults.fire("steal")
+        except Exception:
+            with self._lock:
+                shard.straggled = False  # election vetoed; retry later
+            return
+        with self._lock:
+            if shard.settled or not shard.leases:
+                return
+            self._reassignments.append({
+                "shard": os.path.basename(shard.path),
+                "index": shard.index, "worker": lease.worker,
+                "epoch": lease.epoch, "cause": "straggler",
+            })
+            obs.get_registry().counter("elastic.reassignments").inc()
+            obs.get_registry().counter("elastic.stragglers").inc()
+            if shard.index not in self._pending:
+                self._pending.appendleft(shard.index)
+
+    def _elect_straggler_locked(
+        self, now: float
+    ) -> Optional[Tuple[_Shard, _Lease]]:
+        if self.policy.straggler_factor <= 0:
+            return None
+        walls = sorted(
+            s.wall_s for s in self._shards
+            if s.status == "committed" and s.wall_s > 0
+        )
+        if len(walls) < max(self.policy.straggler_min_done, 1):
+            return None
+        n = len(walls)
+        median = walls[n // 2] if n % 2 else 0.5 * (
+            walls[n // 2 - 1] + walls[n // 2]
+        )
+        bound = max(self.policy.straggler_min_s,
+                    self.policy.straggler_factor * median)
+        for shard in self._shards:
+            if shard.settled or shard.straggled or len(shard.leases) != 1:
+                continue
+            (lease,) = shard.leases.values()
+            if now - lease.granted_at > bound:
+                shard.straggled = True
+                return shard, lease
+        return None
+
+    def _write_lease(self, shard: _Shard, lease: _Lease,
+                     state: str) -> None:
+        """The durable lease record (atomic, not fsynced — on a
+        coordinator crash the journal is the source of truth; leases
+        only need to never be half-written)."""
+        doc = {
+            "schema": LEASE_SCHEMA,
+            "shard": os.path.basename(shard.path),
+            "index": shard.index,
+            "worker": lease.worker,
+            "epoch": lease.epoch,
+            "granted_at": lease.granted_at,
+            "expires_at": lease.expires_at,
+            "hb": lease.hb,
+            "state": state,
+        }
+        path = os.path.join(self.lease_dir, shard.stem + ".json")
+        try:
+            atomic_write(path, lambda f: json.dump(doc, f), fsync=False)
+        except OSError:
+            pass  # lease records are advisory; memory state is canonical
+
+    # ------------------------------------------------------------- results
+    def table(self) -> np.ndarray:
+        """The folded (4, 5) stats table — one float64 addition per
+        settled shard in shard-list order, the single-process fold, so
+        the result is byte-identical to a fault-free ``run_stream``."""
+        from tmr_tpu.parallel.mapreduce import StatAccumulator
+
+        acc = StatAccumulator()
+        with self._lock:
+            for shard in self._shards:
+                if shard.entry is not None and shard.status in (
+                    "committed", "resumed"
+                ):
+                    acc.add_totals(shard.category, shard.entry["sums"])
+        return acc.table
+
+    def state(self) -> dict:
+        """Mid-run introspection for probes/tests (NOT the report): held
+        leases, live tallies, settled counts."""
+        with self._lock:
+            return {
+                "ok": True,
+                "settled": self._settled,
+                "shards": len(self._shards),
+                "pending": list(self._pending),
+                "leases": {
+                    shard.index: [
+                        {"worker": l.worker, "epoch": l.epoch, "hb": l.hb}
+                        for l in shard.leases.values()
+                    ]
+                    for shard in self._shards if shard.leases
+                },
+                "statuses": {
+                    os.path.basename(s.path): s.status
+                    for s in self._shards
+                },
+                "reassignments": [dict(r) for r in self._reassignments],
+                "fenced_rejections": [dict(r) for r in self._fenced],
+                "workers": {
+                    w.wid: {"committed": w.committed,
+                            "failed": sorted(w.failed),
+                            "drained": w.drained, "dead": w.dead}
+                    for w in self._workers.values()
+                },
+            }
+
+    def report(self) -> dict:
+        """The final ``elastic_report/v1`` document (call after
+        :meth:`wait`; diagnostics.validate_elastic_report checks it,
+        including the exact totals reconciliation)."""
+        with self._lock:
+            shards = [{
+                "index": s.index,
+                "shard": os.path.basename(s.path),
+                "category": int(s.category),
+                "status": s.status,
+                "worker": s.worker,
+                "epoch": s.epoch,
+                "assignments": s.assignments,
+                "failures": [dict(f) for f in s.failures],
+                "images": s.images,
+                "wall_s": round(s.wall_s, 6),
+            } for s in self._shards]
+            workers = {
+                w.wid: {
+                    "committed": w.committed,
+                    "failed_shards": sorted(w.failed),
+                    "drained": w.drained,
+                    "dead": w.dead,
+                } for w in self._workers.values()
+            }
+            totals = {
+                "shards": len(self._shards),
+                "committed": sum(
+                    1 for s in self._shards if s.status == "committed"
+                ),
+                "resumed": sum(
+                    1 for s in self._shards if s.status == "resumed"
+                ),
+                "quarantined": sum(
+                    1 for s in self._shards if s.status == "quarantined"
+                ),
+                "reassignments": len(self._reassignments),
+                "fenced_rejections": len(self._fenced),
+                "workers": len(self._workers),
+                "drained_workers": sum(
+                    1 for w in self._workers.values() if w.drained
+                ),
+                "wall_s": round(
+                    self._wall_s or (time.monotonic() - self._t0), 6
+                ),
+            }
+            doc = {
+                "schema": ELASTIC_REPORT_SCHEMA,
+                "shards": shards,
+                "workers": workers,
+                "reassignments": [dict(r) for r in self._reassignments],
+                "fenced_rejections": [dict(r) for r in self._fenced],
+                "quarantined": [
+                    os.path.basename(s.path) for s in self._shards
+                    if s.status == "quarantined"
+                ],
+                "resumed": [
+                    os.path.basename(s.path) for s in self._shards
+                    if s.status == "resumed"
+                ],
+                "totals": totals,
+                "metrics": obs.get_registry().snapshot(),
+            }
+        return doc
+
+    def write_report(self, path: str) -> dict:
+        doc = self.report()
+        problems = validate_elastic_report(doc)
+        if problems:  # emit-then-validate: never write a broken document
+            raise ValueError(
+                f"elastic_report failed validation: {problems}"
+            )
+
+        def dump(f):
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+        atomic_write(path, dump)
+        return doc
+
+
+# ----------------------------------------------------------------- worker
+class WorkerClient:
+    """The worker side of the protocol: one persistent control
+    connection for lease/commit/fail (serial request/response) plus
+    fresh one-shot connections for heartbeats. Thread-safe — the lock
+    serializes the control socket."""
+
+    def __init__(self, address: Tuple[str, int], worker_id: str,
+                 timeout: float = 30.0):
+        self.address = (address[0], int(address[1]))
+        self.worker_id = worker_id
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection(self.address,
+                                              timeout=timeout)
+        self._file = self._sock.makefile("rb")
+        self.config = self._call({"op": "hello"})
+
+    def _call(self, doc: dict) -> dict:
+        doc = dict(doc)
+        doc.setdefault("worker", self.worker_id)
+        with self._lock:
+            _send_line(self._sock, doc)
+            reply = _recv_line(self._file)
+        if reply is None:
+            raise ConnectionError("coordinator closed the connection")
+        return reply
+
+    def lease(self) -> dict:
+        return self._call({"op": "lease"})
+
+    def heartbeat(self, index: int, epoch: int) -> dict:
+        """One beat on a fresh connection (never blocks the control
+        channel; a killed worker's missing beats are the liveness
+        signal)."""
+        return oneshot(self.address, {
+            "op": "heartbeat", "worker": self.worker_id,
+            "index": index, "epoch": epoch,
+        })
+
+    def precommit(self, index: int, epoch: int) -> dict:
+        return self._call({"op": "precommit", "index": index,
+                           "epoch": epoch})
+
+    def commit(self, index: int, epoch: int, entry: dict) -> dict:
+        return self._call({"op": "commit", "index": index,
+                           "epoch": epoch, "entry": entry})
+
+    def fail(self, index: int, epoch: int, causes: List[dict]) -> dict:
+        return self._call({"op": "fail", "index": index, "epoch": epoch,
+                           "causes": causes})
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                _send_line(self._sock, {"op": "bye",
+                                        "worker": self.worker_id})
+                self._file.readline()
+            except OSError:
+                pass
+            try:
+                self._file.close()
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class LeasedJournal(ShardJournal):
+    """ShardJournal whose every commit is fenced on the worker's CURRENT
+    lease epoch: ``record`` round-trips a precommit to the coordinator
+    and raises :class:`StaleLeaseError` when the epoch was revoked —
+    before any marker byte touches disk."""
+
+    def __init__(self, directory: str, client: WorkerClient):
+        super().__init__(directory)
+        self._client = client
+        self._fence_lock = threading.Lock()
+        self._index: Optional[int] = None
+        self._epoch: Optional[int] = None
+
+    def set_lease(self, index: int, epoch: int) -> None:
+        with self._fence_lock:
+            self._index, self._epoch = index, epoch
+
+    def record(self, shard_name, *args, **kw):  # noqa: D102
+        with self._fence_lock:
+            index, epoch = self._index, self._epoch
+
+        def fence():
+            reply = self._client.precommit(index, epoch)
+            if not reply.get("ok"):
+                raise StaleLeaseError(
+                    f"lease for shard {shard_name!r} epoch {epoch} was "
+                    f"revoked ({reply.get('cause', 'stale_epoch')}) — "
+                    "commit fenced"
+                )
+
+        kw.setdefault("worker", self._client.worker_id)
+        kw.setdefault("epoch", epoch)
+        kw.setdefault("fence", fence)
+        return super().record(shard_name, *args, **kw)
+
+    def invalidate(self, shard_name: str) -> None:
+        """No-op ON PURPOSE: marker-invalidation authority stays with
+        the coordinator. The executor invalidates on local quarantine —
+        but a worker quarantined by the fence CANNOT tell its own stale
+        failure from another worker's success, so letting it unlink the
+        marker would delete the winner's valid commit (the same reason
+        workers get cleanup_features=None)."""
+
+
+def make_feature_sinks(features_out: Optional[str]):
+    """(save, cleanup, sync) callables writing per-image feature
+    ``.npy`` under ``features_out/<category>/<shard>/`` — the ONE
+    definition of that layout: the mapreduce CLI and elastic workers
+    both call this, so single-process and elastic runs produce
+    byte-identical trees by construction. All None when features are
+    off."""
+    if not features_out:
+        return None, None, None
+    from tmr_tpu.parallel.mapreduce import (
+        CATEGORIES, atomic_save_npy, category_of,
+    )
+    from tmr_tpu.utils.atomicio import fsync_dir
+
+    def shard_dir(shard: str) -> str:
+        cat = CATEGORIES[category_of(shard)]
+        return os.path.join(features_out, cat, shard.replace(".tar", ""))
+
+    def save(shard: str, name: str, feat) -> None:
+        d = shard_dir(shard)
+        os.makedirs(d, exist_ok=True)
+        base = os.path.splitext(os.path.basename(name))[0]
+        atomic_save_npy(os.path.join(d, base + ".npy"), feat)
+
+    def cleanup(shard: str) -> None:
+        import shutil
+
+        shutil.rmtree(shard_dir(shard), ignore_errors=True)
+
+    def sync(shard: str) -> None:
+        fsync_dir(shard_dir(shard))
+
+    return save, cleanup, sync
+
+
+def stub_encode_stats_fn(delay_s: float = 0.0,
+                         slow_shards: Sequence[str] = (),
+                         slow_delay_s: float = 0.0,
+                         fail_shards: Sequence[str] = ()) -> Callable:
+    """A numpy-only encoder stand-in (no XLA compile — the
+    test_overload stub-predictor pattern applied to the map phase):
+    4x-decimated pixels minus 0.5 as 'features' plus the exact
+    feature_stats math in float32 numpy. Deterministic, so a
+    single-process run and any elastic run over the same shards produce
+    byte-identical tables. ``delay_s`` sleeps per batch (paces shards so
+    kills/stalls land mid-shard); ``slow_shards``/``fail_shards`` match
+    on substrings of the current shard set by the worker loop via the
+    returned fn's ``context`` attribute."""
+
+    def encode(images):
+        shard = getattr(encode, "context", "")
+        if any(s in shard for s in fail_shards):
+            raise RuntimeError(f"stub encoder poisoned for {shard!r}")
+        d = delay_s + (
+            slow_delay_s if any(s in shard for s in slow_shards) else 0.0
+        )
+        if d:
+            time.sleep(d)
+        arr = np.asarray(images, np.float32)
+        feats = arr[:, ::4, ::4, :] - 0.5
+        b = feats.shape[0]
+        flat = feats.reshape(b, -1)
+        mean = flat.mean(axis=1)
+        std = np.sqrt(((flat - mean[:, None]) ** 2).mean(axis=1))
+        mx = flat.max(axis=1)
+        spar = (flat <= 0).mean(axis=1)
+        stats = np.stack([mean, std, mx, spar], axis=1)
+        return feats, stats
+
+    encode.context = ""
+    return encode
+
+
+def run_worker(
+    address: Tuple[str, int],
+    worker_id: str,
+    encode_stats_fn: Callable,
+    *,
+    retry=None,
+    hb_path: Optional[str] = None,
+    batch_size: Optional[int] = None,
+    image_size: Optional[int] = None,
+    features_out: Optional[str] = None,
+    max_idle_s: float = 60.0,
+) -> dict:
+    """One worker's whole life: hello, then lease → run the shard
+    through the unchanged ``_run_stream_impl`` attempt machinery →
+    fenced commit (or fail report) → release, until the coordinator says
+    done (or drains us). Returns a summary dict.
+
+    The lease is heartbeated by an ``obs.flight.Heartbeat`` whose emit
+    callable sends the beat (and logs it to ``hb_path`` JSONL when
+    given) — the ``heartbeat`` fault point fires inside emit, so an
+    injected latency stalls beats exactly like a SIGSTOP would."""
+    from tmr_tpu.parallel.mapreduce import (
+        MapReport, RetryPolicy, _load_shard_python, _run_stream_impl,
+    )
+    from tmr_tpu.obs.flight import Heartbeat
+    from tmr_tpu.utils.profiling import log_progress, log_warning
+
+    client = WorkerClient(address, worker_id)
+    cfg = client.config
+    journal = LeasedJournal(cfg["journal_dir"], client)
+    feat_dir = features_out if features_out is not None \
+        else cfg.get("features_out")
+    # cleanup authority stays with the COORDINATOR: a worker whose local
+    # attempt quarantines (a stale fence included) must never delete
+    # feature files another worker may have just committed — the shard's
+    # features are removed only if the coordinator itself quarantines it
+    save, _cleanup_unused, sync = make_feature_sinks(feat_dir)
+    batch = int(batch_size or cfg.get("batch_size") or 8)
+    size = int(image_size or cfg.get("image_size") or 1024)
+    hb_interval = float(cfg.get("hb_interval_s") or 2.5)
+    retry = retry or RetryPolicy()
+    summary = {"worker": worker_id, "committed": 0, "failed": 0,
+               "fenced": 0, "leases": 0, "drained": False}
+    idle_since: Optional[float] = None
+    try:
+        while True:
+            try:
+                grant = client.lease()
+            except (ConnectionError, OSError) as e:
+                # coordinator gone (run settled and it exited, or it
+                # crashed) — a worker outliving it is normal, not fatal
+                log_warning(
+                    f"elastic worker {worker_id}: coordinator "
+                    f"unreachable ({e}); exiting"
+                )
+                break
+            if grant.get("done") or grant.get("drained"):
+                summary["drained"] = bool(grant.get("drained"))
+                break
+            if grant.get("shard") is None:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > max_idle_s:
+                    log_warning(
+                        f"elastic worker {worker_id}: idle past "
+                        f"{max_idle_s}s with the run unfinished; exiting"
+                    )
+                    break
+                time.sleep(float(grant.get("wait_s", 0.2)))
+                continue
+            idle_since = None
+            summary["leases"] += 1
+            path = grant["shard"]
+            index, epoch = int(grant["index"]), int(grant["epoch"])
+            shard_base = os.path.basename(path)
+            journal.set_lease(index, epoch)
+            if hasattr(encode_stats_fn, "context"):
+                encode_stats_fn.context = shard_base
+
+            def emit(index=index, epoch=epoch, shard=shard_base):
+                with faults.shard_scope(index, epoch):
+                    faults.fire("heartbeat")
+                reply = client.heartbeat(index, epoch)
+                return {"worker": worker_id, "shard": shard,
+                        "epoch": epoch, "ok": bool(reply.get("ok"))}
+
+            hb = Heartbeat(
+                emit,
+                hb_path or os.path.join(
+                    journal.directory, "_leases",
+                    f"hb_{worker_id}.jsonl",
+                ),
+                interval_s=hb_interval,
+            )
+            report = MapReport()
+            try:
+                _run_stream_impl(
+                    [path], encode_stats_fn, batch, size, save,
+                    1, _load_shard_python, retry, journal, False, report,
+                    cleanup_features=None, sync_features=sync,
+                )
+            finally:
+                hb.stop(timeout=hb_interval + 5.0)
+            rec = report.document()["shards"][0]
+            if rec["status"] == "ok":
+                entry = journal.done(shard_base)
+                try:
+                    reply = client.commit(index, epoch, entry)
+                except (ConnectionError, OSError) as e:
+                    log_warning(
+                        f"elastic worker {worker_id}: coordinator "
+                        f"unreachable at commit ({e}); exiting"
+                    )
+                    break
+                if reply.get("ok"):
+                    summary["committed"] += 1
+                    log_progress(
+                        f"elastic worker {worker_id}: committed "
+                        f"{shard_base} (epoch {epoch})"
+                    )
+                else:
+                    summary["fenced"] += 1  # lost the commit race
+            elif any(
+                "StaleLeaseError" in str(c.get("error", ""))
+                for c in rec["causes"]
+            ):
+                # fenced at precommit — the coordinator already counted
+                # the rejection and reassigned; nothing to report
+                summary["fenced"] += 1
+                log_progress(
+                    f"elastic worker {worker_id}: fenced off "
+                    f"{shard_base} (epoch {epoch}); moving on"
+                )
+            else:
+                summary["failed"] += 1
+                try:
+                    client.fail(index, epoch, rec["causes"])
+                except (ConnectionError, OSError) as e:
+                    log_warning(
+                        f"elastic worker {worker_id}: coordinator "
+                        f"unreachable at fail report ({e}); exiting"
+                    )
+                    break
+    finally:
+        client.close()
+    return summary

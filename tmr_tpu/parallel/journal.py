@@ -15,6 +15,16 @@ Layout: ``<features_out>/_journal/<shard-stem>.json`` by default
 (``--journal_dir`` overrides). Write ordering is the correctness
 contract: features first, marker last — a crash between the two re-does
 the shard, which is safe because feature writes are atomic + idempotent.
+
+Elastic (multi-worker) extension: a marker can carry WHO committed it —
+optional ``worker``/``epoch`` fields (parallel/elastic.py lease epochs).
+Both are outside the digest's field set, so old markers (no fields)
+still validate and ``--resume`` folds them unchanged. ``record`` also
+takes a ``fence`` callable, invoked right before the marker touches
+disk: a fence that raises (``StaleLeaseError`` — the worker's lease was
+revoked and the shard reassigned under a higher epoch) aborts the
+commit with NO marker written, which is what keeps a paused-then-resumed
+writer from vouching for a shard it no longer owns.
 """
 
 from __future__ import annotations
@@ -22,13 +32,21 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from tmr_tpu.utils import faults
 from tmr_tpu.utils.atomicio import atomic_write
 
 #: schema tag stamped on every done-marker — bump on incompatible change
 MAP_JOURNAL_SCHEMA = "map_journal/v1"
+
+
+class StaleLeaseError(RuntimeError):
+    """A journal commit was fenced: the committing worker's lease epoch
+    is no longer current (revoked after a stale heartbeat / worker exit,
+    or the shard was already committed by a straggler duplicate). The
+    attempt must NOT retry — the shard belongs to someone else now —
+    so the map executor treats this as non-retryable."""
 
 #: payload fields covered by the digest (order matters — it is the
 #: canonical serialization the digest is computed over)
@@ -75,11 +93,19 @@ class ShardJournal:
         nonfinite_images: int = 0,
         attempts: int = 1,
         wall_s: float = 0.0,
+        worker: Optional[str] = None,
+        epoch: Optional[int] = None,
+        fence: Optional[Callable[[], None]] = None,
     ) -> dict:
         """Atomically commit the done-marker for one shard. The ``journal``
         fault point fires before anything touches disk, so an injected
-        journal failure leaves no marker at all (the shard re-runs)."""
+        journal failure leaves no marker at all (the shard re-runs).
+        ``fence`` (when given) runs after the fault point and before the
+        write: raising (StaleLeaseError) aborts the commit marker-less —
+        the stale-epoch rejection the elastic coordinator counts."""
         faults.fire("journal")
+        if fence is not None:
+            fence()
         entry = {
             "schema": MAP_JOURNAL_SCHEMA,
             "shard": shard_name,
@@ -92,6 +118,10 @@ class ShardJournal:
             "attempts": int(attempts),
             "wall_s": float(wall_s),
         }
+        if worker is not None:
+            entry["worker"] = str(worker)
+        if epoch is not None:
+            entry["epoch"] = int(epoch)
         entry["digest"] = _digest(entry)
         atomic_write(self._path(shard_name), lambda f: json.dump(entry, f))
         return entry

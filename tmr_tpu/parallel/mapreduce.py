@@ -56,6 +56,7 @@ import numpy as np
 
 from tmr_tpu import obs
 from tmr_tpu.diagnostics import MAP_REPORT_SCHEMA
+from tmr_tpu.parallel.journal import StaleLeaseError
 from tmr_tpu.utils import faults
 from tmr_tpu.utils.atomicio import atomic_write
 
@@ -63,13 +64,15 @@ CATEGORIES = ("Easy", "Normal", "Hard", "Unknown")  # mapper.py:15-20
 STAT_NAMES = ("sum_mean", "sum_std", "sum_max", "sum_spar", "count")
 
 #: deterministic failures retrying cannot heal (a structurally corrupt
-#: tar, a shard path that does not exist) — quarantine on first sight
-#: instead of burning the whole backoff budget
+#: tar, a shard path that does not exist, a journal commit fenced off by
+#: a revoked lease epoch — the shard belongs to another worker now) —
+#: quarantine on first sight instead of burning the whole backoff budget
 _NON_RETRYABLE = (
     tarfile.ReadError,
     FileNotFoundError,
     NotADirectoryError,
     IsADirectoryError,
+    StaleLeaseError,
 )
 
 
@@ -976,29 +979,12 @@ def _cli_map(args) -> int:
         )
         fn = make_encode_stats_fn(model, params)
 
-    save = None
-    if args.features_out:
+    # ONE definition of the features_out/<category>/<shard>/ layout for
+    # this CLI and the elastic workers — the byte-identical-tree parity
+    # chaos_probe asserts depends on the two paths never drifting
+    from tmr_tpu.parallel.elastic import make_feature_sinks
 
-        def _shard_dir(shard: str) -> str:
-            cat = CATEGORIES[category_of(shard)]
-            return os.path.join(args.features_out, cat,
-                                shard.replace(".tar", ""))
-
-        def save(shard: str, name: str, feat: np.ndarray) -> None:
-            d = _shard_dir(shard)
-            os.makedirs(d, exist_ok=True)
-            base = os.path.splitext(os.path.basename(name))[0]
-            atomic_save_npy(os.path.join(d, base + ".npy"), feat)
-
-        def cleanup(shard: str) -> None:
-            import shutil
-
-            shutil.rmtree(_shard_dir(shard), ignore_errors=True)
-
-        def sync(shard: str) -> None:
-            from tmr_tpu.utils.atomicio import fsync_dir
-
-            fsync_dir(_shard_dir(shard))
+    save, cleanup, sync = make_feature_sinks(args.features_out)
 
     journal_dir = args.journal_dir
     if journal_dir is None and args.features_out:
@@ -1030,8 +1016,8 @@ def _cli_map(args) -> int:
         paths, fn, batch_size=args.batch_size, image_size=args.image_size,
         save_features=save, feeder_threads=args.feeder_threads,
         retry=retry, journal=journal, resume=args.resume, report=report,
-        cleanup_features=cleanup if save is not None else None,
-        sync_features=sync if save is not None else None,
+        cleanup_features=cleanup,
+        sync_features=sync,
     )
     log_info(report.summary_line())
     if args.report_out:
