@@ -1,15 +1,37 @@
 """Deterministic fault injection for the extraction path.
 
-The MapReduce replacement (parallel/mapreduce.py) threads named injection
-points through everything a shard does on its way to the stats table:
+The map phase threads named injection points through everything a shard
+does on its way to the stats table — the single-process executor
+(parallel/mapreduce.py), the journal (parallel/journal.py), and the
+elastic coordinator/worker layer (parallel/elastic.py). The COMPLETE
+point vocabulary (``POINTS``; a parity test pins this table against the
+actual ``fire()``/``corrupt_bytes``/``poison`` call sites):
 
-    tar.open    the shard tar is opened (the `hadoop fs -get` stand-in —
-                a hung NFS/FUSE read lives here)
-    tar.member  one member's payload was read out of the tar
-    decode      one image payload enters PIL decode
-    encode      one batch enters / leaves the jitted encoder
-    save        one per-image feature .npy is about to be written
-    journal     the per-shard done-marker is about to be committed
+    point       fires at (file: site)                       extra actions
+    ---------   -----------------------------------------   -------------
+    tar.open    mapreduce: shard tar opened (the
+                `hadoop fs -get` stand-in — a hung
+                NFS/FUSE read lives here)
+    tar.member  mapreduce: one member's payload was read    corrupt=1
+                out of the tar
+    decode      mapreduce: one image payload enters PIL     corrupt=1
+                decode
+    encode      mapreduce: one batch enters / leaves the    nan=1
+                jitted encoder
+    save        mapreduce: one per-image feature .npy is
+                about to be written
+    journal     journal: the per-shard done-marker is
+                about to be committed
+    lease       elastic: the coordinator is about to
+                grant a shard lease (scope: shard index,
+                epoch)
+    heartbeat   elastic: a worker is about to send a
+                lease heartbeat (latency=S past the TTL
+                is the SIGSTOP stand-in: the lease goes
+                stale and the shard is reassigned)
+    steal       elastic: the coordinator is about to
+                duplicate-lease a straggler shard
+                (speculative re-execution election)
 
 A schedule is a `;`-separated list of specs, each
 ``point[:key=value]*``, installed from the ``TMR_FAULTS`` env var
@@ -54,8 +76,13 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
-#: the closed set of injection point names threaded through mapreduce.py
-POINTS = ("tar.open", "tar.member", "decode", "encode", "save", "journal")
+#: the closed set of injection point names threaded through the map
+#: phase (mapreduce.py / journal.py / elastic.py) — see the module
+#: docstring's point table; tests pin the parity both ways
+POINTS = (
+    "tar.open", "tar.member", "decode", "encode", "save", "journal",
+    "lease", "heartbeat", "steal",
+)
 
 
 class InjectedFault(Exception):
