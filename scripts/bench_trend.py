@@ -29,6 +29,7 @@ from tmr_tpu.diagnostics import validate_bench_trend  # noqa: E402
 from tmr_tpu.utils.bench_trend import (  # noqa: E402
     DEFAULT_THRESHOLD,
     collect_bench_trend,
+    read_serve_sweep,
 )
 
 
@@ -42,7 +43,26 @@ def main(argv=None) -> int:
                          f"(default {DEFAULT_THRESHOLD})")
     ap.add_argument("--out", default=None,
                     help="also write the JSON document to this path")
+    ap.add_argument("--serve-sweep", default=None,
+                    help="read a serve_bench.py --mesh sweep file "
+                         "(JSONL of serve_report/v1 lines) instead of "
+                         "the BENCH history: one JSON line with the "
+                         "per-mesh-shape scaling table; rc 1 when any "
+                         "shape fails its scaling/exactness/AOT checks")
     args = ap.parse_args(argv)
+
+    if args.serve_sweep:
+        doc = read_serve_sweep(args.serve_sweep)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["all_exact"] and ck["all_scaling_ok"]
+                     and ck["all_warm"]) else 1
 
     doc = collect_bench_trend(args.repo, threshold=args.threshold)
     problems = validate_bench_trend(doc)

@@ -431,15 +431,18 @@ def test_attention_impls_hold_no_s2_at_production_grid():
 
 
 def test_program_audit_default_state_production_programs():
-    """The four bucketed production programs (sam_vit_b reduced CPU
+    """The bucketed production programs (sam_vit_b reduced CPU
     geometry) pass every invariant under the ambient env, and the
-    transfer pins hold under the forced-8-device CPU conftest."""
+    transfer pins hold under the forced-8-device CPU conftest — which
+    is also where the mesh-sharded serve variant (match_heads_dp, the
+    shard_map dp program) is traceable and audited."""
     from tmr_tpu.analysis.program_audit import audit_production_programs
 
     rec = audit_production_programs(image_size=64, include_attention=False)
     assert rec["ok"], rec["problems"]
     names = {r["name"] for r in rec["states"][0]["programs"]}
-    assert names == {"match_heads", "backbone", "heads_only", "nms_topk"}
+    assert names == {"match_heads", "match_heads_dp", "backbone",
+                     "heads_only", "nms_topk"}
     assert rec["platform"] == "cpu"
 
 

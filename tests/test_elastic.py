@@ -254,7 +254,15 @@ def test_poison_worker_drained_and_shards_redistributed(shards, tmp_path):
     coord = _coordinator(
         shards, tmp_path, policy=_fast_policy(poison_failures=2),
     )
-    healthy = _start_worker(coord, "healthy")
+    # pace the healthy worker: instant stub encodes on a fast/loaded
+    # host let it drain the whole queue before the poison worker can
+    # fail its second DISTINCT shard, and the drain assertion below
+    # races. ~0.2s per shard guarantees the (instant-failing) poison
+    # worker reaches the poison_failures=2 bound while work remains.
+    healthy_fn = elastic.stub_encode_stats_fn(
+        slow_shards=(".tar",), slow_delay_s=0.2
+    )
+    healthy = _start_worker(coord, "healthy", fn=healthy_fn)
     assert _poll(lambda: "healthy" in coord.state()["workers"])
     poison_fn = elastic.stub_encode_stats_fn(fail_shards=(".tar",))
     poison = _start_worker(

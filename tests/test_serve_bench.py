@@ -126,6 +126,112 @@ def test_serve_bench_tiny_smoke_meets_acceptance_checks(tmp_path):
     assert "[serve_bench]" in out.stderr
 
 
+def _mesh_attachment():
+    return {
+        "spec": "dp2tp2",
+        "shape": {"dp": 2, "tp": 2},
+        "axis_names": ["dp", "tp"],
+        "replica_groups": [["TFRT_CPU_0", "TFRT_CPU_1"],
+                           ["TFRT_CPU_2", "TFRT_CPU_3"]],
+        "tp_size_threshold": 512,
+    }
+
+
+def test_validate_serve_report_mesh_attachment():
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    doc = _valid_doc()
+    doc["mesh"] = _mesh_attachment()
+    assert validate_serve_report(doc) == []
+    # absent mesh = the unsharded engine, still valid (pre-mesh docs)
+    assert validate_serve_report(_valid_doc()) == []
+    for mutate, fragment in [
+        (lambda m: m.update(spec=""), "spec"),
+        (lambda m: m.update(shape={"dp": "two"}), "shape"),
+        (lambda m: m.update(shape={"dp": 0}), "shape"),
+        (lambda m: m.update(axis_names="dp,tp"), "axis_names"),
+        (lambda m: m.update(replica_groups=[]), "replica_groups"),
+        (lambda m: m.update(replica_groups=[[1, 2]]), "replica_groups"),
+    ]:
+        doc = _valid_doc()
+        doc["mesh"] = _mesh_attachment()
+        mutate(doc["mesh"])
+        problems = validate_serve_report(doc)
+        assert any(fragment in p for p in problems), (fragment, problems)
+
+
+def test_read_serve_sweep_reduces_mesh_rounds(tmp_path):
+    from tmr_tpu.utils.bench_trend import read_serve_sweep
+
+    doc = _valid_doc()
+    doc["mesh"] = _mesh_attachment()
+    doc["config"]["devices"] = 4
+    doc["workloads"][0]["single_device_img_per_sec"] = 0.6
+    doc["checks"].update(scaling_vs_single_device=2.0, scaling_ok=True,
+                         parity="bitwise", p99_ms=30.0)
+    doc["aot"] = {"compile_events_after_warmup": 0}
+    sweep = tmp_path / "sweep.jsonl"
+    sweep.write_text(json.dumps(doc) + "\n" + json.dumps(doc) + "\n"
+                     + "not json\n")
+    out = read_serve_sweep(str(sweep))
+    assert out["checks"]["shapes_read"] == 2
+    assert out["checks"]["all_exact"] is True
+    assert out["checks"]["all_scaling_ok"] is True
+    assert out["checks"]["all_warm"] is True
+    row = out["rows"][0]
+    assert row["spec"] == "dp2tp2" and row["scaling"] == 2.0
+    assert row["cold_compiles_after_warmup"] == 0
+    # an empty / mesh-less file is an error record, not a crash
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps(_valid_doc()) + "\n")
+    assert "error" in read_serve_sweep(str(empty))
+    assert "error" in read_serve_sweep(str(tmp_path / "absent.jsonl"))
+
+
+def test_serve_bench_mesh_sweep_smoke(tmp_path):
+    """``--mesh dp2`` on a forced-8-device CPU subprocess: one
+    serve_report/v1 line with a validated mesh attachment, bitwise
+    parity vs the single-device engine, and the AOT zero-cold-compile
+    pin — the tentpole's sweep contract end to end."""
+    out_file = tmp_path / "mesh_sweep.jsonl"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--tiny", "--batch", "1", "--mesh", "dp2",
+         "--out", str(out_file)],
+        env=_serve_env(
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        ),
+        capture_output=True, text=True, timeout=560,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected one line per mesh shape: {lines}"
+    doc = json.loads(lines[0])
+
+    from tmr_tpu.diagnostics import validate_serve_report
+
+    assert validate_serve_report(doc) == []
+    assert "validator_problems" not in doc
+    assert doc["mesh"]["spec"] == "dp2"
+    assert doc["mesh"]["shape"] == {"dp": 2, "tp": 1}
+    assert len(doc["mesh"]["replica_groups"]) == 2
+    checks = doc["checks"]
+    assert checks["parity"] == "bitwise"
+    assert checks["exact_match"] is True
+    assert checks["no_cold_compiles_after_warmup"] is True
+    assert checks["p99_bounded"] is True, checks
+    assert checks["scaling_ok"] is True, checks
+    assert doc["aot"]["warmup"]["programs"] >= 1
+    assert doc["stats"]["per_group_queues"].keys() >= {"group0",
+                                                       "group1", "dp"}
+    # the sweep reader consumes the --out file
+    from tmr_tpu.utils.bench_trend import read_serve_sweep
+
+    reduced = read_serve_sweep(str(out_file))
+    assert reduced["checks"]["shapes_read"] == 1
+    assert reduced["checks"]["all_warm"] is True
+
+
 @pytest.mark.slow
 def test_serve_bench_watchdog_emits_error_record(tmp_path):
     """A wedge yields the contractual one-line error record — still a

@@ -59,6 +59,9 @@ DENSE_BY_DESIGN = ("densefolded",)
 #: auditing a non-default backbone/geometry.
 DEFAULT_TRANSFER_PINS: Dict[str, int] = {
     "match_heads": 24,
+    "match_heads_dp": 24,  # the shard_map dp serve variant: same ViT
+    # constants staged inside the shard_map body — a drift from the
+    # unsharded pin means the sharded trace grew a host hop of its own
     "backbone": 24,
     "heads_only": 0,
     "nms_topk": 0,
@@ -352,6 +355,27 @@ def _trace_programs(pred, params, image_size: int, batch: int,
             out["nms_topk"] = jax.make_jaxpr(
                 lambda b, s, v: nms_topk(b, s, 0.5, valid=v, k=32)
             )(boxes, scores, valid)
+        if "match_heads_dp" in programs:
+            # the mesh-sharded serving variant (shard_map over dp, the
+            # bitwise-exact fan-out path): trace-only like everything
+            # here — the shard_map in_specs path needs no real params.
+            # Needs >= 2 local devices for a dp-2 mesh; a single-device
+            # runtime records a skip instead of failing the audit (the
+            # forced-8-device test conftest is where the pin is load-
+            # bearing).
+            if len(jax.devices()) >= 2:
+                from tmr_tpu.serve.meshplan import MeshPlan
+
+                plan = MeshPlan("dp2", devices=jax.devices())
+                dp_batch = max(2, batch + (batch % 2))
+                img_dp = jax.ShapeDtypeStruct(
+                    (dp_batch, image_size, image_size, 3), jnp.float32
+                )
+                ex_dp = jax.ShapeDtypeStruct((dp_batch, 1, 4),
+                                             jnp.float32)
+                out["match_heads_dp"] = jax.make_jaxpr(
+                    pred._get_sharded_fn(cap, plan.dp_target)
+                )(params, None, img_dp, ex_dp)
     return out
 
 
@@ -364,8 +388,8 @@ def audit_production_programs(
     backbone: str = "sam_vit_b",
     transfer_pins: Optional[Dict[str, int]] = None,
     gate_states: Optional[Sequence[Dict[str, str]]] = None,
-    programs: Sequence[str] = ("match_heads", "backbone", "heads_only",
-                               "nms_topk"),
+    programs: Sequence[str] = ("match_heads", "match_heads_dp",
+                               "backbone", "heads_only", "nms_topk"),
     attention_grids: Sequence[Tuple[int, int]] = ((64, 64),),
     include_attention: bool = True,
     record_refusals: bool = False,

@@ -216,6 +216,15 @@ ENV_KNOBS = {
     "TMR_SERVE_MAX_WAIT_MS": "ServeEngine micro-batch wait bound",
     "TMR_SERVE_EXEMPLAR_CACHE": "result-cache capacity (entries)",
     "TMR_SERVE_FEATURE_CACHE": "device feature-cache capacity (entries)",
+    "TMR_SERVE_MESH": "serving device mesh spec (dp<N>/tp<M>, e.g. "
+        "dp4, tp4, dp2tp2); unset = unsharded round-robin serving",
+    "TMR_SERVE_AOT": "ahead-of-time compile+warmup of the bucketed "
+        "program set at engine start (default: on under a mesh plan "
+        "or explicit warmup buckets; 0 disables)",
+    "TMR_SERVE_WARMUP_TIMEOUT_S": "AOT warmup wall-clock budget; "
+        "programs past it compile lazily instead",
+    "TMR_SERVE_TP_SIZE": "image-size floor for tensor-parallel replica-"
+        "group execution (buckets >= it run tp, smaller fan out dp)",
     "TMR_SERVE_DEADLINE_MS": "default per-request deadline; expired "
         "requests shed before device work (0/unset = none)",
     "TMR_SERVE_DRAIN_TIMEOUT_S": "close() drain bound; leftover futures "

@@ -300,6 +300,21 @@ def validate_health_report(doc: dict) -> List[str]:
             deg.get("level"), int
         ) or not isinstance(deg.get("steps"), list):
             problems.append("degrade: missing level/steps")
+    # optional mesh-serving sections (present only under a mesh plan —
+    # the default-engine shape stays byte-identical to PR 8)
+    problems += _validate_mesh_attachment(doc)
+    per_group = (queues or {}).get("per_group") if isinstance(
+        queues, dict
+    ) else None
+    if per_group is not None:
+        if not isinstance(per_group, dict) or not all(
+            isinstance(rec, dict) and isinstance(rec.get("pending"), int)
+            and isinstance(rec.get("per_bucket"), dict)
+            for rec in per_group.values()
+        ):
+            problems.append(
+                "queues.per_group: not {group: {pending, per_bucket}}"
+            )
     return problems
 
 
@@ -652,6 +667,42 @@ SERVE_REPORT_SCHEMA = "serve_report/v1"
 SERVE_WORKLOAD_MODES = ("closed", "open")
 
 
+def _validate_mesh_attachment(doc: dict) -> List[str]:
+    """Optional ``mesh`` attachment of a serve_report/v1 (and the
+    engine's health/stats views): the serving-mesh description one
+    sweep round ran under — spec string, axis shape, axis names, and
+    the replica groups by device. Absent = the unsharded engine."""
+    if "mesh" not in doc:
+        return []
+    problems: List[str] = []
+    mesh = doc["mesh"]
+    if not isinstance(mesh, dict):
+        return ["mesh: not a dict"]
+    if not isinstance(mesh.get("spec"), str) or not mesh.get("spec"):
+        problems.append("mesh.spec: not a non-empty string")
+    shape = mesh.get("shape")
+    if not isinstance(shape, dict) or not shape or not all(
+        isinstance(v, int) and not isinstance(v, bool) and v >= 1
+        for v in shape.values()
+    ):
+        problems.append("mesh.shape: not a {axis: size>=1} dict")
+    names = mesh.get("axis_names")
+    if not isinstance(names, list) or not all(
+        isinstance(n, str) for n in names
+    ):
+        problems.append("mesh.axis_names: not a list of strings")
+    groups = mesh.get("replica_groups")
+    if not isinstance(groups, list) or not groups or not all(
+        isinstance(g, list) and g and all(isinstance(d, str) for d in g)
+        for g in groups
+    ):
+        problems.append(
+            "mesh.replica_groups: not a non-empty list of non-empty "
+            "device-string lists"
+        )
+    return problems
+
+
 def validate_serve_report(doc: dict) -> List[str]:
     """Structural check of a serve_report/v1 document; returns a list of
     problems (empty == valid). Dependency-free so CI harnesses can gate on
@@ -660,6 +711,7 @@ def validate_serve_report(doc: dict) -> List[str]:
     problems: List[str] = []
     problems += _validate_metrics_attachment(doc)
     problems += _validate_mfu_attachment(doc)
+    problems += _validate_mesh_attachment(doc)
     if doc.get("schema") != SERVE_REPORT_SCHEMA:
         problems.append(
             f"schema != {SERVE_REPORT_SCHEMA}: {doc.get('schema')!r}"

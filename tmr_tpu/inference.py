@@ -146,6 +146,66 @@ class Predictor:
             dets = compact_detections(dets)
         return dets
 
+    def _single_pipeline(self, model, refine: bool):
+        """The ONE traced body of the fused single-exemplar program:
+        forward -> decode -> [refine] -> NMS. Both the plain jit
+        (:meth:`_get_fn`) and the mesh-sharded variants
+        (:meth:`_get_sharded_fn`) close over this exact function — the
+        dp bitwise-parity contract depends on the two programs tracing
+        the identical op sequence, so there must never be a second
+        copy to drift. Returns ``(dets, model_out)`` (the loss path
+        consumes ``model_out``; other callers drop it)."""
+
+        def body(params, refiner_params, image, exemplars):
+            out = model.apply({"params": params}, image, exemplars)
+            dets = self._decode(out, exemplars[:, 0, :])
+            dets = self._refine_nms(
+                dets, out["backbone_feature"],
+                (image.shape[1], image.shape[2]), refiner_params, refine,
+            )
+            return dets, out
+
+        return body
+
+    def _multi_batched_pipeline(self, model, heads, k_bucket: int,
+                                refine: bool):
+        """The ONE traced body of the batched union-NMS program (see
+        :meth:`_single_pipeline` for why it is shared between the plain
+        and mesh-sharded builders)."""
+
+        def body(params, refiner_params, image, exemplars, k_real):
+            b = image.shape[0]
+            feat = model.backbone.apply(
+                {"params": params["backbone"]}, image
+            )
+            if isinstance(feat, (list, tuple)):
+                if len(feat) != 1:
+                    raise NotImplementedError(
+                        "fused multi-exemplar inference supports single-"
+                        "level backbones only (every shipped backbone is)"
+                    )
+                feat = feat[0]
+            head_params = {n: v for n, v in params.items()
+                           if n != "backbone"}
+            out = heads.apply(
+                {"params": head_params},
+                jnp.repeat(feat, k_bucket, axis=0),  # image-major (B*k,)
+                exemplars.reshape(b * k_bucket, 1, 4),
+            )
+            dets = self._decode(out, exemplars.reshape(b * k_bucket, 4))
+            row_ok = jnp.arange(k_bucket)[None, :] < k_real[:, None]
+            dets["valid"] = dets["valid"] & row_ok.reshape(-1)[:, None]
+            merged = {
+                name: dets[name].reshape((b, -1) + dets[name].shape[2:])
+                for name in ("boxes", "scores", "refs", "valid")
+            }
+            return self._refine_nms(
+                merged, feat, (image.shape[1], image.shape[2]),
+                refiner_params, refine,
+            )
+
+        return body
+
     def _get_fn(self, capacity: int, loss_fn=None,
                 chain_feedback: bool = False, donate: bool = False):
         """Compiled forward -> decode -> [refine] -> NMS program for one
@@ -190,17 +250,14 @@ class Predictor:
             else jax.jit
         )
 
+        body = self._single_pipeline(model, refine)
+
         @jit
         def run(params, refiner_params, image, exemplars, *extra):
             if chain_feedback:
                 image = image + extra[-1]
                 extra = extra[:-1]
-            out = model.apply({"params": params}, image, exemplars)
-            dets = self._decode(out, exemplars[:, 0, :])
-            dets = self._refine_nms(
-                dets, out["backbone_feature"],
-                (image.shape[1], image.shape[2]), refiner_params, refine,
-            )
+            dets, out = body(params, refiner_params, image, exemplars)
             fb = jnp.sum(dets["scores"]) * 0.0
             if loss_fn is not None:
                 dets = (loss_fn(out, exemplars, *extra), dets)
@@ -440,38 +497,8 @@ class Predictor:
             functools.partial(jax.jit, donate_argnums=(2,)) if donate
             else jax.jit
         )
-
-        @jit
-        def run(params, refiner_params, image, exemplars, k_real):
-            b = image.shape[0]
-            feat = model.backbone.apply(
-                {"params": params["backbone"]}, image
-            )
-            if isinstance(feat, (list, tuple)):
-                if len(feat) != 1:
-                    raise NotImplementedError(
-                        "fused multi-exemplar inference supports single-"
-                        "level backbones only (every shipped backbone is)"
-                    )
-                feat = feat[0]
-            head_params = {n: v for n, v in params.items() if n != "backbone"}
-            out = heads.apply(
-                {"params": head_params},
-                jnp.repeat(feat, k_bucket, axis=0),  # image-major (B*k, ...)
-                exemplars.reshape(b * k_bucket, 1, 4),
-            )
-            dets = self._decode(out, exemplars.reshape(b * k_bucket, 4))
-            row_ok = jnp.arange(k_bucket)[None, :] < k_real[:, None]
-            dets["valid"] = dets["valid"] & row_ok.reshape(-1)[:, None]
-            merged = {
-                name: dets[name].reshape((b, -1) + dets[name].shape[2:])
-                for name in ("boxes", "scores", "refs", "valid")
-            }
-            return self._refine_nms(
-                merged, feat, (image.shape[1], image.shape[2]),
-                refiner_params, refine,
-            )
-
+        run = jit(self._multi_batched_pipeline(model, heads, k_bucket,
+                                               refine))
         run = track_devtime(
             track_compile(run, "multi_batched", key,
                           bucket={"capacity": capacity,
@@ -564,6 +591,153 @@ class Predictor:
                                   "image_size": image_size}),
             "heads", key, bucket={"capacity": capacity,
                                   "image_size": image_size},
+        )
+        self._compiled[key] = run
+        return run
+
+    # ------------------------------------------------------- sharded serve
+    # Mesh-sharded program variants for the serving tier (serve/meshplan):
+    # the same _decode/_refine_nms pipeline compiled against a MeshTarget.
+    # Data-parallel targets with tp == 1 go through the shard_map path of
+    # parallel/compat.compile_sharded — the per-shard trace IS the
+    # unsharded program body at the local batch shape, which is what
+    # keeps dp-sharded serve results bitwise-identical to the unsharded
+    # engine. Targets with tp > 1 go through the pjit/GSPMD path: params
+    # shard Megatron-style over the group's 'tp' axis
+    # (parallel/sharding.serve_param_shardings) and XLA inserts the
+    # collectives — allclose-level numerics with identical keep
+    # decisions (reduction reorder; the heads-path precedent).
+    # Every key embeds MeshTarget.key (axis sizes + concrete device ids),
+    # so a mesh-shape change compiles a NEW entry instead of silently
+    # colliding with a cached program bound to other devices.
+
+    def _sharded_shardings(self, target):
+        """(params, replicated) NamedShardings for one tp target."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from tmr_tpu.parallel.sharding import serve_param_shardings
+
+        if self.params is None:
+            raise RuntimeError(
+                "sharded programs need loaded params (the in_shardings "
+                "tree mirrors the real param tree)"
+            )
+        return (
+            serve_param_shardings(self.params, target.mesh),
+            NamedSharding(target.mesh, P()),
+        )
+
+    def _get_sharded_fn(self, capacity: int, target, donate: bool = False):
+        """Sharded variant of :meth:`_get_fn` for one
+        ``serve.meshplan.MeshTarget``: mode "dp" shards the image batch
+        over the mesh's dp axis, mode "group" replicates the batch and
+        shards the ViT feature dims over the group's tp axis. Call
+        signature and outputs match :meth:`_get_fn` (no loss/chain
+        hooks — this is the serving path)."""
+        from jax.sharding import PartitionSpec as P
+
+        from tmr_tpu.parallel.compat import compile_sharded
+
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity = int(capacity)
+        key = ("single_sharded", capacity, refine, donate, target.key)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model.clone(template_capacity=capacity)
+        pipeline = self._single_pipeline(model, refine)
+
+        def body(params, refiner_params, image, exemplars):
+            # the SHARED single-program body (bitwise contract); the
+            # sharded program drops the loss path's model_out
+            return pipeline(params, refiner_params, image, exemplars)[0]
+
+        donate_argnums = (2,) if donate else ()
+        if target.mode == "dp" and target.tp == 1:
+            run = compile_sharded(
+                body, target.mesh,
+                in_specs=(P(), P(), P("dp"), P("dp")),
+                out_specs=P("dp"),
+                donate_argnums=donate_argnums,
+            )
+        else:
+            pshard, repl = self._sharded_shardings(target)
+            batch = (
+                self._dp_sharding(target) if target.mode == "dp" else repl
+            )
+            run = compile_sharded(
+                body, target.mesh,
+                in_shardings=(pshard, repl, batch, batch),
+                out_shardings=batch,
+                donate_argnums=donate_argnums,
+            )
+        bucket = {"capacity": capacity, "mode": target.mode,
+                  "devices": target.n_devices}
+        run = track_devtime(
+            track_compile(run, "single_sharded", key, bucket=bucket),
+            "single_sharded", key, bucket=bucket,
+            devices=target.n_devices,
+        )
+        self._compiled[key] = run
+        return run
+
+    def _dp_sharding(self, target):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return NamedSharding(target.mesh, P("dp"))
+
+    def _get_sharded_multi_fn(self, capacity: int, k_bucket: int, target,
+                              donate: bool = False):
+        """Sharded variant of :meth:`_get_multi_batched_fn` (the batched
+        union-NMS program) for one MeshTarget — same masking and merge
+        semantics, batch sharded over dp / params over tp per the
+        target's mode."""
+        from jax.sharding import PartitionSpec as P
+
+        from tmr_tpu.parallel.compat import compile_sharded
+
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity, k_bucket = int(capacity), int(k_bucket)
+        key = ("multi_sharded", capacity, k_bucket, refine, donate,
+               target.key)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model.clone(template_capacity=capacity)
+        heads = model.clone(backbone=_PassthroughBackbone())
+        # the SHARED batched union-NMS body (bitwise contract)
+        body = self._multi_batched_pipeline(model, heads, k_bucket,
+                                            refine)
+
+        donate_argnums = (2,) if donate else ()
+        if target.mode == "dp" and target.tp == 1:
+            run = compile_sharded(
+                body, target.mesh,
+                in_specs=(P(), P(), P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"),
+                donate_argnums=donate_argnums,
+            )
+        else:
+            pshard, repl = self._sharded_shardings(target)
+            batch = (
+                self._dp_sharding(target) if target.mode == "dp" else repl
+            )
+            run = compile_sharded(
+                body, target.mesh,
+                in_shardings=(pshard, repl, batch, batch, batch),
+                out_shardings=batch,
+                donate_argnums=donate_argnums,
+            )
+        bucket = {"capacity": capacity, "k_bucket": k_bucket,
+                  "mode": target.mode, "devices": target.n_devices}
+        run = track_devtime(
+            track_compile(run, "multi_sharded", key, bucket=bucket),
+            "multi_sharded", key, bucket=bucket,
+            devices=target.n_devices,
         )
         self._compiled[key] = run
         return run

@@ -229,14 +229,23 @@ def _sig_of(args: tuple) -> tuple:
     )
 
 
-def track_devtime(fn, kind: str, key: Any, bucket: Optional[dict] = None):
+def track_devtime(fn, kind: str, key: Any, bucket: Optional[dict] = None,
+                  devices: int = 1):
     """Wrap a compiled-program cache entry so every execution attributes
     its wall/dispatch/device seconds (flight recorder ON only; one bool
     check otherwise). The first call per (program, shape) is recorded as
     warmup — it pays trace + XLA compile (obs/compile.py owns that
-    accounting) and must not pollute the steady-state device numbers."""
+    accounting) and must not pollute the steady-state device numbers.
+
+    ``devices``: how many chips one execution of this program occupies
+    (a mesh-sharded serve program spans its replica group / the full
+    mesh). The MFU report divides by it — N chips spending ``device_s``
+    wall on F flops achieve F/(N * device_s) per chip, and without the
+    division a tensor-parallel program's per-chip MFU reads N×
+    inflated."""
     key_repr = repr(key)
     bucket = dict(bucket or {})
+    devices = max(int(devices), 1)
 
     def wrapped(*args, **kw):
         if not _flight.flight_enabled():
@@ -254,7 +263,7 @@ def track_devtime(fn, kind: str, key: Any, bucket: Optional[dict] = None):
             return out
         t2 = time.perf_counter()
         _record(kind, key_repr, bucket, fn, args,
-                dispatch_s=t1 - t0, device_s=t2 - t1)
+                dispatch_s=t1 - t0, device_s=t2 - t1, devices=devices)
         return out
 
     wrapped.__wrapped__ = fn
@@ -262,7 +271,8 @@ def track_devtime(fn, kind: str, key: Any, bucket: Optional[dict] = None):
 
 
 def _record(kind: str, key_repr: str, bucket: dict, fn, args,
-            dispatch_s: float, device_s: float) -> None:
+            dispatch_s: float, device_s: float,
+            devices: int = 1) -> None:
     sig = _sig_of(args)
     # a WEAK reference to the program: the attribution table must never
     # pin a discarded Predictor's executables alive for process
@@ -277,7 +287,8 @@ def _record(kind: str, key_repr: str, bucket: dict, fn, args,
         entry = _PROGRAMS.get((kind, key_repr))
         if entry is None:
             entry = {"kind": kind, "key": key_repr, "bucket": bucket,
-                     "fn_ref": fn_ref, "sigs": {}}
+                     "fn_ref": fn_ref, "devices": max(int(devices), 1),
+                     "sigs": {}}
             _PROGRAMS[(kind, key_repr)] = entry
         rec = entry["sigs"].get(sig)
         if rec is None:
@@ -313,7 +324,14 @@ def _record(kind: str, key_repr: str, bucket: dict, fn, args,
 def _analytic_cost(kind: str, bucket: dict, sig: tuple) -> Optional[dict]:
     """Fallback FLOPs from the analytic model. Needs the image (or
     feature) arg's shape out of the signature; returns None when the
-    program shape cannot be recognized."""
+    program shape cannot be recognized. Sharded serve kinds map onto
+    their unsharded family — the program computes the same logical
+    FLOPs, just spread over the replica group (the per-chip division
+    happens in :func:`mfu_report`, not here)."""
+    if kind == "single_sharded":
+        kind = "single"
+    elif kind == "multi_sharded":
+        kind = "multi_batched"
     cap = int(bucket.get("capacity", 17) or 17)
     image = next(
         (shape for shape, _ in sig
@@ -397,8 +415,10 @@ def mfu_report() -> dict:
     programs: List[dict] = []
     total_flops = 0.0
     total_device = 0.0
+    total_chip = 0.0  # device_s weighted by chips occupied (per-chip MFU)
     for entry, sig, rec in _resolved_items():
         cost = rec["cost"]
+        devices = max(int(entry.get("devices", 1)), 1)
         warmup_only = rec["calls"] == 0
         calls = rec["warmup_calls"] if warmup_only else rec["calls"]
         # a warmup-only program reports its warmup window CONSISTENTLY
@@ -416,7 +436,12 @@ def mfu_report() -> dict:
         flops = cost["flops"]
         achieved = (flops * calls / device_s
                     if flops and device_s > 0 else None)
-        mfu = achieved / peak_flops if achieved is not None else None
+        # per-CHIP MFU: a sharded program's flops spread over its
+        # replica group, so the denominator is devices × peak — without
+        # the division a tp-N program reads N× inflated (satellite pin:
+        # tests/test_serve_mesh.py on the forced-8-device mesh)
+        mfu = (achieved / (peak_flops * devices)
+               if achieved is not None else None)
         intensity = (flops / cost["bytes"]
                      if flops and cost.get("bytes") else None)
         if intensity is None:
@@ -428,6 +453,7 @@ def mfu_report() -> dict:
             "kind": entry["kind"],
             "key": entry["key"],
             "bucket": entry["bucket"],
+            "devices": devices,
             "shapes": _sig_str(sig),
             "calls": rec["calls"],
             "warmup_calls": rec["warmup_calls"],
@@ -455,8 +481,14 @@ def mfu_report() -> dict:
         if flops and device_s > 0:
             total_flops += flops * calls
             total_device += device_s
+            total_chip += device_s * devices
     total_achieved = (total_flops / total_device
                       if total_device > 0 else None)
+    # per-chip totals MFU over chip-seconds (multi-chip programs weigh
+    # their group size; identical to the old number when every program
+    # is single-device)
+    total_chip_achieved = (total_flops / total_chip
+                           if total_chip > 0 else None)
     return {
         "schema": MFU_REPORT_SCHEMA,
         "platform": platform,
@@ -471,8 +503,8 @@ def mfu_report() -> dict:
                 if total_achieved is not None else None
             ),
             "mfu": (
-                round(total_achieved / peak_flops, 6)
-                if total_achieved is not None else None
+                round(total_chip_achieved / peak_flops, 6)
+                if total_chip_achieved is not None else None
             ),
         },
     }

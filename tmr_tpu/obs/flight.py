@@ -250,14 +250,19 @@ class HealthWatch:
     def observe(self, snapshot: dict, *,
                 compile_events: Any = (),
                 pending: int = 0,
+                pending_by_group: Optional[Dict[str, int]] = None,
                 mfu_totals: Optional[dict] = None) -> List[dict]:
         """One detector pass. ``snapshot`` is a metrics_report/v1 dict;
         ``compile_events`` the compile-event records NEW since the last
         pass; ``pending`` the batcher queue depth right now;
-        ``mfu_totals`` the devtime ``{"flops", "device_s"}`` running
-        totals when the flight recorder is on. Returns the anomalies
-        fired this pass (also kept in :meth:`recent` and recorded into
-        the process flight ring)."""
+        ``pending_by_group`` the per-replica-group depths under a mesh
+        plan — each saturated group fires its OWN ``queue_saturation``
+        record (evidence names the group), so one wedged replica group
+        is visible long before the global total trips; ``mfu_totals``
+        the devtime ``{"flops", "device_s"}`` running totals when the
+        flight recorder is on. Returns the anomalies fired this pass
+        (also kept in :meth:`recent` and recorded into the process
+        flight ring)."""
         fired: List[dict] = []
         with self._lock:
             # recompile storm: key-change events (the storm signature —
@@ -284,8 +289,28 @@ class HealthWatch:
                 ))
 
             # queue saturation: the batcher is holding more requests
-            # than the engine can drain under its latency bound
-            if pending >= self.queue_depth_threshold:
+            # than the engine can drain under its latency bound.
+            # Grouped engines (mesh serving) are judged PER replica
+            # group — each saturated group fires one record with its
+            # group in the evidence; a single wedged group then shows
+            # up while the global total still looks healthy. Ungrouped
+            # engines keep the one global check (at most one record).
+            if pending_by_group:
+                for grp in sorted(pending_by_group):
+                    depth = int(pending_by_group[grp])
+                    if depth >= self.queue_depth_threshold:
+                        fired.append(_anomaly(
+                            "queue_saturation",
+                            f"{depth} requests pending in replica "
+                            f"group {grp} (threshold "
+                            f"{self.queue_depth_threshold}) — this "
+                            "group's arrival rate exceeds its drain "
+                            "rate",
+                            pending=depth,
+                            group=str(grp),
+                            threshold=self.queue_depth_threshold,
+                        ))
+            elif pending >= self.queue_depth_threshold:
                 fired.append(_anomaly(
                     "queue_saturation",
                     f"{pending} requests pending in the batcher "

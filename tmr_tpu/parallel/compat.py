@@ -34,3 +34,46 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
     kw = {"check_vma" if _NEW_API else "check_rep": check_vma}
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **kw)
+
+
+def compile_sharded(f, mesh, *, in_shardings=None, out_shardings=None,
+                    in_specs=None, out_specs=None, donate_argnums=()):
+    """One compile seam for the sharded serving programs (the SNIPPETS.md
+    compile-helper pattern): explicit shardings -> ``jax.jit`` with
+    ``in_shardings``/``out_shardings`` (the pjit/GSPMD path — XLA derives
+    the tensor-parallel collectives from the param specs), plain
+    PartitionSpecs -> :func:`shard_map` over the mesh wrapped in jit (the
+    pure data-parallel map path, whose per-shard trace IS the unsharded
+    program body — the serving tier's bitwise-exactness lever).
+
+    Exactly one of the two spec families must be given; mixing them is a
+    caller bug, refused loudly.
+    """
+    import jax
+
+    use_pjit = in_shardings is not None or out_shardings is not None
+    use_smap = in_specs is not None or out_specs is not None
+    if use_pjit == use_smap:
+        raise ValueError(
+            "compile_sharded: pass in_shardings/out_shardings (pjit) OR "
+            "in_specs/out_specs (shard_map), not both/neither"
+        )
+    if use_pjit:
+        if in_shardings is None or out_shardings is None:
+            raise ValueError(
+                "compile_sharded: the pjit path needs BOTH in_shardings "
+                "and out_shardings"
+            )
+        return jax.jit(f, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=donate_argnums)
+    if in_specs is None or out_specs is None:
+        raise ValueError(
+            "compile_sharded: the shard_map path needs BOTH in_specs "
+            "and out_specs"
+        )
+    return jax.jit(
+        shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False),
+        donate_argnums=donate_argnums,
+    )

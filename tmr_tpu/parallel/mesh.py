@@ -2,7 +2,8 @@
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -64,3 +65,74 @@ def initialize_multihost(coordinator: Optional[str] = None,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+# ---------------------------------------------------------------- serving
+
+#: axis names of the serving mesh: batches shard over ``dp`` (replica
+#: groups), the ViT feature dimensions shard over ``tp`` inside a group
+SERVE_AXES = ("dp", "tp")
+
+_SPEC_RE = re.compile(r"(dp|tp)(\d+)")
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse a serving-mesh spec string into ``{"dp": N, "tp": M}``.
+
+    The spec is a concatenation of ``dp<N>`` / ``tp<M>`` terms in any
+    order (``"dp4"``, ``"tp4"``, ``"dp2tp2"``); an omitted axis is 1.
+    Raises ValueError on anything else — a typo'd ``TMR_SERVE_MESH``
+    must fail engine construction loudly, not silently serve unsharded.
+    """
+    s = (spec or "").strip().lower()
+    if not s:
+        raise ValueError("empty mesh spec")
+    out = {"dp": 1, "tp": 1}
+    seen = set()
+    pos = 0
+    for m in _SPEC_RE.finditer(s):
+        if m.start() != pos:
+            break
+        axis, n = m.group(1), int(m.group(2))
+        if axis in seen:
+            raise ValueError(f"mesh spec {spec!r}: duplicate {axis!r}")
+        if n < 1:
+            raise ValueError(f"mesh spec {spec!r}: {axis}{n} < 1")
+        seen.add(axis)
+        out[axis] = n
+        pos = m.end()
+    if pos != len(s) or not seen:
+        raise ValueError(
+            f"bad mesh spec {spec!r}: expected dp<N>/tp<M> terms, "
+            "e.g. 'dp4', 'tp4', 'dp2tp2'"
+        )
+    return out
+
+
+def make_serve_mesh(spec: str,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """Build the serving mesh for ``spec`` over the leading
+    ``dp * tp`` local devices: axes ``("dp", "tp")``, row-major — the
+    ``tp`` rows are the replica groups (see :func:`replica_groups`).
+    Unlike :func:`make_mesh` the device order is the flat local list on
+    every backend: serving replica groups must be stable across engine
+    restarts for the compiled-program cache keys to hit."""
+    sizes = parse_mesh_spec(spec)
+    devices = list(devices if devices is not None else jax.devices())
+    need = sizes["dp"] * sizes["tp"]
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {spec!r} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(sizes["dp"], sizes["tp"])
+    return Mesh(arr, SERVE_AXES)
+
+
+def replica_groups(mesh: Mesh) -> List[List]:
+    """The serving mesh's replica groups: one list of devices per ``dp``
+    index (each group spans the ``tp`` axis — the devices one
+    tensor-parallel program executes across)."""
+    arr = np.asarray(mesh.devices)
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-axis serve mesh, got {arr.shape}")
+    return [list(row) for row in arr]

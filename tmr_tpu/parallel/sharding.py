@@ -111,15 +111,45 @@ def state_sharding(state, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(assign, state)
 
 
+def serve_param_spec(path: Tuple[str, ...], leaf,
+                     tp_axis: str = "tp") -> P:
+    """:func:`param_spec` with the training-mesh ``'model'`` axis
+    renamed onto the serving mesh's tensor-parallel axis — one rule
+    table for both sides (a drifted copy was the alternative)."""
+    spec = param_spec(path, leaf)
+    return P(*(tp_axis if ax == "model" else ax for ax in spec))
+
+
+def serve_param_shardings(params: Any, mesh: Mesh,
+                          tp_axis: str = "tp") -> Any:
+    """NamedSharding tree for the serving mesh: ViT feature dims over
+    ``tp_axis`` (when the mesh has that axis with size > 1), everything
+    else replicated — the ``in_shardings`` for the tensor-parallel serve
+    programs and the ``device_put`` specs the stager commits params
+    with."""
+    has_tp = dict(mesh.shape).get(tp_axis, 1) > 1
+    flat = traverse_util.flatten_dict(params)
+    out = {
+        path: NamedSharding(
+            mesh,
+            serve_param_spec(path, leaf, tp_axis) if has_tp else P(),
+        )
+        for path, leaf in flat.items()
+    }
+    return traverse_util.unflatten_dict(out)
+
+
 def validate_tp(mesh: Mesh, embed_dim: int, num_heads: int,
-                mlp_ratio: float = 4.0) -> None:
-    """Fail fast when the ViT widths don't divide the 'model' axis.
+                mlp_ratio: float = 4.0, axis: str = "model") -> None:
+    """Fail fast when the ViT widths don't divide the tensor-parallel
+    axis (``'model'`` on the training mesh, ``'tp'`` on the serving
+    mesh — pass ``axis``).
 
     Megatron-style TP shards qkv/lin1 output features and proj/lin2 input
     features; uneven splits would silently produce ragged shards (or XLA
     padding) — refuse instead.
     """
-    tp = mesh.shape.get("model", 1)
+    tp = mesh.shape.get(axis, 1)
     if tp <= 1:
         return
     problems = []

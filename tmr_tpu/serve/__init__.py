@@ -16,6 +16,7 @@ from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
 from tmr_tpu.serve.degrade import DEGRADE_STEPS, DegradeController
 from tmr_tpu.serve.engine import ServeEngine
+from tmr_tpu.serve.meshplan import MeshPlan, MeshTarget, resolve_plan
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
 __all__ = [
@@ -24,6 +25,8 @@ __all__ = [
     "DegradeController",
     "DeviceStager",
     "LRUCache",
+    "MeshPlan",
+    "MeshTarget",
     "MicroBatcher",
     "REJECTION_CAUSES",
     "RejectedError",
@@ -32,4 +35,5 @@ __all__ = [
     "StagedBatch",
     "array_digest",
     "class_weight_fn",
+    "resolve_plan",
 ]

@@ -185,6 +185,21 @@ class AdmissionController:
         self._t_tokens = time.monotonic()
         self._releases: deque = deque(maxlen=64)
         self._rejected: Dict[str, int] = {}
+        #: measured-capacity override (ServeEngine wires its summed
+        #: per-replica-group drain rate here under a mesh plan)
+        self._drain_source = None
+
+    def attach_drain_source(self, fn) -> None:
+        """Use ``fn() -> requests/s`` as the measured drain-rate signal.
+
+        Under a mesh plan the engine completes work on several replica
+        groups concurrently; its summed per-group drain rate is the real
+        multi-chip capacity, while this controller's internal release
+        window is a single aggregate that lags a fleet of pipelines.
+        The source must never take locks that can wait on this
+        controller (the engine's window uses its own dedicated lock)."""
+        with self._lock:
+            self._drain_source = fn
 
     # ----------------------------------------------------------- helpers
     def _class_bound(self, priority: int) -> int:
@@ -197,8 +212,18 @@ class AdmissionController:
         return self.class_pending[min(p, len(self.class_pending) - 1)]
 
     def _drain_rate_unlocked(self) -> float:
-        """Releases per second over the recent release window (0.0 when
-        fewer than two releases have ever been observed)."""
+        """Measured drain rate: the attached engine source (summed
+        per-replica-group rates under a mesh plan) when it yields a
+        positive number, else releases per second over the recent
+        release window (0.0 when fewer than two releases have ever been
+        observed)."""
+        if self._drain_source is not None:
+            try:
+                rate = float(self._drain_source())
+                if rate > 0:
+                    return rate
+            except Exception:
+                pass  # a broken source falls back to the window
         if len(self._releases) < 2:
             return 0.0
         span = self._releases[-1] - self._releases[0]

@@ -42,6 +42,8 @@ class Request:
     features: Any = None  # cached device features (heads path, hit)
     needs_features: bool = False  # heads path, promotion fill
     trace_id: str = ""  # per-request span correlation (obs.tracing)
+    group: Any = None  # replica-group queue id (mesh serving; None = the
+    # single ungrouped pipeline, the pre-mesh behavior)
     priority: int = 0  # class-weighted scheduling (higher = sooner)
     deadline: Optional[float] = None  # absolute perf_counter seconds;
     # coalesced duplicates inherit the EARLIEST deadline of the group
@@ -77,20 +79,28 @@ class MicroBatcher:
 
     def __init__(self, max_wait_ms: float,
                  bound_for: Callable[[tuple], int],
-                 class_weight: Optional[Callable[[int], float]] = None):
+                 class_weight: Optional[Callable[[int], float]] = None,
+                 groups: Optional[List[Any]] = None):
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.bound_for = bound_for
         #: priority-class weight for pop ordering (serve/admission.py's
         #: class_weight_fn in production); None -> all classes equal,
         #: which reproduces the PR 3 discipline exactly
         self.class_weight = class_weight
+        #: replica-group queue ids (mesh serving): when set, requests
+        #: queue per (group, bucket) and each group's consumer thread
+        #: calls ``next_batch(group=...)`` — one engine saturates every
+        #: group concurrently. None (the default) is the single
+        #: ungrouped pipeline, behavior byte-identical to pre-mesh.
+        self.groups = list(groups) if groups else None
         # ordered so the flush scan visits buckets in first-use order —
-        # no bucket can be starved behind a constantly-full sibling
+        # no bucket can be starved behind a constantly-full sibling;
+        # grouped mode keys by (group, bucket)
         self._pending: "OrderedDict[tuple, deque]" = OrderedDict()
-        #: highest priority currently waiting per bucket (entries only
-        #: for nonzero priorities): the weighted full-bucket election
-        #: and the priority-pop guard read this in O(1) instead of
-        #: scanning the backlog — under overload the consumer thread
+        #: highest priority currently waiting per queue key (entries
+        #: only for nonzero priorities): the weighted full-bucket
+        #: election and the priority-pop guard read this in O(1) instead
+        #: of scanning the backlog — under overload the consumer thread
         #: must not pay O(total pending) per released batch
         self._maxp: Dict[tuple, int] = {}
         self._cond = threading.Condition()
@@ -98,15 +108,42 @@ class MicroBatcher:
         #: released-batch size histogram {occupied_slots: count} — the
         #: serve report's batch-occupancy evidence
         self.occupancy: Counter = Counter()
+        #: per-group occupancy (grouped mode only; the health report's
+        #: per-replica-group evidence)
+        self.occupancy_by_group: Dict[Any, Counter] = (
+            {g: Counter() for g in self.groups} if self.groups else {}
+        )
+
+    def _key(self, req: Request) -> tuple:
+        if self.groups is None:
+            return req.bucket
+        if req.group not in self.occupancy_by_group:
+            raise ValueError(
+                f"request group {req.group!r} not in batcher groups "
+                f"{self.groups}"
+            )
+        return (req.group, req.bucket)
+
+    def _bucket_of(self, key: tuple):
+        """The Predictor bucket inside a queue key (grouped keys are
+        (group, bucket))."""
+        return key[1] if self.groups is not None else key
 
     def put(self, req: Request) -> None:
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.setdefault(req.bucket, deque()).append(req)
-            if req.priority > self._maxp.get(req.bucket, 0):
-                self._maxp[req.bucket] = req.priority
-            self._cond.notify()
+            key = self._key(req)
+            self._pending.setdefault(key, deque()).append(req)
+            if req.priority > self._maxp.get(key, 0):
+                self._maxp[key] = req.priority
+            # grouped mode has one consumer PER group parked on the
+            # shared condition: notify_all so the right one wakes
+            # (notify() could wake a consumer whose group got nothing)
+            if self.groups is None:
+                self._cond.notify()
+            else:
+                self._cond.notify_all()
 
     def close(self) -> None:
         """Stop accepting; pending requests still drain via next_batch."""
@@ -114,10 +151,11 @@ class MicroBatcher:
             self._closed = True
             self._cond.notify_all()
 
-    def _pop(self, bucket: tuple, n: int) -> Tuple[tuple, List[Request]]:
-        dq = self._pending[bucket]
+    def _pop(self, key: tuple, n: int) -> Tuple[tuple, List[Request]]:
+        bucket = self._bucket_of(key)
+        dq = self._pending[key]
         n = min(n, len(dq))
-        if self._maxp.get(bucket, 0):
+        if self._maxp.get(key, 0):
             # class-weighted pop: release the n highest-priority
             # requests (FIFO within a class). Queues stay arrival-
             # ordered — put() is O(1) and rule 1's oldest-request
@@ -140,22 +178,24 @@ class MicroBatcher:
         else:
             out = [dq.popleft() for _ in range(n)]
         if not dq:
-            del self._pending[bucket]
-            self._maxp.pop(bucket, None)
+            del self._pending[key]
+            self._maxp.pop(key, None)
         else:
-            if self._maxp.get(bucket, 0):
+            if self._maxp.get(key, 0):
                 # leftover scan only during priority traffic (the
                 # default path never enters this branch)
                 mp = max(r.priority for r in dq)
                 if mp > 0:
-                    self._maxp[bucket] = mp
+                    self._maxp[key] = mp
                 else:
-                    self._maxp.pop(bucket, None)
+                    self._maxp.pop(key, None)
             # rotate a bucket that released but still holds requests to the
             # back of the scan order: a sustained-load bucket must not
             # monopolize rule 2's full-bucket scan while siblings queue
-            self._pending.move_to_end(bucket)
+            self._pending.move_to_end(key)
         self.occupancy[len(out)] += 1
+        if self.groups is not None:
+            self.occupancy_by_group[key[0]][len(out)] += 1
         if obs.tracing_enabled():
             # queue wait = submit -> release, per request: the window was
             # stamped at submit, so it is recorded retroactively here.
@@ -172,7 +212,20 @@ class MicroBatcher:
                 pass
         return bucket, out
 
-    def next_batch(self) -> Optional[Tuple[tuple, List[Request]]]:
+    def next_batch(self, group: Any = None
+                   ) -> Optional[Tuple[tuple, List[Request]]]:
+        """Block until a batch is due and return ``(bucket, requests)``.
+
+        Grouped mode: each replica group's consumer thread passes its
+        ``group`` and sees only that group's queues — the scan/wait
+        logic below is per group, so one saturated group never blocks a
+        sibling's consumer. Ungrouped (``group=None``, the default
+        single-pipeline engine): exactly the original discipline."""
+        if (group is None) != (self.groups is None):
+            raise ValueError(
+                "grouped batchers need next_batch(group=...); ungrouped "
+                "ones take none"
+            )
         with self._cond:
             while True:
                 # 1. an EXPIRED latency deadline releases first — the
@@ -183,13 +236,16 @@ class MicroBatcher:
                 now = time.perf_counter()
                 deadline = None
                 due = None
-                for bucket, dq in self._pending.items():
+                for key, dq in self._pending.items():
+                    if group is not None and key[0] != group:
+                        continue
                     t = dq[0].t_submit + self.max_wait_s
                     if deadline is None or t < deadline:
-                        deadline, due = t, bucket
+                        deadline, due = t, key
                 if deadline is not None and now >= deadline:
                     return self._pop(
-                        due, max(1, int(self.bound_for(due)))
+                        due,
+                        max(1, int(self.bound_for(self._bucket_of(due)))),
                     )
                 # 2. any full bucket releases immediately. With a class
                 # weighting, the full bucket holding the heaviest-class
@@ -201,25 +257,34 @@ class MicroBatcher:
                 best = None
                 best_bound = 0
                 best_w = 0.0
-                for bucket, dq in self._pending.items():
-                    bound = max(1, int(self.bound_for(bucket)))
+                for key, dq in self._pending.items():
+                    if group is not None and key[0] != group:
+                        continue
+                    bound = max(
+                        1, int(self.bound_for(self._bucket_of(key)))
+                    )
                     if len(dq) < bound:
                         continue
                     if self.class_weight is None:
-                        return self._pop(bucket, bound)
+                        return self._pop(key, bound)
                     # O(1) per bucket via the tracked per-bucket max
                     # priority (weights are monotone in class, default
                     # ladder included) — never O(backlog) per release
-                    w = self.class_weight(self._maxp.get(bucket, 0))
+                    w = self.class_weight(self._maxp.get(key, 0))
                     if best is None or w > best_w:
-                        best, best_bound, best_w = bucket, bound, w
+                        best, best_bound, best_w = key, bound, w
                 if best is not None:
                     return self._pop(best, best_bound)
                 if self._closed:
                     # drain: flush partial buckets oldest-first
-                    for bucket in self._pending:
+                    for key in self._pending:
+                        if group is not None and key[0] != group:
+                            continue
                         return self._pop(
-                            bucket, max(1, int(self.bound_for(bucket)))
+                            key,
+                            max(1, int(
+                                self.bound_for(self._bucket_of(key))
+                            )),
                         )
                     return None
                 # 3. else sleep until the earliest deadline (or new work)
@@ -233,11 +298,37 @@ class MicroBatcher:
 
     def depth_snapshot(self) -> Dict[tuple, int]:
         """Per-bucket queue depths right now — the health report's
-        queue evidence (``ServeEngine.health()``)."""
+        queue evidence (``ServeEngine.health()``). Grouped batchers
+        merge groups per bucket here; :meth:`depth_by_group` carries
+        the per-replica-group split."""
         with self._cond:
-            return {bucket: len(dq)
-                    for bucket, dq in self._pending.items()}
+            out: Dict[tuple, int] = {}
+            for key, dq in self._pending.items():
+                bucket = self._bucket_of(key)
+                out[bucket] = out.get(bucket, 0) + len(dq)
+            return out
 
-    def occupancy_snapshot(self) -> Dict[int, int]:
+    def depth_by_group(self) -> Dict[Any, Dict[str, Any]]:
+        """Per-replica-group queue depths: ``{group: {"pending": n,
+        "per_bucket": {bucket: n}}}`` — the evidence HealthWatch's
+        per-group ``queue_saturation`` detector consumes. Empty when
+        ungrouped."""
+        if self.groups is None:
+            return {}
         with self._cond:
+            out: Dict[Any, Dict[str, Any]] = {
+                g: {"pending": 0, "per_bucket": {}} for g in self.groups
+            }
+            for (g, bucket), dq in self._pending.items():
+                rec = out[g]
+                rec["pending"] += len(dq)
+                rec["per_bucket"][bucket] = (
+                    rec["per_bucket"].get(bucket, 0) + len(dq)
+                )
+            return out
+
+    def occupancy_snapshot(self, group: Any = None) -> Dict[int, int]:
+        with self._cond:
+            if group is not None:
+                return dict(self.occupancy_by_group.get(group, {}))
             return dict(self.occupancy)

@@ -57,7 +57,8 @@ from tmr_tpu.serve.admission import (
 from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
 from tmr_tpu.serve.degrade import DegradeController, downscale_image
-from tmr_tpu.serve.staging import DeviceStager, StagedBatch
+from tmr_tpu.serve.meshplan import MeshPlan, resolve_plan
+from tmr_tpu.serve.staging import DeviceStager, StagedBatch, _PAD_BOX
 
 _DET_FIELDS = ("boxes", "scores", "refs", "valid")
 
@@ -125,7 +126,10 @@ class ServeEngine:
                  donate: Optional[bool] = None,
                  admission: Optional[AdmissionController] = None,
                  degrade: Optional[DegradeController] = None,
-                 watch: Optional[Any] = None):
+                 watch: Optional[Any] = None,
+                 mesh: Optional[str] = None,
+                 warmup_buckets: Optional[Sequence[tuple]] = None,
+                 aot: Optional[bool] = None):
         import jax
 
         if predictor.params is None:
@@ -137,7 +141,18 @@ class ServeEngine:
             if max_wait_ms is None else float(max_wait_ms)
         )
         backend = jax.default_backend()
-        if devices is None:
+        #: the mesh execution plan (serve/meshplan.py): mesh= argument >
+        #: TMR_SERVE_MESH env > None = the unsharded round-robin engine
+        #: (byte-identical to pre-mesh behavior, every new code path off)
+        self._plan: Optional[MeshPlan] = resolve_plan(
+            mesh, devices=devices if devices is not None
+            else jax.local_devices(),
+        )
+        if self._plan is not None:
+            self._validate_plan_tp()
+            devices = [d for t in self._plan.group_targets
+                       for d in t.devices]
+        elif devices is None:
             local = jax.local_devices()
             # accelerators round-robin across every local device; only the
             # CPU backend pins to one (virtual host "devices" share the
@@ -208,28 +223,70 @@ class ServeEngine:
         self._lat = self.metrics.histogram("serve.request_latency_s")
         self._per_device: Dict[str, int] = {}
 
+        #: per-replica-group completion-timestamp windows: the measured
+        #: drain rate per group (requests/s), summed into the admission
+        #: controller's capacity signal — the retry_after hint then
+        #: reflects the real multi-chip drain instead of the
+        #: single-pipeline release window
+        self._drain_lock = threading.Lock()
+        self._drain: Dict[str, Any] = {}
+        self._group_rr = 0
+        #: AOT warmup accounting (stats()/health() expose it when run)
+        self._warmup_stats: Optional[Dict[str, Any]] = None
+
+        groups = self._plan.group_ids() if self._plan else None
         self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for,
-                                     class_weight=class_weight_fn())
+                                     class_weight=class_weight_fn(),
+                                     groups=groups)
         self._stager = DeviceStager(
             self.devices, predictor.params, predictor.refiner_params
         )
-        self._staged_q: "queue.Queue" = queue.Queue(maxsize=2)
-        self._done_q: "queue.Queue" = queue.Queue(maxsize=2)
-        self._threads = [
-            threading.Thread(target=self._stage_loop, name="serve-stage",
-                             daemon=True),
-            threading.Thread(target=self._dispatch_loop,
-                             name="serve-dispatch", daemon=True),
-            threading.Thread(target=self._complete_loop,
-                             name="serve-complete", daemon=True),
-        ]
+        if self._plan is None:
+            self._staged_q: "queue.Queue" = queue.Queue(maxsize=2)
+            self._done_q: "queue.Queue" = queue.Queue(maxsize=2)
+            self._threads = [
+                threading.Thread(target=self._stage_loop,
+                                 name="serve-stage", daemon=True),
+                threading.Thread(target=self._dispatch_loop,
+                                 name="serve-dispatch", daemon=True),
+                threading.Thread(target=self._complete_loop,
+                                 name="serve-complete", daemon=True),
+            ]
+        else:
+            # one stage + dispatch pipeline PER queue group (each
+            # replica group and, when dp > 1, the full-mesh dp target),
+            # all feeding one completion thread: every group's chips
+            # stay busy concurrently — the per-replica-group queue
+            # architecture of ROADMAP item 1
+            self._group_staged: Dict[str, "queue.Queue"] = {
+                g: queue.Queue(maxsize=2) for g in groups
+            }
+            self._done_q = queue.Queue(maxsize=max(2 * len(groups), 2))
+            self._threads = []
+            for g in groups:
+                self._threads.append(threading.Thread(
+                    target=self._stage_loop, args=(g,),
+                    name=f"serve-stage-{g}", daemon=True,
+                ))
+                self._threads.append(threading.Thread(
+                    target=self._dispatch_loop, args=(g,),
+                    name=f"serve-dispatch-{g}", daemon=True,
+                ))
+            self._threads.append(threading.Thread(
+                target=self._complete_loop, args=(len(groups),),
+                name="serve-complete", daemon=True,
+            ))
+        self._aot_warmup(warmup_buckets, aot)
         for t in self._threads:
             t.start()
+        if self._plan is not None:
+            self._admission.attach_drain_source(self._drain_total)
 
     # -------------------------------------------------------------- sizing
-    def _bound_for(self, bucket: tuple) -> int:
-        """Coalescing bound for a bucket: explicit arg > TMR_SERVE_BATCH >
-        measured bench_extra winner for this image size > 4.
+    def _bound_device(self, bucket: tuple) -> int:
+        """PER-DEVICE coalescing bound for a bucket: explicit arg >
+        TMR_SERVE_BATCH > measured bench_extra winner for this image
+        size > 4.
 
         ``_batch_bounds`` is touched under ``self._lock``: this runs on
         the batcher's consumer thread while ``stats()`` iterates the
@@ -254,6 +311,18 @@ class ServeEngine:
             self._batch_bounds[size] = bound
         return bound
 
+    def _bound_for(self, bucket: tuple) -> int:
+        """The batcher's release bound: the per-device bound, times the
+        dp width for buckets the mesh plan fans out data-parallel (one
+        dp dispatch feeds every replica group its measured per-device
+        batch — releasing at the single-device bound would ship
+        batches that leave dp-1 groups padding)."""
+        bound = self._bound_device(bucket)
+        if self._plan is not None and \
+                self._plan.mode_for(bucket) == "dp":
+            return bound * self._plan.dp
+        return bound
+
     def _count(self, name: str, n: int = 1) -> None:
         """Lazily created overload counters (``serve.<name>``): the
         admission/shed/degrade tallies exist in the registry only once
@@ -264,6 +333,181 @@ class ServeEngine:
             if c is None:
                 c = self._mx[name] = self.metrics.counter(f"serve.{name}")
         c.inc(n)
+
+    # ---------------------------------------------------------------- mesh
+    def _validate_plan_tp(self) -> None:
+        """Refuse a tensor-parallel plan the backbone widths cannot
+        shard evenly (the training-side validate_tp rule applied to the
+        serving mesh) — a misfit must fail engine construction, not
+        silently pad shards."""
+        if self._plan.tp <= 1:
+            return
+        from tmr_tpu.parallel.sharding import validate_tp
+
+        bb = self._pred.model.backbone
+        embed_dim = getattr(bb, "embed_dim", None)
+        num_heads = getattr(bb, "num_heads", None)
+        if embed_dim and num_heads:
+            validate_tp(self._plan.group_targets[0].mesh,
+                        int(embed_dim), int(num_heads), axis="tp")
+
+    def _assign_group(self, bucket: tuple) -> str:
+        """The replica-group queue a request joins: dp-mode buckets go
+        to the full-mesh queue; group-mode buckets round-robin across
+        replica groups (each group has its own pipeline, so successive
+        batches execute concurrently)."""
+        plan = self._plan
+        if plan.mode_for(bucket) == "dp":
+            return plan.dp_target.name
+        with self._lock:
+            i = self._group_rr
+            self._group_rr = (i + 1) % len(plan.group_targets)
+        return plan.group_targets[i].name
+
+    def _record_drain(self, group: Optional[str], n: int = 1) -> None:
+        """Completion timestamps per replica group (bounded windows) —
+        the measured drain-rate evidence."""
+        from collections import deque
+
+        g = group or "default"
+        now = time.monotonic()
+        with self._drain_lock:
+            win = self._drain.get(g)
+            if win is None:
+                win = self._drain[g] = deque(maxlen=128)
+            for _ in range(max(int(n), 1)):
+                win.append(now)
+
+    def drain_snapshot(self) -> Dict[str, float]:
+        """Measured per-replica-group drain rate (requests/s over each
+        group's recent completion window)."""
+        out: Dict[str, float] = {}
+        with self._drain_lock:
+            for g, win in self._drain.items():
+                if len(win) < 2:
+                    out[g] = 0.0
+                    continue
+                span = win[-1] - win[0]
+                out[g] = (len(win) - 1) / span if span > 0 else 0.0
+        return out
+
+    def _drain_total(self) -> float:
+        """Summed per-group drain rate — the AdmissionController's
+        capacity signal under a mesh plan (admission.attach_drain_source
+        wires it at engine start)."""
+        return sum(self.drain_snapshot().values())
+
+    # ---------------------------------------------------------- AOT warmup
+    def _aot_warmup(self, warmup_buckets, aot) -> None:
+        """Ahead-of-time compilation + warmup of the bucketed program
+        set at engine start: every (bucket, padded-shape, mesh-target)
+        program the declared buckets can reach executes ONCE on zero
+        inputs before the engine serves traffic. The first execution is
+        where jit traces + XLA compiles, so each program's compile event
+        records HERE (through PR 8's track_compile, visible to the
+        compile-event cursor) and steady-state serving never eats a
+        cold-compile cliff — scripts/serve_bench.py pins zero cold
+        events after warmup.
+
+        Enablement: ``aot`` argument > ``TMR_SERVE_AOT`` env > on when
+        a mesh plan or an explicit ``warmup_buckets`` list is present.
+        The bucket set is ``warmup_buckets`` (Predictor.bucket_key
+        tuples) or one derived default (the config image size at the
+        smallest template bucket). ``TMR_SERVE_WARMUP_TIMEOUT_S``
+        bounds the whole pass — past it remaining programs are skipped
+        (counted) and compile lazily like before."""
+        if aot is None:
+            flag = os.environ.get("TMR_SERVE_AOT", "")
+            if flag in ("0", "false", "off"):
+                return
+            if not flag and self._plan is None and not warmup_buckets:
+                return
+        elif not aot:
+            return
+        buckets = list(warmup_buckets or ())
+        if not buckets:
+            cfg = self._pred.cfg
+            buckets = [("single", int(cfg.image_size),
+                        int(cfg.template_buckets[0]), 1)]
+        timeout_s = _env_float("TMR_SERVE_WARMUP_TIMEOUT_S", 600.0)
+        t0 = time.perf_counter()
+        stats = {"programs": 0, "skipped": 0,
+                 "timeout_s": timeout_s, "wall_s": 0.0}
+        for bucket in buckets:
+            if bucket[0] == "heads":
+                # the heads path warms through its fill traffic; it
+                # must not inflate the warmed-program count either
+                continue
+            for target in self._warmup_targets(bucket):
+                for shape in self._warmup_shapes(bucket, target):
+                    if time.perf_counter() - t0 > timeout_s:
+                        stats["skipped"] += 1
+                        continue
+                    try:
+                        self._warmup_one(bucket, target, shape)
+                        stats["programs"] += 1
+                    except Exception:
+                        # warmup is an optimization: a bucket that
+                        # cannot warm (unsupported shape) compiles
+                        # lazily on first real traffic instead
+                        stats["skipped"] += 1
+        stats["wall_s"] = round(time.perf_counter() - t0, 3)
+        self._warmup_stats = stats
+
+    def _warmup_targets(self, bucket: tuple) -> List[Any]:
+        if self._plan is None:
+            return [None]
+        if self._plan.mode_for(bucket) == "dp":
+            return [self._plan.dp_target]
+        return list(self._plan.group_targets)
+
+    def _warmup_shapes(self, bucket: tuple, target) -> List[int]:
+        """The padded batch shapes this bucket's traffic can produce on
+        ``target``: the power-of-two sub-bucket ladder up to the bound
+        (times dp for the fan-out target) — exactly the shapes
+        staging._pad_to emits, so no real batch meets an uncompiled
+        shape."""
+        bound = self._bound_device(bucket)
+        ladder = []
+        s = 1
+        while s < bound:
+            ladder.append(s)
+            s *= 2
+        ladder.append(bound)
+        mult = target.dp if (target is not None and target.mode == "dp") \
+            else 1
+        return sorted({x * mult for x in ladder})
+
+    def _warmup_one(self, bucket: tuple, target, shape: int) -> None:
+        """Build + execute one (bucket, target, padded-shape) program on
+        zero inputs, blocking until outputs are ready."""
+        import jax
+        import numpy as np_  # shadow-proof alias (np is module-level)
+
+        kind, size, cap, k = bucket
+        images = np_.zeros((shape, size, size, 3), np_.float32)
+        exemplars = np_.tile(
+            np_.asarray(_PAD_BOX, np_.float32), (shape, k, 1)
+        )
+        if target is None:
+            device = self._stager.next_device()
+            params, rparams = self._stager.params_for(device)
+            placement = device
+        else:
+            params, rparams = self._run_params(target, kind)
+            placement = self._stager.batch_sharding(target)
+        img_d = jax.device_put(images, placement)
+        ex_d = jax.device_put(exemplars, placement)
+        if kind == "multi":
+            k_real = jax.device_put(
+                np_.ones((shape,), np_.int32), placement
+            )
+            fn = self._program_for(("multi", size, cap, k), target)
+            out = fn(params, rparams, img_d, ex_d, k_real)
+        else:
+            fn = self._program_for(("single", size, cap, k), target)
+            out = fn(params, rparams, img_d, ex_d)
+        jax.block_until_ready(out)
 
     # -------------------------------------------------------------- submit
     def submit(self, image, exemplars, multi: bool = False,
@@ -318,6 +562,8 @@ class ServeEngine:
                 self._admission.release_class(priority)  # frees now
                 return fut
             req.admitted = self._admission.enabled
+            if self._plan is not None:
+                req.group = self._assign_group(req.bucket)
             try:
                 self._batcher.put(req)
             except Exception as e:  # closed mid-submit: a rejection, not
@@ -484,11 +730,15 @@ class ServeEngine:
             self._count(f"shed.{stage}", n)
         return live
 
-    def _stage_loop(self) -> None:
+    def _stage_loop(self, group: Optional[str] = None) -> None:
+        staged_q = (self._staged_q if group is None
+                    else self._group_staged[group])
+        target = (None if group is None
+                  else self._plan.target_by_group(group))
         while True:
-            nb = self._batcher.next_batch()
+            nb = self._batcher.next_batch(group=group)
             if nb is None:
-                self._staged_q.put(None)
+                staged_q.put(None)
                 return
             bucket, reqs = nb
             # deadline shed BEFORE staging: an expired request must
@@ -498,20 +748,23 @@ class ServeEngine:
                 continue
             try:
                 staged = self._stager.stage(
-                    bucket, reqs, self._bound_for(bucket)
+                    bucket, reqs, self._bound_device(bucket),
+                    target=target,
                 )
                 self._m["batches"].inc()
                 self._m["padded_slots"].inc(staged.padded_slots)
                 with self._lock:
                     dev = str(staged.device)
                     self._per_device[dev] = self._per_device.get(dev, 0) + 1
-                self._staged_q.put(staged)
+                staged_q.put(staged)
             except Exception as e:
                 self._isolate(reqs, e)
 
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, group: Optional[str] = None) -> None:
+        staged_q = (self._staged_q if group is None
+                    else self._group_staged[group])
         while True:
-            staged = self._staged_q.get()
+            staged = staged_q.get()
             if staged is None:
                 self._done_q.put(None)
                 return
@@ -539,11 +792,18 @@ class ServeEngine:
             except Exception as e:
                 self._isolate(staged.requests, e, batch_level=True)
 
-    def _complete_loop(self) -> None:
+    def _complete_loop(self, sentinels: int = 1) -> None:
+        """One shared completion thread; ``sentinels`` dispatch loops
+        feed it (one per replica-group pipeline under a mesh plan) and
+        it exits after seeing every loop's shutdown None."""
+        remaining = max(int(sentinels), 1)
         while True:
             item = self._done_q.get()
             if item is None:
-                return
+                remaining -= 1
+                if remaining == 0:
+                    return
+                continue
             staged, out, fill_feats = item
             try:
                 self._finish(staged, out, fill_feats)
@@ -551,23 +811,58 @@ class ServeEngine:
                 self._isolate(staged.requests, e, batch_level=True)
 
     # ------------------------------------------------------------ dispatch
+    def _program_for(self, bucket: tuple, target):
+        """The compiled program one (bucket, target) executes: the
+        unsharded fused program off-mesh and on plain (tp == 1) replica
+        groups, the mesh-sharded variant on dp / tensor-parallel
+        targets — every sharded ``_compiled`` key embeds the target's
+        mesh shape + devices, so shape changes recompile instead of
+        colliding."""
+        kind, _size, cap, k = bucket
+        sharded = target is not None and (
+            target.mode == "dp" or target.tp > 1
+        )
+        if kind == "single":
+            if sharded:
+                return self._pred._get_sharded_fn(cap, target,
+                                                  donate=self.donate)
+            return self._pred._get_fn(cap, donate=self.donate)
+        if kind == "multi":
+            if sharded:
+                return self._pred._get_sharded_multi_fn(
+                    cap, k, target, donate=self.donate
+                )
+            return self._pred._get_multi_batched_fn(cap, k,
+                                                    donate=self.donate)
+        raise RuntimeError(f"unknown bucket kind {kind!r}")
+
+    def _run_params(self, target, kind: str):
+        """(params, refiner_params) placed for one target: heads
+        buckets always run the unsharded tail on the group's primary
+        device (tp-sharded params would silently GSPMD a program never
+        audited that way); everything else takes the target placement
+        the stager committed."""
+        if kind == "heads" and target is not None:
+            return self._stager.params_for(target.primary)
+        return self._stager.params_for(target)
+
     def _run_batch(self, staged: StagedBatch):
         """Run the bucket's jitted program on the staged arrays. Returns
         (dets, fill_features) — fill_features is the heads path's freshly
         encoded (n_fill, h, w, C) device array (None elsewhere)."""
         kind, size, cap, k = staged.bucket
-        params, rparams = self._stager.params_for(staged.device)
-        if kind == "single":
-            fn = self._pred._get_fn(cap, donate=self.donate)
-            return fn(params, rparams, staged.images, staged.exemplars), None
-        if kind == "multi":
-            fn = self._pred._get_multi_batched_fn(cap, k,
-                                                  donate=self.donate)
-            return fn(params, rparams, staged.images, staged.exemplars,
-                      staged.k_real), None
+        target = staged.target
+        params, rparams = (
+            self._run_params(target, kind) if target is not None
+            else self._stager.params_for(staged.device)
+        )
         if kind == "heads":
             return self._run_heads(staged, params, rparams, size, cap)
-        raise RuntimeError(f"unknown bucket kind {kind!r}")
+        fn = self._program_for(staged.bucket, target)
+        if kind == "single":
+            return fn(params, rparams, staged.images, staged.exemplars), None
+        return fn(params, rparams, staged.images, staged.exemplars,
+                  staged.k_real), None
 
     def _run_heads(self, staged: StagedBatch, params, rparams, size, cap):
         import jax.numpy as jnp
@@ -670,6 +965,8 @@ class ServeEngine:
                 # bucket too, or submitted - (completed+errors+rejected)
                 # reads as phantom backlog forever
                 self._m["completed"].inc(len(req.futures))
+                if self._plan is not None:
+                    self._record_drain(req.group)
             except Exception as e:  # isolation: this request alone
                 self._drop_inflight(req)
                 self._admission.release(req)
@@ -692,6 +989,8 @@ class ServeEngine:
                 req.resolve(result)
                 self._lat.observe(time.perf_counter() - req.t_submit)
                 self._m["completed"].inc(len(req.futures))
+                if self._plan is not None:
+                    self._record_drain(req.group)
             except Exception as e:
                 self._drop_inflight(req)
                 self._admission.release(req)
@@ -739,10 +1038,15 @@ class ServeEngine:
             inflight = len(self._inflight)
             closed = self._closed
         pending = self._batcher.pending()
+        by_group = self._batcher.depth_by_group()
         anomalies = self._watch.observe(
             self.metrics.snapshot(),
             compile_events=new_events,
             pending=pending,
+            pending_by_group=(
+                {g: rec["pending"] for g, rec in by_group.items()}
+                if by_group else None
+            ),
             mfu_totals=(devtime.totals() if obs.flight_enabled()
                         else None),
         )
@@ -796,6 +1100,31 @@ class ServeEngine:
             doc["admission"] = self._admission.stats()
         if self._degrade.enabled:
             doc["degrade"] = self._degrade.stats()
+        # mesh-serving sections appear only under a plan, so the
+        # default-engine health shape stays byte-identical to PR 8
+        if self._plan is not None:
+            doc["queues"]["per_group"] = {
+                str(g): {
+                    "pending": rec["pending"],
+                    "per_bucket": {
+                        str(b): n for b, n in rec["per_bucket"].items()
+                    },
+                    "occupancy": {
+                        str(sz): cnt for sz, cnt in sorted(
+                            self._batcher.occupancy_snapshot(
+                                group=g
+                            ).items()
+                        )
+                    },
+                }
+                for g, rec in by_group.items()
+            }
+            doc["mesh"] = self._plan.describe()
+            doc["drain_per_group"] = {
+                g: round(r, 3) for g, r in self.drain_snapshot().items()
+            }
+            if self._warmup_stats is not None:
+                doc["warmup"] = dict(self._warmup_stats)
         return doc
 
     def start_heartbeat(self, path: str,
@@ -924,4 +1253,23 @@ class ServeEngine:
                 "degrade": self._degrade.stats(),
                 "drain_timed_out": drain_timed_out,
             }
+        if self._plan is not None:
+            out["mesh"] = self._plan.describe()
+            out["per_group_queues"] = {
+                str(g): rec["pending"]
+                for g, rec in self._batcher.depth_by_group().items()
+            }
+            out["per_group_occupancy"] = {
+                str(g): {
+                    str(sz): cnt for sz, cnt in sorted(
+                        self._batcher.occupancy_snapshot(group=g).items()
+                    )
+                }
+                for g in self._batcher.groups
+            }
+            out["drain_per_group"] = {
+                g: round(r, 3) for g, r in self.drain_snapshot().items()
+            }
+            if self._warmup_stats is not None:
+                out["warmup"] = dict(self._warmup_stats)
         return out

@@ -37,7 +37,7 @@ _PAD_BOX = (0.45, 0.45, 0.55, 0.55)
 class StagedBatch:
     bucket: tuple
     requests: List[Request]
-    device: Any
+    device: Any  # a jax device (legacy round-robin) or a MeshTarget
     images: Any = None  # device (B, S, S, 3) f32; None for pure-hit heads
     exemplars: Any = None  # device (B, K, 4) f32
     k_real: Any = None  # device (B,) i32 (multi path)
@@ -45,6 +45,14 @@ class StagedBatch:
     fill_index: List[int] = field(default_factory=list)  # rows needing bb
     padded_slots: int = 0
     t_staged: float = 0.0
+
+    @property
+    def target(self):
+        """The MeshTarget this batch stages onto (None on the legacy
+        per-device path)."""
+        from tmr_tpu.serve.meshplan import MeshTarget
+
+        return self.device if isinstance(self.device, MeshTarget) else None
 
 
 def _pad_to(n: int, bound: int) -> int:
@@ -62,7 +70,16 @@ def _pad_to(n: int, bound: int) -> int:
 
 
 class DeviceStager:
-    """Round-robin device placement + lazy per-device params replication."""
+    """Round-robin device placement + lazy per-device params replication.
+
+    Mesh serving (a ``meshplan.MeshPlan`` on the engine) routes through
+    the same stager with :class:`MeshTarget` targets instead of bare
+    devices: params commit once per target — sharded over the group's
+    ``tp`` axis for tensor-parallel targets
+    (``parallel/sharding.serve_param_shardings``), replicated across the
+    mesh for the data-parallel target — and batches stage with the
+    matching NamedSharding so the program's in_shardings are satisfied
+    without a resharding copy at dispatch."""
 
     def __init__(self, devices: Sequence[Any], params, refiner_params=None):
         if not devices:
@@ -74,7 +91,12 @@ class DeviceStager:
         self._lock = threading.Lock()
 
     def params_for(self, device):
-        """(params, refiner_params) committed to ``device`` (cached)."""
+        """(params, refiner_params) committed to ``device`` — a jax
+        device or a MeshTarget — cached per placement."""
+        from tmr_tpu.serve.meshplan import MeshTarget
+
+        if isinstance(device, MeshTarget):
+            return self._params_for_target(device)
         with self._lock:
             if device not in self._per_device:
                 import jax
@@ -84,26 +106,97 @@ class DeviceStager:
                 )
             return self._per_device[device]
 
+    def _params_for_target(self, target):
+        with self._lock:
+            placed = self._per_device.get(target.key)
+        if placed is not None:
+            return placed
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        params, rparams = self._host_params
+        if target.tp > 1:
+            from tmr_tpu.parallel.sharding import serve_param_shardings
+
+            pshard = serve_param_shardings(params, target.mesh)
+            repl = NamedSharding(target.mesh, P())
+            placed = (
+                jax.device_put(params, pshard),
+                None if rparams is None else jax.device_put(rparams, repl),
+            )
+        elif target.mode == "dp":
+            repl = NamedSharding(target.mesh, P())
+            placed = (
+                jax.device_put(params, repl),
+                None if rparams is None else jax.device_put(rparams, repl),
+            )
+        else:  # tp == 1 replica group: the plain per-device program
+            placed = jax.device_put(self._host_params, target.primary)
+        with self._lock:
+            # a racing double-place commits the same values twice; the
+            # second result wins and the first is garbage-collected
+            self._per_device[target.key] = placed
+        return placed
+
+    def batch_sharding(self, target):
+        """How a staged batch array lands on ``target``: sharded over
+        ``dp`` for the data-parallel target, replicated across the
+        group for tensor-parallel ones, the primary device for plain
+        (tp == 1) groups."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if target.mode == "dp":
+            return NamedSharding(target.mesh, P("dp"))
+        if target.tp > 1:
+            return NamedSharding(target.mesh, P())
+        return target.primary
+
     def next_device(self):
         return next(self._rr)
 
     # ------------------------------------------------------------- staging
     def stage(self, bucket: tuple, requests: List[Request],
-              bound: int) -> StagedBatch:
-        """Pad/stack the batch host-side and start its H2D transfers."""
-        import jax
+              bound: int, target=None) -> StagedBatch:
+        """Pad/stack the batch host-side and start its H2D transfers.
+
+        ``bound`` is the PER-DEVICE coalescing bound. With a MeshTarget
+        the padded batch additionally respects the target's geometry: a
+        data-parallel target pads to ``dp x`` a power-of-two per-shard
+        sub-bucket (every shard sees a ladder shape, so dp serving
+        compiles the same log2(bound) program set per bucket as the
+        unsharded engine — and shards divide evenly by construction)."""
         import time
 
+        import jax
+
         kind, size, _cap, k = bucket
-        bound = _pad_to(len(requests), int(bound))
-        device = self.next_device()
+        n = len(requests)
+        if target is not None and target.mode == "dp":
+            per_shard = _pad_to((n + target.dp - 1) // target.dp,
+                                int(bound))
+            bound = per_shard * target.dp
+            device = target
+            placement = self.batch_sharding(target)
+        elif target is not None:
+            bound = _pad_to(n, int(bound))
+            device = target
+            placement = self.batch_sharding(target)
+        else:
+            bound = _pad_to(n, int(bound))
+            device = self.next_device()
+            placement = device
         staged = StagedBatch(bucket=bucket, requests=list(requests),
                              device=device,
-                             padded_slots=bound - len(requests))
+                             padded_slots=bound - n)
 
         t_assemble = time.perf_counter()
         if kind == "heads":
-            t_put = self._stage_heads(staged, bound, size, k, device)
+            t_put = self._stage_heads(
+                staged, bound, size, k,
+                target.primary if target is not None else device,
+            )
         else:
             images = np.zeros((bound, size, size, 3), np.float32)
             exemplars = np.tile(
@@ -117,10 +210,10 @@ class DeviceStager:
                 for i, r in enumerate(requests):
                     k_real[i] = r.k_real
             t_put = time.perf_counter()
-            staged.images = jax.device_put(images, device)
-            staged.exemplars = jax.device_put(exemplars, device)
+            staged.images = jax.device_put(images, placement)
+            staged.exemplars = jax.device_put(exemplars, placement)
             if kind == "multi":
-                staged.k_real = jax.device_put(k_real, device)
+                staged.k_real = jax.device_put(k_real, placement)
         staged.t_staged = time.perf_counter()
         if obs.tracing_enabled():
             # batch-level windows attributed to each rider: host pad/stack

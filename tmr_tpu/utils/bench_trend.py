@@ -145,3 +145,65 @@ def collect_bench_trend(repo_dir: str,
             "regressed": bool(regressions),
         },
     }
+
+
+# ----------------------------------------------------------- serve sweep
+
+
+def read_serve_sweep(path: str) -> dict:
+    """Reduce a ``serve_bench.py --mesh`` sweep file (JSONL, one
+    serve_report/v1 per mesh shape) to a comparable table: per-shape
+    throughput, scaling vs the single-device engine, parity mode,
+    latency p99, and the AOT cold-compile pin — so a trend reader can
+    gate a mesh-scaling regression the same way it gates the headline.
+
+    Returns ``{"rows": [...], "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable mesh rounds."""
+    rows: List[dict] = []
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return {"error": f"unreadable sweep file {path}: {e}"}
+    for ln in lines:
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(doc, dict) or "mesh" not in doc:
+            continue
+        checks = doc.get("checks") or {}
+        workloads = doc.get("workloads") or [{}]
+        w0 = workloads[0] if isinstance(workloads[0], dict) else {}
+        rows.append({
+            "spec": (doc["mesh"] or {}).get("spec"),
+            "shape": (doc["mesh"] or {}).get("shape"),
+            "devices": (doc.get("config") or {}).get("devices"),
+            "throughput_img_per_sec": w0.get("throughput_img_per_sec"),
+            "single_device_img_per_sec": w0.get(
+                "single_device_img_per_sec"
+            ),
+            "scaling": checks.get("scaling_vs_single_device"),
+            "scaling_ok": checks.get("scaling_ok"),
+            "parity": checks.get("parity"),
+            "exact_match": checks.get("exact_match"),
+            "p99_ms": checks.get("p99_ms"),
+            "cold_compiles_after_warmup": (
+                (doc.get("aot") or {}).get("compile_events_after_warmup")
+            ),
+        })
+    if not rows:
+        return {"error": f"no mesh serve_report lines in {path}"}
+    return {
+        "rows": rows,
+        "checks": {
+            "shapes_read": len(rows),
+            "all_exact": all(bool(r["exact_match"]) for r in rows),
+            "all_scaling_ok": all(bool(r["scaling_ok"]) for r in rows),
+            # fail CLOSED like all_exact/all_scaling_ok: a line with no
+            # AOT evidence (missing section / null count) is NOT warm
+            "all_warm": all(
+                r["cold_compiles_after_warmup"] == 0 for r in rows
+            ),
+        },
+    }
