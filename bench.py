@@ -123,6 +123,17 @@ def _emit_error(msg: str):
         "vs_baseline": 0.0,
         "error": msg,
     }
+    _attach_carried(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def _attach_carried(rec: dict) -> None:
+    """Attach the last committed (or newer working-tree) live
+    measurement to ``rec`` and promote it into the top-level
+    ``value``/``vs_baseline`` (``carried: true`` + ``stale_hours``) —
+    shared by the outage record (_emit_error) and the CPU-proxy round
+    (TMR_BENCH_PROXY), which both must never report 0.0 while the repo
+    holds a real number. Best-effort all the way down."""
     try:
         here = os.path.dirname(os.path.abspath(__file__))
         with open(os.path.join(here, "BENCH_LIVE.json")) as f:
@@ -199,9 +210,10 @@ def _emit_error(msg: str):
             )
             rec["carried"] = True
             rec["stale_hours"] = carried.get("stale_hours")
+            if carried.get("metric"):
+                rec["metric"] = carried["metric"]
     except Exception:
-        pass  # the error record itself must never fail to print
-    print(json.dumps(rec), flush=True)
+        pass  # the record itself must never fail to build
 
 
 def _wait_for_backend() -> str | None:
@@ -311,6 +323,60 @@ def _run(cancel_watchdog) -> None:
         tune = autotune(cfg, IMAGE_SIZE, BATCH, log=_progress, sweep=False)
         pending = tune.pop("_pending", [])
 
+    # TMR_BENCH_PROXY=1 off-TPU: the honest CPU-only round. Measure the
+    # local (reduced — set TMR_BENCH_SIZE/BATCH/CHAIN) geometry and
+    # record it under ``cpu_proxy`` with its platform provenance, but
+    # CARRY the committed TPU headline into the top-level value
+    # (carried: true + stale_hours): a CPU number must never enter the
+    # BENCH_r0N trajectory as if it were the TPU headline regressing
+    # 100x. On real hardware the knob is inert — the normal flow runs.
+    if jax.default_backend() != "tpu" and os.environ.get(
+        "TMR_BENCH_PROXY", ""
+    ).lower() in ("1", "true", "yes", "on"):
+        _progress("CPU-proxy round: measuring the local geometry; the "
+                  "committed TPU headline carries")
+        proxy = _build_and_measure(cfg, tune)
+        proxy["platform"] = jax.default_backend()
+        rec = {
+            "metric": _metric(),
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "platform": jax.default_backend(),
+            "proxy": True,
+            "cpu_proxy": proxy,
+        }
+        _attach_carried(rec)
+        if not rec.get("carried"):
+            # nothing committed to carry: the local measurement IS the
+            # headline (clearly platform-stamped)
+            rec["value"] = proxy["value"]
+            rec["vs_baseline"] = proxy["vs_baseline"]
+        if os.environ.get("TMR_BENCH_TREND", "").lower() in (
+            "1", "true", "yes", "on"
+        ):
+            try:
+                from tmr_tpu.diagnostics import validate_bench_trend
+                from tmr_tpu.utils.bench_trend import collect_bench_trend
+
+                trend = collect_bench_trend(
+                    os.path.dirname(os.path.abspath(__file__))
+                )
+                problems = validate_bench_trend(trend)
+                if problems:
+                    raise ValueError(f"invalid bench_trend: {problems}")
+                rec["bench_trend"] = trend
+            except Exception as e:
+                from tmr_tpu.diagnostics import BENCH_TREND_SCHEMA
+
+                rec["bench_trend"] = {
+                    "schema": BENCH_TREND_SCHEMA,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+        cancel_watchdog()
+        print(json.dumps(rec))
+        return
+
     global _PRELIM_REC
     export_lines = None
     # Bank under the last known-good configuration, not the library
@@ -353,7 +419,7 @@ def _run(cancel_watchdog) -> None:
         snap_keys = ("TMR_GLOBAL_ATTN", "TMR_WIN_ATTN", "TMR_XCORR_IMPL",
                      "TMR_XCORR_IMPL_SMALL", "TMR_XCORR_PRECISION",
                      "TMR_GLOBAL_SCORES_DTYPE", "TMR_DECODER_IMPL",
-                     "TMR_QUANT")
+                     "TMR_QUANT", "TMR_QUANT_STORAGE", "TMR_QUANT_KERNEL")
         before = {k: os.environ.get(k) for k in snap_keys}
         tune = {**tune, **autotune(cfg, IMAGE_SIZE, BATCH, log=_progress)}
         if {k: os.environ.get(k) for k in snap_keys} != before:
@@ -540,7 +606,11 @@ def _build_and_measure(cfg, tune) -> dict:
         global _WEIGHTS
         _WEIGHTS = "restored ckpt"
         _progress(f"params restored from {ckpt}")
-    params = predictor.params
+    # exec_params(): the tree the compiled program actually consumes —
+    # under an elected TMR_QUANT_STORAGE=int8 this is the offline int8
+    # tree (feeding the raw f32 tree to a storage-compiled program would
+    # both crash the trace and mislabel the headline)
+    params = predictor.exec_params()
     rng = np.random.default_rng(0)
     image = jnp.asarray(
         rng.standard_normal((BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)), jnp.float32

@@ -140,6 +140,31 @@ def to_config(args):
     return Config(**kw)
 
 
+#: inference-only quantization knobs a TRAINING run must never inherit:
+#: fake_quant's rounding has (near-)zero gradient, and a stored-int8
+#: param tree (TMR_QUANT_STORAGE) must never exist on the training side
+#: at all — optimizer updates on an int8 leaf are meaningless. One
+#: list so the scrub and its test can never drift.
+_TRAINING_SCRUB_KNOBS = ("TMR_QUANT", "TMR_QUANT_STORAGE")
+
+
+def scrub_training_env(environ=None) -> list:
+    """Strip the inference-only quantization knobs from ``environ``
+    (default ``os.environ``) before a training run traces anything —
+    the invariant enforced at the consumption point, not just at
+    autotune election (a sourced TMR_AUTOTUNE_EXPORT file can set them).
+    Returns the knobs that were scrubbed, for logging/tests."""
+    import os
+
+    env = os.environ if environ is None else environ
+    scrubbed = []
+    for knob in _TRAINING_SCRUB_KNOBS:
+        if env.get(knob, "off") not in ("", "off"):
+            env[knob] = "off"
+            scrubbed.append(knob)
+    return scrubbed
+
+
 def main(argv=None):
     args = config_parser(argv)
 
@@ -202,17 +227,18 @@ def main(argv=None):
 
     import os
 
-    if not cfg.eval and os.environ.get("TMR_QUANT", "off") == "int8":
-        # quantized weights are inference-only: fake_quant's rounding has
-        # (near-)zero gradient, so a training trace inheriting int8 (e.g.
-        # from a sourced TMR_AUTOTUNE_EXPORT file) would train the decoder
-        # against a quantization-noise floor. Enforce the invariant at the
-        # consumption point, not just at autotune election.
-        from tmr_tpu.utils.profiling import log_info
+    if not cfg.eval:
+        # quantized weights (and stored-int8 trees) are inference-only:
+        # fake_quant's rounding has (near-)zero gradient, so a training
+        # trace inheriting int8 (e.g. from a sourced TMR_AUTOTUNE_EXPORT
+        # file) would train the decoder against a quantization-noise
+        # floor — and an int8 STORAGE leaf must never reach an optimizer.
+        scrubbed = scrub_training_env()
+        if scrubbed:
+            from tmr_tpu.utils.profiling import log_info
 
-        log_info("TMR_QUANT=int8 ignored for training (inference-only "
-                 "knob); running exact weights")
-        os.environ["TMR_QUANT"] = "off"
+            log_info(f"{'/'.join(scrubbed)} ignored for training "
+                     "(inference-only knobs); running exact weights")
     if not cfg.eval and os.environ.get("TMR_DECODER_IMPL") == "fused":
         # unlike int8 the fused tail is gradient-valid and oracle-pinned,
         # so an explicit pin is honored — but its election evidence is

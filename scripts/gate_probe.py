@@ -221,12 +221,14 @@ def main(argv=None) -> int:
     # own dict caches (not lru_cache), so clear those the same way for a
     # recorded cause even when another trace already cached the verdict.
     from tmr_tpu.ops import fused_heads as _fh
+    from tmr_tpu.ops import pallas_int8 as _pi8
     from tmr_tpu.ops import postprocess as _pp
     from tmr_tpu.ops import quant as _q
 
     _fh._OK_CACHE.clear()
     _q._OK_CACHE.clear()
     _pp._TAIL_OK.clear()
+    _pi8._OK_CACHE.clear()
     # production geometry on the TPU; the off-accelerator contract run
     # (tests/test_bench_cli.py) probes the same code path at a geometry a
     # CPU can turn around — the verdict is per-geometry either way
@@ -236,8 +238,18 @@ def main(argv=None) -> int:
             ph, ph, pc, pc, 1, 3, "bfloat16"),
         f"quant_int8_{ph}x{ph}_c{pc}": lambda: _q.quant_ok(
             ph, ph, pc, pc, 1, 3),
+        # the TMR_QUANT_STORAGE surface: the equality-tier storage pin,
+        # the both-operand-int8 tolerance tier, the Mosaic int8 MXU
+        # kernel self-check, and the matcher's int8dot conv tier
+        f"quant_storage_{ph}x{ph}_c{pc}": lambda: _q.quant_storage_ok(
+            ph, ph, pc, pc, 1, 3),
+        f"quant_int8dot_{ph}x{ph}_c{pc}": lambda: _q.quant_int8dot_ok(
+            ph, ph, pc, pc, 1, 3),
+        "pallas_int8_mm_256": lambda: _pi8.pallas_int8_ok(),
         "quant_xcorr_c256_64_t17": lambda: _q.quant_xcorr_ok(
             256, 64, 64, 17),
+        "quant_xcorr_int8dot_c256_64_t17": lambda: _q.quant_xcorr_ok(
+            256, 64, 64, 17, kernel="int8dot"),
         "device_decode_tail": lambda: _pp.device_tail_ok(),
     }.items():
         try:

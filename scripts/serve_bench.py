@@ -334,6 +334,7 @@ def _run_mesh_sweep(args, tiny: bool, size: int, dtype: str,
                 "host_cores": host_cores,
             },
             "mesh": mesh_desc,
+            **({"quant": stats0["quant"]} if "quant" in stats0 else {}),
             "aot": {
                 "warmup": warmup,
                 "compile_events_after_warmup": len(new_events),
@@ -459,6 +460,10 @@ def _run(cancel_watchdog, argv=None) -> int:
             "result_cache": engine.result_cache.capacity,
             "feature_cache": engine.feature_cache.capacity,
         },
+        # numerics provenance: a storage-quantized engine's report says
+        # so (quant.mode/storage/digest — validator-checked)
+        **({"quant": engine.stats()["quant"]}
+           if "quant" in engine.stats() else {}),
         "workloads": [],
     }
 

@@ -990,17 +990,23 @@ def _stub_non_tail_picks(monkeypatch):
 
 
 def test_decoder_tail_knobs_registered_and_rev_bumped():
-    """TMR_DECODER_IMPL / TMR_QUANT must be versioned sweep knobs with
-    their variant sets registered, under the bumped "decoder-tail"
-    revision so every pre-PR-6 formulation winner re-records at the next
-    hardware window (the tail changed shape under them)."""
+    """TMR_DECODER_IMPL / TMR_QUANT / TMR_QUANT_STORAGE must be
+    versioned sweep knobs with their variant sets registered, under the
+    bumped "int8-storage" revision so every pre-storage winner
+    re-records at the next hardware window (the stored arm joined the
+    quant sweep)."""
     assert at.DECODER_IMPL_VARIANTS == ("xla", "fused")
     assert at.QUANT_VARIANTS == ("off", "int8")
+    assert at.STORAGE_VARIANTS == ("off", "int8")
     assert "TMR_DECODER_IMPL" in at._VERSIONED_KNOBS
     assert "TMR_QUANT" in at._VERSIONED_KNOBS
-    assert at._SWEEP_REV == "decoder-tail"
-    # formulation knob: revision-stamped; numerics knob: variants only
+    assert "TMR_QUANT_STORAGE" in at._VERSIONED_KNOBS
+    assert at._SWEEP_REV == "int8-storage"
+    # the quant knobs are revision-stamped too since the storage arm
+    # joined (pre-storage winners must go stale)
     assert at._variants_sig("TMR_DECODER_IMPL").endswith(at._SWEEP_REV)
+    assert at._variants_sig("TMR_QUANT").endswith(at._SWEEP_REV)
+    assert at._variants_sig("TMR_QUANT_STORAGE").endswith(at._SWEEP_REV)
 
 
 def test_autotune_elects_decoder_impl_then_quant(clean_knobs, monkeypatch):
@@ -1136,17 +1142,20 @@ def test_pick_decoder_impl_real_microbenchmark(monkeypatch, tmp_path):
 def test_pick_quant_sums_decoder_and_xcorr_stages(monkeypatch):
     """With emb_dim given, pick_quant's evidence is the SUM of the two
     surfaces the export flips (decoder tail + matcher correlation); a
-    fallback annotation in EITHER stage poisons the combined row, and the
-    tail stage's refusal causes survive the xcorr sweep's clear."""
-    monkeypatch.setattr(
-        at, "_sweep_tail_env",
-        lambda *a, **k: (
-            at.LAST_SWEEP_REFUSALS.setdefault("TMR_QUANT", {}).update(
-                {"int8" + at.FALLBACK_SUFFIX: [{"gate": "quant_ok"}]}
-            )
-            or {"off": 0.010, "int8" + at.FALLBACK_SUFFIX: 0.008}
-        ),
-    )
+    fallback annotation in EITHER stage poisons the combined row, the
+    tail stage's refusal causes survive the xcorr sweep's clear, and the
+    stored arm ("int8+store", swept via TMR_QUANT_STORAGE) reuses the
+    int8 correlation timing (storage never touches the matcher)."""
+    def tail_sweep(env_var, *a, **k):
+        if env_var == "TMR_QUANT_STORAGE":
+            at.LAST_SWEEP_REFUSALS.setdefault(env_var, {}).clear()
+            return {"int8": 0.007}
+        at.LAST_SWEEP_REFUSALS.setdefault("TMR_QUANT", {}).update(
+            {"int8" + at.FALLBACK_SUFFIX: [{"gate": "quant_ok"}]}
+        )
+        return {"off": 0.010, "int8" + at.FALLBACK_SUFFIX: 0.008}
+
+    monkeypatch.setattr(at, "_sweep_tail_env", tail_sweep)
     monkeypatch.setattr(
         at, "_sweep_xcorr_env",
         lambda env_var, *a, **k: (
@@ -1156,8 +1165,10 @@ def test_pick_quant_sums_decoder_and_xcorr_stages(monkeypatch):
     )
     times = at.pick_quant(1, 8, 16, 1, 3, emb_dim=16, rtt=0.0)
     assert times == {"off": 0.014,
-                     "int8" + at.FALLBACK_SUFFIX: pytest.approx(0.011)}
-    assert at._electable(times) == {"off": 0.014}
+                     "int8" + at.FALLBACK_SUFFIX: pytest.approx(0.011),
+                     "int8+store": pytest.approx(0.010)}
+    assert at._electable(times) == {"off": 0.014,
+                                    "int8+store": pytest.approx(0.010)}
     # the decoder stage's structured causes were merged back
     assert at.LAST_SWEEP_REFUSALS["TMR_QUANT"][
         "int8" + at.FALLBACK_SUFFIX

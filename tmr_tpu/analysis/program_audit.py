@@ -144,6 +144,93 @@ def jaxpr_stats(jaxpr) -> dict:
     return stats
 
 
+def int8_reach_stats(jaxpr) -> dict:
+    """Prove stored int8 weights actually FEED the matmuls (the
+    TMR_QUANT_STORAGE audit): walk the jaxpr tainting every value
+    transitively derived from an int8 program input (or int8 constant),
+    and count the ``dot_general`` equations consuming a tainted or
+    directly-int8 operand. The storage contract is that the program's
+    int8 invars reach the dots through in-program widening only — a
+    tree upconverted to f32 BEFORE the program boundary would show
+    ``int8_invars == 0`` here even though the numerics still pass the
+    equality pin (that is exactly the silent failure this rule exists
+    to catch: the bytes would never have moved).
+
+    Taint propagation is deliberately over-approximate (any equation
+    with a tainted input taints all its outputs); sub-jaxprs map taint
+    positionally where the invar lists line up (pjit) and fall back to
+    whole-body tainting elsewhere (scan/cond) — over-taint can only
+    produce a false PASS for a program with int8 inputs feeding nothing,
+    which ``int8_invars`` plus the dot counts make visible."""
+    from jax import core as _core
+
+    Literal = _core.Literal
+    top = getattr(jaxpr, "jaxpr", jaxpr)
+    stats = {"int8_invars": 0, "dot_eqns": 0, "int8_fed_dots": 0,
+             "int8_operand_dots": 0, "conv_eqns": 0,
+             "int8_fed_convs": 0}
+
+    def is_int8(v):
+        dtype = getattr(getattr(v, "aval", None), "dtype", None)
+        return dtype is not None and str(dtype) == "int8"
+
+    def walk(jx, seed) -> bool:
+        """Returns True when any outvar of ``jx`` ends tainted."""
+        tainted = set(seed)
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            real_ins = [v for v in eqn.invars
+                        if not isinstance(v, Literal)]
+            any_t = any(v in tainted for v in real_ins)
+            direct = any(is_int8(v) for v in real_ins)
+            if name == "dot_general":
+                stats["dot_eqns"] += 1
+                if direct:
+                    stats["int8_operand_dots"] += 1
+                if any_t or direct:
+                    stats["int8_fed_dots"] += 1
+            elif name == "conv_general_dilated":
+                stats["conv_eqns"] += 1
+                if any_t or direct:
+                    stats["int8_fed_convs"] += 1
+            inner_tainted = False
+            for val in eqn.params.values():
+                items = val if isinstance(val, (tuple, list)) else (val,)
+                for item in items:
+                    inner = getattr(item, "jaxpr", item)
+                    if not hasattr(inner, "eqns"):
+                        continue
+                    iseed = set(
+                        v for v in getattr(inner, "constvars", ())
+                        if is_int8(v)
+                    )
+                    if len(inner.invars) == len(eqn.invars):
+                        for outer, iv in zip(eqn.invars, inner.invars):
+                            if not isinstance(outer, Literal) and (
+                                outer in tainted or is_int8(outer)
+                            ):
+                                iseed.add(iv)
+                    elif any_t or direct:
+                        iseed.update(inner.invars)
+                    if walk(inner, iseed):
+                        inner_tainted = True
+            if any_t or direct or inner_tainted:
+                tainted.update(
+                    v for v in eqn.outvars if not isinstance(v, Literal)
+                )
+        return any(v in tainted for v in jx.outvars
+                   if not isinstance(v, Literal))
+
+    seed = set()
+    for v in top.invars:
+        if is_int8(v):
+            stats["int8_invars"] += 1
+            seed.add(v)
+    seed.update(v for v in getattr(top, "constvars", ()) if is_int8(v))
+    walk(top, seed)
+    return stats
+
+
 def audit_jaxpr(
     jaxpr,
     name: str,
@@ -284,7 +371,80 @@ def current_gate_state() -> Dict[str, str]:
         "TMR_DECODER_IMPL": os.environ.get("TMR_DECODER_IMPL", "auto"),
         "TMR_QUANT": os.environ.get("TMR_QUANT", "off"),
         "TMR_DECODE_TAIL": os.environ.get("TMR_DECODE_TAIL", "host"),
+        "TMR_QUANT_STORAGE": os.environ.get("TMR_QUANT_STORAGE", "off"),
     }
+
+
+def audit_storage_program(
+    image_size: int = 32,
+    emb_dim: int = 16,
+    max_detections: int = 32,
+    backbone: str = "resnet50_layer1",
+) -> dict:
+    """The stored-int8 program audited for REAL int8 reach: under
+    TMR_QUANT_STORAGE=int8 (caller's env) a tiny-geometry Predictor is
+    given real params, the stored tree is materialized through the full
+    admission path (quant.stored_params_for), and the traced fused
+    program is checked for (a) int8 invars — the program boundary
+    actually receives int8 arrays, no silent upconvert — and (b) those
+    invars feeding the decoder/head ``dot_general`` equations
+    (:func:`int8_reach_stats`), plus the standard no-f64 / quant-widen /
+    no-callback rules. Real (tiny) init instead of eval_shape because
+    the stored tree's scales are concrete trace constants; ~1 s on CPU.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tmr_tpu.inference import Predictor
+
+    cfg = _audit_cfg(image_size, emb_dim, max_detections, backbone)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=image_size)
+    problems: List[str] = []
+    st = pred._storage_state()
+    if st is None:
+        from tmr_tpu.diagnostics import gate_refusals
+
+        problems.append(
+            "storage: TMR_QUANT_STORAGE=int8 was not admitted for the "
+            "audit predictor (see recorded quant_storage_ok causes: "
+            f"{[r['message'] for r in gate_refusals()[-3:]]})"
+        )
+        return {"name": "match_heads_stored", "ok": False,
+                "problems": problems}
+    cap = int(cfg.template_buckets[0])
+    img = jax.ShapeDtypeStruct((1, image_size, image_size, 3),
+                               jnp.float32)
+    ex = jax.ShapeDtypeStruct((1, 1, 4), jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        jaxpr = jax.make_jaxpr(pred._get_fn(cap))(st.tree, None, img, ex)
+    rec = audit_jaxpr(jaxpr, "match_heads_stored", quant=True,
+                      transfer_pin=None)
+    reach = int8_reach_stats(jaxpr)
+    rec.update(reach)
+    k = int(cfg.decoder_kernel_size)
+    # one conv = k^2 tap dots, + the block-diagonal head dot; every one
+    # of them must be fed from an int8 invar
+    min_dots = k * k + 1
+    if reach["int8_invars"] < len(st.paths):
+        problems.append(
+            f"storage: program receives {reach['int8_invars']} int8 "
+            f"invars but the stored tree holds {len(st.paths)} int8 "
+            "leaves — something upconverted the tree at the boundary"
+        )
+    if reach["int8_fed_dots"] < min_dots:
+        problems.append(
+            f"storage: only {reach['int8_fed_dots']} dot_general "
+            f"equation(s) fed from int8 inputs (expected >= {min_dots}: "
+            f"{k}x{k} taps + the block-diagonal head)"
+        )
+    problems.extend(rec["problems"])
+    rec["problems"] = problems
+    rec["ok"] = not problems
+    rec["stored_leaves"] = len(st.paths)
+    rec["digest"] = st.digest[:16]
+    return rec
 
 
 def _audit_cfg(image_size: int, emb_dim: Optional[int],
@@ -493,6 +653,30 @@ def audit_production_programs(
                 config={"program": "attention", "platform": platform},
             )
 
+    # storage audit: when the ambient env elects TMR_QUANT_STORAGE=int8
+    # (autotune export / explicit pin), prove the int8 leaves reach the
+    # matmuls with real (tiny) params — the states sweep above traces
+    # abstract eval_shape params, which cannot exercise the stored tree
+    storage = None
+    if os.environ.get("TMR_QUANT_STORAGE", "off") == "int8":
+        try:
+            storage = audit_storage_program()
+        except Exception as e:
+            storage = {"name": "match_heads_stored", "ok": False,
+                       "problems": [
+                           f"storage audit raised {type(e).__name__}: {e}"
+                       ]}
+        problems.extend(storage["problems"])
+        if record_refusals and not storage["ok"]:
+            from tmr_tpu.diagnostics import gate_refused
+
+            gate_refused(
+                "program_audit", "; ".join(storage["problems"]),
+                "forward-mismatch",
+                config={"program": "match_heads_stored",
+                        "platform": platform, **current_gate_state()},
+            )
+
     return {
         "platform": platform,
         "geometry": {"image_size": image_size,
@@ -500,6 +684,7 @@ def audit_production_programs(
                      "batch": batch},
         "states": state_records,
         "attention": attention,
+        "storage": storage,
         "problems": problems,
         "ok": not problems,
     }

@@ -188,6 +188,12 @@ ENV_KNOBS = {
         "(ops/fused_heads.py, oracle-gated)",
     "TMR_QUANT": "int8-weight quantized tail: off|int8|auto "
         "(ops/quant.py, tiered-oracle-gated)",
+    "TMR_QUANT_STORAGE": "offline int8 param-tree storage: off|int8 "
+        "(programs receive int8 weight leaves; bitwise the fake-quant "
+        "numerics, equality-tier gated)",
+    "TMR_QUANT_KERNEL": "stored-int8 matmul arm: auto|dequant|int8dot|"
+        "pallas (dequant = bitwise pin; int8dot/pallas = both-operand "
+        "int8, tolerance-gated)",
     "TMR_DECODE_TAIL": "detection decode tail: host|device "
         "(device = on-device compaction, self-check-gated)",
     # kernel tile / schedule parameters (validated, pinnable)
@@ -202,6 +208,8 @@ ENV_KNOBS = {
     "TMR_NO_PALLAS_XCORR": "force-disable the Pallas correlation kernel",
     "TMR_NO_FUSED_HEADS": "force-disable the fused decoder-head path",
     "TMR_NO_DEVICE_TAIL": "force-disable the device decode tail",
+    "TMR_NO_PALLAS_INT8": "force-disable the Mosaic int8 MXU matmul "
+        "kernel",
     # autotune / bench machinery
     "TMR_AUTOTUNE_CACHE": "autotune winner-cache path (0/off disables)",
     "TMR_AUTOTUNE_FORCE": "re-sweep even when cached winners exist",
@@ -286,6 +294,9 @@ ENV_KNOBS = {
     "TMR_BENCH_SELFTEST_PRELIM": "bench.py self-test: force prelim emit",
     "TMR_BENCH_SIZE": "bench.py: image-size override",
     "TMR_BENCH_TINY": "bench.py: tiny CPU-geometry smoke mode",
+    "TMR_BENCH_PROXY": "bench.py: CPU-proxy round — measure the local "
+        "(reduced) geometry honestly under cpu_proxy, carry the "
+        "committed TPU headline into value (carried: true)",
     "TMR_BENCH_TREND": "bench.py: embed the bench_trend/v1 history "
         "record (1 enables)",
 }

@@ -233,6 +233,7 @@ def validate_health_report(doc: dict) -> List[str]:
     problems: List[str] = []
     if not isinstance(doc, dict):
         return [f"not a dict: {type(doc).__name__}"]
+    problems += _validate_quant_attachment(doc)
     if doc.get("schema") != HEALTH_REPORT_SCHEMA:
         problems.append(
             f"schema != {HEALTH_REPORT_SCHEMA}: {doc.get('schema')!r}"
@@ -667,6 +668,40 @@ SERVE_REPORT_SCHEMA = "serve_report/v1"
 SERVE_WORKLOAD_MODES = ("closed", "open")
 
 
+#: closed vocabularies of the optional ``quant`` provenance attachment
+#: (engine stats()/health() and serve_report/v1): which numerics tier
+#: the serving programs ran — "mode" is the in-program TMR_QUANT arm,
+#: "storage" whether the param tree itself was offline-quantized
+#: (TMR_QUANT_STORAGE). Absent = fully exact weights.
+QUANT_STAMP_MODES = ("off", "int8")
+
+
+def _validate_quant_attachment(doc: dict) -> List[str]:
+    """Optional ``quant`` attachment: results served from a quantized
+    (and/or storage-quantized) engine carry their numerics provenance
+    the way degraded results carry ``degrade_steps``."""
+    if "quant" not in doc:
+        return []
+    q = doc["quant"]
+    if not isinstance(q, dict):
+        return ["quant: not a dict"]
+    problems: List[str] = []
+    if q.get("mode") not in QUANT_STAMP_MODES:
+        problems.append(f"quant.mode: bad value {q.get('mode')!r}")
+    if q.get("storage") not in QUANT_STAMP_MODES:
+        problems.append(f"quant.storage: bad value {q.get('storage')!r}")
+    if q.get("storage") == "int8":
+        if not isinstance(q.get("digest"), str) or not q.get("digest"):
+            problems.append("quant.digest: not a non-empty string under "
+                            "storage=int8")
+        for key in ("quantized_leaves", "weight_bytes",
+                    "f32_weight_bytes"):
+            v = q.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                problems.append(f"quant.{key}: not a positive int")
+    return problems
+
+
 def _validate_mesh_attachment(doc: dict) -> List[str]:
     """Optional ``mesh`` attachment of a serve_report/v1 (and the
     engine's health/stats views): the serving-mesh description one
@@ -712,6 +747,7 @@ def validate_serve_report(doc: dict) -> List[str]:
     problems += _validate_metrics_attachment(doc)
     problems += _validate_mfu_attachment(doc)
     problems += _validate_mesh_attachment(doc)
+    problems += _validate_quant_attachment(doc)
     if doc.get("schema") != SERVE_REPORT_SCHEMA:
         problems.append(
             f"schema != {SERVE_REPORT_SCHEMA}: {doc.get('schema')!r}"
@@ -998,6 +1034,17 @@ def validate_stage_breakdown(doc: dict) -> List[str]:
                        ("decode_tail", ("host", "device"))):
         if doc.get(key) not in legal:
             problems.append(f"{key}: {doc.get(key)!r} not in {legal}")
+    # optional storage stamps (absent on pre-storage records)
+    if "quant_storage" in doc and doc["quant_storage"] not in \
+            QUANT_STAMP_MODES:
+        problems.append(
+            f"quant_storage: {doc['quant_storage']!r} not in "
+            f"{QUANT_STAMP_MODES}"
+        )
+    if "quant_kernel" in doc and doc["quant_kernel"] not in (
+        "dequant", "int8dot", "pallas"
+    ):
+        problems.append(f"quant_kernel: {doc['quant_kernel']!r} bad")
     for stage in STAGE_BREAKDOWN_STAGES:
         sec, err = doc.get(f"{stage}_s"), doc.get(f"{stage}_error")
         if sec is None and err is None:

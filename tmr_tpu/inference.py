@@ -92,6 +92,99 @@ class Predictor:
         self.refiner = refiner
         self.refiner_params = refiner_params
         self._compiled: Dict[tuple, callable] = {}
+        #: (params identity, QuantizedParams|None) — the resolved int8
+        #: storage state for the CURRENT param tree (TMR_QUANT_STORAGE)
+        self._storage_cache: Optional[tuple] = None
+
+    # ------------------------------------------------------- int8 storage
+    def _storage_state(self):
+        """The offline-quantized param tree for TMR_QUANT_STORAGE=int8,
+        or None (knob off / params unset / admission refused — refusals
+        record gate_probe/v1 causes, see quant.stored_params_for).
+
+        Materialized once per (process, checkpoint digest) and cached
+        per param-tree identity here, so a second Predictor over the
+        same weights assembles from the digest cache instead of
+        re-quantizing. The compiled programs then RECEIVE the int8
+        arrays (HBM weight bytes for those leaves drop 4x) and every
+        program key carries the digest — a checkpoint swap can never
+        reuse a program compiled against other scales."""
+        from tmr_tpu.ops import quant as _q
+
+        if self.params is None or _q.quant_storage_mode() != "int8":
+            return None
+        if getattr(self.model, "quant_storage", None) is None:
+            # non-MatchingNet models have no stored-tail formulation
+            return None
+        cached = self._storage_cache
+        if cached is not None and cached[0] is self.params:
+            return cached[1]
+        hw = self.feature_hw(int(self.cfg.image_size))
+        c_cat = (self.cfg.emb_dim * 2 if self.cfg.fusion
+                 else self.cfg.emb_dim)
+        st = _q.stored_params_for(
+            self.params, hw, hw, c_cat, c_cat,
+            self.cfg.decoder_num_layer, self.cfg.decoder_kernel_size,
+            dtype_name=self.cfg.compute_dtype,
+            box_reg=self.cfg.box_reg,
+        )
+        self._storage_cache = (self.params, st)
+        return st
+
+    def exec_params(self):
+        """The param tree the compiled programs consume: the stored int8
+        tree under an admitted TMR_QUANT_STORAGE=int8, else
+        ``self.params`` unchanged. The serving layer stages THIS tree
+        (serve/engine.py), so serve-side weight traffic drops with it
+        (4x for the quantized leaves)."""
+        st = self._storage_state()
+        return st.tree if st is not None else self.params
+
+    def quant_stamp(self) -> Optional[dict]:
+        """Provenance for stats()/health()/serve_report: which quant
+        mode + storage the programs run, or None when fully exact."""
+        from tmr_tpu.ops.quant import quant_mode
+
+        st = self._storage_state()
+        if st is not None:
+            return st.stamp()
+        if quant_mode() == "int8":
+            return {"mode": "int8", "storage": "off"}
+        return None
+
+    def _storage_model(self, model, st):
+        """Clone ``model`` for a stored-tree program when storage is
+        active (the flag routes MatchingNet onto the fused stored
+        tail)."""
+        return model.clone(quant_storage=True) if st is not None else model
+
+    @staticmethod
+    def _variables(params, scales):
+        v = {"params": params}
+        if scales is not None:
+            v["quant_scales"] = scales
+        return v
+
+    def _storage_entry(self, run, st):
+        """Caller-proofing for storage-compiled programs: the direct
+        consumers of ``_compiled`` entries (bench.py, bench_extra,
+        profile_breakdown, …) historically pass ``predictor.params``;
+        under TMR_QUANT_STORAGE=int8 the program needs the stored int8
+        tree instead. This wrapper swaps the tree when the caller passed
+        EXACTLY ``self.params`` (identity — device-placed copies pass
+        through untouched); any other f32 tree still fails the trace
+        loudly via the int8-dtype check in fused_heads._maybe_quant,
+        never silently dequantizing unquantized weights."""
+        if st is None:
+            return run
+
+        def swapped(params, *args, **kw):
+            if params is self.params:
+                params = st.tree
+            return run(params, *args, **kw)
+
+        swapped.__wrapped__ = run
+        return swapped
 
     def init_params(self, seed: int = 0, image_size: Optional[int] = None):
         s = image_size or self.cfg.image_size
@@ -146,18 +239,22 @@ class Predictor:
             dets = compact_detections(dets)
         return dets
 
-    def _single_pipeline(self, model, refine: bool):
+    def _single_pipeline(self, model, refine: bool, scales=None):
         """The ONE traced body of the fused single-exemplar program:
         forward -> decode -> [refine] -> NMS. Both the plain jit
         (:meth:`_get_fn`) and the mesh-sharded variants
         (:meth:`_get_sharded_fn`) close over this exact function — the
         dp bitwise-parity contract depends on the two programs tracing
         the identical op sequence, so there must never be a second
-        copy to drift. Returns ``(dets, model_out)`` (the loss path
-        consumes ``model_out``; other callers drop it)."""
+        copy to drift. ``scales`` (storage mode) is the offline
+        quant_scales collection, closed over as trace-time constants —
+        tiny, and the program key carries the tree digest. Returns
+        ``(dets, model_out)`` (the loss path consumes ``model_out``;
+        other callers drop it)."""
 
         def body(params, refiner_params, image, exemplars):
-            out = model.apply({"params": params}, image, exemplars)
+            out = model.apply(self._variables(params, scales), image,
+                              exemplars)
             dets = self._decode(out, exemplars[:, 0, :])
             dets = self._refine_nms(
                 dets, out["backbone_feature"],
@@ -168,7 +265,7 @@ class Predictor:
         return body
 
     def _multi_batched_pipeline(self, model, heads, k_bucket: int,
-                                refine: bool):
+                                refine: bool, scales=None):
         """The ONE traced body of the batched union-NMS program (see
         :meth:`_single_pipeline` for why it is shared between the plain
         and mesh-sharded builders)."""
@@ -188,7 +285,7 @@ class Predictor:
             head_params = {n: v for n, v in params.items()
                            if n != "backbone"}
             out = heads.apply(
-                {"params": head_params},
+                self._variables(head_params, scales),
                 jnp.repeat(feat, k_bucket, axis=0),  # image-major (B*k,)
                 exemplars.reshape(b * k_bucket, 1, 4),
             )
@@ -241,16 +338,26 @@ class Predictor:
         # equal Python int — tuple keys compare equal but a second jit
         # wrapper per int flavor would silently recompile
         capacity = int(capacity)
-        key = (capacity, refine, loss_fn, chain_feedback, donate)
+        # storage mode forks the key on the checkpoint digest: the
+        # program closes over that tree's scales, so a param swap (new
+        # digest) must compile a new entry, never reuse stale scales
+        st = self._storage_state()
+        key = (capacity, refine, loss_fn, chain_feedback, donate) + (
+            (st.digest,) if st is not None else ()
+        )
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
         jit = (
             functools.partial(jax.jit, donate_argnums=(2,)) if donate
             else jax.jit
         )
 
-        body = self._single_pipeline(model, refine)
+        body = self._single_pipeline(
+            model, refine, scales=st.scales if st is not None else None
+        )
 
         @jit
         def run(params, refiner_params, image, exemplars, *extra):
@@ -271,11 +378,11 @@ class Predictor:
         # cliffs. The devtime wrapper outside it (obs/devtime.py) is the
         # flight recorder's per-execution device-time attribution seam;
         # with TMR_FLIGHT=0 (default) it is one bool check.
-        run = track_devtime(
+        run = self._storage_entry(track_devtime(
             track_compile(run, "single", key,
                           bucket={"capacity": capacity}),
             "single", key, bucket={"capacity": capacity},
-        )
+        ), st)
         self._compiled[key] = run
         return run
 
@@ -327,7 +434,7 @@ class Predictor:
         cap = self.pick_capacity(exemplars, int(image.shape[1]))
         fn = self._get_fn(cap)
         return fn(
-            self.params,
+            self.exec_params(),
             self.refiner_params,
             jnp.asarray(image),
             jnp.asarray(exemplars),
@@ -363,11 +470,17 @@ class Predictor:
         # them from array shapes) must hit the same compiled entry as the
         # equal Python int instead of silently recompiling
         capacity, k_bucket = int(capacity), int(k_bucket)
-        key = ("multi", capacity, k_bucket, refine, loss_fn)
+        st = self._storage_state()
+        key = ("multi", capacity, k_bucket, refine, loss_fn) + (
+            (st.digest,) if st is not None else ()
+        )
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
         heads = model.clone(backbone=_PassthroughBackbone())
+        scales = st.scales if st is not None else None
 
         @jax.jit
         def run(params, refiner_params, image, exemplars, k_real, *extra):
@@ -384,7 +497,7 @@ class Predictor:
                 feat = feat[0]
             head_params = {n: v for n, v in params.items() if n != "backbone"}
             out = heads.apply(
-                {"params": head_params},
+                self._variables(head_params, scales),
                 jnp.repeat(feat, k_bucket, axis=0),
                 exemplars[:, None, :],
             )
@@ -424,13 +537,13 @@ class Predictor:
             )
             return losses, final
 
-        run = track_devtime(
+        run = self._storage_entry(track_devtime(
             track_compile(run, "multi", key,
                           bucket={"capacity": capacity,
                                   "k_bucket": k_bucket}),
             "multi", key, bucket={"capacity": capacity,
                                   "k_bucket": k_bucket},
-        )
+        ), st)
         self._compiled[key] = run
         return run
 
@@ -460,7 +573,7 @@ class Predictor:
         cap = self.pick_capacity(exemplars, int(image.shape[1]))
         fn = self._get_multi_fn(cap, k_bucket, loss_fn=loss_fn)
         return fn(
-            self.params,
+            self.exec_params(),
             self.refiner_params,
             jnp.asarray(image),
             jnp.asarray(np.concatenate([exemplars, pad], axis=0)),
@@ -488,24 +601,31 @@ class Predictor:
             self.cfg, "refine_box", False
         )
         capacity, k_bucket = int(capacity), int(k_bucket)
-        key = ("multi_batched", capacity, k_bucket, refine, donate)
+        st = self._storage_state()
+        key = ("multi_batched", capacity, k_bucket, refine, donate) + (
+            (st.digest,) if st is not None else ()
+        )
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
         heads = model.clone(backbone=_PassthroughBackbone())
         jit = (
             functools.partial(jax.jit, donate_argnums=(2,)) if donate
             else jax.jit
         )
-        run = jit(self._multi_batched_pipeline(model, heads, k_bucket,
-                                               refine))
-        run = track_devtime(
+        run = jit(self._multi_batched_pipeline(
+            model, heads, k_bucket, refine,
+            scales=st.scales if st is not None else None,
+        ))
+        run = self._storage_entry(track_devtime(
             track_compile(run, "multi_batched", key,
                           bucket={"capacity": capacity,
                                   "k_bucket": k_bucket}),
             "multi_batched", key, bucket={"capacity": capacity,
                                           "k_bucket": k_bucket},
-        )
+        ), st)
         self._compiled[key] = run
         return run
 
@@ -522,7 +642,7 @@ class Predictor:
             int(exemplars.shape[1]), donate=donate,
         )
         return fn(
-            self.params, self.refiner_params, jnp.asarray(images),
+            self.exec_params(), self.refiner_params, jnp.asarray(images),
             exemplars, jnp.asarray(k_real, jnp.int32),
         )
 
@@ -567,15 +687,21 @@ class Predictor:
             self.cfg, "refine_box", False
         )
         capacity, image_size = int(capacity), int(image_size)
-        key = ("heads", capacity, image_size, refine)
+        st = self._storage_state()
+        key = ("heads", capacity, image_size, refine) + (
+            (st.digest,) if st is not None else ()
+        )
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
+        scales = st.scales if st is not None else None
 
         @jax.jit
         def run(params, refiner_params, features, exemplars):
             out = model.apply(
-                {"params": params},
+                self._variables(params, scales),
                 jnp.zeros((features.shape[0], 1, 1, 3), jnp.float32),
                 exemplars, features=features,
             )
@@ -585,13 +711,13 @@ class Predictor:
                 refiner_params, refine,
             )
 
-        run = track_devtime(
+        run = self._storage_entry(track_devtime(
             track_compile(run, "heads", key,
                           bucket={"capacity": capacity,
                                   "image_size": image_size}),
             "heads", key, bucket={"capacity": capacity,
                                   "image_size": image_size},
-        )
+        ), st)
         self._compiled[key] = run
         return run
 
@@ -643,11 +769,18 @@ class Predictor:
             self.cfg, "refine_box", False
         )
         capacity = int(capacity)
-        key = ("single_sharded", capacity, refine, donate, target.key)
+        st = self._storage_state()
+        key = ("single_sharded", capacity, refine, donate, target.key) + (
+            (st.digest,) if st is not None else ()
+        )
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
-        pipeline = self._single_pipeline(model, refine)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
+        pipeline = self._single_pipeline(
+            model, refine, scales=st.scales if st is not None else None
+        )
 
         def body(params, refiner_params, image, exemplars):
             # the SHARED single-program body (bitwise contract); the
@@ -703,15 +836,20 @@ class Predictor:
             self.cfg, "refine_box", False
         )
         capacity, k_bucket = int(capacity), int(k_bucket)
+        st = self._storage_state()
         key = ("multi_sharded", capacity, k_bucket, refine, donate,
-               target.key)
+               target.key) + ((st.digest,) if st is not None else ())
         if key in self._compiled:
             return self._compiled[key]
-        model = self.model.clone(template_capacity=capacity)
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
         heads = model.clone(backbone=_PassthroughBackbone())
         # the SHARED batched union-NMS body (bitwise contract)
-        body = self._multi_batched_pipeline(model, heads, k_bucket,
-                                            refine)
+        body = self._multi_batched_pipeline(
+            model, heads, k_bucket, refine,
+            scales=st.scales if st is not None else None,
+        )
 
         donate_argnums = (2,) if donate else ()
         if target.mode == "dp" and target.tp == 1:

@@ -27,7 +27,15 @@ _INIT = nn.initializers.normal(stddev=0.01)
 
 class _ConvParams(nn.Module):
     """Param-holder twin of one ``nn.Conv``: declares kernel/bias with
-    nn.Conv's names, shapes, dtypes and inits, returns the values."""
+    nn.Conv's names, shapes, dtypes and inits, returns the values.
+
+    Under TMR_QUANT_STORAGE=int8 the Predictor passes the offline
+    per-tap per-output-channel scales as a ``quant_scales`` variable
+    collection mirroring the param paths (ops/quant.quantize_tree); when
+    this module's path carries one, the return grows to
+    (kernel int8, bias, scale) and the fused tail consumes the stored
+    triple. The params collection itself never forks — same names,
+    same shapes — so checkpoints and goldens stay compatible."""
 
     features: int
     kernel_size: tuple
@@ -43,6 +51,8 @@ class _ConvParams(nn.Module):
             "bias", nn.initializers.zeros_init(), (self.features,),
             jnp.float32,
         )
+        if self.has_variable("quant_scales", "kernel"):
+            return kernel, bias, self.get_variable("quant_scales", "kernel")
         return kernel, bias
 
 

@@ -27,7 +27,11 @@ import jax
 import jax.numpy as jnp
 
 from tmr_tpu.models.heads import BboxesHead, Decoder, ObjectnessHead
-from tmr_tpu.ops.fused_heads import decoder_impl, fused_decoder_heads
+from tmr_tpu.ops.fused_heads import (
+    decoder_impl,
+    fused_decoder_heads,
+    stored_decoder_impl,
+)
 from tmr_tpu.ops.xcorr import cross_correlation, extract_prototype, extract_template
 
 
@@ -71,6 +75,13 @@ class MatchingNet(nn.Module):
     decoder_num_layer: int = 1
     decoder_kernel_size: int = 3
     dtype: Any = jnp.float32
+    #: set by the Predictor when the param tree it passes holds OFFLINE
+    #: int8 decoder/head kernels (TMR_QUANT_STORAGE=int8, admitted by
+    #: quant.stored_params_for): the decoder tail then runs the fused
+    #: formulation with quant="stored", reading each kernel's scale from
+    #: the ``quant_scales`` collection. Never flip this without the
+    #: matching tree — int8 leaves cannot run the XLA module stack.
+    quant_storage: bool = False
 
     @nn.compact
     def __call__(
@@ -142,8 +153,19 @@ class MatchingNet(nn.Module):
             # tree — the modules declare their parameters either way, so
             # checkpoints and goldens never fork. box_reg=False has a
             # single stack and stays on the module path.
-            impl, quant = "xla", False
-            if self.box_reg:
+            impl, quant, kernel_arm = "xla", False, "dequant"
+            if self.quant_storage and self.box_reg:
+                # stored int8 leaves: the fused formulation is the only
+                # runnable path — stored_decoder_impl re-verifies the
+                # gates at THIS geometry and raises (cause recorded) on
+                # refusal instead of silently feeding int8 to nn.Conv
+                impl, quant, kernel_arm = stored_decoder_impl(
+                    f_cat.shape[1], f_cat.shape[2], f_cat.shape[-1],
+                    f_cat.shape[-1], self.decoder_num_layer,
+                    self.decoder_kernel_size,
+                    "bfloat16" if self.dtype == jnp.bfloat16 else "float32",
+                )
+            elif self.box_reg:
                 impl, quant = decoder_impl(
                     f_cat.shape[1], f_cat.shape[2], f_cat.shape[-1],
                     f_cat.shape[-1], self.decoder_num_layer,
@@ -200,7 +222,7 @@ class MatchingNet(nn.Module):
                 )(f_cat, return_params=True)
                 o, b = fused_decoder_heads(
                     f_cat, dec_o_p, dec_b_p, head_o_p, head_b_p,
-                    dtype=self.dtype, quant=quant,
+                    dtype=self.dtype, quant=quant, kernel_arm=kernel_arm,
                 )
                 out["regressions"].append(b)  # already float32
                 out["objectness"].append(o[..., 0])

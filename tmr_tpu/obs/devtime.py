@@ -400,6 +400,44 @@ def _sig_str(sig: tuple) -> List[str]:
     return [f"{'x'.join(map(str, shape))}:{dtype}" for shape, dtype in sig]
 
 
+def _weight_stats(abstract) -> Optional[dict]:
+    """Weight-tree bytes of a program from its stored abstract args: the
+    FIRST argument of every Predictor program is the param tree, so its
+    leaf bytes are the per-call HBM weight traffic floor. Under
+    TMR_QUANT_STORAGE=int8 the quantized leaves arrive as int8 — the
+    figure drops 4x for them, which is how an mfu_report shows the
+    storage knob's bytes actually moved (the roofline's bytes-accessed
+    figure from cost_analysis() moves with it). Returns
+    {"weight_bytes", "int8_weight_bytes", "int8_weights"} or None when
+    the program recorded no abstract args."""
+    if not abstract:
+        return None
+    try:
+        import jax
+        import numpy as np
+
+        leaves = jax.tree.leaves(abstract[0])
+        total = 0
+        int8 = 0
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(
+                dtype
+            ).itemsize
+            total += nbytes
+            if np.dtype(dtype) == np.int8:
+                int8 += nbytes
+        if total == 0:
+            return None
+        return {"weight_bytes": total, "int8_weight_bytes": int8,
+                "int8_weights": int8 > 0}
+    except Exception:
+        return None
+
+
 def mfu_report() -> dict:
     """Reduce the attribution table to one ``mfu_report/v1`` document.
 
@@ -449,6 +487,7 @@ def mfu_report() -> dict:
         else:
             bound = "compute" if intensity >= ridge else "memory"
         analytic = _analytic_cost(entry["kind"], entry["bucket"], sig)
+        wstats = _weight_stats(rec.get("abstract"))
         prog = {
             "kind": entry["kind"],
             "key": entry["key"],
@@ -464,6 +503,10 @@ def mfu_report() -> dict:
             "flops_per_call": flops,
             "bytes_per_call": cost.get("bytes"),
             "cost_source": cost["source"],
+            # param-tree bytes per call + whether int8 storage leaves
+            # reached this program (TMR_QUANT_STORAGE accounting)
+            "weight_bytes": wstats["weight_bytes"] if wstats else None,
+            "int8_weights": wstats["int8_weights"] if wstats else False,
             "analytic_flops_per_call": (
                 analytic["flops"] if analytic else None
             ),

@@ -34,9 +34,20 @@ gate_probe/v1 cause on any refusal.
 The int8 weight variant (TMR_QUANT, ops/quant.py) rides the same
 formulation: each matmul's weight operand is round-tripped through the
 int8 grid with a per-output-channel scale next to its dot_general (the
-fake-quant formulation — int8 numerics pinned exactly, int8 storage a
-follow-up; see the quant module docstring); admitted only through
-quant.quant_ok's tiered oracle.
+fake-quant formulation — int8 numerics pinned exactly); admitted only
+through quant.quant_ok's tiered oracle. Under TMR_QUANT_STORAGE=int8
+the round trip is split across time: the quantize half runs OFFLINE
+(ops/quant.quantize_tree — the program receives int8 arrays, HBM weight
+bytes for those leaves drop 4x) and only the dequantize half stays in-program,
+adjacent to each matmul — same grid, same scales, so the stored output
+is bitwise-identical to the fake-quant path (quant_storage_ok equality
+tier). TMR_QUANT_KERNEL selects faster stored matmul arms: "int8dot"
+feeds BOTH operands to the dot on the int8 grid
+(preferred_element_type=int32, per-channel dequant fused into the f32
+epilogue; dynamic activation quantization, tolerance-gated) and
+"pallas" runs the same contraction through the Mosaic int8 MXU kernel
+(ops/pallas_int8.py), each falling back one arm with a recorded cause
+where its gate refuses.
 """
 
 from __future__ import annotations
@@ -51,17 +62,45 @@ from jax import lax
 #: legal TMR_DECODER_IMPL values (autotune + config registry import this)
 DECODER_IMPLS = ("auto", "xla", "fused")
 
-ParamPair = Tuple[jnp.ndarray, jnp.ndarray]  # (kernel, bias)
+ParamPair = Tuple[jnp.ndarray, ...]  # (kernel, bias[, scale])
 
 
-def _maybe_quant(w: jnp.ndarray, dtype, quant: bool) -> jnp.ndarray:
-    """Weight operand for one matmul: bf16/f32 cast, or the int8
-    quantize-dequantize round trip under TMR_QUANT. Every operand here is
-    a 2D (C_in, C_out) matrix (a conv tap or the block-diagonal head), so
+def _maybe_quant(w: jnp.ndarray, dtype, quant, scale=None) -> jnp.ndarray:
+    """Weight operand for one matmul: bf16/f32 cast, the int8
+    quantize-dequantize round trip under TMR_QUANT (``quant=True``), or
+    the dequantized STORED int8 operand (``quant="stored"`` — ``w`` is
+    int8, ``scale`` its offline per-output-channel scale; the values are
+    bitwise the fake-quant operand's). Every operand here is a 2D
+    (C_in, C_out) matrix (a conv tap or the block-diagonal head), so
     reducing over axis 0 yields one scale per OUTPUT channel — the
     grouping the quant_ok weights tier bounds; a shared-across-outputs
     scale would let one large sibling channel crush small channels'
     weights to zero."""
+    if quant == "stored":
+        from tmr_tpu.ops.quant import dequantize
+
+        if scale is None:
+            raise ValueError(
+                "stored-quant matmul needs its offline scale (int8 "
+                "kernel leaf without a quant_scales entry)"
+            )
+        if w.dtype != jnp.int8:
+            # a caller fed the RAW f32 tree to a storage-compiled
+            # program: dequantizing unquantized weights would multiply
+            # them by ~amax/127 — silent garbage numerics. Fail the
+            # trace loudly instead (Predictor.exec_params() is the tree
+            # these programs consume).
+            raise TypeError(
+                f"stored-quant matmul expected an int8 kernel operand, "
+                f"got {w.dtype} — pass Predictor.exec_params(), not the "
+                "raw f32 params, to a TMR_QUANT_STORAGE=int8 program"
+            )
+        # bitwise-identical to the fake arm's operand by construction:
+        # same grid, same scales (quantize_int8 computes the scale as a
+        # reciprocal MULTIPLY precisely so jit-time constant-division
+        # rewrites cannot fork in-program scales from offline ones —
+        # see its comment), and the same dequantize ops feed the dot
+        return dequantize(w, scale, dtype=dtype)
     if quant:
         from tmr_tpu.ops.quant import fake_quant
 
@@ -69,8 +108,39 @@ def _maybe_quant(w: jnp.ndarray, dtype, quant: bool) -> jnp.ndarray:
     return w.astype(dtype)
 
 
+def _quant_act(xp: jnp.ndarray):
+    """Dynamic per-image int8 quantization of an activation block for
+    the int8dot/pallas arms: (q int8, scale f32 (B, 1, 1, 1)). Rides
+    quant.quantize_int8 — ONE canonical int8 grid (its reciprocal-
+    multiply scale included) instead of a drifting local copy."""
+    from tmr_tpu.ops.quant import quantize_int8
+
+    b = xp.shape[0]
+    q, s = quantize_int8(xp.astype(jnp.float32).reshape(b, -1), axis=-1)
+    return q.reshape(xp.shape), s.reshape(b, 1, 1, 1)
+
+
+def _int8_tap(xq, xs, wq, ws, kernel_arm: str):
+    """One channel-contracted tap on the int8 grid: xq (B, H', W', C_in)
+    int8, xs (B, 1, 1, 1) f32, wq (C_in, C_out) int8, ws (1, C_out) f32.
+    Returns the dequantized f32 tap contribution."""
+    if kernel_arm == "pallas":
+        from tmr_tpu.ops.pallas_int8 import int8_matmul
+
+        b, oh, ow, ci = xq.shape
+        rows = jnp.broadcast_to(xs, (b, oh, ow, 1)).reshape(-1, 1)
+        out = int8_matmul(xq.reshape(-1, ci), wq, rows, ws)
+        return out.reshape(b, oh, ow, -1)
+    acc = lax.dot_general(
+        xq, wq, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * (xs * ws[None, None])
+
+
 def conv_mm(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray,
-            dtype=jnp.bfloat16, quant: bool = False) -> jnp.ndarray:
+            dtype=jnp.bfloat16, quant=False, scale=None,
+            kernel_arm: str = "dequant") -> jnp.ndarray:
     """k x k conv as k^2 channel-contracted matmuls, f32 accumulator,
     with the module stack's torch-style symmetric padding (k-1)//2 — the
     heads.py nn.Conv contract, which the oracle compares against. Odd k
@@ -78,7 +148,12 @@ def conv_mm(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray,
     modules do.
 
     x: (B, H, W, C_in) NHWC; kernel: (k, k, C_in, C_out) (the nn.Conv
-    layout, so module params feed in unchanged); bias: (C_out,).
+    layout, so module params feed in unchanged; int8 with ``scale``
+    (k, k, 1, C_out) under ``quant="stored"``); bias: (C_out,).
+    ``kernel_arm`` (stored mode only) picks the contraction: "dequant"
+    widens the int8 operand next to each dot (bitwise the fake path),
+    "int8dot"/"pallas" quantize the activation per image and contract on
+    the int8 grid with the dequant fused into the f32 epilogue.
     Returns (B, H', W', C_out) float32 — callers round once, after the
     nonlinearity, instead of per conv.
     """
@@ -87,17 +162,34 @@ def conv_mm(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray,
     b, h, w, _ = x.shape
     oh, ow = h + 2 * p - k + 1, w + 2 * p - k + 1
     xp = jnp.pad(x.astype(dtype), ((0, 0), (p, p), (p, p), (0, 0)))
+    int8_act = quant == "stored" and kernel_arm in ("int8dot", "pallas")
+    if int8_act:
+        xq, xs = _quant_act(xp)
     acc = None
     for dy in range(k):
         for dx in range(k):
-            tap = lax.dot_general(
-                xp[:, dy : dy + oh, dx : dx + ow, :],
-                _maybe_quant(kernel[dy, dx], dtype, quant),
-                (((3,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+            if int8_act:
+                tap = _int8_tap(
+                    xq[:, dy : dy + oh, dx : dx + ow, :], xs,
+                    kernel[dy, dx], scale[dy, dx], kernel_arm,
+                )
+            else:
+                tap = lax.dot_general(
+                    xp[:, dy : dy + oh, dx : dx + ow, :],
+                    _maybe_quant(kernel[dy, dx], dtype, quant,
+                                 scale[dy, dx] if scale is not None
+                                 else None),
+                    (((3,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
             acc = tap if acc is None else acc + tap
     return acc + bias.astype(jnp.float32)
+
+
+def _entry(pair):
+    """(kernel, bias[, scale]) -> (kernel, bias, scale_or_None)."""
+    k, b = pair[0], pair[1]
+    return k, b, (pair[2] if len(pair) > 2 else None)
 
 
 def fused_decoder_heads(
@@ -108,49 +200,88 @@ def fused_decoder_heads(
     head_b: ParamPair,
     dtype=jnp.bfloat16,
     negative_slope: float = 0.01,
-    quant: bool = False,
+    quant=False,
+    kernel_arm: str = "dequant",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full decoder tail as channel-tiled matmuls.
 
     f_cat: (B, H, W, C_in); dec_o/dec_b: per-layer (kernel, bias) of the
     objectness/bbox decoder stacks (channel-preserving, C out each);
-    head_o/head_b: the 1x1 head (kernel (1, 1, C, 1|4), bias). Returns
-    (objectness (B, H, W, 1), regressions (B, H, W, 4)) in f32 — the
-    dtypes matching_net.py exports.
+    head_o/head_b: the 1x1 head (kernel (1, 1, C, 1|4), bias). Under
+    ``quant="stored"`` every entry is an offline-quantized
+    (kernel int8, bias f32, scale f32) triple (ops/quant.quantize_tree)
+    and ``kernel_arm`` selects the int8 contraction (see conv_mm).
+    Returns (objectness (B, H, W, 1), regressions (B, H, W, 4)) in f32 —
+    the dtypes matching_net.py exports.
     """
     assert len(dec_o) == len(dec_b), "stacks must have equal depth"
-    c = dec_o[0][0].shape[-1]
+    stored = quant == "stored"
+    ko0, bo0, so0 = _entry(dec_o[0])
+    kb0, bb0, sb0 = _entry(dec_b[0])
+    c = ko0.shape[-1]
 
-    # layer 0 over the shared input: one conv, channels [obj | bbox]
-    w0 = jnp.concatenate([dec_o[0][0], dec_b[0][0]], axis=-1)
-    b0 = jnp.concatenate([dec_o[0][1], dec_b[0][1]], axis=-1)
-    act = conv_mm(f_cat, w0, b0, dtype=dtype, quant=quant)
+    # layer 0 over the shared input: one conv, channels [obj | bbox].
+    # Per-output-channel scales concatenate right along with the int8
+    # kernels — each column's scale depends only on its own column, so
+    # the concat is bitwise the fake path's quantization of the
+    # concatenated f32 kernel.
+    w0 = jnp.concatenate([ko0, kb0], axis=-1)
+    b0 = jnp.concatenate([bo0, bb0], axis=-1)
+    s0 = (jnp.concatenate([so0, sb0], axis=-1) if stored else None)
+    act = conv_mm(f_cat, w0, b0, dtype=dtype, quant=quant, scale=s0,
+                  kernel_arm=kernel_arm)
     act = jax.nn.leaky_relu(act, negative_slope)
 
     # deeper layers are channel-preserving per stack: running them
     # combined would need a block-diagonal (2C, 2C) kernel — 2x the
     # FLOPs — so each stack proceeds on its half of the activation
-    for (wo, bo), (wb, bb) in zip(dec_o[1:], dec_b[1:]):
+    for eo, eb in zip(dec_o[1:], dec_b[1:]):
+        wo, bo, so = _entry(eo)
+        wb, bb, sb = _entry(eb)
         ao = conv_mm(act[..., :c].astype(dtype), wo, bo, dtype=dtype,
-                     quant=quant)
+                     quant=quant, scale=so, kernel_arm=kernel_arm)
         ab = conv_mm(act[..., c:].astype(dtype), wb, bb, dtype=dtype,
-                     quant=quant)
+                     quant=quant, scale=sb, kernel_arm=kernel_arm)
         act = jax.nn.leaky_relu(jnp.concatenate([ao, ab], axis=-1),
                                 negative_slope)
 
     # both 1x1 heads as one block-diagonal (2C, 5) matmul: column 0 reads
     # the objectness half, columns 1..4 the bbox half
-    w1, b1 = head_o
-    w4, b4 = head_b
-    wh = jnp.zeros((2 * c, 5), jnp.float32)
-    wh = wh.at[:c, :1].set(w1.reshape(c, 1))
-    wh = wh.at[c:, 1:].set(w4.reshape(c, 4))
+    w1, b1, s1 = _entry(head_o)
+    w4, b4, s4 = _entry(head_b)
     bh = jnp.concatenate([b1, b4])
-    out = lax.dot_general(
-        act.astype(dtype), _maybe_quant(wh, dtype, quant),
-        (((3,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + bh.astype(jnp.float32)
+    if stored:
+        # assemble the block diagonal ON the int8 grid: the zero blocks
+        # quantize to 0 exactly and each column's per-output-channel
+        # scale equals the fake path's scale of the assembled f32 matrix
+        # (zeros never carry a column's amax), so the dequantized
+        # operand is bitwise the fake path's
+        wh = jnp.zeros((2 * c, 5), jnp.int8)
+        wh = wh.at[:c, :1].set(w1.reshape(c, 1))
+        wh = wh.at[c:, 1:].set(w4.reshape(c, 4))
+        sh = jnp.concatenate([s1.reshape(1, 1), s4.reshape(1, 4)], axis=1)
+        if kernel_arm in ("int8dot", "pallas"):
+            aq, as_ = _quant_act(act)
+            out = _int8_tap(aq, as_, wh, sh, kernel_arm)
+        else:
+            from tmr_tpu.ops.quant import dequantize
+
+            out = lax.dot_general(
+                act.astype(dtype),
+                dequantize(wh, sh, dtype=dtype),
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    else:
+        wh = jnp.zeros((2 * c, 5), jnp.float32)
+        wh = wh.at[:c, :1].set(w1.reshape(c, 1))
+        wh = wh.at[c:, 1:].set(w4.reshape(c, 4))
+        out = lax.dot_general(
+            act.astype(dtype), _maybe_quant(wh, dtype, quant),
+            (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    out = out + bh.astype(jnp.float32)
     return out[..., :1], out[..., 1:]
 
 
@@ -309,3 +440,82 @@ def decoder_impl(h: int, w: int, c_in: int, c: int,
             ))
             quant = False
     return impl, quant
+
+
+def stored_kernel_arm(h: int, w: int, c_in: int, c: int,
+                      num_layers: int, kernel_size: int) -> str:
+    """Resolve TMR_QUANT_KERNEL for the stored tail at one geometry,
+    walking the fallback ladder pallas -> int8dot -> dequant: each arm is
+    admitted by its own gate (pallas_int8_ok Mosaic self-check;
+    quant_int8dot_ok tolerance tier) and a refusal warns + records a
+    cause before trying the next arm. "dequant" needs no gate of its own
+    — it is the bitwise equality-pinned formulation quant_storage_ok
+    already admitted."""
+    import warnings
+
+    from tmr_tpu.diagnostics import FormulationFallbackWarning
+    from tmr_tpu.ops.quant import quant_int8dot_ok, quant_kernel
+
+    arm = quant_kernel()
+    if arm == "pallas":
+        from tmr_tpu.ops.pallas_int8 import pallas_int8_ok
+
+        if not pallas_int8_ok():
+            warnings.warn(FormulationFallbackWarning(
+                "TMR_QUANT_KERNEL",
+                "TMR_QUANT_KERNEL=pallas: Mosaic int8 kernel self-check "
+                "refused; trying the XLA int8dot arm"
+            ))
+            arm = "int8dot"
+    if arm == "int8dot" and not quant_int8dot_ok(
+        h, w, c_in, c, num_layers, kernel_size
+    ):
+        warnings.warn(FormulationFallbackWarning(
+            "TMR_QUANT_KERNEL",
+            "TMR_QUANT_KERNEL int8dot arm: tolerance gate refused at "
+            f"({h}x{w}, {c_in}->{c}); running the dequant (bitwise) arm"
+        ))
+        arm = "dequant"
+    return arm
+
+
+def stored_decoder_impl(h: int, w: int, c_in: int, c: int,
+                        num_layers: int, kernel_size: int,
+                        dtype_name: str) -> Tuple[str, str, str]:
+    """Trace-time resolution for a program whose param tree holds STORED
+    int8 leaves (MatchingNet ``quant_storage=True``): the fused
+    formulation with ``quant="stored"`` is the only runnable path — int8
+    kernels cannot feed the XLA module stack — so a gate refusal here is
+    a hard error (with its cause recorded), not a fallback. Unreachable
+    in practice: Predictor admission (quant.stored_params_for) ran the
+    SAME cached gates before materializing the tree; this re-check
+    catches a mid-process env flip or a geometry the admission never
+    saw. Returns ("fused", "stored", kernel_arm)."""
+    from tmr_tpu.diagnostics import gate_refused
+    from tmr_tpu.ops.quant import quant_ok, quant_storage_ok
+
+    cfg = {"H": h, "W": w, "C_in": c_in, "C": c, "tier": "storage"}
+    for gate_name, gate in (
+        ("fused_heads_ok", lambda: fused_heads_ok(
+            h, w, c_in, c, num_layers, kernel_size, dtype_name)),
+        ("quant_ok", lambda: quant_ok(
+            h, w, c_in, c, num_layers, kernel_size)),
+        ("quant_storage_ok", lambda: quant_storage_ok(
+            h, w, c_in, c, num_layers, kernel_size)),
+    ):
+        if not gate():
+            gate_refused(
+                "quant_storage_ok",
+                f"{gate_name} refused at trace geometry under a stored "
+                "int8 param tree", "forward-mismatch", config=cfg,
+            )
+            raise RuntimeError(
+                f"TMR_QUANT_STORAGE=int8: {gate_name} refused at "
+                f"({h}x{w}, {c_in}->{c}) but the program holds int8 "
+                "weight leaves (no exact fallback exists); unset "
+                "TMR_QUANT_STORAGE or keep this geometry off the stored "
+                "path"
+            )
+    return "fused", "stored", stored_kernel_arm(
+        h, w, c_in, c, num_layers, kernel_size
+    )

@@ -168,6 +168,42 @@ def _xcorr_fft(feature: jnp.ndarray, template: jnp.ndarray) -> jnp.ndarray:
     return corr[:, :, ys][:, :, :, xs]
 
 
+def _xcorr_int8dot(feature: jnp.ndarray,
+                   template: jnp.ndarray) -> jnp.ndarray:
+    """Both-operand-int8 depthwise correlation (TMR_QUANT_KERNEL int8dot
+    arm): feature dynamically quantized per (image, channel), template on
+    the same int8 grid the fake-quant arm uses (ops/quant), ONE grouped
+    integer conv with ``preferred_element_type=int32``, and the
+    per-(image, channel) dequant fused into the f32 epilogue. The
+    depthwise correlation has no channel contraction to feed the MXU, so
+    unlike the decoder matmuls there is no Mosaic arm here — the win is
+    halved operand traffic through the integer conv; admitted by
+    quant_xcorr_ok(kernel="int8dot")'s tolerance tier.
+
+    feature: (B, C, H, W) f32/bf16; template: (B, C, T, T). Returns the
+    SAME-padded (B, C, H, W) f32 map the other arms produce.
+    """
+    from tmr_tpu.ops.quant import quantize_int8, quantize_int8_template
+
+    B, C, H, W = feature.shape
+    T = template.shape[-1]
+    ff = feature.astype(jnp.float32)
+    fq, fs = quantize_int8(ff.reshape(B, C, H * W), axis=-1)
+    fq = fq.reshape(B, C, H, W)
+    fs = fs.reshape(B, C, 1, 1)
+    tq, ts = quantize_int8_template(template)
+    acc = lax.conv_general_dilated(
+        fq.reshape(1, B * C, H, W),
+        tq.reshape(B * C, 1, T, T),
+        window_strides=(1, 1),
+        padding=[(T // 2, T // 2), (T // 2, T // 2)],
+        feature_group_count=B * C,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32,
+    ).reshape(B, C, H, W)
+    return acc.astype(jnp.float32) * (fs * ts)
+
+
 def _ambient_abstract_mesh():
     """jax-version compat: ``jax.sharding.get_abstract_mesh`` is absent on
     jax 0.4.x (the ``_tpu_compiler_params`` situation again). No accessor
@@ -286,8 +322,9 @@ def cross_correlation(
     # cause. Inert on the FFT path (f32 end to end; no MXU operand to
     # shrink) and under TMR_QUANT=off/auto-unelected.
     quant = False
+    quant_arm = "dequant"
     if impl != "fft":
-        from tmr_tpu.ops.quant import quant_mode, quant_xcorr_ok
+        from tmr_tpu.ops.quant import quant_kernel, quant_mode, quant_xcorr_ok
 
         if quant_mode() == "int8":
             if quant_xcorr_ok(C, H, W, T):
@@ -302,6 +339,53 @@ def cross_correlation(
                     f"TMR_QUANT=int8: xcorr oracle refused (C={C}, H={H}, "
                     f"W={W}, T={T}); running the exact correlation"
                 ))
+        if quant:
+            # TMR_QUANT_KERNEL routing for the matcher arm: the depthwise
+            # correlation has no channel contraction, so there is no
+            # Mosaic int8 MXU kernel here — a "pallas" request demotes to
+            # the XLA integer conv (int8dot) with a recorded cause, and
+            # int8dot itself is admitted by its own tolerance tier
+            # (feature quantization is rounding the dequant arm never
+            # pays). Every demotion warns so sweeps annotate timings.
+            arm = quant_kernel()
+            if arm == "pallas":
+                import warnings
+
+                from tmr_tpu.diagnostics import (
+                    FormulationFallbackWarning,
+                    gate_refused,
+                )
+
+                gate_refused(
+                    "pallas_int8_ok",
+                    "depthwise correlation has no MXU contraction; the "
+                    "matcher int8 arm rides the XLA integer conv",
+                    "unsupported-shape",
+                    config={"C": C, "H": H, "W": W, "T": T},
+                )
+                warnings.warn(FormulationFallbackWarning(
+                    "TMR_QUANT_KERNEL",
+                    "TMR_QUANT_KERNEL=pallas: no Mosaic arm for the "
+                    "depthwise correlation; riding the XLA int8dot "
+                    "integer conv"
+                ))
+                arm = "int8dot"
+            if arm == "int8dot":
+                if quant_xcorr_ok(C, H, W, T, kernel="int8dot"):
+                    quant_arm = "int8dot"
+                else:
+                    import warnings
+
+                    from tmr_tpu.diagnostics import (
+                        FormulationFallbackWarning,
+                    )
+
+                    warnings.warn(FormulationFallbackWarning(
+                        "TMR_QUANT_KERNEL",
+                        "TMR_QUANT_KERNEL int8dot arm: xcorr tolerance "
+                        f"gate refused (C={C}, H={H}, W={W}, T={T}); "
+                        "running the dequant arm"
+                    ))
 
     def _compute(f, t):
         # local-shape island: b == B globally, or B/n_data under shard_map
@@ -336,6 +420,10 @@ def cross_correlation(
         if use == "fft":
             return _xcorr_fft(f, t)
         in_dtype = f.dtype
+        if quant and quant_arm == "int8dot":
+            # both operands on the int8 grid through one integer conv;
+            # admitted above by quant_xcorr_ok(kernel="int8dot")
+            return _xcorr_int8dot(f, t).astype(in_dtype)
         if quant:
             from tmr_tpu.ops.quant import quantize_template
 

@@ -234,12 +234,29 @@ class ServeEngine:
         #: AOT warmup accounting (stats()/health() expose it when run)
         self._warmup_stats: Optional[Dict[str, Any]] = None
 
+        #: quant provenance stamp (mode + storage + tree digest), set
+        #: when the predictor runs int8 numerics or stored-int8 trees —
+        #: rides stats()/health() and the serve_report/v1 attachment so
+        #: a served result's numerics tier is always attributable
+        #: (the degrade_steps pattern applied to quantization). None
+        #: (fully exact) adds no key: the default-off stats()/health()
+        #: shapes stay byte-identical.
+        stamp = getattr(predictor, "quant_stamp", None)
+        self._quant_stamp = stamp() if callable(stamp) else None
+
         groups = self._plan.group_ids() if self._plan else None
         self._batcher = MicroBatcher(self.max_wait_ms, self._bound_for,
                                      class_weight=class_weight_fn(),
                                      groups=groups)
+        # the stager stages the tree the compiled programs consume: the
+        # stored int8 tree under TMR_QUANT_STORAGE (weight H2D + HBM
+        # bytes genuinely drop 4x for the quantized leaves), else the
+        # f32 params unchanged
+        exec_params = getattr(predictor, "exec_params", None)
         self._stager = DeviceStager(
-            self.devices, predictor.params, predictor.refiner_params
+            self.devices,
+            exec_params() if callable(exec_params) else predictor.params,
+            predictor.refiner_params,
         )
         if self._plan is None:
             self._staged_q: "queue.Queue" = queue.Queue(maxsize=2)
@@ -1093,6 +1110,8 @@ class ServeEngine:
             },
             "anomalies": anomalies,
         }
+        if self._quant_stamp is not None:
+            doc["quant"] = dict(self._quant_stamp)
         # the overload-control sections appear only when the features
         # are on: a default-knobs engine's health_report shape stays
         # byte-identical to PR 8 (acceptance-pinned)
@@ -1243,6 +1262,8 @@ class ServeEngine:
             "batch_bounds": {str(k): v for k, v in batch_bounds.items()},
             "donate": self.donate,
         }
+        if self._quant_stamp is not None:
+            out["quant"] = dict(self._quant_stamp)
         with self._lock:
             any_fired = bool(self._mx)
             drain_timed_out = self._drain_timed_out

@@ -79,8 +79,17 @@ FALLBACK_SUFFIX = " (fallback)"
 #: tail joined the swept surface (TMR_DECODER_IMPL fused formulation,
 #: TMR_QUANT int8 weights) and the full-program tail changed shape
 #: (device decode compaction): formulation winners recorded against the
-#: old tail must re-measure at the next hardware window.
-_SWEEP_REV = "decoder-tail"
+#: old tail must re-measure at the next hardware window. "int8-storage" —
+#: the TMR_QUANT sweep grew the offline-stored arm ("int8+store":
+#: TMR_QUANT_STORAGE=int8 hands the program a genuinely int8 param tree,
+#: bitwise the fake-quant numerics at 1/4 the weight bytes): every
+#: pre-storage TMR_QUANT winner must re-measure with the stored arm in
+#: the running.
+_SWEEP_REV = "int8-storage"
+
+#: legal TMR_QUANT_STORAGE cache values (the stored arm of the quant
+#: sweep; ops/quant.STORAGE_MODES is the consuming contract)
+STORAGE_VARIANTS = ("off", "int8")
 
 
 def _sweep_xcorr_env(
@@ -481,6 +490,40 @@ def pick_quant(
         num_layers, kernel_size, dtype_name, rtt, log,
         also_fallback_envs=("TMR_DECODER_IMPL",),
     )
+    # the STORED arm ("int8+store"): TMR_QUANT pinned to int8 while
+    # TMR_QUANT_STORAGE sweeps int8 — the stage program then consumes an
+    # offline-quantized tree (utils/stage_bench resolves storage the way
+    # the production trace does), so the timing is about genuinely
+    # shrunken weight bytes (4x on the quantized leaves), not the
+    # fake-quant formulation again. A
+    # storage admission refusal annotates the row as a fallback like
+    # every other gate.
+    prev_q = os.environ.get("TMR_QUANT")
+    os.environ["TMR_QUANT"] = "int8"
+    try:
+        stimes = _sweep_tail_env(
+            "TMR_QUANT_STORAGE", ("int8",), batch, hw, c_cat,
+            num_layers, kernel_size, dtype_name, rtt, log,
+            also_fallback_envs=("TMR_QUANT", "TMR_DECODER_IMPL",
+                                "TMR_QUANT_KERNEL"),
+        )
+    finally:
+        _restore(prev_q, "TMR_QUANT")
+    store_refusals = {
+        label: causes for label, causes in
+        LAST_SWEEP_REFUSALS.get("TMR_QUANT_STORAGE", {}).items()
+    }
+
+    def _store_label(label: str) -> str:
+        return "int8+store" + (
+            FALLBACK_SUFFIX if label.endswith(FALLBACK_SUFFIX) else ""
+        )
+
+    for label, t in stimes.items():
+        times[_store_label(label)] = t
+    refusals = LAST_SWEEP_REFUSALS.setdefault("TMR_QUANT", {})
+    for label, causes in store_refusals.items():
+        refusals.setdefault(_store_label(label), []).extend(causes)
     if emb_dim is None:
         return times
     # both sweeps key LAST_SWEEP_REFUSALS["TMR_QUANT"] and the second
@@ -494,16 +537,20 @@ def pick_quant(
     for label, causes in tail_refusals.items():
         refusals.setdefault(label, []).extend(causes)
     combined: Dict[str, float] = {}
-    for v in QUANT_VARIANTS:
+    for v in QUANT_VARIANTS + ("int8+store",):
+        # the matcher program is identical between the fake and stored
+        # arms (templates are runtime data, storage never touches them):
+        # the stored row reuses the int8 correlation timing
+        xv = "int8" if v == "int8+store" else v
         t = times.get(v)
-        x = xtimes.get(v)
+        x = xtimes.get(xv)
         if t is not None and x is not None:
             combined[v] = t + x
             continue
         # annotated (or failed) in either stage: the sum is evidence
         # about a fallback formulation somewhere — never electable
         tf = t if t is not None else times.get(v + FALLBACK_SUFFIX)
-        xf = x if x is not None else xtimes.get(v + FALLBACK_SUFFIX)
+        xf = x if x is not None else xtimes.get(xv + FALLBACK_SUFFIX)
         if tf is not None and xf is not None:
             combined[v + FALLBACK_SUFFIX] = tf + xf
     log(f"autotune: TMR_QUANT stages decoder={times} xcorr={xtimes}")
@@ -725,7 +772,7 @@ def _cache_load() -> Dict[str, dict]:
 _VERSIONED_KNOBS = (
     "TMR_XCORR_IMPL_SMALL", "TMR_WIN_ATTN", "TMR_GLOBAL_ATTN",
     "TMR_XCORR_PRECISION", "TMR_GLOBAL_SCORES_DTYPE",
-    "TMR_DECODER_IMPL", "TMR_QUANT",
+    "TMR_DECODER_IMPL", "TMR_QUANT", "TMR_QUANT_STORAGE",
 )
 
 
@@ -738,10 +785,11 @@ def _variants_sig(knob: str) -> str:
         "TMR_GLOBAL_SCORES_DTYPE": GLOBAL_SCORES_DTYPES,
         "TMR_DECODER_IMPL": DECODER_IMPL_VARIANTS,
         "TMR_QUANT": QUANT_VARIANTS,
+        "TMR_QUANT_STORAGE": STORAGE_VARIANTS,
     }
     sig = ",".join(sets[knob])
     if knob in ("TMR_WIN_ATTN", "TMR_GLOBAL_ATTN", "TMR_XCORR_IMPL_SMALL",
-                "TMR_DECODER_IMPL"):
+                "TMR_DECODER_IMPL", "TMR_QUANT", "TMR_QUANT_STORAGE"):
         # formulation-sweep winners are additionally versioned by the
         # harness revision: a winner picked by a pre-revision sweep may be
         # a mislabeled fallback timing (see _SWEEP_REV) and must go stale
@@ -771,6 +819,7 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
         "_precision_impl": set(XCORR_VARIANTS),
         "TMR_DECODER_IMPL": set(DECODER_IMPL_VARIANTS) | {"auto"},
         "TMR_QUANT": set(QUANT_VARIANTS) | {"auto"},
+        "TMR_QUANT_STORAGE": set(STORAGE_VARIANTS),
         # metadata: which decoder formulation the quant winner's
         # decisive-win evidence was measured under
         "_quant_decoder_impl": set(DECODER_IMPL_VARIANTS) | {"auto"},
@@ -940,7 +989,7 @@ def autotune(
     for knob in ("TMR_PALLAS_ATTN_BQ", "TMR_PALLAS_ATTN_BK",
                  "TMR_PALLAS_WIN_GROUP", "TMR_GLOBAL_BANDS_UNROLL",
                  "TMR_WIN_SCORES_DTYPE", "TMR_XLA_FLASH_BQ",
-                 "TMR_XLA_FLASH_BK"):
+                 "TMR_XLA_FLASH_BK", "TMR_QUANT_STORAGE"):
         if knob in cached and knob not in os.environ:
             os.environ[knob] = cached[knob]
             report[knob] = {"picked": cached[knob], "cached": True}
@@ -1004,8 +1053,10 @@ def autotune(
         # an int8 winner's decisive-win evidence is decoder-impl-specific
         # (the _precision_impl rule applied to the tail): drop it when the
         # formulation it was measured under changes or is about to be
-        # re-swept — re-decided after the fresh pick instead
-        cached = {k: v for k, v in cached.items() if k != "TMR_QUANT"}
+        # re-swept — re-decided after the fresh pick instead. The stored
+        # arm's evidence rides the same sweep, so it drops with it.
+        cached = {k: v for k, v in cached.items()
+                  if k not in ("TMR_QUANT", "TMR_QUANT_STORAGE")}
     active_global = os.environ.get(
         "TMR_GLOBAL_ATTN", cached.get("TMR_GLOBAL_ATTN")
     )
@@ -1174,16 +1225,32 @@ def autotune(
             # no-op so the cache entry is complete and later runs skip
             os.environ["TMR_QUANT"] = "off"
             report["TMR_QUANT"] = {"picked": "off", "times": {}}
+            if "TMR_QUANT_STORAGE" not in os.environ:
+                os.environ["TMR_QUANT_STORAGE"] = "off"
+                report["TMR_QUANT_STORAGE"] = {"picked": "off",
+                                               "times": {}}
         else:
             times = pick_quant(
                 batch, up_hw, c_cat, cfg.decoder_num_layer,
                 cfg.decoder_kernel_size, cfg.compute_dtype,
                 emb_dim=cfg.emb_dim, rtt=rtt, log=log,
             )
+            # off / fake / stored elect on one decisive-win ladder vs
+            # the exact baseline; the stored row's numerics are bitwise
+            # the fake row's, so between the two int8 arms plain-min
+            # applies implicitly (whichever is faster wins the min)
             best = _decisive_pick(times, "off", log, "TMR_QUANT")
-            os.environ["TMR_QUANT"] = best
-            report["TMR_QUANT"] = {"picked": best, "times": times}
+            picked_quant = "off" if best == "off" else "int8"
+            picked_store = "int8" if best == "int8+store" else "off"
+            os.environ["TMR_QUANT"] = picked_quant
+            report["TMR_QUANT"] = {"picked": picked_quant, "times": times}
             _attach_refusals(report, "TMR_QUANT")
+            if "TMR_QUANT_STORAGE" not in os.environ:
+                # the stored arm's evidence lives in the TMR_QUANT times
+                # ("int8+store" rows); an explicit user pin is respected
+                os.environ["TMR_QUANT_STORAGE"] = picked_store
+                report["TMR_QUANT_STORAGE"] = {"picked": picked_store,
+                                               "times": {}}
 
     if report:
         extra = {}

@@ -63,20 +63,61 @@ def build_decoder_tail_step(
     impl, quant = decoder_impl(
         hw, hw, c_cat, c_cat, num_layers, kernel_size, dtype_name
     )
+    kernel_arm = "dequant"
+    if quant and impl == "fused":
+        # TMR_QUANT_STORAGE=int8: offline-quantize the stage params the
+        # way quantize_tree does (per-tap per-output-channel, axis=2) so
+        # the jitted stage RECEIVES int8 leaves — the sweep's stored-arm
+        # timing is then about genuinely shrunken weight bytes (4x for
+        # the quantized leaves). Admission
+        # mirrors the production path: the quant_storage_ok equality
+        # gate, with a refusal warning (FormulationFallbackWarning) so
+        # the sweep annotates the row as a fallback.
+        from tmr_tpu.ops.quant import quant_storage_mode
+
+        if quant_storage_mode() == "int8":
+            import warnings
+
+            from tmr_tpu.diagnostics import FormulationFallbackWarning
+            from tmr_tpu.ops.fused_heads import stored_kernel_arm
+            from tmr_tpu.ops.quant import quant_storage_ok, quantize_int8
+
+            if quant_storage_ok(hw, hw, c_cat, c_cat, num_layers,
+                                kernel_size):
+                quant = "stored"
+                kernel_arm = stored_kernel_arm(
+                    hw, hw, c_cat, c_cat, num_layers, kernel_size
+                )
+                for sub in params.values():
+                    for conv in sub.values():
+                        q, s = quantize_int8(conv["kernel"], axis=2)
+                        conv["kernel"], conv["scale"] = q, s
+            else:
+                warnings.warn(FormulationFallbackWarning(
+                    "TMR_QUANT_STORAGE",
+                    "TMR_QUANT_STORAGE=int8: equality gate refused at "
+                    f"({hw}x{hw}, {c_cat}); timing the fake-quant "
+                    "formulation"
+                ))
 
     @jax.jit
     def step(p, x, fb):
         xi = x + fb.astype(x.dtype)
         if impl == "fused":
+            stored = quant == "stored"
             mk = lambda q: [
                 (q[f"conv_{i}"]["kernel"], q[f"conv_{i}"]["bias"])
+                + ((q[f"conv_{i}"]["scale"],) if stored else ())
                 for i in range(num_layers)
             ]
+            hd = lambda q: (
+                (q["conv"]["kernel"], q["conv"]["bias"])
+                + ((q["conv"]["scale"],) if stored else ())
+            )
             o, b = fused_decoder_heads(
                 xi, mk(p["dec_o"]), mk(p["dec_b"]),
-                (p["head_o"]["conv"]["kernel"], p["head_o"]["conv"]["bias"]),
-                (p["head_b"]["conv"]["kernel"], p["head_b"]["conv"]["bias"]),
-                dtype=dtype, quant=quant,
+                hd(p["head_o"]), hd(p["head_b"]),
+                dtype=dtype, quant=quant, kernel_arm=kernel_arm,
             )
         else:
             o = head_o.apply({"params": p["head_o"]},
@@ -144,6 +185,20 @@ def measure_stage_breakdown(
     out["decoder_impl"] = impl
     out["quant"] = "int8" if quant else "off"
     out["decode_tail"] = decode_tail_mode()
+    if quant and impl == "fused":
+        from tmr_tpu.ops.fused_heads import stored_kernel_arm
+        from tmr_tpu.ops.quant import quant_storage_mode, quant_storage_ok
+
+        stored = (quant_storage_mode() == "int8" and quant_storage_ok(
+            hw, hw, c_cat, c_cat, cfg.decoder_num_layer,
+            cfg.decoder_kernel_size,
+        ))
+        out["quant_storage"] = "int8" if stored else "off"
+        if stored:
+            out["quant_kernel"] = stored_kernel_arm(
+                hw, hw, c_cat, c_cat, cfg.decoder_num_layer,
+                cfg.decoder_kernel_size,
+            )
     try:
         log("stage_breakdown: decoder_heads")
         step, inputs = build_decoder_tail_step(
