@@ -29,6 +29,7 @@ from tmr_tpu.diagnostics import validate_bench_trend  # noqa: E402
 from tmr_tpu.utils.bench_trend import (  # noqa: E402
     DEFAULT_THRESHOLD,
     collect_bench_trend,
+    read_fleet_report,
     read_serve_sweep,
 )
 
@@ -49,7 +50,29 @@ def main(argv=None) -> int:
                          "the BENCH history: one JSON line with the "
                          "per-mesh-shape scaling table; rc 1 when any "
                          "shape fails its scaling/exactness/AOT checks")
+    ap.add_argument("--fleet", default=None,
+                    help="read an elastic_serve_report/v1 file "
+                         "(elastic_serve_probe output) instead of the "
+                         "BENCH history: one JSON line with per-phase "
+                         "accounting; rc 1 unless double_served is "
+                         "ZERO, the offered == completed + rejected + "
+                         "shed + errors reconciliation is exact, and "
+                         "every probe check passed")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        doc = read_fleet_report(args.fleet)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["zero_double_served"]
+                     and ck["reconciliation_exact"]
+                     and ck["probe_checks_pass"]) else 1
 
     if args.serve_sweep:
         doc = read_serve_sweep(args.serve_sweep)

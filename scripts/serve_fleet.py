@@ -1,0 +1,230 @@
+"""Elastic serve fleet CLI — front door + worker processes over the
+partition-lease protocol (tmr_tpu/serve/fleet.py).
+
+Front door (owns the partition leases, cluster-wide admission, and the
+recruitment election; serves a demo workload when asked)::
+
+    python scripts/serve_fleet.py frontdoor --sizes 1024 --classes 2 \
+        --port 7078 [--requests N --report_out fleet_report.json]
+
+Workers (any number; each wraps a full ServeEngine — mesh-aware via
+TMR_SERVE_MESH — joins the fleet, leases traffic partitions, and
+heartbeats them with its measured drain rate)::
+
+    python scripts/serve_fleet.py worker --coordinator HOST:7078 \
+        [--engine stub --delay_ms 40]      # numpy drill engine
+    python scripts/serve_fleet.py worker --coordinator HOST:7078 \
+        --engine model --checkpoint ckpt   # the real predictor
+
+Lease liveness rides the shared TMR_ELASTIC_* knobs; fleet behavior
+(saturation threshold, recruitment bounds, resubmission bound) rides
+TMR_FLEET_* (config.ENV_KNOBS). ``scripts/elastic_serve_probe.py`` is
+the canned chaos proof (kill -9 / SIGSTOP / recruitment), riding tier-1.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_address(text: str):
+    host, _, port = text.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _cli_frontdoor(args) -> int:
+    import numpy as np
+
+    from tmr_tpu.serve.fleet import ServeFleet, stub_signature
+    from tmr_tpu.utils import faults
+    from tmr_tpu.utils.profiling import log_info, log_warning
+
+    if faults.install_from_env():
+        log_warning(
+            "fault injection ACTIVE (TMR_FAULTS="
+            f"{os.environ.get('TMR_FAULTS', '')!r})"
+        )
+    fleet = ServeFleet(
+        [int(s) for s in args.sizes.split(",") if s.strip()],
+        classes=args.classes, host=args.host, port=args.port,
+    )
+    host, port = fleet.start()
+    log_info(
+        f"fleet front door: {len(fleet.sizes)} size bucket(s) x "
+        f"{fleet.classes} class(es) at {host}:{port}"
+    )
+    rc = 0
+    try:
+        if args.requests > 0:
+            deadline = time.monotonic() + args.worker_wait_s
+            while time.monotonic() < deadline:
+                if any(v["holder"] for v in
+                       fleet.state()["partitions"].values()):
+                    break
+                time.sleep(0.1)
+            rng = np.random.default_rng(args.seed)
+            size = fleet.sizes[0]
+            ex = np.asarray([[0.4, 0.4, 0.6, 0.6]], np.float32)
+            imgs = [
+                rng.standard_normal((size, size, 3)).astype(np.float32)
+                for _ in range(args.requests)
+            ]
+            futs = [fleet.submit(im, ex) for im in imgs]
+            done = errors = 0
+            exact = True
+            for im, f in zip(imgs, futs):
+                try:
+                    r = f.result(timeout=args.request_timeout_s)
+                    done += 1
+                    if args.check_stub and \
+                            float(r["scores"][0, 0]) != stub_signature(im):
+                        exact = False
+                except Exception:
+                    errors += 1
+            log_info(
+                f"fleet workload: {done}/{args.requests} completed, "
+                f"{errors} failed"
+                + ("" if not args.check_stub
+                   else f", stub signatures exact={exact}")
+            )
+            if errors or (args.check_stub and not exact):
+                rc = 1
+        else:
+            log_info("fleet front door serving until interrupted "
+                     "(--requests 0)")
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        doc = fleet.report()
+        if args.report_out:
+            with open(args.report_out, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+        acc = doc["accounting"]
+        log_info(
+            f"fleet: offered {acc['offered']} = "
+            f"{acc['completed']} completed + {acc['rejected']} rejected "
+            f"+ {acc['shed']} shed + {acc['errors']} errors; "
+            f"{acc['double_served']} double-served, "
+            f"{acc['fenced_results']} fenced results, "
+            f"{len(doc['reassignments'])} reassignments"
+        )
+        fleet.close()
+    return rc
+
+
+def _cli_worker(args) -> int:
+    from tmr_tpu.serve.fleet import FleetWorker, stub_engine
+    from tmr_tpu.utils import faults
+    from tmr_tpu.utils.profiling import log_info, log_warning
+
+    if faults.install_from_env():
+        log_warning(
+            "fault injection ACTIVE (TMR_FAULTS="
+            f"{os.environ.get('TMR_FAULTS', '')!r})"
+        )
+    if args.engine == "stub":
+        engine = stub_engine(delay_s=args.delay_ms / 1000.0,
+                             batch=args.batch, max_wait_ms=args.wait_ms)
+    else:
+        from tmr_tpu.config import preset
+        from tmr_tpu.inference import Predictor
+        from tmr_tpu.serve.engine import ServeEngine
+
+        cfg = preset("TMR_FSCD147", backbone="sam_vit_b",
+                     image_size=args.image_size)
+        pred = Predictor(cfg)
+        if args.checkpoint:
+            pred.load_params(args.checkpoint)
+        else:
+            log_warning("worker: no --checkpoint, random weights")
+            pred.init_params(seed=0, image_size=args.image_size)
+        engine = ServeEngine(pred)
+
+    worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
+    worker = FleetWorker(
+        _parse_address(args.coordinator), worker_id, engine,
+        data_host=args.data_host, data_port=args.data_port,
+    )
+    worker.start()
+    log_info(
+        f"fleet worker {worker_id}: engine={args.engine}, data plane at "
+        f"{worker._data_server.server_address[:2]}"
+    )
+    try:
+        while not (worker.drained or worker.coordinator_lost):
+            time.sleep(0.25)
+        log_info(
+            f"fleet worker {worker_id}: "
+            + ("drained" if worker.drained else "coordinator lost")
+            + "; exiting"
+        )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        worker.stop()
+    return 1 if worker.drained or worker.coordinator_lost else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/serve_fleet.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    f = sub.add_parser("frontdoor",
+                       help="serve the partition leases + route submits")
+    f.add_argument("--sizes", default="1024",
+                   help="comma-separated image-size buckets")
+    f.add_argument("--classes", default=1, type=int,
+                   help="priority classes (partitions = sizes x classes)")
+    f.add_argument("--host", default="127.0.0.1")
+    f.add_argument("--port", default=0, type=int,
+                   help="control port (0 = ephemeral, printed at start)")
+    f.add_argument("--requests", default=0, type=int,
+                   help="demo workload size (0 = serve forever)")
+    f.add_argument("--seed", default=0, type=int)
+    f.add_argument("--worker_wait_s", default=30.0, type=float,
+                   help="wait this long for a first worker before the "
+                        "demo workload")
+    f.add_argument("--request_timeout_s", default=120.0, type=float)
+    f.add_argument("--check_stub", action="store_true",
+                   help="verify stub-engine signatures on the demo "
+                        "workload")
+    f.add_argument("--report_out", default=None,
+                   help="write the fleet report section here at exit")
+
+    w = sub.add_parser("worker", help="lease and serve traffic partitions")
+    w.add_argument("--coordinator", required=True,
+                   help="HOST:PORT of the fleet front door")
+    w.add_argument("--worker_id", default=None,
+                   help="stable worker identity (default host-pid)")
+    w.add_argument("--engine", default="stub",
+                   choices=("stub", "model"),
+                   help="'stub' = numpy drill engine (no XLA)")
+    w.add_argument("--delay_ms", default=0.0, type=float,
+                   help="stub engine: per-program-call delay (capacity "
+                        "control for drills)")
+    w.add_argument("--batch", default=2, type=int,
+                   help="stub engine: micro-batch bound")
+    w.add_argument("--wait_ms", default=5.0, type=float,
+                   help="stub engine: micro-batch wait bound")
+    w.add_argument("--image_size", default=1024, type=int)
+    w.add_argument("--checkpoint", default=None)
+    w.add_argument("--data_host", default="127.0.0.1")
+    w.add_argument("--data_port", default=0, type=int)
+
+    args = p.parse_args(argv)
+    return _cli_frontdoor(args) if args.cmd == "frontdoor" \
+        else _cli_worker(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

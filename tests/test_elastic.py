@@ -438,9 +438,46 @@ def test_elastic_report_validator_rejects_drift():
     bad["totals"] = dict(doc["totals"], reassignments=1)
     assert any("bad cause" in p for p in validate_elastic_report(bad))
     assert "cosmic_rays" not in ELASTIC_REASSIGN_CAUSES
+    # the fleet-only scale_out cause stays ILLEGAL in a map report:
+    # the shared vocabulary must not loosen the map validator
+    fleet_only = dict(doc, reassignments=[{
+        "shard": "Easy_0.tar", "index": 0, "worker": "w0", "epoch": 1,
+        "cause": "scale_out",
+    }])
+    fleet_only["totals"] = dict(doc["totals"], reassignments=1)
+    assert any("bad cause" in p
+               for p in validate_elastic_report(fleet_only))
+    assert "scale_out" in ELASTIC_REASSIGN_CAUSES  # fleet vocab keeps it
     # totals that do not reconcile are a validation failure, not a nit
     bad2 = dict(doc, totals=dict(doc["totals"], committed=0, resumed=1))
     assert any("committed" in p for p in validate_elastic_report(bad2))
+
+
+def test_connect_timeout_refused_and_unroutable_fail_fast(monkeypatch):
+    """Satellite (PR 14): the protocol dial is bounded by
+    TMR_ELASTIC_CONNECT_TIMEOUT_S — a refused port errors immediately
+    and a black-holed address (TEST-NET, never routed) times out within
+    the knob instead of parking a worker in hello on the OS default
+    connect timeout."""
+    monkeypatch.setenv("TMR_ELASTIC_CONNECT_TIMEOUT_S", "0.5")
+    assert elastic.connect_timeout() == 0.5
+    # a port nothing listens on: refused, fast
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        elastic.WorkerClient(("127.0.0.1", port), "nobody")
+    assert time.monotonic() - t0 < 3.0
+    # a black-holed address: the connect must give up at the knob bound
+    # (sandboxed runners may refuse routing outright — also an OSError,
+    # also fast; the contract is "raises quickly", not which errno)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        elastic.oneshot(("192.0.2.1", 9), {"op": "heartbeat"},
+                        timeout=30.0)
+    assert time.monotonic() - t0 < 3.0
 
 
 def test_worker_client_refuses_unknown_op(shards, tmp_path):

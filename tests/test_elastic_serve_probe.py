@@ -1,0 +1,59 @@
+"""The elastic serve chaos probe (scripts/elastic_serve_probe.py) must
+pass on tier-1: kill -9 a serve worker mid-batch (every in-flight
+future terminal, zero double-served, exact offered == completed +
+rejected + shed + errors reconciliation engine-side AND probe-side),
+SIGSTOP past the TTL into a FENCED late result, and a recruitment
+round absorbing a 3x spike with the degrade ladder at level 0 — one
+validated elastic_serve_report/v1, rc-gated again through
+scripts/bench_trend.py --fleet."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from tmr_tpu.diagnostics import validate_elastic_serve_report
+from tmr_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_elastic_serve_probe_passes(tmp_path, capsys):
+    out = tmp_path / "elastic_serve_report.json"
+    rc = _load("elastic_serve_probe").main(["--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert validate_elastic_serve_report(doc) == []
+    checks = doc["checks"]
+    assert checks["zero_double_served"] is True
+    assert checks["accounting_exact_probe"] is True
+    assert checks["accounting_exact_fleet"] is True
+    assert checks["fenced_late_result"] is True
+    assert checks["recruitment_absorbed"] is True
+    assert checks["degrade_level0"] is True
+    # the kill phase really exercised death rebalance
+    kill = next(p for p in doc["phases"] if p["name"] == "kill")
+    assert kill["worker_exit_reassigned"] is True
+    assert kill["resubmitted"] >= 1
+    # the trend reader rc-gates the same document
+    capsys.readouterr()
+    assert _load("bench_trend").main(["--fleet", str(out)]) == 0
+    reader_doc = json.loads(capsys.readouterr().out.strip())
+    assert reader_doc["checks"]["zero_double_served"] is True

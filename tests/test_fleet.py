@@ -1,0 +1,649 @@
+"""Elastic serve fleet (tmr_tpu/serve/fleet.py) + the generic lease
+service it rides (tmr_tpu/parallel/leases.py), all in-process on the
+numpy stub engine — the test_overload stub pattern applied to the
+fleet (the kill -9 / SIGSTOP process gauntlet is
+scripts/elastic_serve_probe.py, smoked via
+tests/test_elastic_serve_probe.py).
+
+Covers: partition routing + exactly-once accounting with per-image
+signature proof, dirty-disconnect rebalance with bounded resubmission,
+the stale-epoch result fence, cluster-wide admission fed by (and
+falling back from) worker drain beats, recruitment-before-degrade,
+the new fleet fault points, generic LeaseService mechanics, and the
+elastic_serve_report/v1 validator.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu.parallel.leases import LeasePolicy, LeaseService, Resource
+from tmr_tpu.serve.admission import AdmissionController, RejectedError
+from tmr_tpu.serve.fleet import (
+    FleetWorker,
+    ServeFleet,
+    stub_engine,
+    stub_signature,
+)
+from tmr_tpu.utils import faults
+
+SIZE = 32
+EX = np.asarray([[0.4, 0.4, 0.6, 0.6]], np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _img(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (SIZE, SIZE, 3)
+    ).astype(np.float32)
+
+
+def _policy(**kw):
+    kw.setdefault("lease_ttl_s", 0.8)
+    kw.setdefault("hb_interval_s", 0.2)
+    kw.setdefault("check_interval_s", 0.05)
+    kw.setdefault("straggler_factor", 0.0)
+    kw.setdefault("max_reassigns", 1_000_000_000)
+    kw.setdefault("resource_fail_workers", 1_000_000_000)
+    return LeasePolicy(**kw)
+
+
+def _fleet(**kw):
+    kw.setdefault("classes", 1)
+    kw.setdefault("policy", _policy())
+    kw.setdefault("check_interval_s", 0.05)
+    fleet = ServeFleet([SIZE], **kw)
+    fleet.start()
+    return fleet
+
+
+def _worker(fleet, wid, engine=None, **kw):
+    w = FleetWorker(fleet.address, wid,
+                    engine if engine is not None else stub_engine(),
+                    **kw)
+    return w.start()
+
+
+def _await_holders(fleet, want, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        held = sum(
+            1 for rec in fleet.state()["partitions"].values()
+            if rec["holder"] is not None
+        )
+        if held >= want:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _reconciles(counters) -> bool:
+    return counters["offered"] == (
+        counters["completed"] + counters["rejected"]
+        + counters["shed"] + counters["errors"]
+    )
+
+
+# ---------------------------------------------------------- happy path
+def test_fleet_routes_and_accounts_exactly():
+    fleet = _fleet(classes=2)
+    workers = []
+    try:
+        workers = [_worker(fleet, f"w{i}") for i in range(2)]
+        assert _await_holders(fleet, 2)
+        imgs = [_img(i) for i in range(8)]
+        futs = [fleet.submit(im, EX, priority=i % 2)
+                for i, im in enumerate(imgs)]
+        results = [f.result(timeout=30) for f in futs]
+        # every result carries ITS image's signature: no crossed wires,
+        # no double serves
+        assert all(
+            float(r["scores"][0, 0]) == stub_signature(im)
+            for r, im in zip(results, imgs)
+        )
+        c = fleet.counters()
+        assert c["offered"] == 8 and c["completed"] == 8
+        assert c["double_served"] == 0 and _reconciles(c)
+        # the join rebalance spread the partitions (scale_out recorded)
+        st = fleet.state()
+        holders = {rec["holder"][0]
+                   for rec in st["partitions"].values() if rec["holder"]}
+        assert len(holders) == 2
+        assert any(r["cause"] == "scale_out"
+                   for r in st["reassignments"])
+    finally:
+        for w in workers:
+            w.stop()
+        fleet.close()
+
+
+def test_malformed_submit_fails_alone_and_counts():
+    fleet = _fleet()
+    try:
+        with pytest.raises(Exception):
+            fleet.submit(np.zeros((3, 5, 3), np.float32),
+                         EX).result(timeout=5)
+        c = fleet.counters()
+        assert c["errors"] == 1 and _reconciles(c)
+    finally:
+        fleet.close()
+
+
+def test_submit_after_close_rejects_instead_of_hanging():
+    """Review regression: a submit racing close() past the unlocked
+    fast check must NOT enter the drained registry (its future would
+    never resolve) — the locked check turns it into an immediate
+    rejection."""
+    fleet = _fleet()
+    fleet.close()
+    fut = fleet.submit(_img(95), EX)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=5)
+    assert fleet.counters()["offered"] == 0  # never entered the books
+
+
+def test_pending_ignores_stale_worker_beats():
+    """Review regression: a dead worker's last reported queue depth
+    must age out of the saturation signal (same horizon as the drain
+    signal) — or an idle fleet reads as permanently saturated."""
+    fleet = _fleet()
+    try:
+        now = time.monotonic()
+        with fleet._lock:
+            fleet._worker_beat["fresh"] = (now, 1.0, 5)
+            fleet._worker_beat["dead"] = (now - 300.0, 9.0, 50)
+        assert fleet.pending() == 5
+    finally:
+        fleet.close()
+
+
+def test_close_rejects_leftovers_with_shutdown():
+    fleet = _fleet()  # no workers: everything parks
+    futs = [fleet.submit(_img(40 + i), EX) for i in range(3)]
+    fleet.close()
+    for f in futs:
+        assert f.done()
+        exc = f.exception()
+        assert isinstance(exc, RejectedError) and exc.cause == "shutdown"
+    c = fleet.counters()
+    assert c["shed"] == 3 and _reconciles(c)
+
+
+# ------------------------------------------------------ death rebalance
+def test_dirty_disconnect_rebalances_and_resubmits():
+    fleet = _fleet(max_resubmits=3)
+    w2 = None
+    try:
+        w1 = _worker(fleet, "w1", stub_engine(delay_s=0.3, batch=1))
+        assert _await_holders(fleet, 1)
+        imgs = [_img(10 + i) for i in range(3)]
+        futs = [fleet.submit(im, EX) for im in imgs]
+        time.sleep(0.15)  # w1 is now mid-flight
+        # dirty control disconnect: the in-process kill -9 signature
+        w1._sock.shutdown(socket.SHUT_RDWR)
+        w2 = _worker(fleet, "w2", stub_engine(batch=1))
+        results = [f.result(timeout=30) for f in futs]
+        assert all(
+            float(r["scores"][0, 0]) == stub_signature(im)
+            for r, im in zip(results, imgs)
+        )
+        c = fleet.counters()
+        assert c["completed"] == 3 and c["double_served"] == 0
+        assert c["resubmitted"] >= 1 and _reconciles(c)
+        st = fleet.state()
+        assert any(r["cause"] == "worker_exit"
+                   for r in st["reassignments"])
+        rec = fleet.report()
+        assert rec["rebalance"]["count"] >= 1
+    finally:
+        if w2 is not None:
+            w2.stop()
+        fleet.close()
+
+
+def test_repeated_lease_loss_past_resubmit_bound_is_worker_lost():
+    """A request whose partition keeps losing its holder must end
+    TERMINALLY (cause worker_lost) — never an unbounded silent retry.
+    A beat-less worker with a 5 s program re-leases after every TTL
+    revocation, so the request burns one resubmission per cycle until
+    the bound trips."""
+    w1 = None
+    fleet = _fleet(policy=_policy(lease_ttl_s=0.5), max_resubmits=1)
+    try:
+        w1 = FleetWorker(fleet.address, "w1",
+                         stub_engine(delay_s=5.0, batch=1))
+        w1._hb_interval = 3600.0  # beats never fire
+        w1.start()
+        assert _await_holders(fleet, 1)
+        fut = fleet.submit(_img(20), EX)
+        with pytest.raises(RejectedError) as ei:
+            fut.result(timeout=20)
+        assert ei.value.cause == "worker_lost"
+        c = fleet.counters()
+        assert c["rejected"] == 1 and c["resubmitted"] >= 1
+        assert _reconciles(c)
+    finally:
+        if w1 is not None:
+            w1.stop()
+        fleet.close()
+
+
+def test_dead_data_link_with_live_worker_resubmits():
+    """Review regression: a torn DATA connection (worker alive, leases
+    healthy, so no revocation will ever fire) must not strand its
+    in-flight requests — the link-loss path resubmits them over a
+    fresh connection, and the commit registry keeps it exactly-once."""
+    fleet = _fleet(max_resubmits=3)
+    w = None
+    try:
+        w = _worker(fleet, "w1", stub_engine(delay_s=0.4, batch=1))
+        assert _await_holders(fleet, 1)
+        im = _img(98)
+        fut = fleet.submit(im, EX)
+        time.sleep(0.15)  # routed and in flight on the link
+        with fleet._lock:
+            link = fleet._links.get("w1")
+        assert link is not None
+        link.close()  # the torn connection; heartbeats keep flowing
+        r = fut.result(timeout=30)
+        assert float(r["scores"][0, 0]) == stub_signature(im)
+        c = fleet.counters()
+        assert c["completed"] == 1 and c["double_served"] == 0
+        assert c["resubmitted"] >= 1 and _reconciles(c)
+    finally:
+        if w is not None:
+            w.stop()
+        fleet.close()
+
+
+def test_worker_rejoin_under_stable_id_serves_again():
+    """Review regression: a worker reconnecting with the SAME stable
+    id after a crash/leave must be alive again — not treated as
+    departed forever (address stripped every control pass, its
+    partitions' traffic black-holed). Drained stays sticky."""
+    fleet = _fleet(max_resubmits=4)
+    w = None
+    try:
+        w = _worker(fleet, "stable")
+        assert _await_holders(fleet, 1)
+        fleet.submit(_img(96), EX).result(timeout=30)
+        w.stop()  # clean bye: partitions released, flags set
+        time.sleep(0.3)  # a control pass prunes the departed state
+        # the same id comes back and must serve again
+        w = _worker(fleet, "stable")
+        assert _await_holders(fleet, 1)
+        im = _img(97)
+        r = fleet.submit(im, EX).result(timeout=30)
+        assert float(r["scores"][0, 0]) == stub_signature(im)
+        c = fleet.counters()
+        assert c["completed"] == 2 and _reconciles(c)
+        # sticky drain: a drained record is NOT revived by rejoin
+        rec = fleet._svc.worker_rec("poisoned")
+        with fleet._svc.lock:
+            rec.drained = True
+            rec.dead = True
+        revived = fleet._svc.rejoin("poisoned")
+        assert revived.dead is False and revived.drained is True
+    finally:
+        if w is not None:
+            w.stop()
+        fleet.close()
+
+
+# ------------------------------------------------- stale-epoch fencing
+def test_stale_heartbeat_fences_late_result_exactly_once():
+    """The SIGSTOP story in-process: a worker whose beats stop keeps
+    computing; its lease revokes past the TTL, and the result it sends
+    under the revoked epoch is FENCED at the commit — then its re-lease
+    serves the request exactly once."""
+    fleet = _fleet(policy=_policy(lease_ttl_s=0.6), max_resubmits=5)
+    w1 = None
+    try:
+        w1 = FleetWorker(fleet.address, "w1",
+                         stub_engine(delay_s=1.5, batch=1))
+        w1._hb_interval = 3600.0  # beats never fire: the SIGSTOP stand-in
+        w1.start()
+        assert _await_holders(fleet, 1)
+        im = _img(30)
+        fut = fleet.submit(im, EX)
+        r = fut.result(timeout=30)
+        assert float(r["scores"][0, 0]) == stub_signature(im)
+        c = fleet.counters()
+        assert c["completed"] == 1 and c["fenced_results"] >= 1
+        assert c["double_served"] == 0 and _reconciles(c)
+        rep = fleet.report()
+        assert any(r["cause"] == "stale_heartbeat"
+                   for r in rep["reassignments"])
+        # the fence left a lease-level commit rejection record too
+        assert any(r["op"] == "commit"
+                   for r in rep["fenced_rejections"])
+    finally:
+        if w1 is not None:
+            w1.stop()
+        fleet.close()
+
+
+# ------------------------------------------- cluster-wide admission
+def test_admission_uses_fleet_drain_and_stale_beats_fall_back():
+    ctl = AdmissionController(enabled=True, max_pending=1)
+    fleet = _fleet(admission=ctl)
+    try:
+        w1 = _worker(fleet, "w1")
+        assert _await_holders(fleet, 1)
+        # serve some traffic so the workers report a drain rate; the
+        # controller's measured drain must BE the fleet's summed beat
+        # (re-read in a loop: a beat can land between two reads)
+        for i in range(4):
+            fleet.submit(_img(50 + i), EX).result(timeout=30)
+        wired = False
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and not wired:
+            total = fleet._drain_total()
+            if total > 0 and ctl.stats()["drain_per_sec"] == \
+                    pytest.approx(total, abs=0.002):
+                wired = True
+            time.sleep(0.05)
+        assert wired, "admission never saw the fleet drain signal"
+        # a full fleet rejects with a drain-derived retry hint
+        blocker = fleet.submit(_img(60), EX)  # occupies the one slot
+        rej = fleet.submit(_img(61), EX)
+        exc = rej.exception(timeout=5)
+        assert isinstance(exc, RejectedError)
+        assert exc.cause == "queue_full" and exc.retry_after_s > 0
+        blocker.result(timeout=30)
+        w1.stop()
+        # beats gone: the drain signal must go stale (0.0), so the
+        # controller falls back to its release-window estimate
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and fleet._drain_total() > 0:
+            time.sleep(0.1)
+        assert fleet._drain_total() == 0.0
+        c = fleet.counters()
+        assert c["rejected"] >= 1 and _reconciles(c)
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------ recruitment policy
+def test_saturation_recruits_before_degrade_engages():
+    from tmr_tpu.serve.degrade import DegradeController
+
+    spawned = []
+
+    def spawner(i):
+        spawned.append(i)
+        workers.append(_worker(fleet, f"spawn{i}"))
+
+    workers = []
+    fleet = _fleet(
+        classes=2, spawner=spawner, saturation_pending=3,
+        recruit_passes=2, recruit_grace=50, max_workers=3,
+        degrade=DegradeController(mode="auto"),
+    )
+    try:
+        workers.append(
+            _worker(fleet, "w0", stub_engine(delay_s=0.25, batch=1))
+        )
+        assert _await_holders(fleet, 2)
+        imgs = [_img(70 + i) for i in range(12)]
+        futs = [fleet.submit(im, EX, priority=i % 2)
+                for i, im in enumerate(imgs)]
+        for f in futs:
+            f.result(timeout=60)
+        rep = fleet.report()
+        assert spawned, "sustained saturation never recruited"
+        assert rep["recruitment"]["rounds"] >= 1
+        # scale-out absorbed the spike BEFORE degradation: level 0
+        assert rep["degrade"]["level"] == 0
+        assert rep["degrade"]["max_seen"] == 0
+        assert any(r["cause"] == "scale_out"
+                   for r in rep["reassignments"])
+        c = fleet.counters()
+        assert c["completed"] == 12 and _reconciles(c)
+    finally:
+        for w in workers:
+            w.stop()
+        fleet.close()
+
+
+def test_saturation_reaches_degrade_only_when_recruitment_exhausted():
+    from tmr_tpu.serve.degrade import DegradeController
+
+    deg = DegradeController(mode="auto")
+    fleet = _fleet(
+        spawner=None, saturation_pending=0, recruit_passes=1,
+        max_workers=1, degrade=deg, check_interval_s=0.05,
+    )
+    try:
+        # no workers: one parked request is a saturated backlog every
+        # pass, and with no spawner the anomaly reaches the ladder
+        fleet.submit(_img(80), EX)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and deg.level == 0:
+            time.sleep(0.05)
+        assert deg.level >= 1
+        assert fleet.report()["degrade"]["max_seen"] >= 1
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------- fleet fault points
+def test_fleet_fault_points_parse_and_fire():
+    faults.configure(
+        "fleet.route:shard=0:attempts=2:raise=OSError;"
+        "fleet.commit:raise=RuntimeError;"
+        "fleet.recruit:raise=InjectedFault"
+    )
+    with faults.shard_scope(0, 1):
+        with pytest.raises(OSError):
+            faults.fire("fleet.route")
+    with faults.shard_scope(None, None):
+        with pytest.raises(RuntimeError):
+            faults.fire("fleet.commit")
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("fleet.recruit")
+    assert {f["point"] for f in faults.fired()} == {
+        "fleet.route", "fleet.commit", "fleet.recruit"
+    }
+
+
+def test_injected_commit_fault_ends_request_terminally():
+    fleet = _fleet()
+    try:
+        w1 = _worker(fleet, "w1")
+        assert _await_holders(fleet, 1)
+        faults.configure("fleet.commit:raise=RuntimeError")
+        fut = fleet.submit(_img(90), EX)
+        with pytest.raises(RejectedError) as ei:
+            fut.result(timeout=30)
+        assert ei.value.cause == "worker_lost"
+        faults.clear()
+        c = fleet.counters()
+        assert c["commit_faults"] >= 1
+        assert c["rejected"] == 1 and _reconciles(c)
+        w1.stop()
+    finally:
+        faults.clear()
+        fleet.close()
+
+
+def test_injected_recruit_fault_vetoes_the_round():
+    spawned = []
+    fleet = _fleet(
+        spawner=lambda i: spawned.append(i), saturation_pending=0,
+        recruit_passes=1, max_workers=4, check_interval_s=0.05,
+    )
+    try:
+        faults.configure("fleet.recruit:raise=InjectedFault")
+        fleet.submit(_img(91), EX)  # permanent backlog of one
+        time.sleep(0.5)
+        assert not spawned  # every election vetoed
+        assert any(f["point"] == "fleet.recruit"
+                   for f in faults.fired())
+        faults.clear()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not spawned:
+            time.sleep(0.05)
+        assert spawned  # cleared schedule: the next election spawns
+    finally:
+        faults.clear()
+        fleet.close()
+
+
+# --------------------------------------------------- generic LeaseService
+def test_lease_service_two_phase_grant_and_fence():
+    svc = LeaseService(
+        [Resource(0, "a"), Resource(1, "b")], _policy(),
+        metrics_prefix="t", noun="thing", key_field="thing",
+    )
+    verdict, res, epoch = svc.select("w0")
+    assert verdict == "grant" and res.key == "a" and epoch == 1
+    lease = svc.install(res, epoch, "w0")
+    assert lease is not None and svc.holder(0) == ("w0", 1)
+    assert svc.heartbeat("w0", 0, 1)
+    assert not svc.heartbeat("w0", 0, 2)  # wrong epoch
+    # revoke one lease: epoch bumps, records carry the client key field
+    assert svc.revoke_lease(0, 1, "scale_out")
+    assert svc.holder(0) is None
+    assert svc.reassignments[0]["thing"] == "a"
+    assert svc.reassignments[0]["cause"] == "scale_out"
+    # the stale holder's commit fences
+    assert svc.commit("w0", 0, 1) is None
+    assert svc.fenced[0]["op"] == "commit"
+    # re-grant goes out under a higher epoch
+    verdict, res2, epoch2 = svc.select("w1")
+    assert verdict == "grant" and res2.index == 0 and epoch2 >= 2
+
+
+def test_lease_service_requeue_aborts_reserved_grant():
+    svc = LeaseService([Resource(0, "a")], _policy())
+    verdict, res, epoch = svc.select("w0")
+    assert verdict == "grant"
+    svc.requeue(res)  # fault point vetoed the grant
+    verdict2, res2, epoch2 = svc.select("w0")
+    assert verdict2 == "grant" and res2 is res
+    assert epoch2 == epoch + 1  # the reserved epoch was burned
+
+
+# ----------------------------------------------------------- validator
+def _valid_fleet_section():
+    return {
+        "partitions": [{
+            "index": 0, "partition": "s32c0", "status": "leased",
+            "worker": "w0", "epoch": 1, "assignments": 1,
+        }],
+        "workers": {"w0": {"drained": False, "dead": False}},
+        "reassignments": [{
+            "partition": "s32c0", "index": 0, "worker": "w0",
+            "epoch": 1, "cause": "scale_out",
+        }],
+        "fenced_rejections": [{
+            "partition": "s32c0", "index": 0, "worker": "w0",
+            "epoch": 1, "op": "commit",
+        }],
+        "accounting": {
+            "offered": 4, "completed": 3, "rejected": 1, "shed": 0,
+            "errors": 0, "resubmitted": 1, "fenced_results": 1,
+            "late_results": 0, "double_served": 0,
+        },
+    }
+
+
+def _valid_serve_report():
+    from tmr_tpu.diagnostics import ELASTIC_SERVE_REPORT_SCHEMA
+
+    return {
+        "schema": ELASTIC_SERVE_REPORT_SCHEMA,
+        "config": {"image_size": 32},
+        "phases": [{
+            "name": "kill", "offered": 4,
+            "outcomes": {"completed": 3, "rejected": 1, "shed": 0,
+                         "errors": 0},
+            "fleet": _valid_fleet_section(),
+        }],
+        "accounting": _valid_fleet_section()["accounting"],
+        "rebalance": {"count": 1, "max_latency_s": 0.1, "bound_s": 5.0,
+                      "bounded": True},
+        "recruitment": {"rounds": 1, "workers_before": 1,
+                        "workers_after": 2, "degrade_level": 0,
+                        "degrade_max_seen": 0},
+        "checks": {
+            "futures_terminal": True, "zero_double_served": True,
+            "accounting_exact_probe": True,
+            "accounting_exact_fleet": True, "results_correct": True,
+            "fenced_late_result": True, "rebalance_bounded": True,
+            "recruitment_absorbed": True, "degrade_level0": True,
+        },
+    }
+
+
+def test_elastic_serve_report_validator_accepts_valid_and_error_docs():
+    from tmr_tpu.diagnostics import (
+        ELASTIC_SERVE_REPORT_SCHEMA,
+        validate_elastic_serve_report,
+    )
+
+    assert validate_elastic_serve_report(_valid_serve_report()) == []
+    assert validate_elastic_serve_report(
+        {"schema": ELASTIC_SERVE_REPORT_SCHEMA, "error": "watchdog"}
+    ) == []
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda d: d.update(schema="bogus/v9"), "schema"),
+    (lambda d: d["phases"][0]["fleet"]["reassignments"][0].update(
+        cause="cosmic_rays"), "cause"),
+    (lambda d: d["phases"][0]["fleet"]["accounting"].update(
+        completed=99), "offered"),
+    (lambda d: d["accounting"].pop("double_served"), "double_served"),
+    (lambda d: d["phases"][0]["outcomes"].update(completed=0),
+     "reconcile"),
+    (lambda d: d.pop("rebalance"), "rebalance"),
+    (lambda d: d["recruitment"].pop("rounds"), "recruitment"),
+    (lambda d: d["checks"].pop("zero_double_served"),
+     "zero_double_served"),
+])
+def test_elastic_serve_report_validator_rejects_drift(mutate, fragment):
+    from tmr_tpu.diagnostics import validate_elastic_serve_report
+
+    doc = _valid_serve_report()
+    mutate(doc)
+    problems = validate_elastic_serve_report(doc)
+    assert problems, f"expected a problem for {fragment}"
+    assert any(fragment in p for p in problems), problems
+
+
+def test_fleet_report_reader_rc_gates():
+    import json
+
+    from tmr_tpu.utils.bench_trend import read_fleet_report
+
+    import tempfile
+
+    doc = _valid_serve_report()
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        f.write(json.dumps(doc) + "\n")
+        path = f.name
+    out = read_fleet_report(path)
+    assert out["checks"]["zero_double_served"] is True
+    assert out["checks"]["reconciliation_exact"] is True
+    assert out["checks"]["probe_checks_pass"] is True
+    assert out["rows"][0]["phase"] == "kill"
+    # a double-serve or broken reconciliation must fail CLOSED
+    doc["accounting"]["double_served"] = 1
+    doc["accounting"]["completed"] = 99
+    with open(path, "w") as f:
+        f.write(json.dumps(doc) + "\n")
+    out = read_fleet_report(path)
+    assert out["checks"]["zero_double_served"] is False
+    assert out["checks"]["reconciliation_exact"] is False

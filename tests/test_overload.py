@@ -174,6 +174,81 @@ def test_class_weight_fn_parsing():
     assert parse_class_weights("0,-1") == (1.0, 2.0, 4.0, 8.0)
 
 
+# ------------------------------------------------ drain-source fallback
+def _seeded_controller():
+    """A controller whose release window holds a usable estimate — the
+    fallback the broken-source tests must land on."""
+    from tmr_tpu.serve import AdmissionController
+
+    ctl = AdmissionController(enabled=True, max_pending=8)
+    for _ in range(4):
+        assert ctl.try_admit(0) is None
+        ctl.release_class(0)
+    assert ctl.stats()["drain_per_sec"] > 0  # the window estimate
+    return ctl
+
+
+def test_attach_drain_source_healthy_source_wins():
+    ctl = _seeded_controller()
+    ctl.attach_drain_source(lambda: 123.0)
+    assert ctl.stats()["drain_per_sec"] == 123.0
+
+
+def test_attach_drain_source_raising_falls_back_to_window():
+    """PR 12 documented the fallback; this pins it: a source that
+    RAISES must never poison the retry_after hint — the release-window
+    estimate answers instead."""
+    ctl = _seeded_controller()
+    window = ctl.stats()["drain_per_sec"]
+
+    def broken():
+        raise RuntimeError("drain source wedged")
+
+    ctl.attach_drain_source(broken)
+    assert ctl.stats()["drain_per_sec"] == pytest.approx(window, rel=0.5)
+    rej = None
+    for _ in range(20):  # fill to the bound, then one rejection
+        rej = ctl.try_admit(0)
+        if rej is not None:
+            break
+    assert rej is not None and rej.retry_after_s > 0
+
+
+@pytest.mark.parametrize("bad_rate", [0.0, -3.0, float("nan"),
+                                      float("inf")])
+def test_attach_drain_source_zero_or_nonfinite_falls_back(bad_rate):
+    """A source returning 0 (a STALE engine/fleet window reports
+    exactly this once its completions age out), a negative number, or
+    a non-finite value falls back to the window estimate."""
+    ctl = _seeded_controller()
+    window = ctl.stats()["drain_per_sec"]
+    ctl.attach_drain_source(lambda: bad_rate)
+    got = ctl.stats()["drain_per_sec"]
+    assert got == pytest.approx(window, rel=0.5)
+    assert got > 0
+
+
+def test_engine_drain_snapshot_goes_stale():
+    """The engine side of the 'goes stale' contract: a drain window
+    whose newest completion is old reads 0.0 — which is exactly what
+    makes the attached controller fall back."""
+    import time as _time
+
+    from collections import deque
+
+    eng = _engine()
+    try:
+        now = _time.monotonic()
+        with eng._drain_lock:
+            eng._drain["fresh"] = deque([now - 1.0, now - 0.5])
+            eng._drain["stale"] = deque([now - 300.0, now - 299.0])
+        snap = eng.drain_snapshot()
+        assert snap["fresh"] > 0
+        assert snap["stale"] == 0.0
+    finally:
+        eng.close()
+
+
 # ------------------------------------------------------ priority batching
 def test_batcher_pops_highest_class_first_fifo_within_class():
     from tmr_tpu.serve import MicroBatcher, Request, class_weight_fn

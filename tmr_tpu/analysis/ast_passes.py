@@ -278,7 +278,9 @@ LOCK_FILES = (
     "tmr_tpu/serve/caches.py",
     "tmr_tpu/serve/admission.py",
     "tmr_tpu/serve/degrade.py",
+    "tmr_tpu/serve/fleet.py",
     "tmr_tpu/parallel/elastic.py",
+    "tmr_tpu/parallel/leases.py",
     "tmr_tpu/utils/faults.py",
     "tmr_tpu/obs/metrics.py",
 )

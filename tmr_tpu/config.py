@@ -276,6 +276,27 @@ ENV_KNOBS = {
         "the shard is quarantined outright",
     "TMR_ELASTIC_POISON_FAILURES": "distinct failed shards before a "
         "worker is drained and its shards redistributed",
+    "TMR_ELASTIC_CONNECT_TIMEOUT_S": "connect timeout for every "
+        "lease-protocol dial (coordinator/front-door/worker data "
+        "plane); a black-holed address fails fast instead of hanging "
+        "a worker in hello",
+    # elastic serve fleet (serve/fleet.py; lease liveness rides the
+    # TMR_ELASTIC_* family above)
+    "TMR_FLEET_SATURATION_PENDING": "fleet backlog depth (open "
+        "requests + worker-reported queue) that counts as queue "
+        "saturation",
+    "TMR_FLEET_RECRUIT_PASSES": "consecutive saturated control passes "
+        "before a recruitment round fires",
+    "TMR_FLEET_RECRUIT_GRACE": "control passes a fresh recruit gets "
+        "to absorb load before saturation can recruit (or degrade) "
+        "again",
+    "TMR_FLEET_MAX_WORKERS": "recruitment ceiling: saturation past it "
+        "reaches the degrade ladder instead of the spawner",
+    "TMR_FLEET_MAX_RESUBMITS": "per-request resubmission bound after "
+        "worker loss; past it the future fails with structured cause "
+        "worker_lost",
+    "TMR_FLEET_CHECK_S": "fleet front-door control-pass interval "
+        "(liveness, deadlines, recruitment election)",
     # fault injection (tests/chaos probe)
     "TMR_FAULTS": "deterministic fault-injection schedule",
     "TMR_FAULTS_SEED": "fault-schedule RNG seed",

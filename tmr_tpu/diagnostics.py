@@ -515,15 +515,31 @@ def validate_map_report(doc: dict) -> List[str]:
 #: reassigned shards counted once under whoever finally committed them.
 ELASTIC_REPORT_SCHEMA = "elastic_report/v1"
 
-#: closed reassignment-cause vocabulary in an elastic_report/v1
-#: document: stale_heartbeat = the lease's heartbeat went stale past the
-#: TTL (dead or paused worker); worker_exit = the worker's control
-#: connection dropped while it held the lease (kill -9 / crash);
-#: straggler = a speculative duplicate lease was issued because the
-#: shard's runtime exceeded the rolling-median-based bound;
-#: poison_worker = the worker reported the shard failed (after N
-#: distinct such failures the worker is drained).
+#: closed reassignment-cause vocabulary shared by the lease-service
+#: clients (elastic_report/v1 map shards, elastic_serve_report/v1
+#: traffic partitions): stale_heartbeat = the lease's heartbeat went
+#: stale past the TTL (dead or paused worker); worker_exit = the
+#: worker left while it held the lease — a dropped control connection
+#: (kill -9 / crash) or, for fleet workers, a clean ``bye`` that still
+#: held partitions (serve leases are held for the worker's lifetime,
+#: so a graceful leave releases through the same path); straggler = a
+#: speculative duplicate lease was
+#: issued because the shard's runtime exceeded the rolling-median-based
+#: bound; poison_worker = the worker reported the resource failed
+#: (after N distinct such failures the worker is drained); scale_out =
+#: a traffic partition moved to a newly recruited serve worker to
+#: spread load (fleet rebalance-on-join — never emitted by the map
+#: client).
 ELASTIC_REASSIGN_CAUSES = (
+    "stale_heartbeat", "worker_exit", "straggler", "poison_worker",
+    "scale_out",
+)
+
+#: the MAP client's subset: validate_elastic_report stays exactly as
+#: tight as before the fleet landed — a map-shard reassignment tagged
+#: scale_out is a drift the validator must still catch (only the fleet
+#: section validator accepts the full shared vocabulary)
+MAP_REASSIGN_CAUSES = (
     "stale_heartbeat", "worker_exit", "straggler", "poison_worker",
 )
 
@@ -600,7 +616,7 @@ def validate_elastic_report(doc: dict) -> List[str]:
         for key in ("shard", "worker", "epoch", "cause"):
             if key not in r:
                 problems.append(f"{where}: missing {key!r}")
-        if r.get("cause") not in ELASTIC_REASSIGN_CAUSES:
+        if r.get("cause") not in MAP_REASSIGN_CAUSES:
             problems.append(f"{where}: bad cause {r.get('cause')!r}")
     fenced = doc.get("fenced_rejections")
     if not isinstance(fenced, list):
@@ -652,6 +668,182 @@ def validate_elastic_report(doc: dict) -> List[str]:
                 problems.append(
                     "totals.fenced_rejections != len(fenced_rejections)"
                 )
+    return problems
+
+
+#: schema tag of the elastic-serving probe document emitted by
+#: scripts/elastic_serve_probe.py (the chaos_probe --elastic story
+#: applied to the serve fleet, serve/fleet.py): per-phase fleet state
+#: (partition leases, workers, cause-tagged reassignments, fenced
+#: lease rejections) plus the exactly-once result accounting —
+#: ``offered == completed + rejected + shed + errors`` EXACTLY, zero
+#: double-served request ids, fenced late results counted — rebalance
+#: latency, and the recruitment round. bench_guard wraps the probe, so
+#: an error record ({"schema": ..., "error": str}) is contractually
+#: valid; scripts/bench_trend.py --fleet rc-gates on the
+#: zero-double-served and reconciliation fields.
+ELASTIC_SERVE_REPORT_SCHEMA = "elastic_serve_report/v1"
+
+#: the exactly-once accounting fields every fleet/probe accounting
+#: record must carry as non-negative ints; the first four reconcile
+#: exactly against ``offered``
+FLEET_ACCOUNTING_KEYS = (
+    "offered", "completed", "rejected", "shed", "errors",
+    "resubmitted", "fenced_results", "late_results", "double_served",
+)
+
+
+def _validate_fleet_accounting(acc, where: str) -> List[str]:
+    """The exactly-once contract as a validation rule: every key a
+    non-negative int and offered == completed + rejected + shed +
+    errors EXACTLY (resubmissions/fenced/late commits are bookkeeping,
+    never extra terminals)."""
+    if not isinstance(acc, dict):
+        return [f"{where}: not a dict"]
+    problems: List[str] = []
+    for key in FLEET_ACCOUNTING_KEYS:
+        v = acc.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"{where}.{key}: not a non-negative int")
+    if not problems and acc["offered"] != (
+        acc["completed"] + acc["rejected"] + acc["shed"] + acc["errors"]
+    ):
+        problems.append(
+            f"{where}: offered != completed + rejected + shed + errors"
+        )
+    return problems
+
+
+def _validate_fleet_section(fleet, where: str) -> List[str]:
+    """One ServeFleet.report() document (embedded per probe phase)."""
+    if not isinstance(fleet, dict):
+        return [f"{where}: not a dict"]
+    problems: List[str] = []
+    partitions = fleet.get("partitions")
+    if not isinstance(partitions, list) or not partitions:
+        problems.append(f"{where}.partitions: not a non-empty list")
+        partitions = []
+    for i, rec in enumerate(partitions):
+        sub = f"{where}.partitions[{i}]"
+        if not isinstance(rec, dict):
+            problems.append(f"{sub}: not a dict")
+            continue
+        for key in ("index", "partition", "status", "worker", "epoch",
+                    "assignments"):
+            if key not in rec:
+                problems.append(f"{sub}: missing {key!r}")
+    if not isinstance(fleet.get("workers"), dict):
+        problems.append(f"{where}.workers: not a dict")
+    for section, vocab_key, vocab in (
+        ("reassignments", "cause", ELASTIC_REASSIGN_CAUSES),
+        ("fenced_rejections", "op", ELASTIC_FENCE_OPS),
+    ):
+        recs = fleet.get(section)
+        if not isinstance(recs, list):
+            problems.append(f"{where}.{section}: not a list")
+            continue
+        for i, r in enumerate(recs):
+            sub = f"{where}.{section}[{i}]"
+            if not isinstance(r, dict):
+                problems.append(f"{sub}: not a dict")
+                continue
+            for key in ("partition", "worker", "epoch", vocab_key):
+                if key not in r:
+                    problems.append(f"{sub}: missing {key!r}")
+            if r.get(vocab_key) not in vocab:
+                problems.append(
+                    f"{sub}: bad {vocab_key} {r.get(vocab_key)!r}"
+                )
+    problems += _validate_fleet_accounting(
+        fleet.get("accounting"), f"{where}.accounting"
+    )
+    return problems
+
+
+def validate_elastic_serve_report(doc: dict) -> List[str]:
+    """Structural + reconciliation check of an elastic_serve_report/v1
+    document; returns a list of problems (empty == valid). An error
+    record is contractually valid (the bench_guard wedge path).
+    Dependency-free like the other validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != ELASTIC_SERVE_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {ELASTIC_SERVE_REPORT_SCHEMA}: "
+            f"{doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    if not isinstance(doc.get("config"), dict):
+        problems.append("config: not a dict")
+    phases = doc.get("phases")
+    if not isinstance(phases, list) or not phases:
+        problems.append("phases: not a non-empty list")
+        phases = []
+    for i, phase in enumerate(phases):
+        where = f"phases[{i}]"
+        if not isinstance(phase, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        if not isinstance(phase.get("name"), str) or not phase["name"]:
+            problems.append(f"{where}.name: not a non-empty string")
+        if not isinstance(phase.get("offered"), int) \
+                or isinstance(phase.get("offered"), bool):
+            problems.append(f"{where}.offered: not an int")
+        outcomes = phase.get("outcomes")
+        if not isinstance(outcomes, dict) or not all(
+            isinstance(outcomes.get(k), int)
+            and not isinstance(outcomes.get(k), bool)
+            for k in ("completed", "rejected", "shed", "errors")
+        ):
+            problems.append(
+                f"{where}.outcomes: missing completed/rejected/shed/"
+                "errors ints"
+            )
+        elif isinstance(phase.get("offered"), int) and \
+                sum(outcomes[k] for k in ("completed", "rejected",
+                                          "shed", "errors")) \
+                != phase["offered"]:
+            problems.append(
+                f"{where}: probe-side outcomes do not reconcile with "
+                "offered"
+            )
+        problems += _validate_fleet_section(phase.get("fleet"),
+                                            f"{where}.fleet")
+    problems += _validate_fleet_accounting(doc.get("accounting"),
+                                           "accounting")
+    rebalance = doc.get("rebalance")
+    if not isinstance(rebalance, dict) or not all(
+        isinstance(rebalance.get(k), (int, float))
+        and not isinstance(rebalance.get(k), bool)
+        for k in ("count", "max_latency_s", "bound_s")
+    ):
+        problems.append("rebalance: missing count/max_latency_s/bound_s")
+    recruit = doc.get("recruitment")
+    if not isinstance(recruit, dict) or not all(
+        isinstance(recruit.get(k), int)
+        and not isinstance(recruit.get(k), bool)
+        for k in ("rounds", "workers_before", "workers_after",
+                  "degrade_level", "degrade_max_seen")
+    ):
+        problems.append(
+            "recruitment: missing rounds/workers_before/workers_after/"
+            "degrade_level/degrade_max_seen ints"
+        )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("futures_terminal", "zero_double_served",
+                    "accounting_exact_probe", "accounting_exact_fleet",
+                    "results_correct", "fenced_late_result",
+                    "rebalance_bounded", "recruitment_absorbed",
+                    "degrade_level0"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
     return problems
 
 

@@ -27,9 +27,9 @@ correctness contract changes:
 - **fencing** is what makes all of that safe: every journal commit is
   fenced on the CURRENT lease epoch (``journal.record(fence=...)`` →
   a precommit round-trip). A paused-then-resumed worker whose lease was
-  revoked raises ``StaleLeaseError`` before its marker touches disk —
-  it can never corrupt the table — and the rejection is counted in the
-  report. The journal's digest check plus ``atomic_save_npy``
+  revoked raises :class:`StaleLeaseError` before its marker touches
+  disk — it can never corrupt the table — and the rejection is counted
+  in the report. The journal's digest check plus ``atomic_save_npy``
   idempotence already make double-execution of the FEATURE writes
   harmless;
 - **stragglers**: when a shard's runtime exceeds a rolling-median-based
@@ -41,6 +41,14 @@ correctness contract changes:
   granularity; a shard failed by several distinct workers is
   quarantined like the single-process path would.
 
+The lease/epoch/heartbeat/reassignment state machine itself lives in
+``parallel/leases.py`` as the generic :class:`~tmr_tpu.parallel.leases.
+LeaseService`: this coordinator is its first client (map shards), the
+serve fleet (serve/fleet.py) its second (traffic partitions). The
+extraction changed NOTHING observable here — same counters, same
+records, same grant discipline — pinned by the ``--elastic`` chaos
+gauntlet.
+
 The final stats table folds one float64 contribution per shard in
 shard-list order — exactly the single-process fold — so an elastic run
 over any number of workers, kills, and reassignments produces a
@@ -51,7 +59,10 @@ under kill -9 and SIGSTOP). Everything is accounted in one validated
 Env knobs (all lazily read, registered in config.ENV_KNOBS):
 ``TMR_ELASTIC_TTL_S``, ``TMR_ELASTIC_HB_S``, ``TMR_ELASTIC_CHECK_S``,
 ``TMR_ELASTIC_STRAGGLER_FACTOR``, ``TMR_ELASTIC_STRAGGLER_MIN_S``,
-``TMR_ELASTIC_MAX_REASSIGNS``, ``TMR_ELASTIC_POISON_FAILURES``.
+``TMR_ELASTIC_MAX_REASSIGNS``, ``TMR_ELASTIC_POISON_FAILURES``,
+``TMR_ELASTIC_CONNECT_TIMEOUT_S`` (every protocol dial — a black-holed
+coordinator address fails a worker fast instead of hanging it in
+``hello`` on the OS default connect timeout).
 
 Import-light on purpose: nothing here imports jax at module load — the
 worker pulls mapreduce (and through it jax) lazily, so the coordinator
@@ -67,26 +78,40 @@ import socket
 import socketserver
 import threading
 import time
-from collections import deque
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tmr_tpu import obs
 from tmr_tpu.diagnostics import (
     ELASTIC_REPORT_SCHEMA,
     validate_elastic_report,
 )
+from tmr_tpu import obs
 from tmr_tpu.parallel.journal import (
     ShardJournal,
     StaleLeaseError,
     shard_stem,
+)
+from tmr_tpu.parallel.leases import (
+    Lease,
+    LeasePolicy,
+    LeaseService,
+    Resource,
+    connect_timeout,
+    oneshot,
+    recv_line,
+    send_line,
 )
 from tmr_tpu.utils import faults
 from tmr_tpu.utils.atomicio import atomic_write
 
 #: schema tag stamped on every lease record under ``_leases/``
 LEASE_SCHEMA = "lease/v1"
+
+# protocol helpers shared with the fleet client (parallel/leases.py);
+# the old private names stay importable
+_send_line = send_line
+_recv_line = recv_line
 
 
 def _env_float(name: str, default: float) -> float:
@@ -146,88 +171,35 @@ class ElasticPolicy:
         base.update(overrides)
         return cls(**base)
 
-
-# ------------------------------------------------------------ wire protocol
-def _send_line(sock: socket.socket, doc: dict) -> None:
-    sock.sendall((json.dumps(doc) + "\n").encode())
-
-
-def _recv_line(f) -> Optional[dict]:
-    line = f.readline()
-    if not line:
-        return None
-    return json.loads(line)
-
-
-def oneshot(address: Tuple[str, int], doc: dict,
-            timeout: float = 10.0) -> dict:
-    """One request/response on a fresh connection (heartbeats use this
-    so beats never interleave with the control channel)."""
-    with socket.create_connection(address, timeout=timeout) as sock:
-        _send_line(sock, doc)
-        with sock.makefile("rb") as f:
-            reply = _recv_line(f)
-    if reply is None:
-        raise ConnectionError("coordinator closed the connection")
-    return reply
+    def lease_policy(self) -> LeasePolicy:
+        """This policy in the generic LeaseService vocabulary."""
+        return LeasePolicy(
+            lease_ttl_s=self.lease_ttl_s,
+            hb_interval_s=self.hb_interval_s,
+            check_interval_s=self.check_interval_s,
+            straggler_factor=self.straggler_factor,
+            straggler_min_s=self.straggler_min_s,
+            straggler_min_done=self.straggler_min_done,
+            max_reassigns=self.max_reassigns,
+            poison_failures=self.poison_failures,
+            resource_fail_workers=self.shard_fail_workers,
+        )
 
 
 # --------------------------------------------------------- coordinator state
-class _Lease:
-    __slots__ = ("worker", "epoch", "granted_at", "expires_at", "hb")
+class _Shard(Resource):
+    """A map shard as a leasable resource: the generic lease fields plus
+    the map payload (path, category, the committed journal entry)."""
 
-    def __init__(self, worker: str, epoch: int, granted_at: float,
-                 ttl_s: float):
-        self.worker = worker
-        self.epoch = epoch
-        self.granted_at = granted_at
-        self.expires_at = granted_at + ttl_s
-        self.hb = 0
-
-
-class _Shard:
-    __slots__ = (
-        "index", "path", "category", "stem", "status", "next_epoch",
-        "leases", "assignments", "failures", "failed_workers", "entry",
-        "worker", "epoch", "straggled", "first_granted_at", "wall_s",
-        "images", "cleaned",
-    )
+    __slots__ = ("path", "category", "stem", "entry", "images")
 
     def __init__(self, index: int, path: str, category: int):
-        self.index = index
+        super().__init__(index, os.path.basename(path))
         self.path = path
         self.category = category
         self.stem = shard_stem(os.path.basename(path))
-        self.status = "pending"  # pending|leased|committed|resumed|quarantined
-        self.next_epoch = 1
-        self.leases: Dict[int, _Lease] = {}
-        self.assignments = 0
-        self.failures: List[dict] = []
-        self.failed_workers: set = set()
         self.entry: Optional[dict] = None
-        self.worker: Optional[str] = None
-        self.epoch: Optional[int] = None
-        self.straggled = False
-        self.first_granted_at: Optional[float] = None
-        self.wall_s = 0.0
         self.images = 0
-        self.cleaned = False
-
-    @property
-    def settled(self) -> bool:
-        return self.status in ("committed", "resumed", "quarantined")
-
-
-class _Worker:
-    __slots__ = ("wid", "committed", "failed", "drained", "dead", "bye")
-
-    def __init__(self, wid: str):
-        self.wid = wid
-        self.committed = 0
-        self.failed: set = set()
-        self.drained = False
-        self.dead = False
-        self.bye = False
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -271,8 +243,10 @@ class _Server(socketserver.ThreadingTCPServer):
 
 class ElasticCoordinator:
     """Owns the shard queue as epoch-fenced leases and serves the worker
-    protocol. All mutable run state lives behind ``self._lock``; socket
-    I/O and fault-point firing happen outside it."""
+    protocol. The lease/liveness state machine is a
+    :class:`~tmr_tpu.parallel.leases.LeaseService` (``self._svc``) —
+    all mutable run state lives behind ITS lock; socket I/O and
+    fault-point firing happen outside it."""
 
     def __init__(
         self,
@@ -316,33 +290,39 @@ class ElasticCoordinator:
                 "duplicate shard journal keys cannot be leased "
                 "unambiguously; rename the shards"
             )
-        self._pending: deque = deque()
-        self._workers: Dict[str, _Worker] = {}
-        self._reassignments: List[dict] = []
-        self._fenced: List[dict] = []
-        self._settled = 0
-        self._done_event = threading.Event()
-        self._stop_event = threading.Event()
+        self._svc = LeaseService(
+            self._shards, self.policy.lease_policy(),
+            metrics_prefix="elastic", noun="shard", key_field="shard",
+            on_transition=self._on_transition,
+        )
         self._server: Optional[_Server] = None
         self._server_thread: Optional[threading.Thread] = None
         self._monitor_thread: Optional[threading.Thread] = None
-        self._t0 = time.monotonic()
-        self._wall_s = 0.0
-        for shard in self._shards:
-            entry = self.journal.done(
-                os.path.basename(shard.path)
-            ) if resume else None
-            if entry is not None:
-                shard.status = "resumed"
-                shard.entry = entry
-                shard.worker = entry.get("worker")
-                shard.epoch = entry.get("epoch")
-                shard.images = int(entry.get("images", 0))
-                self._settled += 1
-            else:
-                self._pending.append(shard.index)
-        if self._settled == len(self._shards):
-            self._done_event.set()
+        self._stop_event = threading.Event()
+        if resume:
+            for shard in self._shards:
+                entry = self.journal.done(os.path.basename(shard.path))
+                if entry is not None:
+                    with self._svc.lock:
+                        shard.entry = entry
+                        shard.images = int(entry.get("images", 0))
+                        self._svc.mark_resumed(
+                            shard.index, worker=entry.get("worker"),
+                            epoch=entry.get("epoch"),
+                        )
+
+    def _on_transition(self, shard: _Shard, lease: Lease,
+                       state: str) -> None:
+        """LeaseService transition hook (fires under the service lock):
+        the durable lease record tracks held/revoked/committed/failed;
+        quarantine invalidates the journal marker — the feature-tree
+        removal is deferred to :meth:`_sweep_quarantined` (an rmtree
+        here would hold the protocol lock through disk I/O and stall
+        every worker's heartbeat)."""
+        if state == "quarantined":
+            self.journal.invalidate(os.path.basename(shard.path))
+            return
+        self._write_lease(shard, lease, state)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> Tuple[str, int]:
@@ -360,7 +340,9 @@ class ElasticCoordinator:
             self._server = server
             self._server_thread = server_thread
             self._monitor_thread = monitor_thread
-            self._t0 = time.monotonic()
+        # wall_s measures serving: the resume journal scan in __init__
+        # (and any caller delay before start) must not count
+        self._svc.restart_clock()
         server_thread.start()
         monitor_thread.start()
         return self.address
@@ -376,7 +358,7 @@ class ElasticCoordinator:
         quarantined); True when it happened within ``timeout``. A
         settled wait also runs the quarantine feature sweep, so disk
         reconciles with the table before the caller reads either."""
-        done = self._done_event.wait(timeout)
+        done = self._svc.done_event.wait(timeout)
         if done:
             self._sweep_quarantined()
         return done
@@ -412,87 +394,46 @@ class ElasticCoordinator:
         except Exception as e:  # protocol must answer, never wedge
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
-    def _worker_rec(self, wid: str) -> _Worker:
-        rec = self._workers.get(wid)
-        if rec is None:
-            rec = self._workers[wid] = _Worker(wid)
-        return rec
-
     def _op_hello(self, msg: dict) -> dict:
-        with self._lock:
-            self._worker_rec(str(msg.get("worker")))
-            return {
-                "ok": True,
-                "journal_dir": self.journal.directory,
-                "features_out": self.features_out,
-                "data_dir": self.data_dir,
-                "image_size": self.image_size,
-                "batch_size": self.batch_size,
-                "ttl_s": self.policy.lease_ttl_s,
-                "hb_interval_s": self.policy.hb_interval_s,
-                "shards": len(self._shards),
-            }
+        # a fresh hello clears a prior incarnation's departure flags
+        # (stable worker ids may reconnect); a drained worker stays
+        # drained
+        self._svc.rejoin(str(msg.get("worker")))
+        return {
+            "ok": True,
+            "journal_dir": self.journal.directory,
+            "features_out": self.features_out,
+            "data_dir": self.data_dir,
+            "image_size": self.image_size,
+            "batch_size": self.batch_size,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+            "shards": len(self._shards),
+        }
 
     def _op_lease(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         wait = {"shard": None,
                 "wait_s": max(self.policy.check_interval_s, 0.05)}
-        with self._lock:
-            worker = self._worker_rec(wid)
-            if worker.drained:
-                return {"shard": None, "drained": True}
-            if self._done_event.is_set():
-                return {"shard": None, "done": True}
-            # a worker is not handed back a shard it already failed —
-            # UNLESS it is the only non-drained live worker left (the
-            # reassignment bound then ends the ping-pong in quarantine).
-            # Departed workers (clean bye included) are NOT alive: a
-            # sole survivor skipping its failed shard forever would
-            # leave the run unsettleable.
-            others_alive = any(
-                w.wid != wid and not w.drained and not w.dead
-                and not w.bye
-                for w in self._workers.values()
-            )
-            shard = None
-            for _ in range(len(self._pending)):
-                idx = self._pending.popleft()
-                cand = self._shards[idx]
-                if cand.settled:
-                    continue  # a straggler dup whose original won
-                if wid in cand.failed_workers and others_alive:
-                    self._pending.append(idx)  # someone else's to retry
-                    continue
-                shard = cand
-                break
-            if shard is None:
-                return wait
-            epoch = shard.next_epoch
-            shard.next_epoch += 1
+        verdict, shard, epoch = self._svc.select(wid)
+        if verdict == "drained":
+            return {"shard": None, "drained": True}
+        if verdict == "done":
+            return {"shard": None, "done": True}
+        if verdict != "grant":
+            return wait
         # the lease fault point fires OUTSIDE the lock (latency specs
         # sleep here); an injected grant failure re-queues the shard
         try:
             with faults.shard_scope(shard.index, epoch):
                 faults.fire("lease")
         except Exception as e:
-            with self._lock:
-                if not shard.settled:
-                    self._pending.appendleft(shard.index)
+            self._svc.requeue(shard)
             wait = dict(wait)
             wait["error"] = f"{type(e).__name__}: {e}"
             return wait
-        now = time.monotonic()
-        with self._lock:
-            if shard.settled:  # committed while we were firing faults
-                return wait
-            lease = _Lease(wid, epoch, now, self.policy.lease_ttl_s)
-            shard.leases[epoch] = lease
-            shard.status = "leased"
-            shard.assignments += 1
-            if shard.first_granted_at is None:
-                shard.first_granted_at = now
-            self._write_lease(shard, lease, "held")
-            obs.get_registry().counter("elastic.leases_granted").inc()
+        if self._svc.install(shard, epoch, wid) is None:
+            return wait  # committed while we were firing faults
         return {
             "shard": shard.path,
             "index": shard.index,
@@ -504,36 +445,16 @@ class ElasticCoordinator:
     def _op_heartbeat(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
-        with self._lock:
-            lease = self._current_lease(index, epoch, wid)
-            if lease is None:
-                return {"ok": False, "cause": "stale_epoch"}
-            # expiry extension is memory-only: the durable lease record
-            # is advisory (rewritten on grant/revoke/commit/fail
-            # transitions) and a per-beat tmp+rename under the protocol
-            # lock would serialize every worker's beat on disk latency
-            lease.expires_at = time.monotonic() + self.policy.lease_ttl_s
-            lease.hb += 1
-            return {"ok": True}
-
-    def _current_lease(self, index: int, epoch: int,
-                       wid: str) -> Optional[_Lease]:
-        if not (0 <= index < len(self._shards)):
-            return None
-        shard = self._shards[index]
-        if shard.settled:
-            return None
-        lease = shard.leases.get(epoch)
-        if lease is None or lease.worker != wid:
-            return None
-        return lease
+        if not self._svc.heartbeat(wid, index, epoch):
+            return {"ok": False, "cause": "stale_epoch"}
+        return {"ok": True}
 
     def _op_precommit(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
-        with self._lock:
-            if self._current_lease(index, epoch, wid) is None:
-                self._record_fence(index, wid, epoch, "precommit")
+        with self._svc.lock:
+            if self._svc.current_lease(index, epoch, wid) is None:
+                self._svc.record_fence(index, wid, epoch, "precommit")
                 return {"ok": False, "cause": "stale_epoch"}
             return {"ok": True}
 
@@ -541,26 +462,15 @@ class ElasticCoordinator:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
         entry = msg.get("entry")
-        with self._lock:
-            lease = self._current_lease(index, epoch, wid)
-            if lease is None or not isinstance(entry, dict):
-                self._record_fence(index, wid, epoch, "commit")
+        with self._svc.lock:
+            if self._svc.current_lease(index, epoch, wid) is None \
+                    or not isinstance(entry, dict):
+                self._svc.record_fence(index, wid, epoch, "commit")
                 self._invalidate_stale_marker(index, epoch)
                 return {"ok": False, "cause": "stale_epoch"}
-            shard = self._shards[index]
-            shard.status = "committed"
+            shard, _lease = self._svc.commit(wid, index, epoch)
             shard.entry = entry
-            shard.worker = wid
-            shard.epoch = epoch
             shard.images = int(entry.get("images", 0))
-            shard.wall_s = time.monotonic() - (
-                shard.first_granted_at or lease.granted_at
-            )
-            self._write_lease(shard, lease, "committed")
-            shard.leases.clear()
-            self._worker_rec(wid).committed += 1
-            obs.get_registry().counter("elastic.shards_committed").inc()
-            self._settle_locked()
             return {"ok": True}
 
     def _invalidate_stale_marker(self, index: int, epoch: int) -> None:
@@ -598,97 +508,22 @@ class ElasticCoordinator:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
         causes = msg.get("causes") or []
-        with self._lock:
-            lease = self._current_lease(index, epoch, wid)
-            if lease is None:
-                return {"ok": True, "stale": True}
-            shard = self._shards[index]
-            shard.leases.pop(epoch, None)
-            shard.failures.append({"worker": wid, "causes": causes})
-            shard.failed_workers.add(wid)
-            worker = self._worker_rec(wid)
-            worker.failed.add(index)
-            self._write_lease(shard, lease, "failed")
-            self._reassign_locked(shard, lease, "poison_worker")
-            if len(worker.failed) >= self.policy.poison_failures \
-                    and not worker.drained:
-                worker.drained = True
-                obs.get_registry().counter("elastic.workers_drained").inc()
-                self._revoke_worker_locked(wid, "poison_worker")
-            return {"ok": True, "drained": worker.drained}
+        res = self._svc.fail(wid, index, epoch, causes)
+        if res["stale"]:
+            return {"ok": True, "stale": True}
+        return {"ok": True, "drained": res["drained"]}
 
     def _op_bye(self, msg: dict) -> dict:
-        wid = str(msg.get("worker"))
-        with self._lock:
-            self._worker_rec(wid).bye = True
-            return {"ok": True}
+        self._svc.bye(str(msg.get("worker")))
+        return {"ok": True}
 
     def control_closed(self, wid: str, clean: bool) -> None:
         """The worker's control connection ended. A dirty close (no
         ``bye``) with leases held is a crashed/killed worker — reassign
         everything it was running immediately."""
-        with self._lock:
-            worker = self._worker_rec(str(wid))
-            if clean or worker.bye:
-                return
-            worker.dead = True
-            self._revoke_worker_locked(str(wid), "worker_exit")
+        self._svc.control_closed(str(wid), clean)
 
     # ------------------------------------------------------------- liveness
-    def _record_fence(self, index: int, wid: str, epoch: int,
-                      op: str) -> None:
-        shard_name = (
-            os.path.basename(self._shards[index].path)
-            if 0 <= index < len(self._shards) else f"#{index}"
-        )
-        self._fenced.append({
-            "shard": shard_name, "index": index, "worker": wid,
-            "epoch": epoch, "op": op,
-        })
-        obs.get_registry().counter("elastic.fenced_rejections").inc()
-
-    def _reassign_locked(self, shard: _Shard, lease: _Lease,
-                         cause: str) -> None:
-        """Record one reassignment and put the shard back in play (or
-        quarantine it once it has bounced past the policy bound)."""
-        self._reassignments.append({
-            "shard": os.path.basename(shard.path), "index": shard.index,
-            "worker": lease.worker, "epoch": lease.epoch, "cause": cause,
-        })
-        obs.get_registry().counter("elastic.reassignments").inc()
-        if shard.settled:
-            return
-        exhausted = (
-            len(self._reassignments_for(shard.index))
-            > self.policy.max_reassigns
-            or len(shard.failed_workers) >= self.policy.shard_fail_workers
-        )
-        if exhausted and not shard.leases:
-            shard.status = "quarantined"
-            obs.get_registry().counter("elastic.shards_quarantined").inc()
-            self.journal.invalidate(os.path.basename(shard.path))
-            # feature-tree removal is deferred to _sweep_quarantined —
-            # an rmtree here would hold the protocol lock through disk
-            # I/O and stall every worker's heartbeat
-            self._settle_locked()
-            return
-        if not shard.leases:
-            shard.status = "pending"
-        if shard.index not in self._pending and not exhausted:
-            self._pending.appendleft(shard.index)
-
-    def _reassignments_for(self, index: int) -> List[dict]:
-        return [r for r in self._reassignments if r["index"] == index]
-
-    def _revoke_worker_locked(self, wid: str, cause: str) -> None:
-        for shard in self._shards:
-            for epoch, lease in list(shard.leases.items()):
-                if lease.worker == wid:
-                    shard.leases.pop(epoch, None)
-                    shard.next_epoch = max(shard.next_epoch, epoch + 1)
-                    self._write_lease(shard, lease, "revoked")
-                    self._reassign_locked(shard, lease, cause)
-
     def _sweep_quarantined(self) -> None:
         """Remove quarantined shards' feature files — the coordinator is
         the ONLY party allowed to do this (workers cannot tell their own
@@ -698,13 +533,7 @@ class ElasticCoordinator:
         settle. Best-effort: feature writes are idempotent but unfenced,
         so a paused writer resuming after the sweep can recreate files —
         the journal fence keeps the TABLE exact regardless."""
-        with self._lock:
-            targets = [
-                s for s in self._shards
-                if s.status == "quarantined" and not s.cleaned
-            ]
-            for shard in targets:
-                shard.cleaned = True
+        targets = self._svc.take_cleanup_targets()
         if not targets:
             return
         _save, cleanup, _sync = make_feature_sinks(self.features_out)
@@ -716,82 +545,29 @@ class ElasticCoordinator:
             except Exception:
                 pass
 
-    def _settle_locked(self) -> None:
-        self._settled = sum(1 for s in self._shards if s.settled)
-        if self._settled == len(self._shards):
-            self._wall_s = time.monotonic() - self._t0
-            self._done_event.set()
-
     def _monitor_loop(self) -> None:
         while not self._stop_event.wait(self.policy.check_interval_s):
-            if not self._done_event.is_set():
+            if not self._svc.done_event.is_set():
                 self._monitor_pass()
             self._sweep_quarantined()  # outside the protocol lock
 
     def _monitor_pass(self) -> None:
-        now = time.monotonic()
-        steal_candidate = None
-        with self._lock:
-            for shard in self._shards:
-                for epoch, lease in list(shard.leases.items()):
-                    if now > lease.expires_at:
-                        shard.leases.pop(epoch, None)
-                        self._write_lease(shard, lease, "revoked")
-                        self._reassign_locked(shard, lease,
-                                              "stale_heartbeat")
-            steal_candidate = self._elect_straggler_locked(now)
-        if steal_candidate is None:
+        self._svc.expire_pass()
+        candidate = self._svc.elect_straggler()
+        if candidate is None:
             return
-        shard, lease = steal_candidate
+        shard, lease = candidate
         try:
             # speculative duplicate election — its own fault point,
             # fired outside the lock (latency specs sleep)
             with faults.shard_scope(shard.index, lease.epoch):
                 faults.fire("steal")
         except Exception:
-            with self._lock:
-                shard.straggled = False  # election vetoed; retry later
+            self._svc.veto_steal(shard)
             return
-        with self._lock:
-            if shard.settled or not shard.leases:
-                return
-            self._reassignments.append({
-                "shard": os.path.basename(shard.path),
-                "index": shard.index, "worker": lease.worker,
-                "epoch": lease.epoch, "cause": "straggler",
-            })
-            obs.get_registry().counter("elastic.reassignments").inc()
-            obs.get_registry().counter("elastic.stragglers").inc()
-            if shard.index not in self._pending:
-                self._pending.appendleft(shard.index)
+        self._svc.confirm_steal(shard, lease)
 
-    def _elect_straggler_locked(
-        self, now: float
-    ) -> Optional[Tuple[_Shard, _Lease]]:
-        if self.policy.straggler_factor <= 0:
-            return None
-        walls = sorted(
-            s.wall_s for s in self._shards
-            if s.status == "committed" and s.wall_s > 0
-        )
-        if len(walls) < max(self.policy.straggler_min_done, 1):
-            return None
-        n = len(walls)
-        median = walls[n // 2] if n % 2 else 0.5 * (
-            walls[n // 2 - 1] + walls[n // 2]
-        )
-        bound = max(self.policy.straggler_min_s,
-                    self.policy.straggler_factor * median)
-        for shard in self._shards:
-            if shard.settled or shard.straggled or len(shard.leases) != 1:
-                continue
-            (lease,) = shard.leases.values()
-            if now - lease.granted_at > bound:
-                shard.straggled = True
-                return shard, lease
-        return None
-
-    def _write_lease(self, shard: _Shard, lease: _Lease,
+    def _write_lease(self, shard: _Shard, lease: Lease,
                      state: str) -> None:
         """The durable lease record (atomic, not fsynced — on a
         coordinator crash the journal is the source of truth; leases
@@ -821,7 +597,7 @@ class ElasticCoordinator:
         from tmr_tpu.parallel.mapreduce import StatAccumulator
 
         acc = StatAccumulator()
-        with self._lock:
+        with self._svc.lock:
             for shard in self._shards:
                 if shard.entry is not None and shard.status in (
                     "committed", "resumed"
@@ -832,12 +608,12 @@ class ElasticCoordinator:
     def state(self) -> dict:
         """Mid-run introspection for probes/tests (NOT the report): held
         leases, live tallies, settled counts."""
-        with self._lock:
+        with self._svc.lock:
             return {
                 "ok": True,
-                "settled": self._settled,
+                "settled": self._svc.settled_count,
                 "shards": len(self._shards),
-                "pending": list(self._pending),
+                "pending": self._svc.pending_snapshot(),
                 "leases": {
                     shard.index: [
                         {"worker": l.worker, "epoch": l.epoch, "hb": l.hb}
@@ -849,13 +625,14 @@ class ElasticCoordinator:
                     os.path.basename(s.path): s.status
                     for s in self._shards
                 },
-                "reassignments": [dict(r) for r in self._reassignments],
-                "fenced_rejections": [dict(r) for r in self._fenced],
+                "reassignments": [dict(r)
+                                  for r in self._svc.reassignments],
+                "fenced_rejections": [dict(r) for r in self._svc.fenced],
                 "workers": {
                     w.wid: {"committed": w.committed,
                             "failed": sorted(w.failed),
                             "drained": w.drained, "dead": w.dead}
-                    for w in self._workers.values()
+                    for w in self._svc.workers.values()
                 },
             }
 
@@ -863,7 +640,7 @@ class ElasticCoordinator:
         """The final ``elastic_report/v1`` document (call after
         :meth:`wait`; diagnostics.validate_elastic_report checks it,
         including the exact totals reconciliation)."""
-        with self._lock:
+        with self._svc.lock:
             shards = [{
                 "index": s.index,
                 "shard": os.path.basename(s.path),
@@ -882,7 +659,7 @@ class ElasticCoordinator:
                     "failed_shards": sorted(w.failed),
                     "drained": w.drained,
                     "dead": w.dead,
-                } for w in self._workers.values()
+                } for w in self._svc.workers.values()
             }
             totals = {
                 "shards": len(self._shards),
@@ -895,22 +672,21 @@ class ElasticCoordinator:
                 "quarantined": sum(
                     1 for s in self._shards if s.status == "quarantined"
                 ),
-                "reassignments": len(self._reassignments),
-                "fenced_rejections": len(self._fenced),
-                "workers": len(self._workers),
+                "reassignments": len(self._svc.reassignments),
+                "fenced_rejections": len(self._svc.fenced),
+                "workers": len(self._svc.workers),
                 "drained_workers": sum(
-                    1 for w in self._workers.values() if w.drained
+                    1 for w in self._svc.workers.values() if w.drained
                 ),
-                "wall_s": round(
-                    self._wall_s or (time.monotonic() - self._t0), 6
-                ),
+                "wall_s": round(self._svc.run_wall_s(), 6),
             }
             doc = {
                 "schema": ELASTIC_REPORT_SCHEMA,
                 "shards": shards,
                 "workers": workers,
-                "reassignments": [dict(r) for r in self._reassignments],
-                "fenced_rejections": [dict(r) for r in self._fenced],
+                "reassignments": [dict(r)
+                                  for r in self._svc.reassignments],
+                "fenced_rejections": [dict(r) for r in self._svc.fenced],
                 "quarantined": [
                     os.path.basename(s.path) for s in self._shards
                     if s.status == "quarantined"
@@ -945,15 +721,20 @@ class WorkerClient:
     """The worker side of the protocol: one persistent control
     connection for lease/commit/fail (serial request/response) plus
     fresh one-shot connections for heartbeats. Thread-safe — the lock
-    serializes the control socket."""
+    serializes the control socket. The DIAL is bounded by
+    ``TMR_ELASTIC_CONNECT_TIMEOUT_S`` (leases.connect_timeout) so a
+    black-holed coordinator address fails fast; ``timeout`` bounds each
+    exchange once connected."""
 
     def __init__(self, address: Tuple[str, int], worker_id: str,
                  timeout: float = 30.0):
         self.address = (address[0], int(address[1]))
         self.worker_id = worker_id
         self._lock = threading.Lock()
-        self._sock = socket.create_connection(self.address,
-                                              timeout=timeout)
+        self._sock = socket.create_connection(
+            self.address, timeout=connect_timeout(min(timeout, 5.0))
+        )
+        self._sock.settimeout(timeout)
         self._file = self._sock.makefile("rb")
         self.config = self._call({"op": "hello"})
 

@@ -16,6 +16,13 @@ from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
 from tmr_tpu.serve.degrade import DEGRADE_STEPS, DegradeController
 from tmr_tpu.serve.engine import ServeEngine
+from tmr_tpu.serve.fleet import (
+    FleetWorker,
+    ServeFleet,
+    StubFleetPredictor,
+    stub_engine,
+    stub_signature,
+)
 from tmr_tpu.serve.meshplan import MeshPlan, MeshTarget, resolve_plan
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
@@ -24,6 +31,7 @@ __all__ = [
     "DEGRADE_STEPS",
     "DegradeController",
     "DeviceStager",
+    "FleetWorker",
     "LRUCache",
     "MeshPlan",
     "MeshTarget",
@@ -32,8 +40,12 @@ __all__ = [
     "RejectedError",
     "Request",
     "ServeEngine",
+    "ServeFleet",
     "StagedBatch",
+    "StubFleetPredictor",
     "array_digest",
     "class_weight_fn",
     "resolve_plan",
+    "stub_engine",
+    "stub_signature",
 ]

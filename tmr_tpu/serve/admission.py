@@ -29,6 +29,7 @@ offered load).
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -41,13 +42,17 @@ from typing import Dict, List, Optional, Sequence
 #: tripped, "rate_limited" = the token bucket ran dry, "deadline" = the
 #: request's deadline expired before a pipeline stage would have spent
 #: device time on it (shed), "shutdown" = the engine closed before the
-#: request could be served (bounded-drain rejection).
+#: request could be served (bounded-drain rejection), "worker_lost" =
+#: the fleet front door (serve/fleet.py) exhausted its resubmission
+#: bound after the request's serve worker died/was fenced — terminal,
+#: never silently retried past the bound.
 REJECTION_CAUSES = (
     "queue_full",
     "class_limit",
     "rate_limited",
     "deadline",
     "shutdown",
+    "worker_lost",
 )
 
 
@@ -213,14 +218,18 @@ class AdmissionController:
 
     def _drain_rate_unlocked(self) -> float:
         """Measured drain rate: the attached engine source (summed
-        per-replica-group rates under a mesh plan) when it yields a
-        positive number, else releases per second over the recent
-        release window (0.0 when fewer than two releases have ever been
-        observed)."""
+        per-replica-group rates under a mesh plan, the fleet's summed
+        per-worker beats) when it yields a positive FINITE number, else
+        releases per second over the recent release window (0.0 when
+        fewer than two releases have ever been observed). A source that
+        raises, returns 0/negative/non-finite, or has gone stale (the
+        engine/fleet side reports 0 once its completion window ages
+        out) therefore always falls back to the window estimate —
+        pinned by tests/test_overload.py."""
         if self._drain_source is not None:
             try:
                 rate = float(self._drain_source())
-                if rate > 0:
+                if rate > 0 and math.isfinite(rate):
                     return rate
             except Exception:
                 pass  # a broken source falls back to the window

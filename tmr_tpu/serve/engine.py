@@ -395,13 +395,24 @@ class ServeEngine:
             for _ in range(max(int(n), 1)):
                 win.append(now)
 
+    #: a drain window whose NEWEST completion is older than this reads
+    #: as rate 0.0: an idle group must not keep advertising its historic
+    #: rate forever, or the admission controller's retry_after hints
+    #: would be computed from capacity that no longer drains anything —
+    #: a zero from a stale source makes the controller fall back to its
+    #: own release-window estimate (the documented PR 12 fallback, now
+    #: pinned by tests/test_overload.py)
+    _DRAIN_STALE_S = 60.0
+
     def drain_snapshot(self) -> Dict[str, float]:
         """Measured per-replica-group drain rate (requests/s over each
-        group's recent completion window)."""
+        group's recent completion window; 0.0 once the window goes
+        stale — see ``_DRAIN_STALE_S``)."""
         out: Dict[str, float] = {}
+        now = time.monotonic()
         with self._drain_lock:
             for g, win in self._drain.items():
-                if len(win) < 2:
+                if len(win) < 2 or now - win[-1] > self._DRAIN_STALE_S:
                     out[g] = 0.0
                     continue
                 span = win[-1] - win[0]

@@ -1,0 +1,1618 @@
+"""Elastic serve fleet: serve workers lease traffic partitions from a
+generic lease service, and a front door routes requests to the current
+holder with exactly-once result accounting.
+
+PR 10 made the MAP phase elastic; serving — the millions-of-users path
+in the ROADMAP north star — still died with its single process. This
+module puts serving on the same lease discipline
+(:class:`~tmr_tpu.parallel.leases.LeaseService`, the PR 10 coordinator's
+state machine extracted):
+
+- **traffic partitions** (image-size bucket × priority class) are the
+  leased resources. Each :class:`FleetWorker` wraps a full serve engine
+  (a real mesh-aware ``ServeEngine`` in production, the numpy
+  :func:`stub_predictor` in drills), joins the fleet over the same
+  JSON-lines control protocol the map workers use, leases partitions,
+  and heartbeats them — one ``beat`` op carries every held lease plus
+  the worker's measured drain rate and queue depth;
+- the **front door** (:class:`ServeFleet`) owns submit: requests route
+  to their partition's current lease holder over a per-worker data
+  connection (``fleet.route`` fault point). A partition with no holder
+  parks its requests; the grant flushes them;
+- **exactly-once accounting**: every result commits at the front door
+  (``fleet.commit`` fault point) against the in-flight registry AND the
+  partition's CURRENT epoch — a revoked holder's late result is fenced
+  (counted ``fenced_results``), a result for an already-terminal
+  request counted ``late_results``, and a request id can never resolve
+  twice (``double_served`` is the structural-zero witness). The
+  reconciliation ``offered == completed + rejected + shed + errors`` is
+  EXACT, engine-side and probe-side (the LeasedJournal discipline
+  applied to serving);
+- **death rebalance**: a worker kill -9 drops its control connection →
+  its partitions reassign under epoch+1 (``worker_exit``; a SIGSTOP
+  past the TTL reassigns as ``stale_heartbeat``) and their in-flight
+  requests are RE-SUBMITTED to the new holder — or terminally rejected
+  with structured cause ``worker_lost`` past ``TMR_FLEET_MAX_RESUBMITS``
+  — never double-served, never silently dropped;
+- **cluster-wide admission**: the front door's
+  :class:`~tmr_tpu.serve.admission.AdmissionController` consumes the
+  fleet's summed per-worker drain rates through
+  ``attach_drain_source`` — ``retry_after_s`` reflects FLEET capacity,
+  and beats that go stale stop counting (the controller falls back to
+  its release window);
+- **recruitment before degradation**: sustained queue saturation across
+  the fleet asks the ``spawner`` for a new worker (``fleet.recruit``
+  fault point) BEFORE the degrade ladder sees an anomaly — scale-out is
+  the first response to load, result-shrinking the last (only when the
+  fleet is already at ``TMR_FLEET_MAX_WORKERS`` does saturation reach
+  the :class:`~tmr_tpu.serve.degrade.DegradeController`). A new worker
+  joining an all-leased fleet triggers a ``scale_out`` rebalance so it
+  actually absorbs load.
+
+Proof: ``scripts/elastic_serve_probe.py`` (kill -9 mid-batch, SIGSTOP
+past the TTL into a fenced late result, a recruitment round absorbing a
+3× spike with the ladder at level 0) emits one validated
+``elastic_serve_report/v1`` and rides tier-1 as a lean smoke.
+
+Env knobs (lazily read; registered in config.ENV_KNOBS): the
+``TMR_ELASTIC_*`` lease-liveness family (shared with the map client)
+plus ``TMR_FLEET_SATURATION_PENDING``, ``TMR_FLEET_RECRUIT_PASSES``,
+``TMR_FLEET_RECRUIT_GRACE``, ``TMR_FLEET_MAX_WORKERS``,
+``TMR_FLEET_MAX_RESUBMITS``, ``TMR_FLEET_CHECK_S``.
+"""
+
+from __future__ import annotations
+
+import base64
+import math
+import os
+import socket
+import socketserver
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tmr_tpu import obs
+from tmr_tpu.parallel.leases import (
+    LeasePolicy,
+    LeaseService,
+    Resource,
+    connect_timeout,
+    oneshot,
+    recv_line,
+    send_line,
+)
+from tmr_tpu.serve.admission import AdmissionController, RejectedError
+from tmr_tpu.serve.degrade import DegradeController
+from tmr_tpu.utils import faults
+
+#: detection fields the data plane ships (mirrors engine._DET_FIELDS +
+#: the device tail's optional count vector)
+_DET_FIELDS = ("boxes", "scores", "refs", "valid", "count")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ----------------------------------------------------------- wire helpers
+def pack_array(a) -> dict:
+    arr = np.ascontiguousarray(np.asarray(a))
+    return {
+        "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+    }
+
+
+def unpack_array(doc: dict) -> np.ndarray:
+    raw = base64.b64decode(doc["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(doc["dtype"])).reshape(
+        doc["shape"]
+    ).copy()
+
+
+def pack_detections(dets: dict) -> dict:
+    return {
+        name: pack_array(dets[name]) for name in _DET_FIELDS
+        if name in dets
+    }
+
+
+def unpack_detections(doc: dict) -> dict:
+    return {name: unpack_array(rec) for name, rec in doc.items()}
+
+
+# ------------------------------------------------------------- partitions
+class FleetPartition(Resource):
+    """One traffic partition: an image-size bucket × a priority class.
+    Leased for the lifetime of its holder (never settles)."""
+
+    __slots__ = ("size", "klass")
+
+    def __init__(self, index: int, size: int, klass: int):
+        super().__init__(index, f"s{size}c{klass}")
+        self.size = int(size)
+        self.klass = int(klass)
+
+
+def fleet_policy(policy: Optional[LeasePolicy] = None) -> LeasePolicy:
+    """The fleet's lease policy: the shared TMR_ELASTIC_* liveness
+    knobs, with straggler speculation OFF (a long-held partition is
+    normal, not a straggler) and the reassignment bound effectively
+    unbounded (partitions legitimately move many times over a fleet's
+    life — quarantining one would blackhole its traffic)."""
+    if policy is not None:
+        return policy
+    return LeasePolicy.from_env(
+        straggler_factor=0.0,
+        max_reassigns=1_000_000_000,
+        resource_fail_workers=1_000_000_000,
+    )
+
+
+# ------------------------------------------------------------ fleet server
+class _FleetHandler(socketserver.StreamRequestHandler):
+    """Control-plane handler (the elastic _Handler shape): JSON lines
+    in/out; EOF on a worker's control channel with leases held is the
+    kill -9 signature."""
+
+    def handle(self):  # noqa: D102 — protocol loop
+        fleet = self.server.fleet  # type: ignore[attr-defined]
+        control_worker = None
+        clean = False
+        try:
+            while True:
+                try:
+                    msg = recv_line(self.rfile)
+                except (OSError, ValueError):
+                    break
+                if msg is None:
+                    break
+                if msg.get("op") == "hello":
+                    control_worker = msg.get("worker")
+                if msg.get("op") == "bye":
+                    clean = True
+                reply = fleet.dispatch(msg)
+                try:
+                    send_line(self.connection, reply)
+                except OSError:
+                    break
+                if clean:
+                    break
+        finally:
+            if control_worker is not None:
+                fleet.control_closed(control_worker, clean=clean)
+
+
+class _FleetServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _Inflight:
+    """One routed request's front-door state."""
+
+    __slots__ = ("rid", "fut", "partition", "epoch", "payload",
+                 "priority", "attempts", "t_submit", "deadline")
+
+    def __init__(self, rid: str, fut: Future, partition: int,
+                 payload: dict, priority: int,
+                 deadline: Optional[float]):
+        self.rid = rid
+        self.fut = fut
+        self.partition = partition
+        self.epoch: Optional[int] = None  # set when routed
+        self.payload = payload
+        self.priority = priority
+        self.attempts = 0
+        self.t_submit = time.monotonic()
+        self.deadline = deadline
+
+
+class _WorkerLink:
+    """One data-plane connection from the front door to a worker. The
+    send lock serializes writers (router thread + flush paths); the
+    fleet owns one reader thread per link."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self.address = (address[0], int(address[1]))
+        self.sock = socket.create_connection(
+            self.address, timeout=connect_timeout(5.0)
+        )
+        self.sock.settimeout(None)  # reader blocks until EOF/close
+        self.file = self.sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self.dead = False
+
+    def send(self, doc: dict) -> bool:
+        with self._wlock:
+            if self.dead:
+                return False
+            try:
+                send_line(self.sock, doc)
+                return True
+            except OSError:
+                self.dead = True
+                return False
+
+    def close(self) -> None:
+        with self._wlock:
+            self.dead = True
+        # shutdown FIRST: the reader thread is blocked inside this
+        # file's buffered readinto holding its internal lock — closing
+        # the file object from here would deadlock on that lock, while
+        # a socket shutdown unblocks the read with EOF and lets the
+        # reader run the file down itself
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ServeFleet:
+    """The fleet front door + partition-lease coordinator in one
+    process: workers join over the control socket; callers submit here.
+
+    Lock order (outermost first): ``self._svc.lock`` →
+    ``self._lock`` → ``self._events_cond`` — never take an earlier lock
+    while holding a later one. Socket I/O happens under NO fleet lock
+    (links have their own send locks)."""
+
+    def __init__(self, sizes: Sequence[int], *, classes: int = 1,
+                 policy: Optional[LeasePolicy] = None,
+                 admission: Optional[AdmissionController] = None,
+                 degrade: Optional[DegradeController] = None,
+                 spawner: Optional[Callable[[int], Any]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_resubmits: Optional[int] = None,
+                 saturation_pending: Optional[int] = None,
+                 recruit_passes: Optional[int] = None,
+                 recruit_grace: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 check_interval_s: Optional[float] = None):
+        self.sizes = sorted({int(s) for s in sizes})
+        if not self.sizes:
+            raise ValueError("a fleet needs at least one size bucket")
+        self.classes = max(int(classes), 1)
+        partitions = [
+            FleetPartition(i, size, klass)
+            for i, (size, klass) in enumerate(
+                (s, c) for s in self.sizes for c in range(self.classes)
+            )
+        ]
+        self.policy = fleet_policy(policy)
+        self._svc = LeaseService(
+            partitions, self.policy,
+            metrics_prefix="fleet", noun="partition",
+            key_field="partition", on_transition=self._on_transition,
+            history_bound=4096,  # indefinite serving: a flapping
+            # worker must not grow the event history forever
+        )
+        self._partitions = partitions
+        #: cluster-wide admission: the fleet's summed per-worker drain
+        #: rate is the capacity signal behind every retry_after hint
+        self._admission = AdmissionController() if admission is None \
+            else admission
+        self._admission.attach_drain_source(self._drain_total)
+        #: fleet-level degrade ladder: sees saturation anomalies ONLY
+        #: when recruitment cannot absorb the load (the scale-out-first
+        #: contract)
+        self._degrade = DegradeController() if degrade is None \
+            else degrade
+        self._spawner = spawner
+        self._host, self._port = host, int(port)
+        self._lock = threading.RLock()
+        self._inflight: Dict[str, _Inflight] = {}
+        self._parked: Dict[int, deque] = {
+            p.index: deque() for p in partitions
+        }
+        #: the partition's CURRENT routable epoch (None while unheld) —
+        #: the result-commit fence compares against THIS, so a revoked
+        #: holder's late result can never commit
+        self._partition_epoch: Dict[int, Optional[int]] = {
+            p.index: None for p in partitions
+        }
+        self._counters: Dict[str, int] = {
+            k: 0 for k in (
+                "offered", "completed", "rejected", "shed", "errors",
+                "resubmitted", "fenced_results", "late_results",
+                "double_served", "commit_faults",
+            )
+        }
+        self._reject_causes: Dict[str, int] = {}
+        self._worker_addr: Dict[str, Tuple[str, int]] = {}
+        self._worker_beat: Dict[str, Tuple[float, float, int]] = {}
+        self._links: Dict[str, _WorkerLink] = {}
+        self._revoked_at: Dict[int, float] = {}
+        self._rebalance_lat: deque = deque(maxlen=256)
+        self._events: deque = deque()
+        self._events_cond = threading.Condition()
+        self._recruit = {"rounds": 0, "spawned": 0,
+                         "saturated_passes": 0, "grace": 0}
+        self._degrade_max_seen = 0
+        self._rid_seq = 0
+        self._closed = False
+        self._stop_event = threading.Event()
+        self._server: Optional[_FleetServer] = None
+        self._threads: List[threading.Thread] = []
+        self._t0 = time.monotonic()
+        self._max_resubmits = (
+            _env_int("TMR_FLEET_MAX_RESUBMITS", 2)
+            if max_resubmits is None else int(max_resubmits)
+        )
+        self._saturation_pending = (
+            _env_int("TMR_FLEET_SATURATION_PENDING", 16)
+            if saturation_pending is None else int(saturation_pending)
+        )
+        self._recruit_passes = max(
+            _env_int("TMR_FLEET_RECRUIT_PASSES", 2)
+            if recruit_passes is None else int(recruit_passes), 1,
+        )
+        self._recruit_grace = max(
+            _env_int("TMR_FLEET_RECRUIT_GRACE", 10)
+            if recruit_grace is None else int(recruit_grace), 0,
+        )
+        self._max_workers = max(
+            _env_int("TMR_FLEET_MAX_WORKERS", 4)
+            if max_workers is None else int(max_workers), 1,
+        )
+        self._check_s = (
+            _env_float("TMR_FLEET_CHECK_S",
+                       self.policy.check_interval_s)
+            if check_interval_s is None else float(check_interval_s)
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> Tuple[str, int]:
+        server = _FleetServer((self._host, self._port), _FleetHandler)
+        server.fleet = self  # type: ignore[attr-defined]
+        threads = [
+            threading.Thread(target=server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             name="fleet-control", daemon=True),
+            threading.Thread(target=self._router_loop,
+                             name="fleet-router", daemon=True),
+            threading.Thread(target=self._monitor_loop,
+                             name="fleet-monitor", daemon=True),
+        ]
+        with self._lock:
+            self._server = server
+            self._threads = threads
+        self._svc.restart_clock()
+        for t in threads:
+            t.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        with self._lock:
+            assert self._server is not None, "fleet not started"
+            return self._server.server_address[:2]
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting, terminally reject everything still in
+        flight (structured ``shutdown`` sheds — the bounded-drain
+        discipline), and tear down threads/links."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            leftovers = list(self._inflight.values())
+            self._inflight.clear()
+            for dq in self._parked.values():
+                dq.clear()
+        for rec in leftovers:
+            self._record_cause("shutdown")
+            self._terminal(rec, "shed", RejectedError(
+                "shutdown", "fleet closed with the request unserved",
+                priority=rec.priority,
+            ), already_removed=True)
+        self._stop_event.set()
+        with self._events_cond:
+            self._events_cond.notify_all()
+        with self._lock:
+            server = self._server
+            links = list(self._links.values())
+            threads = list(self._threads)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        for link in links:
+            link.close()
+        deadline = time.monotonic() + max(timeout, 0.0)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+
+    def __enter__(self) -> "ServeFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- routing
+    def partition_index(self, size: int, priority: int) -> int:
+        """The partition a (image size, priority class) pair routes to:
+        exact size bucket (else the smallest bucket that fits, else the
+        largest), class capped at the fleet's class count."""
+        klass = min(max(int(priority), 0), self.classes - 1)
+        if size in self.sizes:
+            s_idx = self.sizes.index(size)
+        else:
+            fits = [i for i, s in enumerate(self.sizes) if s >= size]
+            s_idx = fits[0] if fits else len(self.sizes) - 1
+        return s_idx * self.classes + klass
+
+    def submit(self, image, exemplars, multi: bool = False,
+               k_real: Optional[int] = None, priority: int = 0,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the
+        detections dict (numpy). Admission applies HERE — cluster-wide:
+        a bounced future carries a structured RejectedError whose
+        retry_after reflects the fleet's summed drain rate."""
+        fut: Future = Future()
+        if self._closed:
+            fut.set_exception(RuntimeError("fleet is closed"))
+            return fut
+        rej = self._admission.try_admit(priority)
+        if rej is not None:
+            self._record_cause(rej.cause)
+            with self._lock:
+                self._counters["offered"] += 1
+                self._counters["rejected"] += 1
+            fut.set_exception(rej)
+            return fut
+        try:
+            image = np.asarray(image, np.float32)
+            if image.ndim == 4 and image.shape[0] == 1:
+                image = image[0]
+            if image.ndim != 3 or image.shape[0] != image.shape[1] \
+                    or image.shape[2] != 3:
+                raise ValueError(
+                    f"expected one square (S, S, 3) image, got "
+                    f"{image.shape}"
+                )
+            ex = np.asarray(exemplars, np.float32).reshape(-1, 4)
+            payload = {
+                "op": "serve",
+                "image": pack_array(image),
+                "exemplars": pack_array(ex),
+                "multi": bool(multi),
+                "k_real": None if k_real is None else int(k_real),
+                "priority": max(int(priority), 0),
+                "deadline_ms": (None if deadline_ms is None
+                                else float(deadline_ms)),
+            }
+        except Exception as e:  # isolation: reject this request alone
+            self._admission.release_class(priority)
+            with self._lock:
+                self._counters["offered"] += 1
+                self._counters["errors"] += 1
+            fut.set_exception(e)
+            return fut
+        index = self.partition_index(int(image.shape[0]), priority)
+        with self._lock:
+            # authoritative closed check INSIDE the lock: a submit
+            # racing close() must never enter the registry after the
+            # drain emptied it (its future would hang forever)
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._rid_seq += 1
+                rid = f"r{self._rid_seq}"
+                payload["rid"] = rid
+                rec = _Inflight(
+                    rid, fut, index, payload, max(int(priority), 0),
+                    None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1000.0,
+                )
+                self._counters["offered"] += 1
+                self._inflight[rid] = rec
+        if closed:
+            self._admission.release_class(priority)
+            fut.set_exception(RuntimeError("fleet is closed"))
+            return fut
+        self._push_event(("route", rid))
+        return fut
+
+    def predict(self, image, exemplars, **kw) -> dict:
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(image, exemplars, **kw).result()
+
+    def _push_event(self, event: tuple) -> None:
+        with self._events_cond:
+            self._events.append(event)
+            self._events_cond.notify()
+
+    def _router_loop(self) -> None:
+        while True:
+            with self._events_cond:
+                while not self._events and not self._stop_event.is_set():
+                    self._events_cond.wait(timeout=0.5)
+                if self._stop_event.is_set() and not self._events:
+                    return
+                event = self._events.popleft()
+            try:
+                self._handle_event(event)
+            except Exception:
+                # the router must survive anything: a request it could
+                # not place stays parked for the next pass
+                pass
+
+    def _handle_event(self, event: tuple) -> None:
+        kind = event[0]
+        if kind == "route":
+            self._route_one(event[1])
+        elif kind == "granted":
+            self._flush_partition(event[1])
+        elif kind == "revoked":
+            self._resubmit_partition(event[1], event[2])
+
+    def _route_one(self, rid: str) -> None:
+        """Send one in-flight request to its partition's current lease
+        holder — or park it until a holder exists."""
+        with self._lock:
+            rec = self._inflight.get(rid)
+        if rec is None:
+            return
+        holder = self._svc.holder(rec.partition)
+        if holder is None:
+            with self._lock:
+                if rid in self._inflight:
+                    self._parked[rec.partition].append(rid)
+            return
+        wid, epoch = holder
+        link = self._link_for(wid)
+        if link is None:
+            with self._lock:
+                if rid in self._inflight:
+                    self._parked[rec.partition].append(rid)
+            return
+        try:
+            # the route fault point (scope: partition index, epoch)
+            # fires OUTSIDE every fleet lock — injected latency stalls
+            # one routing decision, not the fleet
+            with faults.shard_scope(rec.partition, epoch):
+                faults.fire("fleet.route")
+        except Exception:
+            self._fail_attempt(rec, f"injected route fault for {rid}")
+            return
+        with self._lock:
+            if rid not in self._inflight:
+                return
+            rec.epoch = epoch
+            doc = dict(rec.payload)
+            doc["partition"] = rec.partition
+            doc["epoch"] = epoch
+        if not link.send(doc):
+            self._fail_attempt(rec, f"send to worker {wid!r} failed")
+
+    def _fail_attempt(self, rec: _Inflight, message: str) -> None:
+        """One routing/serving attempt failed. Bounded: past
+        ``max_resubmits`` the request terminally rejects with cause
+        ``worker_lost`` (never an unbounded silent retry loop)."""
+        with self._lock:
+            if rec.rid not in self._inflight:
+                return
+            rec.attempts += 1
+            rec.epoch = None
+            if rec.attempts > self._max_resubmits:
+                del self._inflight[rec.rid]
+                exceeded = True
+            else:
+                self._counters["resubmitted"] += 1
+                self._parked[rec.partition].append(rec.rid)
+                exceeded = False
+        if exceeded:
+            self._record_cause("worker_lost")
+            self._terminal(rec, "rejected", RejectedError(
+                "worker_lost",
+                f"{message}; gave up after {rec.attempts} attempts",
+                priority=rec.priority,
+            ), already_removed=True)
+        # else: parked above; the next grant flushes it
+
+    def _flush_partition(self, index: int) -> None:
+        """A (re)granted partition drains its parked requests to the
+        new holder."""
+        with self._lock:
+            rids = list(self._parked.get(index, ()))
+            self._parked[index].clear()
+        for rid in rids:
+            self._route_one(rid)
+
+    def _resubmit_partition(self, index: int, epoch: int) -> None:
+        """A revoked lease orphans its in-flight requests: every one
+        routed under the dead epoch goes back through the bounded
+        resubmission path."""
+        with self._lock:
+            orphans = [
+                rec for rec in self._inflight.values()
+                if rec.partition == index and rec.epoch == epoch
+            ]
+        for rec in orphans:
+            self._fail_attempt(
+                rec,
+                f"partition {index} epoch {epoch} revoked mid-flight",
+            )
+
+    def _link_for(self, wid: str) -> Optional[_WorkerLink]:
+        with self._lock:
+            link = self._links.get(wid)
+            addr = self._worker_addr.get(wid)
+        if link is not None and not link.dead:
+            return link
+        if addr is None:
+            return None
+        try:
+            link = _WorkerLink(addr)
+        except OSError:
+            return None
+        reader = threading.Thread(
+            target=self._reader_loop, args=(wid, link),
+            name=f"fleet-reader-{wid}", daemon=True,
+        )
+        with self._lock:
+            old = self._links.get(wid)
+            self._links[wid] = link
+        if old is not None:
+            old.close()
+        reader.start()  # daemon; exits on link EOF/close, never joined
+        return link
+
+    def _reader_loop(self, wid: str, link: _WorkerLink) -> None:
+        """One data connection's results, committed as they arrive."""
+        while True:
+            try:
+                doc = recv_line(link.file)
+            except (OSError, ValueError):
+                break
+            if doc is None:
+                break
+            try:
+                self._commit_result(doc)
+            except Exception:
+                pass  # a malformed line must not kill the reader
+        link.dead = True
+        self._link_lost(wid)
+
+    def _link_lost(self, wid: str) -> None:
+        """A DATA link died while its worker may still be alive (torn
+        connection, malformed stream): the lease layer saw no failure,
+        so revocation will never rescue the requests already in flight
+        on that link — push them back through the bounded resubmission
+        path ourselves (the control pass re-flushes once a fresh link
+        dials; exactly-once holds because the registry, not the wire,
+        is the commit authority)."""
+        if self._stop_event.is_set():
+            return
+        held: List[Tuple[int, int]] = []
+        with self._svc.lock:
+            for part in self._partitions:
+                for epoch, lease in part.leases.items():
+                    if lease.worker == wid:
+                        held.append((part.index, epoch))
+        for index, epoch in held:
+            self._push_event(("revoked", index, epoch))
+
+    # ----------------------------------------------------------- committing
+    def _commit_result(self, doc: dict) -> None:
+        """Exactly-once result commit: the in-flight registry is the
+        set of open requests, and the partition's CURRENT epoch is the
+        fence — a revoked holder's late result never commits, a second
+        result for a terminal request never resolves anything."""
+        rid = str(doc.get("rid"))
+        index = int(doc.get("partition", -1))
+        epoch = int(doc.get("epoch", -1))
+        worker = str(doc.get("worker", ""))
+        try:
+            with faults.shard_scope(index, epoch):
+                faults.fire("fleet.commit")
+        except Exception:
+            # an injected commit fault discards the result and ends the
+            # request terminally — a half-committed result must not
+            # linger as phantom in-flight work
+            with self._lock:
+                rec = self._inflight.pop(rid, None)
+                self._counters["commit_faults"] += 1
+            if rec is not None:
+                self._record_cause("worker_lost")
+                self._terminal(rec, "rejected", RejectedError(
+                    "worker_lost", "injected fault at fleet.commit",
+                    priority=rec.priority,
+                ), already_removed=True)
+            return
+        fence_op = None
+        with self._lock:
+            rec = self._inflight.get(rid)
+            if rec is None:
+                self._counters["late_results"] += 1
+                return
+            current = self._partition_epoch.get(index)
+            if epoch != rec.epoch or current != epoch:
+                # the epoch fence at the result commit (the
+                # LeasedJournal discipline): a result from a revoked
+                # lease is rejected BEFORE it can touch the future
+                self._counters["fenced_results"] += 1
+                fence_op = ("commit", index, worker, epoch)
+            elif rec.fut.done():
+                # structurally unreachable (terminal requests leave the
+                # registry) — counted so the report can PROVE it
+                self._counters["double_served"] += 1
+                del self._inflight[rid]
+                return
+            else:
+                del self._inflight[rid]
+        if fence_op is not None:
+            self._svc.record_fence(index, worker, epoch, "commit")
+            return
+        status = doc.get("status")
+        if status == "ok":
+            try:
+                result = unpack_detections(doc.get("detections") or {})
+            except Exception as e:
+                self._terminal(rec, "errors", e, already_removed=True)
+                return
+            self._terminal(rec, "completed", result,
+                           already_removed=True)
+        elif status == "fenced":
+            # the worker no longer held the lease at receipt: the
+            # partition is mid-rebalance — bounded resubmission. A
+            # fleet that closed in the window must NOT re-register the
+            # request (close already drained the registry): it ends
+            # terminally with the shutdown discipline instead.
+            with self._lock:
+                readd = not self._closed
+                if readd:
+                    self._inflight[rid] = rec  # back in the registry
+            if readd:
+                self._fail_attempt(
+                    rec, f"worker {worker!r} fenced the request",
+                )
+            else:
+                self._record_cause("shutdown")
+                self._terminal(rec, "shed", RejectedError(
+                    "shutdown",
+                    "fleet closed while the request was mid-rebalance",
+                    priority=rec.priority,
+                ), already_removed=True)
+        elif status == "rejected":
+            cause = doc.get("cause") or "queue_full"
+            err = RejectedError(
+                cause if cause in ("queue_full", "class_limit",
+                                   "rate_limited", "deadline",
+                                   "shutdown", "worker_lost")
+                else "queue_full",
+                str(doc.get("message") or "worker rejected the request"),
+                priority=rec.priority,
+            )
+            bucket = "shed" if err.cause in ("deadline", "shutdown") \
+                else "rejected"
+            self._record_cause(err.cause)
+            self._terminal(rec, bucket, err, already_removed=True)
+        else:
+            self._terminal(rec, "errors", RuntimeError(
+                str(doc.get("message") or f"worker error ({status})")
+            ), already_removed=True)
+
+    def _terminal(self, rec: _Inflight, bucket: str, outcome,
+                  already_removed: bool = False) -> None:
+        """One request's single terminal event: releases the admission
+        slot, counts the outcome bucket, resolves the future."""
+        with self._lock:
+            if not already_removed and \
+                    self._inflight.pop(rec.rid, None) is None:
+                return
+            self._counters[bucket] += 1
+        self._admission.release_class(rec.priority)
+        if bucket == "completed":
+            if not rec.fut.done():
+                rec.fut.set_result(outcome)
+        elif not rec.fut.done():
+            rec.fut.set_exception(outcome)
+        if obs.flight_enabled():
+            obs.flight_record(
+                "fleet.request", rid=rec.rid, outcome=bucket,
+                partition=rec.partition, attempts=rec.attempts,
+                latency_s=round(time.monotonic() - rec.t_submit, 6),
+            )
+
+    def _record_cause(self, cause: str) -> None:
+        with self._lock:
+            self._reject_causes[cause] = (
+                self._reject_causes.get(cause, 0) + 1
+            )
+
+    # ----------------------------------------------------- control protocol
+    def dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        handler = {
+            "hello": self._op_hello,
+            "lease": self._op_lease,
+            "beat": self._op_beat,
+            "fail": self._op_fail,
+            "bye": self._op_bye,
+            "state": lambda m: self.state(),
+        }.get(op)
+        if handler is None:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        try:
+            return handler(msg)
+        except Exception as e:  # protocol must answer, never wedge
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _op_hello(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        # a rejoining stable worker id is ALIVE again: without clearing
+        # its departure flags, the control pass would strip its fresh
+        # address/link every interval and its partitions' traffic would
+        # park forever (drained stays sticky — poison drain survives a
+        # reconnect)
+        self._svc.rejoin(wid)
+        data_addr = msg.get("data_addr")
+        if isinstance(data_addr, (list, tuple)) and len(data_addr) == 2:
+            with self._lock:
+                self._worker_addr[wid] = (str(data_addr[0]),
+                                          int(data_addr[1]))
+        self._rebalance_for_join(wid)
+        return {
+            "ok": True,
+            "sizes": list(self.sizes),
+            "classes": self.classes,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+            "partitions": len(self._partitions),
+        }
+
+    def _op_lease(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        wait = {"partition": None,
+                "wait_s": max(self.policy.check_interval_s, 0.05)}
+        verdict, part, epoch = self._svc.select(wid)
+        if verdict == "drained":
+            return {"partition": None, "drained": True}
+        if verdict != "grant":
+            return wait  # fleets are never "done" while serving
+        if self._svc.install(part, epoch, wid) is None:
+            return wait
+        return {
+            "partition": part.key,
+            "index": part.index,
+            "epoch": epoch,
+            "size": part.size,
+            "klass": part.klass,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+        }
+
+    def _op_beat(self, msg: dict) -> dict:
+        """One worker heartbeat covering every lease it holds, plus its
+        measured drain rate and queue depth — the cluster-wide
+        admission signal rides the liveness beat."""
+        wid = str(msg.get("worker"))
+        stale: List[List[int]] = []
+        for pair in msg.get("held") or ():
+            index, epoch = int(pair[0]), int(pair[1])
+            if not self._svc.heartbeat(wid, index, epoch):
+                stale.append([index, epoch])
+        drain = msg.get("drain")
+        pending = msg.get("pending")
+        with self._lock:
+            self._worker_beat[wid] = (
+                time.monotonic(),
+                float(drain) if isinstance(drain, (int, float)) else 0.0,
+                int(pending) if isinstance(pending, int) else 0,
+            )
+        worker = self._svc.worker_rec(wid)
+        return {"ok": True, "stale": stale, "drained": worker.drained}
+
+    def _op_fail(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        res = self._svc.fail(wid, index, epoch, msg.get("causes") or [])
+        return {"ok": True, **res}
+
+    def _op_bye(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        self._svc.bye(wid)
+        # a clean leaver still releases its partitions for rebalance —
+        # serve leases are held for the worker's lifetime, so a
+        # graceful leave exits through the same worker_exit cause as a
+        # crash (the closed vocabulary documents both)
+        self._svc.revoke_worker(wid, "worker_exit")
+        return {"ok": True}
+
+    def control_closed(self, wid: str, clean: bool) -> None:
+        self._svc.control_closed(str(wid), clean)
+
+    # -------------------------------------------------------- lease events
+    def _on_transition(self, part: FleetPartition, lease,
+                       state: str) -> None:
+        """LeaseService hook (fires under the service lock): keeps the
+        commit fence's per-partition epoch EXACTLY in step with grants
+        and revocations, and queues the router's flush/resubmit work."""
+        if state == "held":
+            with self._lock:
+                self._partition_epoch[part.index] = lease.epoch
+                revoked_at = self._revoked_at.pop(part.index, None)
+                if revoked_at is not None:
+                    self._rebalance_lat.append(
+                        time.monotonic() - revoked_at
+                    )
+            self._push_event(("granted", part.index))
+        elif state in ("revoked", "failed"):
+            # a worker-reported failure frees the partition exactly
+            # like a revocation: the fence epoch clears so nothing from
+            # the failed holder can commit, and its in-flight requests
+            # go back through the bounded resubmission path
+            with self._lock:
+                self._partition_epoch[part.index] = None
+                self._revoked_at.setdefault(part.index,
+                                            time.monotonic())
+            self._push_event(("revoked", part.index, lease.epoch))
+
+    def _rebalance_for_join(self, new_wid: str) -> None:
+        """Scale-out rebalance: a new worker joining an all-leased
+        fleet takes over the excess partitions of over-loaded holders
+        (cause ``scale_out``) — recruitment must actually MOVE load,
+        not just add an idle process."""
+        excess: List[Tuple[int, int]] = []
+        with self._svc.lock:
+            alive = [
+                w.wid for w in self._svc.workers.values()
+                if not (w.drained or w.dead or w.bye)
+            ]
+            if len(alive) < 2 or self._svc.pending_snapshot():
+                return
+            target = math.ceil(len(self._partitions) / len(alive))
+            held: Dict[str, List[Tuple[int, int]]] = {}
+            for part in self._partitions:
+                for epoch, lease in part.leases.items():
+                    held.setdefault(lease.worker, []).append(
+                        (part.index, epoch)
+                    )
+            for wid, leases in held.items():
+                if wid == new_wid:
+                    continue
+                for index, epoch in leases[target:]:
+                    excess.append((index, epoch))
+        for index, epoch in excess:
+            self._svc.revoke_lease(index, epoch, "scale_out")
+
+    # -------------------------------------------------------- control loop
+    def _monitor_loop(self) -> None:
+        while not self._stop_event.wait(self._check_s):
+            try:
+                self._control_pass()
+            except Exception:
+                pass  # the control loop must survive anything
+
+    def _control_pass(self) -> None:
+        """One fleet control pass: lease liveness, deadline expiry, the
+        recruitment election, and (only when scale-out cannot help) the
+        degrade ladder's anomaly feed."""
+        self._svc.expire_pass()
+        self._expire_deadlines()
+        pending = self.pending()
+        with self._svc.lock:
+            alive = sum(
+                1 for w in self._svc.workers.values()
+                if not (w.drained or w.dead or w.bye)
+            )
+            departed = [
+                w.wid for w in self._svc.workers.values()
+                if w.dead or w.drained or w.bye
+            ]
+        # worker churn must not leak: a departed worker's beat/address/
+        # link bookkeeping goes with it (the service keeps the
+        # WorkerRecord itself — that is report history, bounded by
+        # distinct worker ids, not by reconnects)
+        dead_links: List[_WorkerLink] = []
+        with self._lock:
+            for wid in departed:
+                self._worker_beat.pop(wid, None)
+                self._worker_addr.pop(wid, None)
+                link = self._links.pop(wid, None)
+                if link is not None:
+                    dead_links.append(link)
+        for link in dead_links:
+            link.close()
+        can_recruit = (
+            self._spawner is not None and alive < self._max_workers
+        )
+        with self._lock:
+            saturated = pending > self._saturation_pending
+            if saturated:
+                self._recruit["saturated_passes"] += 1
+            else:
+                self._recruit["saturated_passes"] = 0
+            in_grace = self._recruit["grace"] > 0
+            if in_grace:
+                self._recruit["grace"] -= 1
+            should_recruit = (
+                saturated and can_recruit and not in_grace
+                and self._recruit["saturated_passes"]
+                >= self._recruit_passes
+            )
+            if should_recruit:
+                spawn_i = self._recruit["spawned"]
+        if should_recruit:
+            try:
+                faults.fire("fleet.recruit")
+            except Exception:
+                should_recruit = False  # election vetoed; retry later
+        if should_recruit:
+            try:
+                self._spawner(spawn_i)
+            except Exception:
+                should_recruit = False
+        if should_recruit:
+            with self._lock:
+                self._recruit["rounds"] += 1
+                self._recruit["spawned"] += 1
+                self._recruit["saturated_passes"] = 0
+                self._recruit["grace"] = self._recruit_grace
+            obs.get_registry().counter("fleet.recruited").inc()
+        # degradation is the LAST resort: saturation reaches the ladder
+        # only when recruitment cannot absorb it (spawner exhausted or
+        # absent) — a spike the fleet can scale out of must never
+        # shrink user results
+        if self._degrade.enabled:
+            anomalies: List[dict] = []
+            if saturated and not can_recruit and not should_recruit \
+                    and not in_grace:
+                anomalies = [{
+                    "anomaly": "queue_saturation",
+                    "message": f"fleet backlog {pending} over "
+                               f"{self._saturation_pending} with "
+                               "recruitment exhausted",
+                    "evidence": {"pending": pending, "workers": alive},
+                }]
+            level = self._degrade.observe(anomalies)
+            with self._lock:
+                self._degrade_max_seen = max(self._degrade_max_seen,
+                                             level)
+        # safety net: flush any parked work whose partition is held
+        # (covers a grant event the router processed before the
+        # worker's data server came up)
+        for part in self._partitions:
+            with self._lock:
+                has_parked = bool(self._parked.get(part.index))
+            if has_parked and self._svc.holder(part.index) is not None:
+                self._push_event(("granted", part.index))
+
+    def _expire_deadlines(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [
+                rec for rec in self._inflight.values()
+                if rec.deadline is not None and now > rec.deadline
+            ]
+            for rec in expired:
+                del self._inflight[rec.rid]
+        for rec in expired:
+            self._record_cause("deadline")
+            self._terminal(rec, "shed", RejectedError(
+                "deadline",
+                f"deadline expired after "
+                f"{(now - rec.t_submit) * 1000:.1f} ms in the fleet",
+                priority=rec.priority,
+            ), already_removed=True)
+
+    # -------------------------------------------------------------- signals
+    def _drain_total(self) -> float:
+        """Summed per-worker drain rate from the recent beats — the
+        admission controller's cluster-wide capacity signal. Beats
+        older than ~3 heartbeat intervals stop counting (a dead
+        worker's historic rate is not capacity), so a fully-stale fleet
+        reads 0.0 and the controller falls back to its release
+        window."""
+        horizon = 3.0 * max(self.policy.hb_interval_s, 0.1)
+        now = time.monotonic()
+        with self._lock:
+            return sum(
+                rate for (t, rate, _pending)
+                in self._worker_beat.values()
+                if now - t <= horizon
+            )
+
+    def pending(self) -> int:
+        """The fleet backlog: every open request (routed or parked)
+        plus the queue depth the workers reported on their RECENT
+        beats — the queue-saturation signal. Beats past the same
+        horizon the drain signal uses stop counting: a dead worker's
+        last reported backlog must not read as permanent saturation
+        (which would recruit to the ceiling, then degrade an idle
+        fleet)."""
+        horizon = 3.0 * max(self.policy.hb_interval_s, 0.1)
+        now = time.monotonic()
+        with self._lock:
+            return len(self._inflight) + sum(
+                p for (t, _rate, p) in self._worker_beat.values()
+                if now - t <= horizon
+            )
+
+    # -------------------------------------------------------------- reports
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def state(self) -> dict:
+        """Mid-run introspection (NOT the report)."""
+        with self._svc.lock:
+            with self._lock:
+                return {
+                    "ok": True,
+                    "partitions": {
+                        p.key: {
+                            "status": p.status,
+                            "holder": self._svc.holder(p.index),
+                            "epoch": self._partition_epoch.get(p.index),
+                            "parked": len(self._parked.get(p.index, ())),
+                        }
+                        for p in self._partitions
+                    },
+                    "workers": {
+                        w.wid: {"drained": w.drained, "dead": w.dead}
+                        for w in self._svc.workers.values()
+                    },
+                    "inflight": len(self._inflight),
+                    "counters": dict(self._counters),
+                    "reassignments": [
+                        dict(r) for r in self._svc.reassignments
+                    ],
+                }
+
+    def report(self) -> dict:
+        """The fleet section of an ``elastic_serve_report/v1`` (the
+        probe embeds one per phase; diagnostics._validate_fleet_section
+        checks it, including the exact accounting reconciliation)."""
+        # admission stats FIRST, outside every fleet lock: the
+        # controller's lock and this fleet's lock meet in the drain
+        # source (admission → fleet), so calling into the controller
+        # while holding fleet locks would invert the order
+        admission_stats = self._admission.stats()
+        with self._svc.lock:
+            with self._lock:
+                partitions = [{
+                    "index": p.index,
+                    "partition": p.key,
+                    "size": p.size,
+                    "klass": p.klass,
+                    "status": p.status,
+                    "worker": (self._svc.holder(p.index) or (None,))[0],
+                    "epoch": self._partition_epoch.get(p.index),
+                    "assignments": p.assignments,
+                } for p in self._partitions]
+                workers = {
+                    w.wid: {
+                        "drained": w.drained,
+                        "dead": w.dead,
+                        "drain_per_sec": round(
+                            self._worker_beat.get(
+                                w.wid, (0.0, 0.0, 0)
+                            )[1], 3,
+                        ),
+                    } for w in self._svc.workers.values()
+                }
+                doc = {
+                    "partitions": partitions,
+                    "workers": workers,
+                    "reassignments": [
+                        dict(r) for r in self._svc.reassignments
+                    ],
+                    "fenced_rejections": [
+                        dict(r) for r in self._svc.fenced
+                    ],
+                    "accounting": {
+                        k: v for k, v in self._counters.items()
+                        if k != "commit_faults"
+                    },
+                    "commit_faults": self._counters["commit_faults"],
+                    "reject_causes": dict(self._reject_causes),
+                    "rebalance": {
+                        "count": len(self._rebalance_lat),
+                        "max_latency_s": round(
+                            max(self._rebalance_lat, default=0.0), 3
+                        ),
+                    },
+                    "recruitment": {
+                        **{k: int(v) for k, v in self._recruit.items()},
+                        "max_workers": self._max_workers,
+                    },
+                    "degrade": {
+                        "level": self._degrade.level
+                        if self._degrade.enabled else 0,
+                        "max_seen": self._degrade_max_seen,
+                    },
+                    "admission": admission_stats,
+                    "wall_s": round(time.monotonic() - self._t0, 3),
+                }
+        return doc
+
+
+# ------------------------------------------------------------ fleet worker
+class _DataHandler(socketserver.StreamRequestHandler):
+    """One front-door data connection: request lines in, result lines
+    out (engine completion threads write under a per-connection lock)."""
+
+    def handle(self):  # noqa: D102 — protocol loop
+        worker = self.server.fleet_worker  # type: ignore[attr-defined]
+        wlock = threading.Lock()
+        while True:
+            try:
+                msg = recv_line(self.rfile)
+            except (OSError, ValueError):
+                break
+            if msg is None:
+                break
+            try:
+                worker.handle_serve(msg, self.connection, wlock)
+            except Exception:
+                break
+
+
+class _DataServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FleetWorker:
+    """One serve worker: wraps an engine (``ServeEngine`` or anything
+    with its ``submit``/``close`` shape), joins the fleet, leases
+    traffic partitions, heartbeats them with its measured drain rate,
+    and serves routed requests over its data socket.
+
+    A request is admitted only when the worker CURRENTLY holds the
+    (partition, epoch) it was routed under — a mid-rebalance request is
+    answered ``fenced`` so the front door resubmits to the real holder.
+    Results are sent with the epoch they were admitted under; the front
+    door's commit fence does the rest (a SIGSTOPped worker resuming
+    past its TTL sends a stale-epoch result that can never commit)."""
+
+    def __init__(self, coordinator: Tuple[str, int], worker_id: str,
+                 engine, *, data_host: str = "127.0.0.1",
+                 data_port: int = 0, own_engine: bool = True,
+                 timeout: float = 30.0):
+        self.worker_id = worker_id
+        self.engine = engine
+        self._own_engine = bool(own_engine)
+        self.coordinator = (coordinator[0], int(coordinator[1]))
+        self._lock = threading.RLock()
+        self._held: Dict[int, int] = {}  # partition index -> epoch
+        self._stop_event = threading.Event()
+        self._drained = False
+        self._coordinator_lost = False
+        self._last_drain = (time.monotonic(), 0)
+        self._data_server = _DataServer((data_host, int(data_port)),
+                                        _DataHandler)
+        self._data_server.fleet_worker = self  # type: ignore[attr-defined]
+        self._sock = socket.create_connection(
+            self.coordinator, timeout=connect_timeout(min(timeout, 5.0))
+        )
+        self._sock.settimeout(timeout)
+        self._file = self._sock.makefile("rb")
+        self._ctl_lock = threading.Lock()
+        self.config = self._call({
+            "op": "hello",
+            "data_addr": list(self._data_server.server_address[:2]),
+        })
+        self._hb_interval = float(
+            self.config.get("hb_interval_s") or 2.5
+        )
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- control
+    def _call(self, doc: dict) -> dict:
+        doc = dict(doc)
+        doc.setdefault("worker", self.worker_id)
+        with self._ctl_lock:
+            send_line(self._sock, doc)
+            reply = recv_line(self._file)
+        if reply is None:
+            raise ConnectionError("fleet coordinator closed the "
+                                  "connection")
+        return reply
+
+    def start(self) -> "FleetWorker":
+        threads = [
+            threading.Thread(target=self._data_server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             name=f"fleet-data-{self.worker_id}",
+                             daemon=True),
+            threading.Thread(target=self._lease_loop,
+                             name=f"fleet-lease-{self.worker_id}",
+                             daemon=True),
+            threading.Thread(target=self._beat_loop,
+                             name=f"fleet-beat-{self.worker_id}",
+                             daemon=True),
+        ]
+        with self._lock:
+            self._threads = threads
+        for t in threads:
+            t.start()
+        return self
+
+    def _lease_loop(self) -> None:
+        """Keep leasing: a worker holds every partition the coordinator
+        will grant it, and keeps polling so rebalanced/new partitions
+        find a holder fast."""
+        while not self._stop_event.is_set():
+            try:
+                grant = self._call({"op": "lease"})
+            except (ConnectionError, OSError):
+                # coordinator gone: flag it so a supervising loop
+                # (the CLI) can exit instead of spinning forever
+                if not self._stop_event.is_set():
+                    with self._lock:
+                        self._coordinator_lost = True
+                return
+            if grant.get("drained"):
+                with self._lock:
+                    self._drained = True
+                return
+            index = grant.get("index")
+            if index is None:
+                if self._stop_event.wait(
+                    float(grant.get("wait_s", 0.2))
+                ):
+                    return
+                continue
+            with self._lock:
+                self._held[int(index)] = int(grant["epoch"])
+
+    def _beat_loop(self) -> None:
+        while not self._stop_event.wait(self._hb_interval):
+            try:
+                self._beat_once()
+            except (ConnectionError, OSError):
+                pass  # missed beats ARE the liveness signal
+
+    def _beat_once(self) -> dict:
+        with self._lock:
+            held = [[i, e] for i, e in self._held.items()]
+        reply = oneshot(self.coordinator, {
+            "op": "beat", "worker": self.worker_id, "held": held,
+            "drain": self._drain_rate(), "pending": self._pending(),
+        })
+        stale = reply.get("stale") or ()
+        with self._lock:
+            for index, epoch in stale:
+                if self._held.get(int(index)) == int(epoch):
+                    del self._held[int(index)]
+            if reply.get("drained"):
+                self._drained = True
+        return reply
+
+    def _drain_rate(self) -> float:
+        """Requests/s from the engine's completed-counter delta between
+        beats — the capacity evidence each beat carries."""
+        counters = getattr(self.engine, "counters", None)
+        completed = int((counters or {}).get("completed", 0)) \
+            if isinstance(counters, dict) else 0
+        now = time.monotonic()
+        with self._lock:
+            t_last, c_last = self._last_drain
+            self._last_drain = (now, completed)
+        dt = now - t_last
+        if dt <= 0 or completed < c_last:
+            return 0.0
+        return (completed - c_last) / dt
+
+    def _pending(self) -> int:
+        stats = getattr(self.engine, "stats", None)
+        if not callable(stats):
+            return 0
+        try:
+            return int(stats().get("pending", 0))
+        except Exception:
+            return 0
+
+    # ---------------------------------------------------------- data plane
+    def holds(self, index: int, epoch: int) -> bool:
+        with self._lock:
+            return self._held.get(int(index)) == int(epoch)
+
+    def handle_serve(self, msg: dict, conn, wlock) -> None:
+        """One routed request: fence at receipt, submit to the engine,
+        send the result line when the future resolves."""
+        rid = str(msg.get("rid"))
+        index = int(msg.get("partition", -1))
+        epoch = int(msg.get("epoch", -1))
+        base = {"op": "result", "rid": rid, "partition": index,
+                "epoch": epoch, "worker": self.worker_id}
+
+        def reply(**fields):
+            doc = dict(base)
+            doc.update(fields)
+            try:
+                with wlock:
+                    send_line(conn, doc)
+            except OSError:
+                pass  # front door gone; it will resubmit on revoke
+
+        if not self.holds(index, epoch):
+            reply(status="fenced")
+            return
+        try:
+            image = unpack_array(msg["image"])
+            ex = unpack_array(msg["exemplars"])
+            fut = self.engine.submit(
+                image, ex, multi=bool(msg.get("multi")),
+                k_real=msg.get("k_real"),
+                priority=int(msg.get("priority") or 0),
+                deadline_ms=msg.get("deadline_ms"),
+            )
+        except Exception as e:
+            reply(status="error", message=f"{type(e).__name__}: {e}")
+            return
+
+        def on_done(f: Future, _reply=reply):
+            try:
+                exc = f.exception()
+                if exc is None:
+                    _reply(status="ok",
+                           detections=pack_detections(f.result()))
+                elif isinstance(exc, RejectedError):
+                    _reply(status="rejected", cause=exc.cause,
+                           message=str(exc))
+                else:
+                    _reply(status="error",
+                           message=f"{type(exc).__name__}: {exc}")
+            except Exception:
+                pass  # the engine's completion thread must survive
+
+        fut.add_done_callback(on_done)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def held(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._held)
+
+    @property
+    def drained(self) -> bool:
+        with self._lock:
+            return self._drained
+
+    @property
+    def coordinator_lost(self) -> bool:
+        """True once the control connection died outside a stop() —
+        the worker cannot lease again; supervising loops should exit
+        (and let their process supervisor decide about a restart)."""
+        with self._lock:
+            return self._coordinator_lost
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_event.set()
+        try:
+            self._call({"op": "bye"})
+        except (ConnectionError, OSError):
+            pass
+        try:  # shutdown-first: unblocks any reader before the close
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._data_server.shutdown()
+        self._data_server.server_close()
+        with self._lock:
+            threads = list(self._threads)
+        deadline = time.monotonic() + max(timeout, 0.0)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        if self._own_engine:
+            close = getattr(self.engine, "close", None)
+            if callable(close):
+                close()
+
+
+# ------------------------------------------------------------ stub engine
+class StubFleetPredictor:
+    """Numpy-only Predictor stand-in for fleet drills (the
+    test_overload stub pattern, exported so subprocess workers and the
+    probe share ONE definition): instant host 'programs', no XLA. Each
+    detection row 0 carries the request image's mean as its score — a
+    deterministic per-image signature, so the probe can verify every
+    routed result came from ITS image (crossed wires or double serves
+    would show as signature mismatches). ``delay_s`` paces each
+    program call (capacity control: kills land mid-batch, spikes
+    saturate)."""
+
+    def __init__(self, delay_s: float = 0.0, slots: int = 8):
+        self.params = np.zeros((1,), np.float32)
+        self.refiner_params = None
+        self.delay_s = float(delay_s)
+        self.slots = int(slots)
+
+    def bucket_key(self, size, ex, multi=False, k_real=None):
+        ex = np.asarray(ex, np.float32).reshape(-1, 4)
+        k = int(k_real) if k_real is not None else len(ex)
+        if multi:
+            return ("multi", int(size), 9, k)
+        return ("single", int(size), 9, len(ex))
+
+    def _dets(self, images) -> dict:
+        arr = np.asarray(images, np.float32)
+        b = arr.shape[0]
+        sig = arr.reshape(b, -1).mean(axis=1)
+        dets = {
+            "boxes": np.zeros((b, self.slots, 4), np.float32),
+            "scores": np.zeros((b, self.slots), np.float32),
+            "refs": np.zeros((b, self.slots, 2), np.float32),
+            "valid": np.zeros((b, self.slots), bool),
+        }
+        dets["scores"][:, 0] = sig
+        dets["valid"][:, 0] = True
+        return dets
+
+    def _run(self, images):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return self._dets(images)
+
+    def _get_fn(self, capacity, donate=False):
+        return lambda p, rp, image, ex, *a: self._run(image)
+
+    def _get_multi_batched_fn(self, capacity, k, donate=False):
+        return lambda p, rp, image, ex, k_real: self._run(image)
+
+    def _get_backbone_fn(self):
+        return lambda p, image: np.zeros(
+            (np.asarray(image).shape[0], 2, 2, 4), np.float32
+        )
+
+    def _get_heads_fn(self, capacity, size):
+        return lambda p, rp, feats, ex: self._run(
+            np.zeros((np.asarray(feats).shape[0], 1, 1, 3), np.float32)
+        )
+
+    def __call__(self, image, exemplars):
+        return self._run(np.asarray(image)[None]
+                         if np.asarray(image).ndim == 3 else image)
+
+    def predict_multi_exemplar(self, image, exemplars, k_real=None):
+        return self._run(image)
+
+
+def stub_signature(image) -> float:
+    """The per-image signature StubFleetPredictor stamps into
+    ``scores[0, 0]`` — float32 mean, computed exactly like the stub
+    does so probe-side expectations match bitwise."""
+    arr = np.asarray(image, np.float32)
+    return float(arr.reshape(1, -1).mean(axis=1)[0])
+
+
+def stub_engine(delay_s: float = 0.0, *, batch: int = 2,
+                max_wait_ms: float = 5.0):
+    """A real ServeEngine over the numpy stub predictor: the full
+    batcher/staging/completion pipeline with zero XLA — what fleet
+    drills and the elastic_serve_probe workers run."""
+    from tmr_tpu.serve.engine import ServeEngine
+
+    return ServeEngine(
+        StubFleetPredictor(delay_s=delay_s), batch=batch,
+        max_wait_ms=max_wait_ms, feature_cache=0, exemplar_cache=0,
+    )

@@ -147,6 +147,83 @@ def collect_bench_trend(repo_dir: str,
     }
 
 
+# ----------------------------------------------------------- fleet report
+
+
+def read_fleet_report(path: str) -> dict:
+    """Reduce an ``elastic_serve_report/v1`` document (the
+    elastic_serve_probe's one JSON line, or a pretty-printed file) to
+    the rc-gating fields: the exactly-once contract — ZERO double-served
+    request ids and the exact ``offered == completed + rejected + shed +
+    errors`` reconciliation — plus a per-phase summary table.
+
+    Returns ``{"rows": [...], "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable fleet report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"fleet report is an error record: "
+                         f"{doc['error']}"}
+    acc = doc.get("accounting")
+    if not isinstance(acc, dict):
+        return {"error": f"no accounting section in {path}"}
+    rows: List[dict] = []
+    for phase in doc.get("phases") or ():
+        if not isinstance(phase, dict):
+            continue
+        pacc = (phase.get("fleet") or {}).get("accounting") or {}
+        rows.append({
+            "phase": phase.get("name"),
+            "offered": phase.get("offered"),
+            "completed": pacc.get("completed"),
+            "rejected": pacc.get("rejected"),
+            "shed": pacc.get("shed"),
+            "errors": pacc.get("errors"),
+            "resubmitted": pacc.get("resubmitted"),
+            "fenced_results": pacc.get("fenced_results"),
+            "double_served": pacc.get("double_served"),
+        })
+
+    def _ints(*keys):
+        vals = [acc.get(k) for k in keys]
+        return vals if all(
+            isinstance(v, int) and not isinstance(v, bool) for v in vals
+        ) else None
+
+    parts = _ints("offered", "completed", "rejected", "shed", "errors")
+    report_checks = doc.get("checks")
+    return {
+        "rows": rows,
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "zero_double_served": acc.get("double_served") == 0,
+            "reconciliation_exact": bool(
+                parts is not None
+                and parts[0] == parts[1] + parts[2] + parts[3] + parts[4]
+            ),
+            "probe_checks_pass": bool(
+                isinstance(report_checks, dict) and report_checks
+                and all(report_checks.values())
+            ),
+        },
+    }
+
+
 # ----------------------------------------------------------- serve sweep
 
 

@@ -1,11 +1,13 @@
-"""Deterministic fault injection for the extraction path.
+"""Deterministic fault injection for the extraction and serving paths.
 
 The map phase threads named injection points through everything a shard
 does on its way to the stats table — the single-process executor
 (parallel/mapreduce.py), the journal (parallel/journal.py), and the
-elastic coordinator/worker layer (parallel/elastic.py). The COMPLETE
-point vocabulary (``POINTS``; a parity test pins this table against the
-actual ``fire()``/``corrupt_bytes``/``poison`` call sites):
+elastic coordinator/worker layer (parallel/elastic.py) — and the serve
+fleet (serve/fleet.py) does the same for its routing/commit/recruit
+control points. The COMPLETE point vocabulary (``POINTS``; a parity
+test pins this table against the actual
+``fire()``/``corrupt_bytes``/``poison`` call sites):
 
     point       fires at (file: site)                       extra actions
     ---------   -----------------------------------------   -------------
@@ -32,6 +34,16 @@ actual ``fire()``/``corrupt_bytes``/``poison`` call sites):
     steal       elastic: the coordinator is about to
                 duplicate-lease a straggler shard
                 (speculative re-execution election)
+    fleet.route fleet: the serve front door is about to
+                route one request to its partition's
+                current lease holder (scope: partition
+                index, epoch)
+    fleet.commit fleet: a worker's result is about to be
+                committed (exactly-once accept) at the
+                front door
+    fleet.recruit fleet: sustained queue saturation is
+                about to recruit a worker through the
+                spawner (scale-out election)
 
 A schedule is a `;`-separated list of specs, each
 ``point[:key=value]*``, installed from the ``TMR_FAULTS`` env var
@@ -82,6 +94,7 @@ from typing import Dict, Iterator, List, Optional
 POINTS = (
     "tar.open", "tar.member", "decode", "encode", "save", "journal",
     "lease", "heartbeat", "steal",
+    "fleet.route", "fleet.commit", "fleet.recruit",
 )
 
 
