@@ -30,6 +30,7 @@ from tmr_tpu.utils.bench_trend import (  # noqa: E402
     DEFAULT_THRESHOLD,
     collect_bench_trend,
     read_fleet_report,
+    read_gallery_report,
     read_serve_sweep,
 )
 
@@ -58,7 +59,29 @@ def main(argv=None) -> int:
                          "ZERO, the offered == completed + rejected + "
                          "shed + errors reconciliation is exact, and "
                          "every probe check passed")
+    ap.add_argument("--gallery", default=None,
+                    help="read a gallery_report/v1 file "
+                         "(gallery_bench output) instead of the BENCH "
+                         "history: one JSON line with the prefilter "
+                         "rung table; rc 1 unless the fused arm is "
+                         "exact, backbone executions == frames "
+                         "(amortized), and the elected prefilter "
+                         "top-k meets its recall + cut targets")
     args = ap.parse_args(argv)
+
+    if args.gallery:
+        doc = read_gallery_report(args.gallery)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["bitwise_exact"] and ck["backbone_amortized"]
+                     and ck["prefilter_recall_ok"]
+                     and ck["prefilter_cut_ok"]) else 1
 
     if args.fleet:
         doc = read_fleet_report(args.fleet)

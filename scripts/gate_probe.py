@@ -259,6 +259,33 @@ def main(argv=None) -> int:
             emit(probe=name, ok=False, error=f"{type(e).__name__}: {e}",
                  refusals=drain_gate_refusals())
 
+    # 4b. the fused gallery program's gate (serve/gallery.py): the
+    # trace-only backbone-amortization invariant — the jaxpr of the
+    # one-backbone-pass multi-pattern program must consume the frame
+    # through exactly one backbone entry conv. Production bank shape
+    # (N=8, k=1) at the smallest capacity bucket; production image
+    # geometry on TPU, reduced on CPU like the decoder-tail gates.
+    # No params needed: the gate traces over eval_shape abstract params.
+    try:
+        from tmr_tpu.config import preset as _preset
+        from tmr_tpu.inference import Predictor as _Predictor
+        from tmr_tpu.serve import gallery as _gallery
+
+        _gallery._GATE_CACHE.clear()
+        gsize = 1024 if jax.default_backend() == "tpu" else 64
+        gpred = _Predictor(_preset(
+            "TMR_FSCD147", backbone="sam_vit_b", image_size=gsize,
+            compute_dtype="float32",
+        ))
+        emit(probe=f"gallery_fused_{gsize}_n8_k1",
+             ok=bool(_gallery.gallery_fused_ok(gpred, 9, 8, 1)),
+             refusals=drain_gate_refusals())
+    except Exception as e:
+        traceback.print_exc()
+        emit(probe="gallery_fused", ok=False,
+             error=f"{type(e).__name__}: {e}",
+             refusals=drain_gate_refusals())
+
     # 5. the program-tier audit (tmr_tpu/analysis): the bucketed
     # production programs traced to jaxprs under the CURRENT env knobs
     # and checked structurally (no-S^2 attention, no-f64, quant-widen,

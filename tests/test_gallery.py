@@ -1,0 +1,417 @@
+"""The gallery tier (tmr_tpu/serve/gallery.py): bank registry, the
+fused one-backbone-pass multi-pattern program, feature-cache promotion,
+the coarse prefilter contract, byte-bounded caches, the K/N bucket
+ladders, and the network feature sink.
+
+The load-bearing pin is the FUSED-ARM EXACTNESS: a cold frame searched
+against an N-entry bank must return, per entry, detections
+bitwise-identical to an N-loop of ``predict_multi_exemplar`` on the
+same inputs (the forced-8-device caveat of test_serve.py applies to
+batched COMPOSITION, not here: the gallery frame is always B=1, so the
+backbone trace shape matches the sequential call's exactly)."""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+SIZE = 128
+
+FIELDS = ("boxes", "scores", "refs", "valid")
+
+
+def _predictor():
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=SIZE,
+                 compute_dtype="float32", batch_size=1)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=SIZE)
+    return pred
+
+
+@pytest.fixture(scope="module")
+def pred():
+    return _predictor()
+
+
+def _img(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (SIZE, SIZE, 3)
+    ).astype(np.float32)
+
+
+BOXES = [
+    np.asarray([[0.2 + 0.15 * i, 0.3, 0.3 + 0.15 * i, 0.42]], np.float32)
+    for i in range(3)
+]
+
+
+def _assert_bitwise(a, b, ctx=""):
+    for k in FIELDS:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), (
+            f"{ctx}: field {k!r} not bitwise-identical"
+        )
+
+
+# ------------------------------------------------------ byte-bounded cache
+def test_lru_cache_byte_bound_and_stats():
+    from tmr_tpu.serve.caches import LRUCache, value_nbytes
+
+    a = np.zeros(10, np.float32)  # 40 bytes
+    assert value_nbytes(a) == 40
+    assert value_nbytes({"x": a, "y": [a, a]}) == 120
+    assert value_nbytes(object()) == 0
+
+    c = LRUCache(10, max_bytes=100)
+    c.put("a", a)
+    c.put("b", a)
+    s = c.stats()
+    assert s["bytes"] == 80 and s["max_bytes"] == 100
+    c.put("c", a)  # 120 > 100: LRU out
+    s = c.stats()
+    assert s["bytes"] == 80 and s["size"] == 2 and s["evictions"] == 1
+    assert c.get("a") is None and c.get("c") is not None
+    # an entry ALONE over the bound is dropped (insert + eviction
+    # counted) WITHOUT disturbing the resident working set
+    c.put("big", np.zeros(100, np.float32))
+    s = c.stats()
+    assert s["size"] == 2 and s["bytes"] == 80
+    assert s["evictions"] == 2  # the big entry's own drop counted
+    assert c.get("big") is None and c.get("c") is not None
+    # replacement updates the accounted bytes instead of double-counting
+    c2 = LRUCache(10, max_bytes=100)
+    c2.put("x", a)
+    c2.put("x", np.zeros(5, np.float32))
+    assert c2.stats()["bytes"] == 20
+    assert c2.pop("x") is not None and c2.stats()["bytes"] == 0
+    # count-only cache: stats shape unchanged (no bytes keys)
+    plain = LRUCache(2)
+    plain.put("k", a)
+    assert "bytes" not in plain.stats()
+    assert "max_bytes" not in plain.stats()
+
+
+def test_engine_feature_cache_mb_knob(pred, monkeypatch):
+    from tmr_tpu.serve import ServeEngine
+
+    monkeypatch.setenv("TMR_SERVE_FEATURE_CACHE_MB", "2")
+    with ServeEngine(pred, batch=1, max_wait_ms=5,
+                     exemplar_cache=0) as eng:
+        assert eng.feature_cache.max_bytes == 2 * (1 << 20)
+        assert "bytes" in eng.feature_cache.stats()
+    monkeypatch.delenv("TMR_SERVE_FEATURE_CACHE_MB")
+    with ServeEngine(pred, batch=1, max_wait_ms=5,
+                     exemplar_cache=0) as eng:
+        assert eng.feature_cache.max_bytes == 0
+        assert "bytes" not in eng.feature_cache.stats()
+
+
+# -------------------------------------------------------------- the ladder
+def test_k_buckets_power_of_two_rungs(pred):
+    """Satellite pin: the k ladder's 16/32 rungs — ragged exemplar
+    counts past the paper's k<=3 land on shared rungs instead of one
+    compiled program per distinct k."""
+    from tmr_tpu.inference import Predictor
+
+    assert Predictor.K_BUCKETS == (1, 2, 3, 4, 6, 8, 16, 32)
+    assert Predictor.N_BUCKETS == (1, 2, 4, 8, 16, 32)
+    ex9 = np.tile(BOXES[0], (9, 1))
+    ex12 = np.tile(BOXES[0], (12, 1))
+    key9 = pred.bucket_key(SIZE, ex9, multi=True, k_real=9)
+    key12 = pred.bucket_key(SIZE, ex12, multi=True, k_real=12)
+    assert key9[3] == 16 and key12[3] == 16  # one rung for both
+    img = _img(1)
+    pred.predict_multi_exemplar(img[None], ex9, k_real=9)
+    n0 = len(pred._compiled)
+    pred.predict_multi_exemplar(img[None], ex12, k_real=12)
+    pred.predict_multi_exemplar(img[None], np.tile(BOXES[0], (16, 1)))
+    assert len(pred._compiled) == n0  # no recompile inside the rung
+
+
+# ------------------------------------------------------------ bank + fused
+def test_register_evict_and_bucketing(pred):
+    from tmr_tpu.serve import GalleryBank
+
+    bank = GalleryBank(pred, feature_cache=0, max_n_bucket=32)
+    rec = bank.register("a", BOXES[0])
+    assert rec == {"name": "a", "capacity": 9, "k_bucket": 1, "k_real": 1}
+    rec3 = bank.register("b", np.concatenate([b for b in BOXES], axis=0))
+    assert rec3["k_bucket"] == 3 and rec3["k_real"] == 3
+    assert len(bank) == 2 and "a" in bank
+    groups = bank.stats()["groups"]
+    assert len(groups) == 2  # k buckets 1 and 3 split
+    assert bank.evict("a") is True
+    assert bank.evict("a") is False
+    assert bank.names() == ["b"]
+    with pytest.raises(ValueError):
+        bank.register("bad", BOXES[0], k_real=5)
+    with pytest.raises(ValueError):
+        bank.search(np.zeros((SIZE // 2, SIZE // 2, 3), np.float32))
+
+
+def test_fused_gallery_bitwise_vs_n_loop(pred):
+    """THE acceptance pin: one cold-frame search == the N-loop of
+    predict_multi_exemplar, bitwise, with the backbone traced once."""
+    from tmr_tpu.serve import GalleryBank, gallery_fused_ok
+
+    assert gallery_fused_ok(pred, 9, 4, 1)
+    bank = GalleryBank(pred, feature_cache=4, max_n_bucket=32)
+    for i, b in enumerate(BOXES):
+        bank.register(f"p{i}", b)
+    img = _img(10)
+    res = bank.search(img)
+    assert bank.counters["fused_frames"] == 1
+    assert bank.counters["full_match_entries"] == 3
+    for i, b in enumerate(BOXES):
+        want = pred.predict_multi_exemplar(img[None], b, k_real=1)
+        _assert_bitwise(want, res[f"p{i}"], ctx=f"entry {i}")
+
+    # ragged N inside the rung: a 4th entry stays on the same compiled
+    # program (rung 4 held for both 3 and 4 real entries)
+    n0 = len(pred._compiled)
+    bank.register("p3", np.asarray([[0.5, 0.5, 0.62, 0.62]], np.float32))
+    bank.search(_img(11))
+    assert len(pred._compiled) == n0
+
+
+def test_second_sighting_promotion_and_heads_parity(pred):
+    """Feature-cache integration, as-is from the engine: sighting 1 =
+    fused (bitwise), 2 = backbone fill + gallery heads (features
+    stored), 3 = pure heads hit — results allclose with identical keep
+    decisions (the documented heads-path ULP exception)."""
+    from tmr_tpu.serve import GalleryBank
+
+    bank = GalleryBank(pred, feature_cache=4, max_n_bucket=32)
+    for i, b in enumerate(BOXES):
+        bank.register(f"p{i}", b)
+    img = _img(12)
+    r1 = bank.search(img)
+    r2 = bank.search(img)
+    r3 = bank.search(img)
+    c = bank.counters
+    assert c["fused_frames"] == 1
+    assert c["backbone_fills"] == 1  # sighting 2 filled; 3 hit the cache
+    assert c["heads_frames"] == 2
+    assert bank.feature_cache.stats()["hits"] == 1
+    for i in range(3):
+        for r in (r2, r3):
+            a, b_ = r1[f"p{i}"], r[f"p{i}"]
+            assert np.array_equal(a["valid"], b_["valid"]), i
+            for k in ("boxes", "scores", "refs"):
+                assert np.allclose(a[k], b_[k], atol=1e-4), (i, k)
+
+
+def test_prefilter_skips_carry_degrade_step(pred):
+    """Prefilter contract: off = exact (pinned above); on = skipped
+    entries return empty detections that SAY so, full-match invocations
+    drop to the top-k, and the scores rank a featureless-region entry
+    below textured ones."""
+    from tmr_tpu.serve import GalleryBank
+
+    bank = GalleryBank(pred, feature_cache=4, max_n_bucket=32)
+    # frame: zero background + texture at BOXES[0] and BOXES[2]; entry
+    # "empty" registered over the untouched zero region
+    img = np.zeros((SIZE, SIZE, 3), np.float32)
+    rng = np.random.default_rng(5)
+    for b in (BOXES[0], BOXES[2]):
+        x1, y1 = int(b[0, 0] * SIZE), int(b[0, 1] * SIZE)
+        x2, y2 = int(b[0, 2] * SIZE), int(b[0, 3] * SIZE)
+        img[y1:y2, x1:x2, :] = rng.standard_normal(
+            (y2 - y1, x2 - x1, 3)
+        ).astype(np.float32) * 3.0
+    bank.register("tex0", BOXES[0])
+    bank.register("empty", np.asarray([[0.7, 0.7, 0.82, 0.82]],
+                                      np.float32))
+    bank.register("tex2", BOXES[2])
+    fm0 = bank.counters["full_match_entries"]
+    res = bank.search(img, prefilter_topk=2)
+    assert bank.counters["prefilter_runs"] == 1
+    assert bank.counters["prefilter_skipped"] == 1
+    assert bank.counters["full_match_entries"] - fm0 == 2
+    skipped = [n for n, r in res.items() if r.get("degrade_steps")]
+    assert skipped == ["empty"]
+    r = res["empty"]
+    assert r["degrade_steps"] == ["prefilter"]
+    assert r["valid"].size == 0 and "prefilter_score" in r
+    for name in ("tex0", "tex2"):
+        assert "degrade_steps" not in res[name]
+
+
+def test_gallery_gate_refusal_records_cause(pred):
+    """A gallery program whose trace runs the backbone more than once
+    must be refused with a recorded gate_probe/v1 cause (and the tier
+    then routes through the split programs — amortization preserved by
+    construction)."""
+    from tmr_tpu.diagnostics import drain_gate_refusals
+    from tmr_tpu.serve import gallery as gal
+
+    class Doubled:
+        """Predictor stand-in whose gallery tail re-runs the backbone on
+        the frame — the exact amortization violation the gate exists
+        to catch."""
+
+        cfg = pred.cfg
+        model = pred.model
+        params = pred.params
+
+        def _gallery_tail(self, heads, n_bucket, k_bucket, refine,
+                          scales=None):
+            real = pred._gallery_tail(heads, n_bucket, k_bucket, refine,
+                                      scales)
+            backbone = pred.model.backbone
+
+            def tail(params, rparams, feat, ex, k_real, n_real, hw):
+                import jax.numpy as jnp
+
+                extra = backbone.apply(
+                    {"params": params["backbone"]},
+                    jnp.zeros((1, hw[0], hw[1], 3), jnp.float32),
+                )
+                if isinstance(extra, (list, tuple)):
+                    extra = extra[0]
+                feat = feat + 0.0 * extra.sum()
+                return real(params, rparams, feat, ex, k_real, n_real,
+                            hw)
+
+            return tail
+
+    drain_gate_refusals()
+    gal._GATE_CACHE.clear()
+    try:
+        assert gal.gallery_fused_ok(Doubled(), 9, 2, 1) is False
+        recs = drain_gate_refusals()
+        assert recs and recs[-1]["gate"] == "gallery_fused_ok"
+        assert recs[-1]["cause"] == "forward-mismatch"
+        assert "2x" in recs[-1]["message"]
+    finally:
+        gal._GATE_CACHE.clear()
+
+
+def test_coarse_prefilter_scores_rank_texture_over_void(pred):
+    """ops/xcorr.coarse_prefilter_scores: on a zero frame with one
+    textured region, the textured entry outranks the featureless one
+    and padded entries read -inf."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.ops.xcorr import coarse_prefilter_scores
+
+    img = np.zeros((SIZE, SIZE, 3), np.float32)
+    b = BOXES[0]
+    x1, y1 = int(b[0, 0] * SIZE), int(b[0, 1] * SIZE)
+    x2, y2 = int(b[0, 2] * SIZE), int(b[0, 3] * SIZE)
+    img[y1:y2, x1:x2, :] = np.random.default_rng(3).standard_normal(
+        (y2 - y1, x2 - x1, 3)
+    ).astype(np.float32) * 3.0
+    feats = pred._get_backbone_fn()(pred.exec_params(),
+                                    jnp.asarray(img[None]))
+    ex = np.stack([BOXES[0],
+                   np.asarray([[0.7, 0.7, 0.82, 0.82]], np.float32),
+                   BOXES[0]])  # third row is rung padding
+    s = np.asarray(coarse_prefilter_scores(
+        feats, jnp.asarray(ex), jnp.ones((3,), np.int32),
+        jnp.asarray(2, np.int32),
+    ))
+    assert s[0] > s[1], s
+    assert s[2] == -np.inf
+
+
+# ------------------------------------------------------------ feature sink
+def test_feature_sink_streams_evicts_and_syncs(tmp_path):
+    """The PR 10 deferred half, wire level: make_feature_sinks with a
+    tcp:// target streams features into a FeatureSinkServer index, the
+    sync ack vouches for delivery (journal-commit ordering), and evict
+    drops a shard's features (coordinator quarantine authority)."""
+    from tmr_tpu.parallel.elastic import make_feature_sinks
+    from tmr_tpu.serve.gallery import FeatureSinkServer
+
+    sink = FeatureSinkServer(max_entries=64)
+    host, port = sink.start()
+    try:
+        save, cleanup, sync = make_feature_sinks(f"tcp://{host}:{port}")
+        f1 = np.arange(12, dtype=np.float32).reshape(3, 4)
+        f2 = np.ones((2, 2), np.float32)
+        save("shard_a.tar", "img_001.jpg", f1)
+        save("shard_a.tar", "img_002.jpg", f2)
+        save("shard_b.tar", "img_009.jpg", f2)
+        sync("shard_a.tar")  # ack vouches for everything sent before
+        assert np.array_equal(sink.index.get(("shard_a", "img_001")), f1)
+        assert np.array_equal(sink.index.get(("shard_a", "img_002")), f2)
+        c = sink.counters()
+        assert c["features"] == 3 and c["errors"] == 0
+        assert c["bytes"] == f1.nbytes + 2 * f2.nbytes
+        cleanup("shard_a.tar")  # quarantine eviction
+        assert sink.index.get(("shard_a", "img_001")) is None
+        assert sink.index.get(("shard_b", "img_009")) is not None
+        assert sink.counters()["evicted_shards"] == 1
+    finally:
+        sink.close()
+
+
+def test_feature_sink_sync_fails_dirty_connection():
+    """A feature the sink could not index must fail the shard's sync —
+    the durability contract: the journal marker only commits after a
+    CLEAN ack, so the retry machinery re-streams the shard."""
+    from tmr_tpu.parallel.leases import recv_line, send_line
+    from tmr_tpu.serve.gallery import FeatureSinkServer
+
+    sink = FeatureSinkServer(max_entries=8)
+    host, port = sink.start()
+    try:
+        with socket.create_connection((host, port), timeout=5) as s:
+            f = s.makefile("rb")
+            send_line(s, {"op": "hello", "worker": "t"})
+            assert recv_line(f)["ok"]
+            send_line(s, {"op": "feature", "shard": "x", "name": "bad",
+                          "array": {"b64": "!!!", "dtype": "float32",
+                                    "shape": [1]}})
+            send_line(s, {"op": "sync", "shard": "x"})
+            reply = recv_line(f)
+            assert reply["ok"] is False and reply["errors"] == 1
+            # the ack resets the window: a clean RETRY on the same
+            # connection must sync ok — a historic error fails exactly
+            # the attempt that streamed it, not every attempt after
+            from tmr_tpu.serve.fleet import pack_array
+
+            send_line(s, {"op": "feature", "shard": "x", "name": "good",
+                          "array": pack_array(
+                              np.ones((2,), np.float32)
+                          )})
+            send_line(s, {"op": "sync", "shard": "x"})
+            retry = recv_line(f)
+            assert retry["ok"] is True and retry["errors"] == 0
+            assert retry["features"] == 1  # the window, not lifetime
+            send_line(s, {"op": "bye"})
+    finally:
+        sink.close()
+    assert sink.counters()["errors"] == 1
+
+
+def test_network_sink_failure_raises_for_retry():
+    """A dead sink fails the save/sync fast (ConnectionError) instead
+    of wedging — the shard attempt machinery owns the retry."""
+    from tmr_tpu.parallel.elastic import make_feature_sinks
+
+    # grab a port and close it: nothing listens there
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    save, _cleanup, sync = make_feature_sinks(f"tcp://127.0.0.1:{port}")
+    with pytest.raises((ConnectionError, OSError)):
+        save("shard.tar", "img.jpg", np.zeros(3, np.float32))
+    with pytest.raises((ConnectionError, OSError)):
+        sync("shard.tar")
+    with pytest.raises(ValueError):
+        make_feature_sinks("tcp://nope")
+
+
+def test_make_feature_sinks_npy_path_unchanged(tmp_path):
+    from tmr_tpu.parallel.elastic import make_feature_sinks
+
+    save, cleanup, sync = make_feature_sinks(str(tmp_path / "feat"))
+    assert callable(save) and callable(cleanup) and callable(sync)
+    assert make_feature_sinks(None) == (None, None, None)

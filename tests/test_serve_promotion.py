@@ -1,0 +1,130 @@
+"""Feature-cache second-sighting promotion × the degrade ladder's
+prefer_heads level × TMR_QUANT_STORAGE=int8 (the PR 15 satellite pin).
+
+The heads-split builders gained stored-param variants in the int8
+storage PR; the serve engine's promotion path (backbone fill program +
+heads-only program + cached-feature reuse) had no parity coverage
+against them, and the prefer_heads degrade step's first-sighting
+routing had no direct result-provenance pin. Both ride one small CPU
+geometry here."""
+
+import numpy as np
+import pytest
+
+SIZE = 128
+
+FIELDS = ("boxes", "scores", "refs", "valid")
+
+
+def _predictor():
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=SIZE,
+                 compute_dtype="float32", batch_size=1)
+    pred = Predictor(cfg)
+    pred.init_params(seed=0, image_size=SIZE)
+    return pred
+
+
+def _img(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (SIZE, SIZE, 3)
+    ).astype(np.float32)
+
+
+EX = [
+    np.asarray([[0.45, 0.45, 0.53, 0.55]], np.float32),
+    np.asarray([[0.2, 0.2, 0.28, 0.3]], np.float32),
+    np.asarray([[0.6, 0.6, 0.68, 0.7]], np.float32),
+]
+
+
+def test_prefer_heads_promotes_on_first_sighting(monkeypatch):
+    """TMR_DEGRADE=2 (truncate_k + prefer_heads): a FIRST-sighting
+    single request routes straight to the feature-fill + heads-only
+    path, its result carries the step (the ladder's never-silent
+    contract), and a repeat with fresh exemplars hits the cache —
+    results allclose vs the sequential predictor with identical keep
+    decisions (the documented heads-path exception)."""
+    from tmr_tpu.serve import ServeEngine
+
+    pred = _predictor()
+    monkeypatch.setenv("TMR_DEGRADE", "2")
+    img = _img(1)
+    with ServeEngine(pred, batch=1, max_wait_ms=5, feature_cache=4,
+                     exemplar_cache=0) as eng:
+        r1 = eng.submit(img, EX[0]).result(timeout=600)
+        r2 = eng.submit(img, EX[1]).result(timeout=600)
+        stats = eng.stats()
+    assert r1["degrade_steps"] == ["prefer_heads"]
+    # the SECOND sighting is an ordinary feature-cache hit — the
+    # heads route is the engine's normal second-sighting behavior, so
+    # no degrade step is recorded for it (routing only differed for
+    # the first sighting)
+    assert "degrade_steps" not in r2
+    assert stats["feature_fills"] >= 1
+    assert stats["feature_cache"]["hits"] >= 1  # first sighting filled
+    assert stats["overload"]["counters"]["degrade.prefer_heads"] == 1
+    for r, ex in ((r1, EX[0]), (r2, EX[1])):
+        want = pred(img[None], ex[None])
+        assert np.array_equal(np.asarray(want["valid"]),
+                              np.asarray(r["valid"]))
+        for k in ("boxes", "scores", "refs"):
+            assert np.allclose(np.asarray(want[k]), np.asarray(r[k]),
+                               atol=1e-4), k
+
+
+@pytest.mark.parametrize("degrade", ["off", "2"])
+def test_promotion_parity_under_int8_storage(monkeypatch, degrade):
+    """THE parity pin: the engine's promotion path (fused first
+    sighting [or prefer_heads first-sighting fill under the ladder],
+    backbone-fill program, heads-only program, cached-feature reuse)
+    under TMR_QUANT_STORAGE=int8 must return BITWISE the fake-quant
+    (f32-storage) engine's results for every request — the
+    quant_storage_ok equality tier carried through the split-program
+    serving path, not just the monolithic programs test_quant_storage
+    pins."""
+    from tmr_tpu.serve import ServeEngine
+
+    # the storage equality tier is defined against the ADMITTED
+    # fake-quant path: fused decoder formulation + int8 numerics (an
+    # unelected auto would run the exact XLA stack on the storage=off
+    # side and the comparison would measure quantization, not storage)
+    monkeypatch.setenv("TMR_DECODER_IMPL", "fused")
+    monkeypatch.setenv("TMR_QUANT", "int8")
+    if degrade != "off":
+        monkeypatch.setenv("TMR_DEGRADE", degrade)
+
+    def run(storage: str):
+        if storage == "int8":
+            monkeypatch.setenv("TMR_QUANT_STORAGE", "int8")
+        else:
+            monkeypatch.delenv("TMR_QUANT_STORAGE", raising=False)
+        pred = _predictor()
+        img = _img(2)
+        out = []
+        with ServeEngine(pred, batch=1, max_wait_ms=5, feature_cache=4,
+                         exemplar_cache=0) as eng:
+            for ex in EX:
+                out.append(eng.submit(img, ex).result(timeout=600))
+            stats = eng.stats()
+        return out, stats
+
+    stored_results, stored_stats = run("int8")
+    fake_results, fake_stats = run("off")
+    # the storage engine really ran stored int8 trees (provenance
+    # stamp) and the promotion path really engaged (fills + hits)
+    assert stored_stats["quant"]["storage"] == "int8"
+    assert fake_stats["quant"]["storage"] == "off"
+    for stats in (stored_stats, fake_stats):
+        assert stats["feature_fills"] >= 1
+        assert stats["feature_cache"]["hits"] >= 1
+        assert stats["heads_batches"] >= 2
+    for i, (a, b) in enumerate(zip(stored_results, fake_results)):
+        for k in FIELDS:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), (
+                f"request {i}: field {k!r} not bitwise-identical "
+                "between stored-int8 and fake-quant promotion paths"
+            )
+        assert a.get("degrade_steps") == b.get("degrade_steps")

@@ -224,6 +224,19 @@ ENV_KNOBS = {
     "TMR_SERVE_MAX_WAIT_MS": "ServeEngine micro-batch wait bound",
     "TMR_SERVE_EXEMPLAR_CACHE": "result-cache capacity (entries)",
     "TMR_SERVE_FEATURE_CACHE": "device feature-cache capacity (entries)",
+    "TMR_SERVE_FEATURE_CACHE_MB": "byte bound on the device feature "
+        "cache (MB; unset = count-only, the original behavior)",
+    # gallery tier (serve/gallery.py: persistent template banks +
+    # streaming-image search)
+    "TMR_GALLERY_PREFILTER_TOPK": "coarse-prefilter top-k: 0/unset = "
+        "off (exact), auto = the gallery_bench-elected winner, int = "
+        "that many entries earn the full match per frame",
+    "TMR_GALLERY_NMAX": "gallery N-bucket ladder cap (entries per "
+        "fused program; default the measured winner, else 32)",
+    "TMR_GALLERY_FEATURE_CACHE": "gallery frame-feature cache capacity "
+        "(entries)",
+    "TMR_GALLERY_FEATURE_CACHE_MB": "byte bound on the gallery "
+        "frame-feature cache (MB)",
     "TMR_SERVE_MESH": "serving device mesh spec (dp<N>/tp<M>, e.g. "
         "dp4, tp4, dp2tp2); unset = unsharded round-robin serving",
     "TMR_SERVE_AOT": "ahead-of-time compile+warmup of the bucketed "

@@ -1022,6 +1022,110 @@ def validate_serve_report(doc: dict) -> List[str]:
     return problems
 
 
+#: schema tag of the gallery-tier benchmark document emitted by
+#: scripts/gallery_bench.py (tmr_tpu/serve/gallery.py): patterns×frames
+#: throughput of the one-backbone-pass gallery tier vs the N-loop of
+#: predict_multi_exemplar on identical (frame, pattern) pairs, the
+#: backbone-amortization evidence (devtime program-call counts:
+#: backbone executions == frames, never frames×N), the fused-arm
+#: bitwise-exactness pin, and the coarse-prefilter sweep
+#: (recall-vs-full-match + full-match invocation cut per top-k rung,
+#: with the elected winner). bench_guard wraps the script, so an error
+#: record ({"schema": ..., "error": str}) is contractually valid;
+#: scripts/bench_trend.py --gallery rc-gates on exactness +
+#: backbone-amortization + the prefilter checks.
+GALLERY_REPORT_SCHEMA = "gallery_report/v1"
+
+#: the boolean acceptance checks a usable gallery_report/v1 must carry
+GALLERY_REPORT_CHECKS = (
+    "bitwise_exact", "backbone_amortized", "prefilter_recall_ok",
+    "prefilter_cut_ok",
+)
+
+
+def validate_gallery_report(doc: dict) -> List[str]:
+    """Structural check of a gallery_report/v1 document; returns a list
+    of problems (empty == valid). An error record is contractually
+    valid (the bench_guard wedge path). Dependency-free like the other
+    validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != GALLERY_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {GALLERY_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("config: not a dict")
+    else:
+        for key in ("image_size", "patterns", "frames"):
+            v = cfg.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                problems.append(f"config.{key}: not a positive int")
+    bank = doc.get("bank")
+    if not isinstance(bank, dict) or not isinstance(
+        bank.get("groups"), list
+    ):
+        problems.append("bank: missing groups list")
+    tput = doc.get("throughput")
+    if not isinstance(tput, dict):
+        problems.append("throughput: not a dict")
+    else:
+        for key in ("gallery_pattern_frames_per_sec",
+                    "n_loop_pattern_frames_per_sec", "speedup"):
+            v = tput.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"throughput.{key}: not a number")
+    bb = doc.get("backbone")
+    if not isinstance(bb, dict):
+        problems.append("backbone: not a dict")
+    else:
+        for key in ("frames", "executions", "pattern_frame_pairs"):
+            v = bb.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"backbone.{key}: not a non-neg int")
+        if not isinstance(bb.get("by_program"), dict):
+            problems.append("backbone.by_program: not a dict")
+    pre = doc.get("prefilter")
+    if not isinstance(pre, dict):
+        problems.append("prefilter: not a dict")
+    else:
+        rungs = pre.get("rungs")
+        if not isinstance(rungs, list):
+            problems.append("prefilter.rungs: not a list")
+        else:
+            for i, r in enumerate(rungs):
+                where = f"prefilter.rungs[{i}]"
+                if not isinstance(r, dict):
+                    problems.append(f"{where}: not a dict")
+                    continue
+                for key in ("topk", "recall", "invocation_cut",
+                            "full_matches"):
+                    if key not in r:
+                        problems.append(f"{where}: missing {key!r}")
+        elected = pre.get("elected_topk")
+        if elected is not None and (
+            not isinstance(elected, int) or isinstance(elected, bool)
+            or elected <= 0
+        ):
+            problems.append(
+                "prefilter.elected_topk: not a positive int or null"
+            )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in GALLERY_REPORT_CHECKS + ("speedup_vs_n_loop",):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 #: schema tag of the overload-robustness probe document emitted by
 #: scripts/overload_probe.py: measured capacity, a >=5x offered-load
 #: round against a bounded-admission engine (admitted-traffic latency

@@ -443,8 +443,20 @@ class Predictor:
     #: static exemplar-count buckets for the multi-exemplar program: the
     #: compiled fn is keyed by bucket, real counts pad up and padded rows'
     #: detections are masked out — variable per-image exemplar counts
-    #: (FSCD-LVIS) don't trigger a full recompile each.
-    K_BUCKETS = (1, 2, 3, 4, 6, 8)
+    #: (FSCD-LVIS) don't trigger a full recompile each. The paper's
+    #: contract is k <= 3; the 16/32 power-of-two rungs exist for the
+    #: gallery tier (serve/gallery.py), where a standing pattern set's
+    #: union of boxes rides the same ladder — without them every distinct
+    #: k past 8 fell through to its own compiled program (a recompile per
+    #: ragged count, pinned against by tests/test_gallery.py).
+    K_BUCKETS = (1, 2, 3, 4, 6, 8, 16, 32)
+
+    #: static entry-count buckets for the fused gallery programs: N bank
+    #: entries pad up to a rung and mask with ``n_real`` exactly like the
+    #: k ladder — ragged bank sizes inside one rung never recompile. The
+    #: serving-side ladder cap is autotune-elected like the batch bound
+    #: (utils/autotune.measured_gallery_nmax).
+    N_BUCKETS = (1, 2, 4, 8, 16, 32)
 
     def _get_multi_fn(self, capacity: int, k_bucket: int, loss_fn=None):
         """One fused program for K-exemplar inference: encoder ONCE, then the
@@ -720,6 +732,254 @@ class Predictor:
         ), st)
         self._compiled[key] = run
         return run
+
+    # -------------------------------------------------------------- gallery
+    # Template-bank programs for the gallery tier (tmr_tpu/serve/gallery):
+    # a STANDING pattern set of N registered exemplar sets matched against
+    # a stream frame with ONE backbone pass, then the matcher/heads/decode
+    # tail batched over N*k rows and a union NMS PER ENTRY — the
+    # multi-pattern generalization of _get_multi_fn. Entry i's slice
+    # traces the same op sequence as predict_multi_exemplar on that
+    # entry's exemplars, which is what keeps the fused gallery arm
+    # bitwise-identical to the N-loop (tests/test_gallery.py pins it;
+    # the same batch-invariance caveat as test_serve applies under the
+    # forced-8-device CPU conftest). N pads to an N_BUCKETS rung with
+    # ``n_real`` masking exactly like the k ladder.
+
+    def _gallery_tail(self, heads, n_bucket: int, k_bucket: int,
+                      refine: bool, scales=None):
+        """The ONE traced tail of the gallery programs: heads over
+        ``n_bucket * k_bucket`` exemplar rows against one frame's
+        features, per-entry row masking, per-entry union NMS. Shared by
+        the fused (:meth:`_get_gallery_fn`) and heads-split
+        (:meth:`_get_gallery_heads_fn`) builders so the two arms can
+        never drift — the split arm differs only in where the features
+        come from (the documented heads-path ULP exception)."""
+
+        def tail(params, refiner_params, feat, exemplars, k_real, n_real,
+                 image_hw):
+            # feat (1, h, w, C); exemplars (n_bucket, k_bucket, 4);
+            # k_real (n_bucket,) int32; n_real () int32
+            head_params = {n: v for n, v in params.items()
+                           if n != "backbone"}
+            rows = n_bucket * k_bucket
+            out = heads.apply(
+                self._variables(head_params, scales),
+                jnp.repeat(feat, rows, axis=0),
+                exemplars.reshape(rows, 1, 4),
+            )
+            dets = self._decode(out, exemplars.reshape(rows, 4))
+            row_ok = jnp.arange(k_bucket)[None, :] < k_real[:, None]
+            entry_ok = (jnp.arange(n_bucket) < n_real)[:, None]
+            dets["valid"] = dets["valid"] & (
+                (row_ok & entry_ok).reshape(-1)[:, None]
+            )
+            merged = {
+                name: dets[name].reshape(
+                    (n_bucket, -1) + dets[name].shape[2:]
+                )
+                for name in ("boxes", "scores", "refs", "valid")
+            }
+            feature = (jnp.repeat(feat, n_bucket, axis=0) if refine
+                       else feat)
+            return self._refine_nms(merged, feature, image_hw,
+                                    refiner_params, refine)
+
+        return tail
+
+    def _get_gallery_fn(self, capacity: int, n_bucket: int, k_bucket: int,
+                        donate: bool = False):
+        """The FUSED gallery program: frame image in, backbone ONCE,
+        then :meth:`_gallery_tail` over the bank — the cold-frame arm
+        whose per-entry results are bitwise the N-loop of
+        ``predict_multi_exemplar``. image (1, S, S, 3); exemplars
+        (n_bucket, k_bucket, 4); k_real (n_bucket,); n_real () int32.
+        Returns fixed-slot dets with leading dim n_bucket (entry
+        order)."""
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity, n_bucket, k_bucket = (
+            int(capacity), int(n_bucket), int(k_bucket)
+        )
+        st = self._storage_state()
+        key = ("gallery", capacity, n_bucket, k_bucket, refine, donate) + (
+            (st.digest,) if st is not None else ()
+        )
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
+        heads = model.clone(backbone=_PassthroughBackbone())
+        tail = self._gallery_tail(
+            heads, n_bucket, k_bucket, refine,
+            scales=st.scales if st is not None else None,
+        )
+        jit = (
+            functools.partial(jax.jit, donate_argnums=(2,)) if donate
+            else jax.jit
+        )
+
+        @jit
+        def run(params, refiner_params, image, exemplars, k_real, n_real):
+            feat = model.backbone.apply(
+                {"params": params["backbone"]}, image
+            )
+            if isinstance(feat, (list, tuple)):
+                if len(feat) != 1:
+                    raise NotImplementedError(
+                        "gallery inference supports single-level "
+                        "backbones only (every shipped backbone is)"
+                    )
+                feat = feat[0]
+            return tail(params, refiner_params, feat, exemplars, k_real,
+                        n_real, (image.shape[1], image.shape[2]))
+
+        bucket = {"capacity": capacity, "n_bucket": n_bucket,
+                  "k_bucket": k_bucket}
+        run = self._storage_entry(track_devtime(
+            track_compile(run, "gallery", key, bucket=bucket),
+            "gallery", key, bucket=bucket,
+        ), st)
+        self._compiled[key] = run
+        return run
+
+    def _get_gallery_heads_fn(self, capacity: int, n_bucket: int,
+                              k_bucket: int, image_size: int):
+        """Gallery tail on PRECOMPUTED features (the feature-cache /
+        prefilter arm): features (1, h, w, C) from
+        :meth:`_get_backbone_fn`. Same tail as the fused program —
+        compiled as its own XLA program, so the heads-path last-ULP
+        exception applies (cold gallery traffic stays on the fused
+        bitwise arm)."""
+        refine = self.refiner is not None and getattr(
+            self.cfg, "refine_box", False
+        )
+        capacity, n_bucket, k_bucket, image_size = (
+            int(capacity), int(n_bucket), int(k_bucket), int(image_size)
+        )
+        st = self._storage_state()
+        key = ("gallery_heads", capacity, n_bucket, k_bucket, image_size,
+               refine) + ((st.digest,) if st is not None else ())
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self._storage_model(
+            self.model.clone(template_capacity=capacity), st
+        )
+        heads = model.clone(backbone=_PassthroughBackbone())
+        tail = self._gallery_tail(
+            heads, n_bucket, k_bucket, refine,
+            scales=st.scales if st is not None else None,
+        )
+
+        @jax.jit
+        def run(params, refiner_params, features, exemplars, k_real,
+                n_real):
+            return tail(params, refiner_params, features, exemplars,
+                        k_real, n_real, (image_size, image_size))
+
+        bucket = {"capacity": capacity, "n_bucket": n_bucket,
+                  "k_bucket": k_bucket, "image_size": image_size}
+        run = self._storage_entry(track_devtime(
+            track_compile(run, "gallery_heads", key, bucket=bucket),
+            "gallery_heads", key, bucket=bucket,
+        ), st)
+        self._compiled[key] = run
+        return run
+
+    def _get_gallery_prefilter_fn(self, n_bucket: int, k_bucket: int):
+        """Coarse prefilter program: channel-pooled low-res correlation
+        score per bank entry (ops/xcorr.coarse_prefilter_scores) on the
+        frame's backbone features — the cheap ranking stage that decides
+        which entries earn the full match+decode. Parameter-free; one
+        compiled entry per (n_bucket, k_bucket)."""
+        from tmr_tpu.ops.xcorr import coarse_prefilter_scores
+
+        n_bucket, k_bucket = int(n_bucket), int(k_bucket)
+        key = ("gallery_prefilter", n_bucket, k_bucket)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        @jax.jit
+        def run(features, exemplars, k_real, n_real):
+            return coarse_prefilter_scores(features, exemplars, k_real,
+                                           n_real)
+
+        bucket = {"n_bucket": n_bucket, "k_bucket": k_bucket}
+        run = track_devtime(
+            track_compile(run, "gallery_prefilter", key, bucket=bucket),
+            "gallery_prefilter", key, bucket=bucket,
+        )
+        self._compiled[key] = run
+        return run
+
+    def predict_gallery(self, image, exemplars, k_real, n_real=None,
+                        features=None, image_size=None) -> dict:
+        """Match a bank of N exemplar sets against ONE frame: image
+        (1, S, S, 3); exemplars (N, k_bucket, 4) pre-padded to one k
+        rung; k_real (N,) real row counts; ``n_real`` marks how many
+        leading entries are real (the rest are rung padding). With
+        ``features`` ((1, h, w, C) from :meth:`_get_backbone_fn`, plus
+        ``image_size``) the encoder is skipped — the feature-cache arm.
+        Returns fixed-slot dets with leading dim = the padded N rung;
+        rows past ``n_real`` are fully masked."""
+        if self.params is None:
+            raise RuntimeError("call init_params() or load params first")
+        exemplars = np.asarray(exemplars, np.float32)
+        if exemplars.ndim != 3 or exemplars.shape[-1] != 4:
+            raise ValueError(
+                f"expected (N, k_bucket, 4) exemplars, got "
+                f"{exemplars.shape}"
+            )
+        n = int(n_real) if n_real is not None else exemplars.shape[0]
+        if not 1 <= n <= exemplars.shape[0]:
+            raise ValueError(
+                f"n_real={n} out of range for {exemplars.shape[0]} "
+                "bank entries"
+            )
+        k_real = np.asarray(k_real, np.int32).reshape(-1)
+        if k_real.shape[0] != exemplars.shape[0]:
+            raise ValueError("k_real must have one count per entry")
+        k_bucket = int(exemplars.shape[1])
+        if not all(1 <= int(k) <= k_bucket for k in k_real[:n]):
+            raise ValueError(
+                f"k_real rows must lie in [1, {k_bucket}]"
+            )
+        n_bucket = int(next((b for b in self.N_BUCKETS if b >= n), n))
+        if exemplars.shape[0] < n_bucket:
+            pad = n_bucket - exemplars.shape[0]
+            exemplars = np.concatenate(
+                [exemplars, np.tile(exemplars[-1:], (pad, 1, 1))], axis=0
+            )
+            k_real = np.concatenate(
+                [k_real, np.ones((pad,), np.int32)]
+            )
+        else:
+            exemplars = exemplars[:n_bucket]
+            k_real = k_real[:n_bucket]
+        if features is None:
+            size = int(image.shape[1])
+        else:
+            if image_size is None:
+                raise ValueError(
+                    "features-arm predict_gallery needs image_size"
+                )
+            size = int(image_size)
+        rows = np.concatenate(
+            [exemplars[i, :int(k_real[i])] for i in range(n)], axis=0
+        )
+        cap = self.pick_capacity(rows, size)
+        args = (
+            self.exec_params(), self.refiner_params,
+            jnp.asarray(exemplars), jnp.asarray(k_real),
+            jnp.asarray(n, jnp.int32),
+        )
+        if features is None:
+            fn = self._get_gallery_fn(cap, n_bucket, k_bucket)
+            return fn(args[0], args[1], jnp.asarray(image), *args[2:])
+        fn = self._get_gallery_heads_fn(cap, n_bucket, k_bucket, size)
+        return fn(args[0], args[1], features, *args[2:])
 
     # ------------------------------------------------------- sharded serve
     # Mesh-sharded program variants for the serving tier (serve/meshplan):

@@ -522,6 +522,99 @@ def cross_correlation(
     return out
 
 
+#: sketch width of the coarse prefilter: the C feature channels project
+#: onto this many fixed ±1 sketch channels before the low-res
+#: correlation — the Johnson-Lindenstrauss estimate of the full C-channel
+#: correlation at ~G/C of its cost
+COARSE_SKETCH_CHANNELS = 32
+
+
+def coarse_prefilter_scores(
+    feature: jnp.ndarray,
+    exemplars: jnp.ndarray,
+    k_real: jnp.ndarray,
+    n_real: jnp.ndarray,
+    pool: int = 2,
+    sketch: int = COARSE_SKETCH_CHANNELS,
+) -> jnp.ndarray:
+    """Channel-sketched, low-resolution correlation score per gallery
+    bank entry — the gallery tier's coarse prefilter (serve/gallery.py).
+
+    The full match runs the depthwise correlation over every (entry,
+    channel) pair at the upsampled grid; this ranking stage reuses the
+    SAME normalized-cross-correlation scoring at a fraction of the cost
+    (the coarse-to-fine lesson of PAPERS.md's semi-dense matching paper
+    + the NCC-scoring paper): the C feature channels project onto
+    ``sketch`` fixed ±1 Rademacher channels (a deterministic
+    Johnson-Lindenstrauss sketch — the sketch-space correlation is an
+    unbiased estimator of the full-channel correlation with variance
+    ~1/sketch, where a plain channel mean would be exactly ZERO after
+    the backbone neck's per-position LayerNorm), average-pool ``pool``x
+    spatially, and each entry's boxes extract tiny sketch-channel
+    templates whose summed correlation peak — normalized by template
+    energy, the NCC form at reduced resolution — is the entry's score.
+    An entry's score is the max over its real exemplar rows.
+
+    feature: (1, H, W, C) NHWC backbone features; exemplars
+    (N, K, 4) normalized xyxy; k_real (N,) int32 real rows per entry;
+    n_real () int32 real entries. Returns (N,) float32 scores with
+    padded entries at ``-inf``. A RANKING heuristic only: the gallery
+    tier's exactness contract is prefilter-off = exact, and the
+    gallery_report/v1 bench measures recall-vs-full-match at the
+    elected top-k rather than assuming it.
+    """
+    c = int(feature.shape[-1])
+    g = max(min(int(sketch), c), 1)
+    # fixed seeded Rademacher sketch: a trace-time constant (folded by
+    # XLA), deterministic across processes/platforms by construction
+    signs = jnp.where(
+        jax.random.bernoulli(jax.random.key(20260804), 0.5, (c, g)),
+        1.0, -1.0,
+    ) / jnp.sqrt(float(g))
+    f = jnp.einsum(
+        "bhwc,cg->bghw", feature.astype(jnp.float32), signs
+    )  # (1, G, H, W)
+    # adaptive pooling: keep at least 8 coarse cells per axis — tiny
+    # probe grids (a 128px frame's 8x8 backbone grid) would otherwise
+    # pool below the resolution a box-sized template needs to rank
+    if min(int(feature.shape[1]), int(feature.shape[2])) < 8 * pool:
+        pool = 1
+    if pool > 1:
+        H, W = f.shape[2], f.shape[3]
+        f = f[:, :, : H - H % pool, : W - W % pool]
+        f = f.reshape(
+            1, g, f.shape[2] // pool, pool, f.shape[3] // pool, pool
+        ).mean(axis=(3, 5))
+    # NCC zero-mean, per sketch channel per frame: untrained and
+    # trained backbones alike carry a large common token component, and
+    # without centering every template's correlation is dominated by
+    # the shared DC (a featureless region would outrank a true match)
+    f = f - f.mean(axis=(2, 3), keepdims=True)
+    h, w = int(f.shape[2]), int(f.shape[3])
+    m = max(h, w)
+    cap = m - (1 - m % 2)  # largest odd capacity the coarse grid holds
+    N, K = int(exemplars.shape[0]), int(exemplars.shape[1])
+    fm = jnp.broadcast_to(f, (N * K, g, h, w))  # (NK, G, h, w)
+    ex = exemplars.reshape(N * K, 4)
+    templates, thw = jax.vmap(
+        lambda fi, e: extract_template(fi, e, cap)
+    )(fm, ex)
+    # squeeze=True: the correlation sums over sketch channels — the
+    # sketch estimate of the full matcher's channel-summed response.
+    # Deliberately NO template-energy normalization beyond the
+    # matcher's own 1/(ht*wt): the prefilter predicts the FULL
+    # MATCHER's response magnitude, and the matcher is not
+    # scale-invariant — an energy-normalized score would rank against
+    # exactly the signal the downstream heads consume.
+    corr = cross_correlation(fm, templates, thw, squeeze=True)
+    scores = corr.reshape(N * K, -1).max(axis=1)
+    scores = scores.reshape(N, K)
+    row_ok = jnp.arange(K)[None, :] < k_real[:, None]
+    scores = jnp.where(row_ok, scores, -jnp.inf).max(axis=1)
+    entry_ok = jnp.arange(N) < n_real
+    return jnp.where(entry_ok, scores, -jnp.inf)
+
+
 def match_templates(
     feature: jnp.ndarray,
     exemplars: jnp.ndarray,

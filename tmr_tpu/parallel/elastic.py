@@ -270,9 +270,17 @@ class ElasticCoordinator:
         os.makedirs(self.lease_dir, exist_ok=True)
         # like the shard paths: workers resolve this from their own cwd,
         # so a relative features tree would scatter across worker cwds
+        # (a tcp:// sink target is location-independent and must NOT be
+        # mangled into a filesystem path)
         self.features_out = (
-            os.path.abspath(features_out) if features_out else None
+            features_out if not features_out
+            or str(features_out).startswith("tcp://")
+            else os.path.abspath(features_out)
         )
+        # the cleanup sinks build ONCE (for a tcp:// target each
+        # make_feature_sinks call would dial its own connection per
+        # sweep pass and abandon it — connection churn at the sink)
+        self._feature_sinks = make_feature_sinks(self.features_out)
         self.data_dir = data_dir
         self.image_size = int(image_size)
         self.batch_size = int(batch_size)
@@ -536,7 +544,7 @@ class ElasticCoordinator:
         targets = self._svc.take_cleanup_targets()
         if not targets:
             return
-        _save, cleanup, _sync = make_feature_sinks(self.features_out)
+        _save, cleanup, _sync = self._feature_sinks
         if cleanup is None:
             return
         for shard in targets:
@@ -837,9 +845,18 @@ def make_feature_sinks(features_out: Optional[str]):
     definition of that layout: the mapreduce CLI and elastic workers
     both call this, so single-process and elastic runs produce
     byte-identical trees by construction. All None when features are
-    off."""
+    off.
+
+    A ``tcp://host:port`` target streams features over the fleet
+    data-link JSON-lines protocol into a serve-side
+    ``serve.gallery.FeatureSinkServer`` instead (the deferred half of
+    PR 10's elastic item: extracted features land in the serve feature
+    cache / gallery index directly, no ``.npy`` bounce) — see
+    :func:`_network_feature_sinks` for the durability contract."""
     if not features_out:
         return None, None, None
+    if str(features_out).startswith("tcp://"):
+        return _network_feature_sinks(str(features_out))
     from tmr_tpu.parallel.mapreduce import (
         CATEGORIES, atomic_save_npy, category_of,
     )
@@ -862,6 +879,117 @@ def make_feature_sinks(features_out: Optional[str]):
 
     def sync(shard: str) -> None:
         fsync_dir(shard_dir(shard))
+
+    return save, cleanup, sync
+
+
+def _network_feature_sinks(url: str):
+    """(save, cleanup, sync) streaming over the fleet data-link
+    protocol to a ``serve.gallery.FeatureSinkServer`` at
+    ``tcp://host:port`` — extracted features flow straight into the
+    serve feature cache / gallery index, never through ``.npy`` files.
+
+    Durability keeps the ``atomic_save_npy``-before-journal contract on
+    the wire: ``save`` pipelines feature lines with NO per-image ack,
+    and ``sync`` (called by ``_run_stream_impl`` before the shard's
+    journal marker commits) round-trips one ack that vouches for every
+    feature sent before it on the same ordered TCP connection — a
+    dirty ack (or any socket error) RAISES, failing the shard attempt
+    so the existing retry machinery re-streams the whole shard. One
+    lazily-dialed persistent connection per process, reset on error;
+    ``cleanup`` is the coordinator's quarantine eviction."""
+    rest = url[len("tcp://"):]
+    host, _, port_s = rest.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"feature-sink url {url!r}: expected tcp://host:port"
+        )
+    if not host:
+        raise ValueError(
+            f"feature-sink url {url!r}: expected tcp://host:port"
+        )
+    from tmr_tpu.serve.fleet import pack_array
+
+    state = {"sock": None, "file": None}
+    lock = threading.Lock()
+
+    def _drop_locked() -> None:
+        for key in ("file", "sock"):
+            obj, state[key] = state[key], None
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+
+    def _conn_locked():
+        if state["sock"] is None:
+            sock = socket.create_connection(
+                (host, port), timeout=connect_timeout()
+            )
+            # generous exchange timeout: a dead sink must FAIL the
+            # shard attempt (retryable), never wedge the worker — the
+            # same philosophy as the map phase's stall timeout
+            sock.settimeout(60.0)
+            f = sock.makefile("rb")
+            state["sock"], state["file"] = sock, f
+            send_line(sock, {"op": "hello", "worker": f"map-{os.getpid()}"})
+            reply = recv_line(f)
+            if not (reply and reply.get("ok")):
+                _drop_locked()
+                raise ConnectionError(
+                    f"feature sink {host}:{port} refused hello: {reply!r}"
+                )
+        return state["sock"], state["file"]
+
+    def _exchange(doc: dict, want_ack: bool) -> Optional[dict]:
+        with lock:
+            try:
+                sock, f = _conn_locked()
+                send_line(sock, doc)
+                if not want_ack:
+                    return None
+                reply = recv_line(f)
+            except (OSError, ValueError) as e:
+                _drop_locked()
+                raise ConnectionError(
+                    f"feature sink {host}:{port} unreachable: {e}"
+                ) from e
+            if reply is None:
+                _drop_locked()
+                raise ConnectionError(
+                    f"feature sink {host}:{port} closed mid-exchange"
+                )
+            return reply
+
+    def save(shard: str, name: str, feat) -> None:
+        base = os.path.splitext(os.path.basename(name))[0]
+        _exchange({
+            "op": "feature",
+            "shard": shard_stem(shard),
+            "name": base,
+            "array": pack_array(feat),
+        }, want_ack=False)
+
+    def cleanup(shard: str) -> None:
+        _exchange({"op": "evict", "shard": shard_stem(shard)},
+                  want_ack=True)
+
+    def sync(shard: str) -> None:
+        reply = _exchange({"op": "sync", "shard": shard_stem(shard)},
+                          want_ack=True)
+        if not reply.get("ok"):
+            # drop the connection before failing the attempt: the
+            # retry must start from a FRESH dial, not inherit any
+            # half-streamed connection state
+            with lock:
+                _drop_locked()
+            raise ConnectionError(
+                f"feature sink {host}:{port} reported "
+                f"{reply.get('errors')} failed features for {shard}"
+            )
 
     return save, cleanup, sync
 

@@ -23,6 +23,11 @@ from tmr_tpu.serve.fleet import (
     stub_engine,
     stub_signature,
 )
+from tmr_tpu.serve.gallery import (
+    FeatureSinkServer,
+    GalleryBank,
+    gallery_fused_ok,
+)
 from tmr_tpu.serve.meshplan import MeshPlan, MeshTarget, resolve_plan
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
 
@@ -31,7 +36,9 @@ __all__ = [
     "DEGRADE_STEPS",
     "DegradeController",
     "DeviceStager",
+    "FeatureSinkServer",
     "FleetWorker",
+    "GalleryBank",
     "LRUCache",
     "MeshPlan",
     "MeshTarget",
@@ -45,6 +52,7 @@ __all__ = [
     "StubFleetPredictor",
     "array_digest",
     "class_weight_fn",
+    "gallery_fused_ok",
     "resolve_plan",
     "stub_engine",
     "stub_signature",

@@ -45,6 +45,26 @@ def array_digest(*arrays) -> str:
     return h.hexdigest()
 
 
+def value_nbytes(value) -> int:
+    """Best-effort byte size of a cached value: arrays (numpy or jax —
+    anything with ``.nbytes``) count their buffer, containers sum their
+    leaves, everything else counts zero. Zero-on-unknown keeps the byte
+    bound conservative-in-one-direction only for exotic values; every
+    value the serving layer actually caches (feature maps, detection
+    dicts) is array-shaped and counts exactly."""
+    nbytes = getattr(value, "nbytes", None)
+    if nbytes is not None:
+        try:
+            return int(nbytes)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(value, dict):
+        return sum(value_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(value_nbytes(v) for v in value)
+    return 0
+
+
 class LRUCache:
     """Bounded LRU mapping with observable counters.
 
@@ -56,13 +76,29 @@ class LRUCache:
     MetricsRegistry (they then travel in its ``snapshot()``); otherwise
     the cache keeps private Counter instances. ``stats()`` reads the same
     shape either way.
+
+    ``max_bytes``: optional RESIDENCY bound on top of the entry-count
+    bound — the on-device feature cache holds whole feature maps, so a
+    count-only bound lets large frames blow HBM invisibly
+    (``TMR_SERVE_FEATURE_CACHE_MB`` wires this on the engine; gallery
+    banks size theirs the same way). When set, inserts evict LRU-first
+    until the tracked total fits; an entry ALONE bigger than the bound
+    is dropped up front without disturbing the resident working set
+    (insert + eviction both counted — observable, never a silent
+    no-op), and ``stats()`` additionally reports ``bytes`` /
+    ``max_bytes``. Unset (0/None) keeps the count-only behavior and the
+    original stats shape byte-identical.
     """
 
     def __init__(self, capacity: int,
                  registry: Optional[MetricsRegistry] = None,
-                 name: str = ""):
+                 name: str = "",
+                 max_bytes: Optional[int] = None):
         self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes) if max_bytes else 0
         self._data: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._sizes: dict = {}
+        self._bytes = 0
         self._lock = threading.Lock()
         if registry is not None and name:
             make = lambda which: registry.counter(f"{name}.{which}")  # noqa: E731
@@ -103,14 +139,48 @@ class LRUCache:
     def put(self, key: Hashable, value: Any) -> None:
         if self.capacity <= 0:
             return
+        size = value_nbytes(value) if self.max_bytes else 0
         with self._lock:
+            if self.max_bytes and size > self.max_bytes:
+                # an entry alone over the bound is dropped WITHOUT
+                # touching the resident working set (evicting hot
+                # entries to make room for something that can never fit
+                # would wipe the cache); counted as insert + eviction so
+                # the drop is observable, and a previous value under
+                # the same key is removed — the caller replaced it
+                if key in self._data:
+                    self._bytes -= self._sizes.pop(key, 0)
+                    del self._data[key]
+                self._inserts.inc()
+                self._evictions.inc()
+                return
             if key in self._data:
                 self._data.move_to_end(key)
+                self._bytes -= self._sizes.pop(key, 0)
             self._data[key] = value
+            if self.max_bytes:
+                self._sizes[key] = size
+                self._bytes += size
             self._inserts.inc()
-            while len(self._data) > self.capacity:
-                self._data.popitem(last=False)
+            while self._data and (
+                len(self._data) > self.capacity
+                or (self.max_bytes and self._bytes > self.max_bytes)
+            ):
+                dead, _ = self._data.popitem(last=False)
+                self._bytes -= self._sizes.pop(dead, 0)
                 self._evictions.inc()
+
+    def pop(self, key: Hashable) -> Optional[Any]:
+        """Remove one entry (byte accounting updated); returns the value
+        or None when absent. A bookkeeping operation like
+        ``__contains__`` — it touches neither the traffic counters nor
+        the eviction tally (evictions count capacity pressure, not
+        explicit removals)."""
+        with self._lock:
+            if key not in self._data:
+                return None
+            self._bytes -= self._sizes.pop(key, 0)
+            return self._data.pop(key)
 
     def __len__(self) -> int:
         with self._lock:
@@ -126,7 +196,7 @@ class LRUCache:
         with self._lock:
             hits, misses = self._hits.value, self._misses.value
             total = hits + misses
-            return {
+            out = {
                 "capacity": self.capacity,
                 "size": len(self._data),
                 "hits": hits,
@@ -135,3 +205,9 @@ class LRUCache:
                 "inserts": self._inserts.value,
                 "hit_rate": (hits / total) if total else 0.0,
             }
+            if self.max_bytes:
+                # present only under byte accounting: the default stats
+                # shape stays byte-identical (engine/report pins)
+                out["bytes"] = self._bytes
+                out["max_bytes"] = self.max_bytes
+            return out

@@ -171,10 +171,16 @@ class ServeEngine:
             if exemplar_cache is None else exemplar_cache,
             registry=self.metrics, name="serve.cache.result",
         )
+        # optional HBM-residency bound on the device feature cache
+        # (TMR_SERVE_FEATURE_CACHE_MB): gallery/large-frame workloads
+        # can blow memory through a count-only bound — when set, inserts
+        # evict by tracked bytes too and stats() reports `bytes`
+        feat_mb = _env_float("TMR_SERVE_FEATURE_CACHE_MB", 0.0)
         self.feature_cache = LRUCache(
             _env_int("TMR_SERVE_FEATURE_CACHE", 8)
             if feature_cache is None else feature_cache,
             registry=self.metrics, name="serve.cache.feature",
+            max_bytes=int(feat_mb * (1 << 20)) if feat_mb > 0 else None,
         )
         # image digests seen once: the second sighting promotes the image
         # into the feature cache (cold traffic stays on the bitwise-exact

@@ -1,0 +1,826 @@
+"""Gallery tier: persistent template banks, one-backbone-pass
+multi-pattern matching, and a coarse prefilter for streaming-image
+search.
+
+The paper's contract is 1-3 exemplars per request; production serves
+STANDING pattern sets — watchlists, catalog SKUs, defect libraries —
+against image streams, where a naive deployment pays one full request
+(backbone included) per (frame, pattern) pair. This tier closes that
+multiplier over the existing backbone/heads split programs:
+
+- **Bank registry** (:class:`GalleryBank`): register/evict named
+  exemplar sets. Registration does ALL the per-pattern work once — the
+  odd template-capacity bucket is picked from the exemplar geometry
+  (``ops/xcorr.template_geometry``'s host mirror,
+  ``select_capacity_bucket``), the boxes pad onto the static
+  (k-bucket) grid, entries bucket by (capacity, k_bucket), and each
+  bucket's pattern tensors are placed device-resident — so per-frame
+  work never re-processes or re-uploads a pattern. (The (C, T, T)
+  template values themselves are functions of the FRAME's features in
+  this model — extraction is two tiny einsums fused into the device
+  program on the pre-staged grid; hoisting them would break the bitwise
+  contract below.)
+- **Fused gallery-vs-image matching**: one backbone pass per frame.
+  Cold frames run ``Predictor._get_gallery_fn`` — backbone + N·k
+  matcher/heads rows + per-entry union NMS in ONE program, per-entry
+  results bitwise-identical to an N-loop of ``predict_multi_exemplar``
+  (pinned by tests/test_gallery.py; gate below). Hot frames ride the
+  feature cache with second-sighting promotion exactly like the serve
+  engine — backbone program once, then ``_get_gallery_heads_fn`` per
+  bucket (the documented heads-path last-ULP exception). Bank sizes pad
+  to the ``N_BUCKETS`` rung ladder with ``n_real`` masking, so ragged
+  bank sizes inside a rung never recompile; the ladder cap is
+  autotune-elected like the batch bound
+  (``utils/autotune.measured_gallery_nmax``).
+- **Coarse prefilter** (``TMR_GALLERY_PREFILTER_TOPK``; off = exact):
+  a channel-sketched, low-resolution NCC-style score per bank entry
+  (``ops/xcorr.coarse_prefilter_scores`` — fixed ±1 Rademacher sketch,
+  spatially pooled, per-frame zero-meaned) ranks which entries earn the
+  full match+decode. Entries outside the top-k return empty results
+  carrying ``degrade_steps: ["prefilter"]`` — the degrade ladder's
+  exactness contract: approximation is never silent.
+  ``scripts/gallery_bench.py`` measures recall-vs-full-match at the
+  elected top-k and emits the validated ``gallery_report/v1``.
+- **Feature sink** (:class:`FeatureSinkServer`): elastic map workers
+  stream extracted features straight into a serve-side feature index
+  over the fleet data-link JSON-lines protocol
+  (``parallel/elastic.make_feature_sinks`` with a ``tcp://`` target)
+  instead of bouncing through ``.npy`` trees — the deferred half of
+  PR 10's elastic item.
+
+Env knobs (lazily read; registered in config.ENV_KNOBS):
+``TMR_GALLERY_PREFILTER_TOPK`` (0/unset = off = exact; ``auto`` = the
+bench-elected winner; int = that top-k), ``TMR_GALLERY_NMAX`` (N-bucket
+ladder cap; default the measured winner, else 32),
+``TMR_GALLERY_FEATURE_CACHE`` (frame-feature cache entries),
+``TMR_GALLERY_FEATURE_CACHE_MB`` (byte bound on the same cache).
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tmr_tpu.obs.metrics import MetricsRegistry
+from tmr_tpu.serve.caches import LRUCache, array_digest
+
+#: detection fields a gallery result carries (mirrors engine._det_fields:
+#: the fixed four plus the device decode tail's optional count vector)
+_DET_FIELDS = ("boxes", "scores", "refs", "valid", "count")
+
+#: the bank's counter names, registered as ``gallery.<name>`` —
+#: full_match_entries is the prefilter-cut denominator the bench reads
+_COUNTER_NAMES = (
+    "searches", "fused_frames", "heads_frames", "backbone_fills",
+    "registered", "evicted", "full_match_entries", "prefilter_runs",
+    "prefilter_skipped", "nloop_fallback_frames",
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------- the gate
+_GATE_CACHE: dict = {}
+_GATE_LOCK = threading.Lock()
+
+
+def gallery_fused_ok(predictor, capacity: int, n_bucket: int,
+                     k_bucket: int) -> bool:
+    """Trace-only gate for the fused gallery program (the program-audit
+    pattern: ``make_jaxpr`` over abstract inputs, no compile): the
+    program's jaxpr at this geometry must consume the frame through
+    exactly ONE backbone entry convolution — the backbone-amortization
+    invariant the whole tier exists for. A duplicated backbone (N of
+    them) would silently restore the frames×N cost while reading as
+    "fused".
+
+    A refusal records a ``gate_probe/v1`` cause (scripts/gate_probe.py
+    probes this gate) and the gallery tier routes cold frames through
+    the split backbone+heads programs instead — still one backbone pass
+    per frame by construction; what is given up is the fused arm's
+    bitwise contract. Verdicts cache per geometry.
+    """
+    from tmr_tpu.diagnostics import gate_refused
+
+    key = (int(capacity), int(n_bucket), int(k_bucket),
+           int(predictor.cfg.image_size), str(predictor.cfg.backbone))
+    with _GATE_LOCK:
+        if key in _GATE_CACHE:
+            return _GATE_CACHE[key]
+    config = {"capacity": key[0], "n_bucket": key[1], "k_bucket": key[2],
+              "image_size": key[3], "backbone": key[4]}
+    ok = False
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tmr_tpu.analysis.program_audit import iter_eqns
+        from tmr_tpu.inference import _PassthroughBackbone
+
+        size = int(predictor.cfg.image_size)
+        model = predictor.model.clone(template_capacity=int(capacity))
+        heads = model.clone(backbone=_PassthroughBackbone())
+        tail = predictor._gallery_tail(heads, int(n_bucket),
+                                       int(k_bucket), False)
+        image = jax.ShapeDtypeStruct((1, size, size, 3), jnp.float32)
+        ex = jax.ShapeDtypeStruct((int(n_bucket), int(k_bucket), 4),
+                                  jnp.float32)
+        kr = jax.ShapeDtypeStruct((int(n_bucket),), jnp.int32)
+        nr = jax.ShapeDtypeStruct((), jnp.int32)
+        params = predictor.params
+        if params is None:
+            params = jax.eval_shape(
+                model.init, jax.random.key(0), image,
+                jax.ShapeDtypeStruct((1, 1, 4), jnp.float32),
+            )["params"]
+
+        def body(params, image, exemplars, k_real, n_real):
+            feat = model.backbone.apply(
+                {"params": params["backbone"]}, image
+            )
+            if isinstance(feat, (list, tuple)):
+                feat = feat[0]
+            return tail(params, None, feat, exemplars, k_real, n_real,
+                        (size, size))
+
+        jaxpr = jax.make_jaxpr(body)(params, image, ex, kr, nr)
+        entry_convs = 0
+        for eqn in iter_eqns(getattr(jaxpr, "jaxpr", jaxpr)):
+            if eqn.primitive.name != "conv_general_dilated":
+                continue
+            aval = getattr(eqn.invars[0], "aval", None)
+            shape = getattr(aval, "shape", None)
+            # the backbone entry conv is the only conv consuming a
+            # 3-channel image-layout tensor anywhere in the program
+            if shape is not None and len(shape) == 4 and 3 in (
+                shape[-1], shape[1]
+            ):
+                entry_convs += 1
+        if entry_convs == 1:
+            ok = True
+        else:
+            ok = gate_refused(
+                "gallery_fused_ok",
+                f"backbone entry conv traced {entry_convs}x "
+                "(amortization requires exactly once per frame)",
+                "forward-mismatch", config=config,
+            )
+    except Exception as e:
+        ok = gate_refused(
+            "gallery_fused_ok", f"{type(e).__name__}: {e}", "exception",
+            config=config, exception=type(e).__name__,
+        )
+    with _GATE_LOCK:
+        # a racing double-trace stores the same verdict twice — benign
+        _GATE_CACHE[key] = ok
+    return ok
+
+
+# ------------------------------------------------------------- the registry
+class GalleryEntry:
+    """One registered pattern: name + its boxes padded onto the static
+    (k_bucket) grid, with the capacity bucket picked at registration."""
+
+    __slots__ = ("name", "exemplars", "k_real", "k_bucket", "capacity")
+
+    def __init__(self, name: str, exemplars: np.ndarray, k_real: int,
+                 k_bucket: int, capacity: int):
+        self.name = name
+        self.exemplars = exemplars  # (k_bucket, 4) f32, rows >= k_real pad
+        self.k_real = int(k_real)
+        self.k_bucket = int(k_bucket)
+        self.capacity = int(capacity)
+
+
+class _Group:
+    """One (capacity, k_bucket) bucket chunk of the bank, padded to its
+    N rung with the pattern tensors device-resident."""
+
+    __slots__ = ("capacity", "k_bucket", "names", "n_real", "n_bucket",
+                 "host_ex", "host_k", "ex_dev", "k_dev", "n_dev")
+
+    def __init__(self, capacity: int, k_bucket: int,
+                 members: List[GalleryEntry], n_bucket: int):
+        import jax.numpy as jnp
+
+        self.capacity = capacity
+        self.k_bucket = k_bucket
+        self.names = [e.name for e in members]
+        self.n_real = len(members)
+        self.n_bucket = n_bucket
+        ex = np.stack([e.exemplars for e in members], axis=0)
+        kr = np.asarray([e.k_real for e in members], np.int32)
+        pad = n_bucket - len(members)
+        if pad:
+            ex = np.concatenate([ex, np.tile(ex[-1:], (pad, 1, 1))],
+                                axis=0)
+            kr = np.concatenate([kr, np.ones((pad,), np.int32)])
+        self.host_ex = ex
+        self.host_k = kr
+        # device-resident ONCE at (re)build: per-frame submission moves
+        # only the frame — never the patterns
+        self.ex_dev = jnp.asarray(ex)
+        self.k_dev = jnp.asarray(kr)
+        self.n_dev = jnp.asarray(self.n_real, jnp.int32)
+
+
+class GalleryBank:
+    """A standing pattern set over one Predictor, searched per frame
+    with one backbone pass (module docstring has the architecture).
+
+    Parameters
+    ----------
+    predictor: initialized Predictor (params loaded).
+    image_size: the stream's frame size (None -> cfg.image_size); a
+        bank is pinned to one size (its capacity buckets derive from
+        that feature grid), and ``search`` refuses other frames loudly.
+    prefilter_topk: coarse-prefilter top-k (None -> the
+        ``TMR_GALLERY_PREFILTER_TOPK`` knob; 0 = off = exact).
+    feature_cache: frame-feature cache — an int capacity (None ->
+        ``TMR_GALLERY_FEATURE_CACHE``, default 8; 0 disables) or an
+        existing :class:`LRUCache` to SHARE (e.g. a ServeEngine's, so
+        stream frames and interactive traffic amortize one encoder
+        pass; keys are the engine's (digest, size) tuples).
+    feature_cache_mb: byte bound on an owned feature cache (None ->
+        ``TMR_GALLERY_FEATURE_CACHE_MB``; ignored for a shared cache).
+    max_n_bucket: N-rung ladder cap (None -> ``TMR_GALLERY_NMAX`` ->
+        the autotune-measured winner -> 32); banks larger than the cap
+        chunk into multiple program calls.
+    """
+
+    def __init__(self, predictor, *, image_size: Optional[int] = None,
+                 prefilter_topk: Optional[int] = None,
+                 feature_cache: Any = None,
+                 feature_cache_mb: Optional[float] = None,
+                 max_n_bucket: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if predictor.params is None:
+            raise RuntimeError("predictor has no params loaded")
+        self._pred = predictor
+        self.image_size = int(image_size or predictor.cfg.image_size)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, GalleryEntry]" = OrderedDict()
+        self._groups: Optional[List[_Group]] = None
+        self._topk_arg = prefilter_topk
+        self.metrics = MetricsRegistry() if registry is None else registry
+        self._m = {
+            name: self.metrics.counter(f"gallery.{name}")
+            for name in _COUNTER_NAMES
+        }
+        if isinstance(feature_cache, LRUCache):
+            self.feature_cache = feature_cache
+        else:
+            mb = (_env_float("TMR_GALLERY_FEATURE_CACHE_MB", 0.0)
+                  if feature_cache_mb is None else float(feature_cache_mb))
+            self.feature_cache = LRUCache(
+                _env_int("TMR_GALLERY_FEATURE_CACHE", 8)
+                if feature_cache is None else int(feature_cache),
+                registry=self.metrics, name="gallery.cache.feature",
+                max_bytes=int(mb * (1 << 20)) if mb > 0 else None,
+            )
+        self._seen = LRUCache(
+            max(4 * max(self.feature_cache.capacity, 1), 16)
+        )
+        if max_n_bucket is not None:
+            nmax = int(max_n_bucket)
+        else:
+            nmax = _env_int("TMR_GALLERY_NMAX", 0)
+            if nmax <= 0:
+                from tmr_tpu.utils.autotune import measured_gallery_nmax
+
+                nmax = measured_gallery_nmax(self.image_size) or 0
+        ladder = tuple(self._pred.N_BUCKETS)
+        self.max_n_bucket = (
+            max(b for b in ladder if b <= nmax) if nmax > 0 else ladder[-1]
+        )
+
+    # ------------------------------------------------------------ registry
+    def register(self, name: str, exemplars, k_real: Optional[int] = None
+                 ) -> dict:
+        """Register (or replace) one named pattern set. All host-side
+        pattern work happens HERE, once: k-bucket padding, capacity
+        bucketing, and (at the next search) device placement of the
+        bucket tensors. Returns the entry's resolved buckets."""
+        ex = np.asarray(exemplars, np.float32).reshape(-1, 4)
+        k = int(k_real) if k_real is not None else len(ex)
+        if not 1 <= k <= len(ex):
+            raise ValueError(
+                f"k_real={k} out of range for {len(ex)} exemplar rows"
+            )
+        ex = ex[:k]
+        k_bucket = int(next(
+            (b for b in self._pred.K_BUCKETS if b >= k), k
+        ))
+        cap = self._pred.pick_capacity(ex, self.image_size)
+        padded = np.concatenate(
+            [ex, np.tile(ex[-1:], (k_bucket - k, 1))], axis=0
+        )
+        with self._lock:
+            self._entries[str(name)] = GalleryEntry(
+                str(name), padded, k, k_bucket, cap
+            )
+            self._groups = None  # rebuilt (and re-placed) lazily
+        self._m["registered"].inc()
+        return {"name": str(name), "capacity": cap, "k_bucket": k_bucket,
+                "k_real": k}
+
+    def evict(self, name: str) -> bool:
+        """Drop one named pattern; True when it existed. The bucket
+        tensors rebuild on the next search — the device copies of a
+        dead entry are not kept resident."""
+        with self._lock:
+            existed = self._entries.pop(str(name), None) is not None
+            if existed:
+                self._groups = None
+        if existed:
+            self._m["evicted"].inc()
+        return existed
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return str(name) in self._entries
+
+    def _groups_locked(self) -> List[_Group]:
+        """The (capacity, k_bucket)-bucketed device-resident view of the
+        registry, rebuilt only when the registry changed. Buckets larger
+        than the ladder cap chunk into multiple groups."""
+        with self._lock:
+            if self._groups is not None:
+                return self._groups
+            buckets: "OrderedDict[tuple, List[GalleryEntry]]" = \
+                OrderedDict()
+            for e in self._entries.values():
+                buckets.setdefault((e.capacity, e.k_bucket), []).append(e)
+            ladder = tuple(self._pred.N_BUCKETS)
+            groups: List[_Group] = []
+            for (cap, kb), members in buckets.items():
+                for i in range(0, len(members), self.max_n_bucket):
+                    chunk = members[i:i + self.max_n_bucket]
+                    rung = int(next(
+                        (b for b in ladder if b >= len(chunk)),
+                        len(chunk),
+                    ))
+                    groups.append(_Group(cap, kb, chunk, rung))
+            self._groups = groups
+            return groups
+
+    # -------------------------------------------------------------- search
+    def _resolve_topk(self, override: Optional[int]) -> int:
+        if override is not None:
+            return max(int(override), 0)
+        if self._topk_arg is not None:
+            return max(int(self._topk_arg), 0)
+        raw = os.environ.get("TMR_GALLERY_PREFILTER_TOPK", "")
+        if not raw or raw in ("0", "off", "false"):
+            return 0
+        if raw == "auto":
+            from tmr_tpu.utils.autotune import measured_gallery_topk
+
+            return measured_gallery_topk(self.image_size) or 0
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            raise ValueError(
+                f"TMR_GALLERY_PREFILTER_TOPK={raw!r}: expected "
+                "off|auto|<int>"
+            )
+
+    def search(self, image, prefilter_topk: Optional[int] = None
+               ) -> Dict[str, dict]:
+        """Match every registered pattern against ONE frame. Returns
+        ``{name: dets}`` — numpy fixed-slot detections with leading dim
+        1 per entry (``count`` included under the device decode tail).
+        Entries the prefilter skipped return empty detections carrying
+        ``degrade_steps: ["prefilter"]``; with the prefilter off (the
+        default) results are exact — bitwise the N-loop of
+        ``predict_multi_exemplar`` on cold frames, the documented
+        heads-path allclose on feature-cache hits."""
+        import jax.numpy as jnp
+
+        img = np.asarray(image, np.float32)
+        if img.ndim == 4 and img.shape[0] == 1:
+            img = img[0]
+        if img.ndim != 3 or img.shape[0] != img.shape[1] \
+                or img.shape[2] != 3:
+            raise ValueError(
+                f"expected one square (S, S, 3) frame, got {img.shape}"
+            )
+        size = int(img.shape[0])
+        if size != self.image_size:
+            raise ValueError(
+                f"frame size {size} != bank size {self.image_size} "
+                "(a bank's capacity buckets are pinned to one grid; "
+                "build a second bank for a second stream geometry)"
+            )
+        groups = self._groups_locked()
+        total = sum(g.n_real for g in groups)
+        if total == 0:
+            return {}
+        self._m["searches"].inc()
+        topk = self._resolve_topk(prefilter_topk)
+        prefilter_on = 0 < topk < total
+        digest = array_digest(img)
+        feats = (self.feature_cache.get((digest, size))
+                 if self.feature_cache.capacity > 0 else None)
+
+        if feats is None and not prefilter_on and len(groups) == 1 \
+                and (digest, size) not in self._seen:
+            g = groups[0]
+            if gallery_fused_ok(self._pred, g.capacity, g.n_bucket,
+                                g.k_bucket):
+                # cold frame, one bucket: the FUSED bitwise arm
+                self._seen.put((digest, size), True)
+                try:
+                    fn = self._pred._get_gallery_fn(
+                        g.capacity, g.n_bucket, g.k_bucket
+                    )
+                    dets = fn(
+                        self._pred.exec_params(),
+                        self._pred.refiner_params,
+                        jnp.asarray(img[None]), g.ex_dev, g.k_dev,
+                        g.n_dev,
+                    )
+                except Exception:
+                    return self._nloop_fallback(img, groups)
+                self._m["fused_frames"].inc()
+                self._m["full_match_entries"].inc(g.n_real)
+                return self._unpack(g, dets)
+
+        # ---- features route: backbone program once, gallery tails on it
+        computed = False
+        if feats is None:
+            try:
+                bb = self._pred._get_backbone_fn()
+                feats = bb(self._pred.exec_params(), jnp.asarray(img[None]))
+            except Exception:
+                return self._nloop_fallback(img, groups)
+            computed = True
+            self._m["backbone_fills"].inc()
+        if computed and self.feature_cache.capacity > 0:
+            # second-sighting promotion, as-is from the serve engine:
+            # one-off frames never churn the cache, repeats amortize
+            if (digest, size) in self._seen:
+                self.feature_cache.put((digest, size), feats)
+            else:
+                self._seen.put((digest, size), True)
+
+        selected: Optional[set] = None
+        scores: Dict[str, float] = {}
+        if prefilter_on:
+            selected = set()
+            self._m["prefilter_runs"].inc()
+            ranked: List[Tuple[float, int, str]] = []
+            for gi, g in enumerate(groups):
+                fn = self._pred._get_gallery_prefilter_fn(g.n_bucket,
+                                                          g.k_bucket)
+                s = np.asarray(fn(feats, g.ex_dev, g.k_dev, g.n_dev))
+                for i in range(g.n_real):
+                    scores[g.names[i]] = float(s[i])
+                    ranked.append((float(s[i]), gi, g.names[i]))
+            ranked.sort(key=lambda r: -r[0])
+            selected = {name for _s, _gi, name in ranked[:topk]}
+
+        results: Dict[str, dict] = {}
+        ran_heads = False
+        for g in groups:
+            if selected is None:
+                keep = list(range(g.n_real))
+            else:
+                keep = [i for i in range(g.n_real)
+                        if g.names[i] in selected]
+            skipped = ([] if selected is None else
+                       [i for i in range(g.n_real) if i not in set(keep)])
+            if keep:
+                try:
+                    dets = self._run_group_heads(g, feats, keep, jnp)
+                except Exception:
+                    return self._nloop_fallback(img, groups)
+                ran_heads = True
+                self._m["full_match_entries"].inc(len(keep))
+                results.update(self._unpack(g, dets, keep=keep))
+            for i in skipped:
+                results[g.names[i]] = self._empty_result(
+                    scores.get(g.names[i])
+                )
+                self._m["prefilter_skipped"].inc()
+        if ran_heads:
+            # once per FRAME, not per bucket group: the counter
+            # vocabulary (fused_frames / heads_frames /
+            # nloop_fallback_frames) reconciles against `searches`
+            self._m["heads_frames"].inc()
+        return results
+
+    def _run_group_heads(self, g: _Group, feats, keep: List[int], jnp):
+        """Full match+decode for ``keep``'s entries of one group on the
+        precomputed frame features, padded to the smallest rung that
+        holds them (ragged selections inside a rung share the compiled
+        program — the n_real mask does the rest)."""
+        ladder = tuple(self._pred.N_BUCKETS)
+        if len(keep) == g.n_real:
+            ex_dev, k_dev, n_dev = g.ex_dev, g.k_dev, g.n_dev
+            rung = g.n_bucket
+        else:
+            rung = int(next(
+                (b for b in ladder if b >= len(keep)), len(keep)
+            ))
+            ex = g.host_ex[keep]
+            kr = g.host_k[keep]
+            pad = rung - len(keep)
+            if pad:
+                ex = np.concatenate(
+                    [ex, np.tile(ex[-1:], (pad, 1, 1))], axis=0
+                )
+                kr = np.concatenate([kr, np.ones((pad,), np.int32)])
+            ex_dev = jnp.asarray(ex)
+            k_dev = jnp.asarray(kr)
+            n_dev = jnp.asarray(len(keep), jnp.int32)
+        fn = self._pred._get_gallery_heads_fn(
+            g.capacity, rung, g.k_bucket, self.image_size
+        )
+        return fn(self._pred.exec_params(), self._pred.refiner_params,
+                  feats, ex_dev, k_dev, n_dev)
+
+    def _nloop_fallback(self, img: np.ndarray, groups: List[_Group]
+                        ) -> Dict[str, dict]:
+        """Exact per-entry fallback (the engine's isolation move): one
+        ``predict_multi_exemplar`` call per entry. Correctness
+        preserved, amortization lost — counted, never silent."""
+        self._m["nloop_fallback_frames"].inc()
+        results: Dict[str, dict] = {}
+        for g in groups:
+            for i in range(g.n_real):
+                dets = self._pred.predict_multi_exemplar(
+                    img[None], g.host_ex[i], k_real=int(g.host_k[i])
+                )
+                results[g.names[i]] = {
+                    name: np.asarray(dets[name])
+                    for name in _DET_FIELDS if name in dets
+                }
+            self._m["full_match_entries"].inc(g.n_real)
+        return results
+
+    def _unpack(self, g: _Group, dets: dict,
+                keep: Optional[List[int]] = None) -> Dict[str, dict]:
+        host = {name: np.asarray(dets[name])
+                for name in _DET_FIELDS if name in dets}
+        names = g.names if keep is None else [g.names[i] for i in keep]
+        out: Dict[str, dict] = {}
+        for row, name in enumerate(names):
+            # .copy(): a row-slice VIEW would pin the whole padded
+            # (n_bucket, slots, ...) batch alive per entry (the engine
+            # _finish retention lesson)
+            out[name] = {
+                field: host[field][row:row + 1].copy() for field in host
+            }
+        return out
+
+    def _empty_result(self, score: Optional[float]) -> dict:
+        out = {
+            "boxes": np.zeros((1, 0, 4), np.float32),
+            "scores": np.zeros((1, 0), np.float32),
+            "refs": np.zeros((1, 0, 2), np.float32),
+            "valid": np.zeros((1, 0), bool),
+            "degrade_steps": ["prefilter"],
+        }
+        if score is not None:
+            out["prefilter_score"] = score
+        return out
+
+    # --------------------------------------------------------------- stats
+    @property
+    def counters(self) -> Dict[str, int]:
+        return {name: c.value for name, c in self._m.items()}
+
+    def stats(self) -> dict:
+        groups = self._groups_locked()
+        return {
+            "image_size": self.image_size,
+            "entries": len(self),
+            "groups": [
+                {"capacity": g.capacity, "k_bucket": g.k_bucket,
+                 "n_real": g.n_real, "n_bucket": g.n_bucket}
+                for g in groups
+            ],
+            "max_n_bucket": self.max_n_bucket,
+            "prefilter_topk": self._resolve_topk(None),
+            "feature_cache": self.feature_cache.stats(),
+            **self.counters,
+        }
+
+
+# ------------------------------------------------------------ feature sink
+class _SinkHandler(socketserver.StreamRequestHandler):
+    """One worker's data-link connection: JSON lines in, acks out (the
+    feature op is pipelined — see FeatureSinkServer)."""
+
+    def handle(self):  # noqa: D102 — protocol loop
+        state = {"features": 0, "errors": 0}
+        while True:
+            try:
+                doc = _recv_line(self.rfile)
+            except (ValueError, OSError):
+                break
+            if doc is None:
+                break
+            try:
+                reply = self.server.sink._dispatch(doc, state)
+            except Exception:
+                break
+            if reply is not None:
+                try:
+                    _send_line(self.connection, reply)
+                except OSError:
+                    break
+            if doc.get("op") == "bye":
+                break
+
+
+def _recv_line(f):
+    from tmr_tpu.parallel.leases import recv_line
+
+    return recv_line(f)
+
+
+def _send_line(sock, doc):
+    from tmr_tpu.parallel.leases import send_line
+
+    send_line(sock, doc)
+
+
+class _SinkServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FeatureSinkServer:
+    """Serve-side feature sink: elastic map workers stream extracted
+    features STRAIGHT into a feature index over the fleet data-link
+    JSON-lines protocol instead of bouncing through ``.npy`` trees —
+    the deferred half of PR 10's elastic item
+    (``parallel/elastic.make_feature_sinks`` grows the matching
+    ``tcp://host:port`` client).
+
+    Protocol (one JSON document per line, ``serve.fleet.pack_array``
+    payloads):
+
+    - ``{"op": "hello", "worker": id}`` → ``{"ok": true}``;
+    - ``{"op": "feature", "shard": s, "name": n, "array": ...}`` →
+      NO reply (pipelined: TCP ordering means the next sync ack vouches
+      for every feature sent before it);
+    - ``{"op": "sync", "shard": s}`` → ``{"ok": <no errors since the
+      last sync on this connection>, "features": n, "errors": e}`` —
+      the ``atomic_save_npy`` durability contract on the wire: the
+      worker's journal marker commits only after a clean ack, and a
+      dirty ack fails the shard attempt so the retry machinery
+      re-streams it. Each ack RESETS the connection's accounting
+      window, so a historic error fails exactly the attempt that
+      streamed it, never every attempt after;
+    - ``{"op": "evict", "shard": s}`` → ack; drops the shard's features
+      (the coordinator's quarantine-cleanup authority);
+    - ``{"op": "bye"}`` → ack, connection closes.
+
+    ``index`` is any :class:`LRUCache`-shaped store keyed
+    ``(shard_stem, image_stem)`` — byte-bound it for HBM/host residency
+    (``max_bytes``); a :class:`GalleryBank`'s feature cache or a plain
+    standalone index both work. ``on_feature(shard, name, array)`` is
+    the optional push hook (e.g. device placement, digest-keyed serve
+    cache fill).
+    """
+
+    def __init__(self, index: Optional[LRUCache] = None, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_entries: int = 4096,
+                 max_bytes: Optional[int] = None,
+                 on_feature=None):
+        self.index = LRUCache(max_entries, max_bytes=max_bytes) \
+            if index is None else index
+        self._on_feature = on_feature
+        self._lock = threading.Lock()
+        self._host, self._port = host, int(port)
+        self._server: Optional[_SinkServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._shards: Dict[str, set] = {}
+        self._counters = {"connections": 0, "features": 0, "bytes": 0,
+                          "syncs": 0, "evicted_shards": 0, "errors": 0}
+
+    def start(self) -> Tuple[str, int]:
+        with self._lock:
+            if self._server is not None:
+                return self._server.server_address
+            server = _SinkServer((self._host, self._port), _SinkHandler)
+            server.sink = self
+            thread = threading.Thread(
+                target=server.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="feature-sink", daemon=True,
+            )
+            self._server = server
+            self._thread = thread
+        thread.start()
+        return server.server_address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        with self._lock:
+            if self._server is None:
+                raise RuntimeError("feature sink not started")
+            return self._server.server_address
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"tcp://{host}:{port}"
+
+    def close(self) -> None:
+        with self._lock:
+            server, self._server = self._server, None
+            thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # ------------------------------------------------------------ protocol
+    def _dispatch(self, doc: dict, state: dict) -> Optional[dict]:
+        op = doc.get("op")
+        if op == "feature":
+            try:
+                from tmr_tpu.serve.fleet import unpack_array
+
+                shard = str(doc.get("shard", ""))
+                name = str(doc.get("name", ""))
+                arr = unpack_array(doc["array"])
+                if self._on_feature is not None:
+                    self._on_feature(shard, name, arr)
+                self.index.put((shard, name), arr)
+                state["features"] += 1
+                with self._lock:
+                    self._counters["features"] += 1
+                    self._counters["bytes"] += int(arr.nbytes)
+                    self._shards.setdefault(shard, set()).add(name)
+            except Exception:
+                state["errors"] += 1
+                with self._lock:
+                    self._counters["errors"] += 1
+            return None  # pipelined: the sync ack vouches
+        if op == "sync":
+            with self._lock:
+                self._counters["syncs"] += 1
+            reply = {"op": "sync", "ok": state["errors"] == 0,
+                     "shard": doc.get("shard"),
+                     "features": state["features"],
+                     "errors": state["errors"]}
+            # the ack CLOSES this connection's accounting window: the
+            # next shard attempt on the same connection starts clean —
+            # a historic error must fail exactly the attempt that
+            # streamed it, never every attempt after (the retry
+            # machinery re-streams the whole shard)
+            state["features"] = 0
+            state["errors"] = 0
+            return reply
+        if op == "evict":
+            shard = str(doc.get("shard", ""))
+            with self._lock:
+                names = self._shards.pop(shard, set())
+                self._counters["evicted_shards"] += 1
+            for name in names:
+                self.index.pop((shard, name))
+            return {"op": "evict", "ok": True, "shard": shard,
+                    "dropped": len(names)}
+        if op == "hello":
+            with self._lock:
+                self._counters["connections"] += 1
+            return {"op": "hello", "ok": True}
+        if op == "bye":
+            return {"op": "bye", "ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}

@@ -720,6 +720,77 @@ def measured_bench_batch(
         return None
 
 
+def gallery_cache_key(device_kind: str, image_size: int) -> str:
+    """Cache key for the gallery tier's measured winners (the N-bucket
+    ladder cap and the prefilter top-k) — written by
+    scripts/gallery_bench.py's sweeps, read by serve/gallery.py; one
+    definition so writer and reader can never drift."""
+    return f"{device_kind}|gallery|{image_size}"
+
+
+def _measured_gallery(image_size: int, knob: str,
+                      device_kind: Optional[str]) -> Optional[int]:
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    picked = _cache_load().get(
+        gallery_cache_key(device_kind, int(image_size)), {}
+    ).get(knob)
+    try:
+        return int(picked) if picked is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def measured_gallery_nmax(
+    image_size: int, device_kind: Optional[str] = None
+) -> Optional[int]:
+    """The measured fused-gallery N-bucket ladder cap for (device kind,
+    image size), or None when never measured — the gallery analog of
+    :func:`measured_bench_batch` (bank sizes past the cap chunk into
+    multiple program calls). Best-effort like its sibling."""
+    return _measured_gallery(image_size, "TMR_GALLERY_NMAX", device_kind)
+
+
+def measured_gallery_topk(
+    image_size: int, device_kind: Optional[str] = None
+) -> Optional[int]:
+    """The bench-elected coarse-prefilter top-k (smallest rung with
+    recall >= 0.99 vs full match and >= 2x invocation cut on the
+    gallery_bench workload), or None. Consumed only when the user opts
+    in with ``TMR_GALLERY_PREFILTER_TOPK=auto`` — the prefilter stays
+    off (exact) by default."""
+    return _measured_gallery(image_size, "TMR_GALLERY_PREFILTER_TOPK",
+                             device_kind)
+
+
+def record_gallery_winners(
+    image_size: int, nmax: Optional[int] = None,
+    topk: Optional[int] = None, device_kind: Optional[str] = None
+) -> None:
+    """Persist gallery sweep winners (scripts/gallery_bench.py is the
+    writer). Best-effort like every cache write."""
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return
+    extra = {}
+    if nmax is not None and int(nmax) > 0:
+        extra["TMR_GALLERY_NMAX"] = str(int(nmax))
+    if topk is not None and int(topk) > 0:
+        extra["TMR_GALLERY_PREFILTER_TOPK"] = str(int(topk))
+    if extra:
+        _cache_store(gallery_cache_key(device_kind, int(image_size)), {},
+                     extra=extra)
+
+
 CACHE_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "tmr_tpu", "autotune.json"
 )
@@ -830,6 +901,10 @@ def _validate_cache_obj(obj: dict) -> Dict[str, dict]:
     digit_keys = {
         "TMR_BENCH_BATCH", "TMR_PALLAS_WIN_GROUP",
         "TMR_GLOBAL_BANDS_UNROLL", "TMR_XLA_FLASH_BQ", "TMR_XLA_FLASH_BK",
+        # gallery sweep winners (scripts/gallery_bench.py writes them,
+        # serve/gallery.py reads): the N-bucket ladder cap + the
+        # elected prefilter top-k
+        "TMR_GALLERY_NMAX", "TMR_GALLERY_PREFILTER_TOPK",
     }
     # global-kernel tile preferences: powers of two >= 128 (the contract
     # _env_tile enforces at read time — an off-contract seed value would

@@ -224,6 +224,76 @@ def read_fleet_report(path: str) -> dict:
     }
 
 
+# --------------------------------------------------------- gallery report
+
+
+def read_gallery_report(path: str) -> dict:
+    """Reduce a ``gallery_report/v1`` document (scripts/gallery_bench.py
+    output) to the rc-gating fields: the fused-arm exactness pin, the
+    backbone-amortization evidence (backbone executions == frames, not
+    frames×N), and the prefilter recall/cut checks at the elected
+    top-k — plus a per-rung prefilter table.
+
+    Returns ``{"summary": ..., "rungs": [...], "checks": {...}}`` or
+    ``{"error": ...}`` when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable gallery report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"gallery report is an error record: "
+                         f"{doc['error']}"}
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        return {"error": f"no checks section in {path}"}
+    bb = doc.get("backbone") or {}
+    tput = doc.get("throughput") or {}
+    pre = doc.get("prefilter") or {}
+    rungs = [
+        {"topk": r.get("topk"), "recall": r.get("recall"),
+         "invocation_cut": r.get("invocation_cut"),
+         "full_matches": r.get("full_matches")}
+        for r in (pre.get("rungs") or ()) if isinstance(r, dict)
+    ]
+    return {
+        "summary": {
+            "patterns": (doc.get("config") or {}).get("patterns"),
+            "frames": (doc.get("config") or {}).get("frames"),
+            "speedup_vs_n_loop": checks.get("speedup_vs_n_loop"),
+            "backbone_executions": bb.get("executions"),
+            "backbone_frames": bb.get("frames"),
+            "pattern_frame_pairs": bb.get("pattern_frame_pairs"),
+            "gallery_pattern_frames_per_sec": tput.get(
+                "gallery_pattern_frames_per_sec"
+            ),
+            "elected_topk": pre.get("elected_topk"),
+        },
+        "rungs": rungs,
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "bitwise_exact": checks.get("bitwise_exact") is True,
+            "backbone_amortized": checks.get("backbone_amortized")
+            is True,
+            "prefilter_recall_ok": checks.get("prefilter_recall_ok")
+            is True,
+            "prefilter_cut_ok": checks.get("prefilter_cut_ok") is True,
+        },
+    }
+
+
 # ----------------------------------------------------------- serve sweep
 
 
