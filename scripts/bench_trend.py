@@ -32,6 +32,7 @@ from tmr_tpu.utils.bench_trend import (  # noqa: E402
     read_fleet_report,
     read_gallery_report,
     read_serve_sweep,
+    read_stream_report,
 )
 
 
@@ -67,7 +68,32 @@ def main(argv=None) -> int:
                          "exact, backbone executions == frames "
                          "(amortized), and the elected prefilter "
                          "top-k meets its recall + cut targets")
+    ap.add_argument("--stream", default=None,
+                    help="read a stream_report/v1 file (stream_bench "
+                         "output) instead of the BENCH history: one "
+                         "JSON line with the reuse/throughput "
+                         "summary; rc 1 unless backbone executions "
+                         "are amortized below the frame count, the "
+                         "frames/s speedup clears 1.5x, every "
+                         "'changed' frame is bitwise-exact, reuse "
+                         "never crossed stream ids, and every reused "
+                         "frame carried the temporal_reuse label")
     args = ap.parse_args(argv)
+
+    if args.stream:
+        doc = read_stream_report(args.stream)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["backbone_amortized"] and ck["speedup_ok"]
+                     and ck["changed_frames_exact"]
+                     and ck["cross_stream_isolated"]
+                     and ck["reuse_labeled"]) else 1
 
     if args.gallery:
         doc = read_gallery_report(args.gallery)

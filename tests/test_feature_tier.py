@@ -1,0 +1,331 @@
+"""Disaggregated backbone/match tiers (tmr_tpu/serve/feature_tier.py).
+
+The load-bearing contracts:
+
+- the generalized FeatureSinkServer accounting window resets on ANY
+  successful round-trip (the PR 16 fix — the pre-fix server reset only
+  on sync acks, so an online request/response link that never synced
+  accumulated errors forever);
+- remote features through the heads-only path match local execution
+  (the StubFeaturePredictor carries each image's signature THROUGH its
+  features, so equality is an end-to-end data-path check);
+- a dead feature worker degrades the engine to counted LOCAL execution
+  with zero dropped futures; a fenced (revoked-epoch) extract answers
+  ``fenced``, never stale features; a stamp mismatch (different
+  checkpoint) is refused client-side; a saturated client window fails
+  fast instead of queueing.
+
+Everything runs on loopback with numpy stubs — no XLA in the tier
+tests themselves.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+SIZE = 32
+BOX = np.asarray([[0.2, 0.2, 0.4, 0.4]], np.float32)
+
+
+def _img(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (SIZE, SIZE, 3)
+    ).astype(np.float32)
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def tier_worker():
+    """One coordinator + one holding worker on loopback; yields
+    (tier, worker, predictor)."""
+    from tmr_tpu.serve.feature_tier import (
+        FeatureTier,
+        FeatureWorker,
+        StubFeaturePredictor,
+    )
+
+    pred = StubFeaturePredictor()
+    tier = FeatureTier([SIZE], host="127.0.0.1", port=0)
+    tier.start()
+    worker = FeatureWorker(tier.address, "w0", StubFeaturePredictor(),
+                           data_host="127.0.0.1", data_port=0)
+    worker.start()
+    try:
+        _wait(lambda: worker.held, msg="worker to acquire a partition")
+        yield tier, worker, pred
+    finally:
+        worker.stop()
+        tier.close()
+
+
+# ------------------------------------------------------- sink window reset
+def test_sink_window_resets_on_any_successful_roundtrip():
+    """The satellite fix, wire level: an error followed by a successful
+    NON-SYNC round-trip (here: evict) must not poison the next sync —
+    pre-fix, only sync acks reset the window, so the stale error would
+    fail a later clean attempt."""
+    from tmr_tpu.parallel.leases import recv_line, send_line
+    from tmr_tpu.serve.fleet import pack_array
+    from tmr_tpu.serve.gallery import FeatureSinkServer
+
+    sink = FeatureSinkServer(max_entries=8)
+    host, port = sink.start()
+    try:
+        with socket.create_connection((host, port), timeout=5) as s:
+            f = s.makefile("rb")
+            send_line(s, {"op": "hello", "worker": "t"})
+            assert recv_line(f)["ok"]
+            send_line(s, {"op": "feature", "shard": "x", "name": "bad",
+                          "array": {"b64": "!!!", "dtype": "float32",
+                                    "shape": [1]}})
+            send_line(s, {"op": "evict", "shard": "y"})
+            assert recv_line(f)["ok"] is True  # successful round-trip
+            # the window is CLEAN now: a fresh attempt on the same
+            # connection syncs ok despite the historic error
+            send_line(s, {"op": "feature", "shard": "x", "name": "good",
+                          "array": pack_array(np.ones((2,), np.float32))})
+            send_line(s, {"op": "sync", "shard": "x"})
+            reply = recv_line(f)
+            assert reply["ok"] is True, reply
+            assert reply["errors"] == 0 and reply["features"] == 1
+            send_line(s, {"op": "bye"})
+    finally:
+        sink.close()
+    assert sink.counters()["errors"] == 1  # lifetime tally still counts
+
+
+def test_sink_on_request_hook_acks_errors_and_unknown_ops():
+    """The online generalization: on_request replies close the window
+    like any ack, its exceptions become counted error replies, and ops
+    nobody owns still get the unknown-op error."""
+    from tmr_tpu.parallel.leases import recv_line, send_line
+    from tmr_tpu.serve.gallery import FeatureSinkServer
+
+    def hook(doc, state):
+        if doc.get("op") == "ping":
+            return {"op": "ping", "ok": True}
+        if doc.get("op") == "boom":
+            raise ValueError("kapow")
+        return None
+
+    sink = FeatureSinkServer(max_entries=8, on_request=hook)
+    host, port = sink.start()
+    try:
+        with socket.create_connection((host, port), timeout=5) as s:
+            f = s.makefile("rb")
+            send_line(s, {"op": "feature", "shard": "x", "name": "bad",
+                          "array": {"b64": "!!!", "dtype": "float32",
+                                    "shape": [1]}})
+            send_line(s, {"op": "ping"})
+            assert recv_line(f)["ok"] is True
+            send_line(s, {"op": "sync", "shard": "x"})
+            assert recv_line(f)["ok"] is True  # ping reset the window
+            send_line(s, {"op": "boom"})
+            reply = recv_line(f)
+            assert reply["ok"] is False and "kapow" in reply["error"]
+            send_line(s, {"op": "nonsense"})
+            reply = recv_line(f)
+            assert reply["ok"] is False and "unknown op" in reply["error"]
+    finally:
+        sink.close()
+    assert sink.counters()["errors"] == 2  # bad feature + boom
+
+
+# --------------------------------------------------- disaggregated serving
+def test_remote_features_match_local_execution(tier_worker):
+    """End to end through the wire: an engine armed with a feature
+    client routes its first sighting down the heads-only path on
+    REMOTE features, and the result carries the image's signature —
+    identical to a direct local call."""
+    from tmr_tpu.serve import ServeEngine
+
+    tier, worker, pred = tier_worker
+    client = tier.client(predictor=pred)
+    eng = ServeEngine(pred, batch=2, max_wait_ms=5.0, feature_cache=4,
+                      exemplar_cache=0, feature_client=client)
+    try:
+        img = _img(1)
+        out = eng.submit(img, BOX).result()
+        local = pred(img[None], BOX[None])
+        for k in ("boxes", "scores", "refs", "valid"):
+            assert np.array_equal(out[k], np.asarray(local[k])), k
+        oc = eng.overload_counters()
+        assert oc.get("feature_tier.remote_frames", 0) == 1, oc
+        assert worker.counters()["extracted"] == 1
+        assert client.counters()["fetched"] == 1
+        # the fetched features landed in the stamped feature cache
+        assert eng.feature_cache.stats()["inserts"] == 1
+    finally:
+        eng.close()
+        client.close()
+
+
+def test_dead_worker_degrades_to_counted_local_fallback(tier_worker):
+    """Kill the only feature worker mid-stream: subsequent frames must
+    resolve through LOCAL execution (cold or fallback counted — never
+    silent) with zero dropped futures."""
+    from tmr_tpu.serve import ServeEngine
+
+    tier, worker, pred = tier_worker
+    client = tier.client(predictor=pred)
+    eng = ServeEngine(pred, batch=2, max_wait_ms=5.0, feature_cache=4,
+                      exemplar_cache=0, feature_client=client)
+    try:
+        out = eng.submit(_img(2), BOX).result()
+        assert out["valid"].any()
+        worker.stop()
+        _wait(lambda: tier.holder_for(SIZE) is None,
+              msg="holder to clear after worker exit")
+        futs = [eng.submit(_img(10 + i), BOX) for i in range(3)]
+        for i, fut in enumerate(futs):
+            got = fut.result(timeout=30)
+            local = pred(_img(10 + i)[None], BOX[None])
+            assert np.array_equal(got["scores"],
+                                  np.asarray(local["scores"]))
+        oc = eng.overload_counters()
+        counted = oc.get("feature_tier.cold_frames", 0) \
+            + oc.get("feature_tier.fallback_frames", 0)
+        assert counted >= 3, oc
+    finally:
+        eng.close()
+        client.close()
+
+
+def test_fenced_extract_never_serves_stale_features(tier_worker):
+    """An extract carrying a revoked/unknown (partition, epoch) pair
+    answers ``fenced`` — the worker's own hold is the fence, so a
+    lease the coordinator moved can never produce stale features."""
+    from tmr_tpu.serve.feature_tier import _ExtractLink
+    from tmr_tpu.serve.fleet import pack_array
+
+    tier, worker, pred = tier_worker
+    resolved = tier.holder_for(SIZE)
+    assert resolved is not None
+    wid, epoch, index, addr = resolved
+    link = _ExtractLink(addr, timeout_s=5.0)
+    try:
+        stale = link.call({"op": "extract", "partition": index,
+                           "epoch": epoch + 7, "digest": "d",
+                           "image": pack_array(_img(3))})
+        assert stale["ok"] is False and stale["status"] == "fenced"
+        assert worker.counters()["fenced"] == 1
+        live = link.call({"op": "extract", "partition": index,
+                          "epoch": epoch, "digest": "d",
+                          "image": pack_array(_img(3))})
+        assert live["ok"] is True
+        assert tuple(live["stamp"]) == pred.feature_stamp()
+    finally:
+        link.close()
+
+
+def test_client_refuses_stamp_mismatch(tier_worker):
+    """A client whose engine runs a DIFFERENT checkpoint/formulation
+    must refuse the worker's features (counted) — the wire-level half
+    of the stamped feature-key contract."""
+    from tmr_tpu.serve.feature_tier import StubFeaturePredictor
+
+    tier, worker, pred = tier_worker
+
+    class OtherCheckpoint(StubFeaturePredictor):
+        def feature_stamp(self):
+            return ("other-params", "stub-backbone")
+
+    client = tier.client(predictor=OtherCheckpoint())
+    try:
+        assert client.fetch(_img(4), "d", SIZE) is None
+        assert client.counters()["stamp_mismatches"] == 1
+    finally:
+        client.close()
+
+
+def test_client_window_saturation_fails_fast(tier_worker):
+    """Backpressure contract: a saturated in-flight window makes fetch
+    return None immediately (counted) instead of queueing on the
+    link — the engine's local fallback owns the frame."""
+    tier, worker, pred = tier_worker
+    client = tier.client(predictor=pred, window=1)
+    try:
+        assert client._window.acquire(blocking=False)  # saturate it
+        t0 = time.monotonic()
+        assert client.fetch(_img(5), "d", SIZE) is None
+        assert time.monotonic() - t0 < 1.0  # fast, not a queue wait
+        assert client.counters()["window_rejections"] == 1
+        client._window.release()
+        assert client.fetch(_img(5), "d", SIZE) is not None
+    finally:
+        client.close()
+
+
+def test_client_counts_no_holder_when_tier_is_cold():
+    """An empty tier (no worker ever joined) routes nothing: holds()
+    is False and fetch counts no_holder."""
+    from tmr_tpu.serve.feature_tier import (
+        FeatureTier,
+        StubFeaturePredictor,
+    )
+
+    tier = FeatureTier([SIZE], host="127.0.0.1", port=0)
+    tier.start()
+    client = tier.client(predictor=StubFeaturePredictor())
+    try:
+        assert client.holds(SIZE) is False
+        assert client.fetch(_img(6), "d", SIZE) is None
+        assert client.counters()["no_holder"] == 1
+    finally:
+        client.close()
+        tier.close()
+
+
+def test_worker_rebalance_after_kill_minus_nine():
+    """The lease discipline under the tier: a worker that vanishes
+    without bye (socket torn down, no clean handshake) loses its
+    partition after TTL and a second worker inherits it at a HIGHER
+    epoch — the fence the extract path checks."""
+    from tmr_tpu.parallel.leases import LeasePolicy
+    from tmr_tpu.serve.feature_tier import (
+        FeatureTier,
+        FeatureWorker,
+        StubFeaturePredictor,
+    )
+    from tmr_tpu.serve.fleet import fleet_policy
+
+    policy = fleet_policy(LeasePolicy.from_env(
+        lease_ttl_s=0.4, hb_interval_s=0.1, check_interval_s=0.05,
+    ))
+    tier = FeatureTier([SIZE], host="127.0.0.1", port=0, policy=policy,
+                       check_interval_s=0.05)
+    tier.start()
+    w1 = FeatureWorker(tier.address, "w1", StubFeaturePredictor(),
+                       data_host="127.0.0.1", data_port=0)
+    w1.start()
+    try:
+        _wait(lambda: w1.held, msg="w1 to hold")
+        epoch1 = next(iter(w1.held.values()))
+        # kill -9: freeze the beats and sever the control socket
+        w1._stop_event.set()
+        w1._sock.close()
+        w2 = FeatureWorker(tier.address, "w2", StubFeaturePredictor(),
+                           data_host="127.0.0.1", data_port=0)
+        w2.start()
+        try:
+            _wait(lambda: w2.held, timeout=15.0,
+                  msg="w2 to inherit the partition")
+            resolved = tier.holder_for(SIZE)
+            assert resolved is not None and resolved[0] == "w2"
+            assert resolved[1] > epoch1  # fenced-off old epoch
+        finally:
+            w2.stop()
+    finally:
+        w1._sink.close()
+        tier.close()

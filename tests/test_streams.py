@@ -1,0 +1,339 @@
+"""Stream sessions (tmr_tpu/serve/streams.py): temporal feature reuse
+behind the block-mean delta check.
+
+The load-bearing contracts: reuse is OFF by default (and off = a pure
+passthrough); the delta election is exact at its boundaries (an
+exact-equal frame always reuses, a perturbation AT the threshold still
+reuses, strictly above goes full path); every reused result is labeled
+``temporal_reuse`` and lives under its own result-cache namespace;
+reuse never crosses stream ids; idle sessions evict; and the stamped
+feature keys (PR 16's cache-key fix) keep two checkpoints from ever
+sharing a feature-cache entry.
+
+Everything runs on the numpy StubFeaturePredictor — the stub's
+features carry each image's mean signature end to end, so a wrong
+anchor, a crossed stream, or a stale cache row all show as score
+mismatches without any XLA.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+SIZE = 32
+BOX = np.asarray([[0.2, 0.2, 0.4, 0.4]], np.float32)
+FIELDS = ("boxes", "scores", "refs", "valid")
+
+
+def _img(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (SIZE, SIZE, 3)
+    ).astype(np.float32)
+
+
+@pytest.fixture()
+def engine():
+    from tmr_tpu.serve import ServeEngine
+    from tmr_tpu.serve.feature_tier import StubFeaturePredictor
+
+    eng = ServeEngine(StubFeaturePredictor(), batch=2, max_wait_ms=5.0,
+                      feature_cache=0, exemplar_cache=0)
+    yield eng
+    eng.close()
+
+
+def _same(a, b):
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in FIELDS)
+
+
+# ------------------------------------------------------------ off by default
+def test_reuse_off_by_default_is_pure_passthrough(engine, monkeypatch):
+    """No env, no constructor flag: submit_stream is engine.submit with
+    a frame counter — no sessions, no labels, no feature work."""
+    monkeypatch.delenv("TMR_STREAM_REUSE", raising=False)
+    from tmr_tpu.serve import StreamRouter
+
+    r = StreamRouter(engine)
+    assert r.reuse is False
+    frame = _img(0)
+    out = r.submit_stream("a", frame, BOX).result()
+    again = r.submit_stream("a", frame, BOX).result()  # same frame twice
+    assert "degrade_steps" not in out and "degrade_steps" not in again
+    assert _same(out, engine.submit(frame, BOX).result())
+    assert r.sessions() == {} and r.counters() == {"frames": 2}
+    # TMR_STREAM_REUSE=0 is the same OFF; =1 arms it
+    monkeypatch.setenv("TMR_STREAM_REUSE", "0")
+    assert StreamRouter(engine).reuse is False
+    monkeypatch.setenv("TMR_STREAM_REUSE", "1")
+    assert StreamRouter(engine).reuse is True
+
+
+# --------------------------------------------------------- delta boundaries
+def test_delta_boundaries_exact_equal_at_threshold_and_above(engine):
+    """The election rule at its edges, in exact float32 arithmetic
+    (zeros base, power-of-two perturbations, 4x4 signature blocks):
+    delta 0.0 reuses even at threshold 0.0; a single-pixel change
+    landing EXACTLY on the threshold still reuses; strictly above goes
+    full path and re-anchors."""
+    from tmr_tpu.serve import StreamRouter
+
+    # one pixel changed by 1.0 in a 4x4 block -> block-mean delta is
+    # exactly 1/16 = 0.0625 (a power of two: exact in float32)
+    r = StreamRouter(engine, reuse=True, delta=0.0625)
+    base = np.zeros((SIZE, SIZE, 3), np.float32)
+    first = r.submit_stream("s", base, BOX).result()
+    assert "degrade_steps" not in first
+
+    exact = r.submit_stream("s", base.copy(), BOX).result()
+    assert exact.get("degrade_steps") == ["temporal_reuse"]
+
+    at = base.copy()
+    at[0, 0, 0] = 1.0  # delta == threshold: still reuses
+    out_at = r.submit_stream("s", at, BOX).result()
+    assert out_at.get("degrade_steps") == ["temporal_reuse"]
+
+    above = base.copy()
+    above[0, 0, 0] = 2.0  # delta 0.125 > 0.0625: full path, new anchor
+    out_above = r.submit_stream("s", above, BOX).result()
+    assert "degrade_steps" not in out_above
+    assert _same(out_above, engine.submit(above, BOX).result())
+    c = r.counters()
+    assert (c["first_frames"], c["reused_frames"], c["changed_frames"]) \
+        == (1, 2, 1)
+    # the changed frame re-anchored: repeating it now reuses
+    rep = r.submit_stream("s", above.copy(), BOX).result()
+    assert rep.get("degrade_steps") == ["temporal_reuse"]
+    assert np.array_equal(rep["scores"], out_above["scores"])
+
+    # delta 0.0 still admits the bitwise-equal frame
+    r0 = StreamRouter(engine, reuse=True, delta=0.0)
+    r0.submit_stream("z", base, BOX).result()
+    out = r0.submit_stream("z", base.copy(), BOX).result()
+    assert out.get("degrade_steps") == ["temporal_reuse"]
+
+
+def test_block_signature_is_deterministic_and_shape_bound():
+    from tmr_tpu.serve import block_signature
+
+    frame = _img(7)
+    a, b = block_signature(frame), block_signature(frame.copy())
+    assert np.array_equal(a, b)
+    assert a.shape == (64, 3) and a.dtype == np.float32
+    tiny = np.ones((3, 3, 3), np.float32)  # grid clamps to the frame
+    assert block_signature(tiny).shape == (9, 3)
+
+
+# ------------------------------------------------------ reuse data contracts
+def test_reused_frames_ride_anchor_features_per_stream(engine):
+    """Reused results derive from the session's OWN anchor features
+    (the stub's signature rides through), and two concurrent streams
+    with different content never share: structural isolation."""
+    from tmr_tpu.serve import StreamRouter
+
+    r = StreamRouter(engine, reuse=True)
+    a_frame, b_frame = _img(1), _img(2)
+    a0 = r.submit_stream("a", a_frame, BOX).result()
+    b0 = r.submit_stream("b", b_frame, BOX).result()
+    a1 = r.submit_stream("a", a_frame.copy(), BOX).result()
+    b1 = r.submit_stream("b", b_frame.copy(), BOX).result()
+    assert np.array_equal(a1["scores"], a0["scores"])
+    assert np.array_equal(b1["scores"], b0["scores"])
+    assert not np.array_equal(a1["scores"], b1["scores"])
+    c = r.counters()
+    assert c["reused_frames"] == 2 and c["local_fills"] == 2
+    assert set(r.sessions()) == {"a", "b"}
+
+
+def test_reused_result_cache_namespace_never_leaks(engine):
+    """A reused answer can never be served to a frame-independent
+    query: the temporal_reuse step is part of the result-cache key."""
+    from tmr_tpu.serve import ServeEngine, StreamRouter
+    from tmr_tpu.serve.feature_tier import StubFeaturePredictor
+
+    eng = ServeEngine(StubFeaturePredictor(), batch=2, max_wait_ms=5.0,
+                      feature_cache=0, exemplar_cache=16)
+    try:
+        r = StreamRouter(eng, reuse=True)
+        frame = _img(3)
+        r.submit_stream("a", frame, BOX).result()
+        reused = r.submit_stream("a", frame.copy(), BOX).result()
+        assert reused.get("degrade_steps") == ["temporal_reuse"]
+        # the SAME frame, frame-independent: must not hit the reused
+        # entry (the label would leak with it)
+        plain = eng.submit(frame, BOX).result()
+        assert "degrade_steps" not in plain
+    finally:
+        eng.close()
+
+
+def test_features_with_multi_exemplar_is_rejected(engine):
+    """Temporal reuse rides the heads-only program, which has no
+    multi-exemplar formulation — the combination fails that request
+    alone, synchronously at submit."""
+    multi_ex = np.asarray(
+        [[0.2, 0.2, 0.4, 0.4], [0.5, 0.5, 0.7, 0.7]], np.float32
+    )
+    feats = np.zeros((1, 2, 2, 4), np.float32)
+    fut = engine.submit(_img(4), multi_ex, multi=True, k_real=2,
+                        features=feats)
+    with pytest.raises(ValueError, match="single-exemplar"):
+        fut.result()
+
+
+def test_router_prefers_feature_tier_for_anchor_fills(engine):
+    """With the engine's feature client armed and holding, the anchor
+    fill goes REMOTE (counted remote_fills); a client that fails drops
+    to the counted local fill — the kill-mid-stream degrade path."""
+    from tmr_tpu.serve import StreamRouter
+
+    calls = []
+
+    class FakeClient:
+        def __init__(self, alive=True):
+            self.alive = alive
+
+        def holds(self, size):
+            return self.alive
+
+        def fetch(self, image, digest, size):
+            calls.append(digest)
+            if not self.alive:
+                return None
+            arr = np.asarray(image, np.float32)
+            sig = arr.reshape(1, -1).mean(axis=1)
+            return np.tile(sig.reshape(1, 1, 1, 1),
+                           (1, 2, 2, 4)).astype(np.float32)
+
+    engine._feature_client = FakeClient(alive=True)
+    r = StreamRouter(engine, reuse=True)
+    frame = _img(5)
+    first = r.submit_stream("a", frame, BOX).result()
+    reused = r.submit_stream("a", frame.copy(), BOX).result()
+    assert np.array_equal(reused["scores"], first["scores"])
+    assert calls and r.counters()["remote_fills"] == 1
+
+    # dead worker mid-stream: the next anchor's fill falls back local
+    engine._feature_client.alive = False
+    frame2 = _img(6)
+    r.submit_stream("b", frame2, BOX).result()
+    fb = r.submit_stream("b", frame2.copy(), BOX).result()
+    assert fb.get("degrade_steps") == ["temporal_reuse"]
+    c = r.counters()
+    assert c["local_fills"] == 1 and c["remote_fills"] == 1
+
+
+# ----------------------------------------------------------------- lifecycle
+def test_idle_sessions_evict_lazily(engine, monkeypatch):
+    from tmr_tpu.serve import StreamRouter
+
+    monkeypatch.setenv("TMR_STREAM_IDLE_S", "0.05")
+    r = StreamRouter(engine, reuse=True)
+    assert r.idle_s == 0.05
+    frame = _img(8)
+    r.submit_stream("a", frame, BOX).result()
+    time.sleep(0.12)
+    r.submit_stream("b", _img(9), BOX).result()  # sweeps "a" out
+    assert set(r.sessions()) == {"b"}
+    assert r.counters()["evicted_sessions"] == 1
+    # the evicted stream starts over: its next frame is "first" again
+    out = r.submit_stream("a", frame.copy(), BOX).result()
+    assert "degrade_steps" not in out
+    assert r.counters()["first_frames"] == 3
+
+
+def test_explicit_evict_drops_session_and_features(engine):
+    from tmr_tpu.serve import StreamRouter
+
+    r = StreamRouter(engine, reuse=True)
+    frame = _img(10)
+    r.submit_stream("a", frame, BOX).result()
+    r.submit_stream("a", frame.copy(), BOX).result()
+    assert r.evict("a") is True and r.evict("a") is False
+    assert r.sessions() == {} and r.stats()["feature_cache"]["size"] == 0
+
+
+def test_stream_knob_defaults_and_stats(engine, monkeypatch):
+    from tmr_tpu.serve import StreamRouter
+
+    for knob in ("TMR_STREAM_REUSE", "TMR_STREAM_DELTA",
+                 "TMR_STREAM_IDLE_S", "TMR_STREAM_CACHE_MB"):
+        monkeypatch.delenv(knob, raising=False)
+    r = StreamRouter(engine)
+    assert (r.reuse, r.delta, r.idle_s) == (False, 0.02, 300.0)
+    assert r._features.max_bytes == 64 << 20
+    monkeypatch.setenv("TMR_STREAM_DELTA", "0.5")
+    monkeypatch.setenv("TMR_STREAM_CACHE_MB", "1")
+    r2 = StreamRouter(engine, reuse=True)
+    assert r2.delta == 0.5 and r2._features.max_bytes == 1 << 20
+    s = r2.stats()
+    assert s["reuse"] is True and s["sessions"] == 0
+
+
+# ------------------------------------------------------- stamped feature keys
+def test_feature_cache_keys_carry_params_and_backbone_stamp():
+    """The cache-key fix: feature keys carry (params digest, backbone
+    formulation), so two engines over DIFFERENT checkpoints sharing
+    one cache object can never serve each other's features — and a
+    real Predictor's stamp moves when its params digest moves."""
+    from tmr_tpu.serve import ServeEngine
+    from tmr_tpu.serve.feature_tier import StubFeaturePredictor
+
+    class OtherCheckpoint(StubFeaturePredictor):
+        def feature_stamp(self):
+            return ("other-params", "stub-backbone")
+
+    a = ServeEngine(StubFeaturePredictor(), batch=1, max_wait_ms=5.0,
+                    feature_cache=4, exemplar_cache=0)
+    b = ServeEngine(OtherCheckpoint(), batch=1, max_wait_ms=5.0,
+                    feature_cache=4, exemplar_cache=0)
+    try:
+        ka = a._feature_key("digest", SIZE)
+        kb = b._feature_key("digest", SIZE)
+        assert ka != kb
+        assert ka == ("digest", SIZE, "stub-params", "stub-backbone")
+        assert kb[2:] == ("other-params", "stub-backbone")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_gallery_bank_feature_keys_carry_stamp_too():
+    from tmr_tpu.serve import GalleryBank
+    from tmr_tpu.serve.feature_tier import StubFeaturePredictor
+
+    class OtherCheckpoint(StubFeaturePredictor):
+        def feature_stamp(self):
+            return ("other-params", "stub-backbone")
+
+    bank_a = GalleryBank.__new__(GalleryBank)
+    bank_b = GalleryBank.__new__(GalleryBank)
+    for bank, pred in ((bank_a, StubFeaturePredictor()),
+                       (bank_b, OtherCheckpoint())):
+        fstamp = getattr(pred, "feature_stamp", None)
+        bank._feat_stamp = tuple(fstamp()) if callable(fstamp) else ()
+    assert bank_a._feature_key("d", SIZE) != bank_b._feature_key("d",
+                                                                 SIZE)
+
+
+def test_predictor_feature_stamp_tracks_params_identity():
+    """The real Predictor's stamp: (params digest | identity, backbone
+    formulation) — a params swap or a different backbone moves it."""
+    from tmr_tpu.config import preset
+    from tmr_tpu.inference import Predictor
+
+    cfg = preset("TMR_FSCD147", backbone="sam_vit_b", image_size=SIZE,
+                 compute_dtype="float32", batch_size=1)
+    pred = Predictor(cfg)
+    # hold BOTH trees: the stamp is identity-keyed without storage
+    # digests, and a freed tree's id could be reused
+    tree_a = {"w": np.zeros((2,), np.float32)}
+    tree_b = {"w": np.ones((2,), np.float32)}
+    pred.params = tree_a
+    s1 = pred.feature_stamp()
+    assert s1[1] == "sam_vit_b"
+    pred.params = tree_b
+    s2 = pred.feature_stamp()
+    assert s1 != s2
+    del tree_a, tree_b

@@ -278,8 +278,10 @@ LOCK_FILES = (
     "tmr_tpu/serve/caches.py",
     "tmr_tpu/serve/admission.py",
     "tmr_tpu/serve/degrade.py",
+    "tmr_tpu/serve/feature_tier.py",
     "tmr_tpu/serve/fleet.py",
     "tmr_tpu/serve/gallery.py",
+    "tmr_tpu/serve/streams.py",
     "tmr_tpu/parallel/elastic.py",
     "tmr_tpu/parallel/leases.py",
     "tmr_tpu/utils/faults.py",

@@ -313,6 +313,24 @@ ENV_KNOBS = {
     # fault injection (tests/chaos probe)
     "TMR_FAULTS": "deterministic fault-injection schedule",
     "TMR_FAULTS_SEED": "fault-schedule RNG seed",
+    # stream sessions (serve/streams.py)
+    "TMR_STREAM_REUSE": "stream sessions: temporal feature reuse "
+        "election (0 = off, the default: every frame pays the full "
+        "frame-independent path)",
+    "TMR_STREAM_DELTA": "stream sessions: block-mean delta threshold — "
+        "a frame STRICTLY above it vs the session anchor is 'changed' "
+        "(full path, new anchor); at or below reuses the anchor's "
+        "features",
+    "TMR_STREAM_IDLE_S": "stream sessions: idle bound — sessions "
+        "inactive past it evict lazily on the next submit",
+    "TMR_STREAM_CACHE_MB": "stream sessions: byte bound on the "
+        "per-stream anchor-feature cache",
+    # disaggregated feature tier (serve/feature_tier.py)
+    "TMR_FEATURE_TIER_WINDOW": "feature-tier client: bounded in-flight "
+        "extract window per engine — past it a fetch fails fast to the "
+        "counted local fallback instead of queueing",
+    "TMR_FEATURE_TIER_TIMEOUT_S": "feature-tier client: per-extract "
+        "round-trip timeout before the counted local fallback",
     # bench.py driver knobs (consumed outside tmr_tpu/ but part of the
     # same surface; the parity test scans bench.py + scripts/ for these)
     "TMR_AUTOTUNE": "bench.py: run the autotune sweep (0 skips)",

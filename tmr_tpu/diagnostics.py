@@ -1126,6 +1126,107 @@ def validate_gallery_report(doc: dict) -> List[str]:
     return problems
 
 
+#: schema tag of the streaming-video bench document emitted by
+#: scripts/stream_bench.py: a synthetic bursty multi-stream workload
+#: through StreamRouter (serve/streams.py) with the devtime
+#: program-call witness that backbone executions ≪ frames, measured
+#: frames/s vs the frame-independent path, the bitwise-exactness pin
+#: on every frame the delta check called "changed", and the
+#: cross-stream isolation count. bench_guard wraps the script, so an
+#: error record ({"schema": ..., "error": str}) is contractually
+#: valid; scripts/bench_trend.py --stream rc-gates the checks
+#: fail-closed.
+STREAM_REPORT_SCHEMA = "stream_report/v1"
+
+#: the boolean acceptance checks a usable stream_report/v1 must carry
+STREAM_REPORT_CHECKS = (
+    "backbone_amortized", "speedup_ok", "changed_frames_exact",
+    "cross_stream_isolated", "reuse_labeled",
+)
+
+
+def validate_stream_report(doc: dict) -> List[str]:
+    """Structural check of a stream_report/v1 document; returns a list
+    of problems (empty == valid). An error record is contractually
+    valid (the bench_guard wedge path). Dependency-free like the other
+    validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != STREAM_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {STREAM_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("config: not a dict")
+    else:
+        for key in ("image_size", "streams", "frames_per_stream",
+                    "frames"):
+            v = cfg.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                problems.append(f"config.{key}: not a positive int")
+        d = cfg.get("delta")
+        if not isinstance(d, (int, float)) or isinstance(d, bool):
+            problems.append("config.delta: not a number")
+    tput = doc.get("throughput")
+    if not isinstance(tput, dict):
+        problems.append("throughput: not a dict")
+    else:
+        for key in ("stream_frames_per_sec",
+                    "independent_frames_per_sec", "speedup"):
+            v = tput.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"throughput.{key}: not a number")
+    bb = doc.get("backbone")
+    if not isinstance(bb, dict):
+        problems.append("backbone: not a dict")
+    else:
+        for key in ("frames", "executions"):
+            v = bb.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"backbone.{key}: not a non-neg int")
+        if not isinstance(bb.get("by_program"), dict):
+            problems.append("backbone.by_program: not a dict")
+    reuse = doc.get("reuse")
+    if not isinstance(reuse, dict):
+        problems.append("reuse: not a dict")
+    else:
+        for key in ("reused_frames", "changed_frames", "first_frames"):
+            v = reuse.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"reuse.{key}: not a non-neg int")
+    ex = doc.get("exactness")
+    if not isinstance(ex, dict):
+        problems.append("exactness: not a dict")
+    else:
+        for key in ("changed_frames_checked", "mismatches"):
+            v = ex.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"exactness.{key}: not a non-neg int")
+    iso = doc.get("isolation")
+    if not isinstance(iso, dict):
+        problems.append("isolation: not a dict")
+    else:
+        v = iso.get("cross_stream_hits")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(
+                "isolation.cross_stream_hits: not a non-neg int"
+            )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in STREAM_REPORT_CHECKS:
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 #: schema tag of the overload-robustness probe document emitted by
 #: scripts/overload_probe.py: measured capacity, a >=5x offered-load
 #: round against a bounded-admission engine (admitted-traffic latency

@@ -152,6 +152,21 @@ class Predictor:
             return {"mode": "int8", "storage": "off"}
         return None
 
+    def feature_stamp(self) -> tuple:
+        """Cache-key provenance for extracted backbone features:
+        ``(param-tree digest, backbone formulation)``. Every serving
+        feature-cache key carries this tuple alongside the image digest,
+        so a checkpoint swap or a storage-knob flip can never serve
+        features extracted under OTHER weights (the stale-feature bug
+        class the image-digest-only key allowed). The stored-int8 tree
+        contributes its content digest; an f32 tree contributes its
+        in-process identity — a fresh tree is a fresh identity, and the
+        caches these keys feed are in-process."""
+        st = self._storage_state()
+        params_digest = (st.digest if st is not None
+                         else f"id{id(self.params)}")
+        return (params_digest, str(self.cfg.backbone))
+
     def _storage_model(self, model, st):
         """Clone ``model`` for a stored-tree program when storage is
         active (the flag routes MatchingNet onto the fused stored

@@ -16,6 +16,13 @@ from tmr_tpu.serve.batcher import MicroBatcher, Request
 from tmr_tpu.serve.caches import LRUCache, array_digest
 from tmr_tpu.serve.degrade import DEGRADE_STEPS, DegradeController
 from tmr_tpu.serve.engine import ServeEngine
+from tmr_tpu.serve.feature_tier import (
+    FeaturePartition,
+    FeatureTier,
+    FeatureTierClient,
+    FeatureWorker,
+    StubFeaturePredictor,
+)
 from tmr_tpu.serve.fleet import (
     FleetWorker,
     ServeFleet,
@@ -30,13 +37,18 @@ from tmr_tpu.serve.gallery import (
 )
 from tmr_tpu.serve.meshplan import MeshPlan, MeshTarget, resolve_plan
 from tmr_tpu.serve.staging import DeviceStager, StagedBatch
+from tmr_tpu.serve.streams import StreamRouter, block_signature
 
 __all__ = [
     "AdmissionController",
     "DEGRADE_STEPS",
     "DegradeController",
     "DeviceStager",
+    "FeaturePartition",
     "FeatureSinkServer",
+    "FeatureTier",
+    "FeatureTierClient",
+    "FeatureWorker",
     "FleetWorker",
     "GalleryBank",
     "LRUCache",
@@ -49,8 +61,11 @@ __all__ = [
     "ServeEngine",
     "ServeFleet",
     "StagedBatch",
+    "StreamRouter",
+    "StubFeaturePredictor",
     "StubFleetPredictor",
     "array_digest",
+    "block_signature",
     "class_weight_fn",
     "gallery_fused_ok",
     "resolve_plan",

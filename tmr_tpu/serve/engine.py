@@ -116,6 +116,16 @@ class ServeEngine:
         ``TMR_SERVE_FEATURE_CACHE`` (default 8); 0 disables).
     donate: donate staged image buffers to the program (None -> only on
         backends that implement donation: tpu/gpu).
+    feature_client: optional disaggregated match-tier mode
+        (serve/feature_tier.py): an object with ``holds(size)`` and
+        ``fetch(image, digest, size)``. When set, single-exemplar
+        requests whose size partition has a live feature worker route
+        through the heads-only programs on REMOTELY extracted features
+        (the documented heads-path ULP exception); frames with no
+        holder, and rows whose fetch fails mid-flight, fall back to
+        local execution — counted (``feature_tier.cold_frames`` /
+        ``feature_tier.fallback_frames``), never silent, and their futures
+        always resolve.
     """
 
     def __init__(self, predictor, *, batch: Optional[int] = None,
@@ -129,7 +139,8 @@ class ServeEngine:
                  watch: Optional[Any] = None,
                  mesh: Optional[str] = None,
                  warmup_buckets: Optional[Sequence[tuple]] = None,
-                 aot: Optional[bool] = None):
+                 aot: Optional[bool] = None,
+                 feature_client: Optional[Any] = None):
         import jax
 
         if predictor.params is None:
@@ -186,6 +197,15 @@ class ServeEngine:
         # into the feature cache (cold traffic stays on the bitwise-exact
         # fused path; hot images amortize one split-path fill)
         self._seen = LRUCache(max(4 * self.feature_cache.capacity, 16))
+        #: disaggregated match-tier mode (serve/feature_tier.py) —
+        #: None keeps every routing decision byte-identical to before
+        self._feature_client = feature_client
+        #: feature-cache key provenance: (params digest, backbone
+        #: formulation) — a checkpoint/knob swap can never serve stale
+        #: features (predictors without the stamp key on image alone,
+        #: the pre-PR-16 behavior)
+        fstamp = getattr(predictor, "feature_stamp", None)
+        self._feat_stamp = tuple(fstamp()) if callable(fstamp) else ()
 
         self._batch_bounds: Dict[int, int] = {}
         self._lock = threading.Lock()
@@ -345,6 +365,12 @@ class ServeEngine:
                 self._plan.mode_for(bucket) == "dp":
             return bound * self._plan.dp
         return bound
+
+    def _feature_key(self, digest: str, size: int) -> tuple:
+        """The feature-cache key for one frame: image digest + size +
+        the predictor's (params digest, backbone formulation) stamp, so
+        reuse can never cross a checkpoint or formulation swap."""
+        return (digest, size) + self._feat_stamp
 
     def _count(self, name: str, n: int = 1) -> None:
         """Lazily created overload counters (``serve.<name>``): the
@@ -547,7 +573,8 @@ class ServeEngine:
     def submit(self, image, exemplars, multi: bool = False,
                k_real: Optional[int] = None,
                priority: int = 0,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               features: Optional[Any] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the
         fixed-slot detections dict (numpy, leading dim 1 — treat as
         read-only, results may be shared with the cache).
@@ -565,6 +592,14 @@ class ServeEngine:
         deadline-free rider included (one execution, one fate; a rider
         that must not expire should not share a deadline-bearing
         group's exact inputs mid-flight).
+
+        ``features`` is the stream-session reuse hook
+        (serve/streams.py): a precomputed (1, h, w, C) backbone feature
+        map for THIS frame. The request then skips the encoder entirely
+        (heads-only program) and its result — cache entry included —
+        carries ``degrade_steps: ["temporal_reuse"]`` under its own
+        result-cache key, so a reused answer can never be served to a
+        frame-independent query.
 
         A request that cannot be served (bad shapes, an exemplar needing a
         template bucket beyond cfg.template_buckets, ...) fails only its
@@ -586,7 +621,8 @@ class ServeEngine:
         with obs.span("serve.submit", trace_id=tid or None):
             try:
                 req = self._make_request(image, exemplars, multi, k_real,
-                                         fut, tid, priority, deadline_ms)
+                                         fut, tid, priority, deadline_ms,
+                                         features)
             except Exception as e:  # isolation: reject this request alone
                 self._admission.release_class(priority)
                 self._m["rejected"].inc()
@@ -615,7 +651,8 @@ class ServeEngine:
 
     def _make_request(self, image, exemplars, multi, k_real,
                       fut, trace_id: str = "", priority: int = 0,
-                      deadline_ms: Optional[float] = None
+                      deadline_ms: Optional[float] = None,
+                      features: Optional[Any] = None
                       ) -> Optional[Request]:
         image = np.asarray(image, np.float32)
         if image.ndim == 4 and image.shape[0] == 1:
@@ -638,6 +675,17 @@ class ServeEngine:
         # a degraded result can never be served to an undegraded query.
         steps = self._degrade.active_steps()
         applied = []
+        if features is not None:
+            if multi:
+                raise ValueError(
+                    "features= (temporal reuse) supports single-exemplar "
+                    "requests only"
+                )
+            # temporal reuse (serve/streams.py): keyed + counted like a
+            # degrade step BEFORE the cache lookup, so a reused result
+            # lives under its own cache/coalesce namespace and can never
+            # be served to a frame-independent query
+            applied.append("temporal_reuse")
         if "downscale" in steps and size // 2 >= self._degrade.min_size:
             image = downscale_image(image)
             size = int(image.shape[0])
@@ -687,11 +735,34 @@ class ServeEngine:
                       priority=max(int(priority), 0))
         if deadline_ms is not None:
             req.deadline = req.t_submit + deadline_ms / 1000.0
-        if not multi and self.feature_cache.capacity > 0:
-            feat = self.feature_cache.get((digest, size))
+        if features is not None:
+            # stream-session reuse: the caller supplies this frame's
+            # features — the request skips the encoder outright
+            req.features = np.asarray(features) if not hasattr(
+                features, "dtype"
+            ) else features
+            req.bucket = ("heads",) + bucket[1:]
+        elif not multi and (self.feature_cache.capacity > 0
+                            or self._feature_client is not None):
+            feat = (self.feature_cache.get(self._feature_key(digest, size))
+                    if self.feature_cache.capacity > 0 else None)
             if feat is not None:
                 req.features = feat
                 req.bucket = ("heads",) + bucket[1:]
+            elif self._feature_client is not None \
+                    and self._feature_client.holds(size):
+                # disaggregated match tier: a live feature worker holds
+                # this size's partition — route heads-only, the fetch
+                # happens batch-side (_run_heads)
+                req.needs_features = True
+                req.bucket = ("heads",) + bucket[1:]
+            elif self._feature_client is not None:
+                # no holder for the partition: this cold frame stays on
+                # the local fused path — counted, never silent (the
+                # feature-tier fallback contract)
+                self._count("feature_tier.cold_frames")
+                if self.feature_cache.capacity > 0:
+                    self._seen.put((digest, size), True)
             elif (digest, size) in self._seen:
                 req.needs_features = True
                 req.bucket = ("heads",) + bucket[1:]
@@ -882,8 +953,9 @@ class ServeEngine:
 
     def _run_batch(self, staged: StagedBatch):
         """Run the bucket's jitted program on the staged arrays. Returns
-        (dets, fill_features) — fill_features is the heads path's freshly
-        encoded (n_fill, h, w, C) device array (None elsewhere)."""
+        (dets, fill_map) — fill_map is the heads path's dict of
+        {fill row index: freshly obtained (1, h, w, C) feature row}
+        (None elsewhere)."""
         kind, size, cap, k = staged.bucket
         target = staged.target
         params, rparams = (
@@ -902,25 +974,51 @@ class ServeEngine:
         import jax.numpy as jnp
 
         self._m["heads_batches"].inc()
-        fill_feats = None
-        if staged.fill_index:
+        # fill_map: fill row index -> its freshly obtained (1, h, w, C)
+        # feature row (remote fetch or local encode) — _finish caches
+        # every entry under the stamped feature key
+        fill_map: Dict[int, Any] = {}
+        fill_local = list(staged.fill_index)
+        if fill_local and self._feature_client is not None:
+            # disaggregated match tier: fetch each fill row's features
+            # from the remote feature worker; a row whose fetch fails
+            # (dead worker, saturated window) drops to the LOCAL encode
+            # below — counted, never silent, its future still resolves
+            still: List[int] = []
+            for i in fill_local:
+                req = staged.requests[i]
+                try:
+                    feat = self._feature_client.fetch(
+                        req.image, req.image_digest, size
+                    )
+                except Exception:
+                    feat = None
+                if feat is None:
+                    still.append(i)
+                    self._count("feature_tier.fallback_frames")
+                else:
+                    fill_map[i] = jnp.asarray(feat)
+                    self._count("feature_tier.remote_frames")
+            fill_local = still
+        if fill_local:
             bb = self._pred._get_backbone_fn()
             fill_feats = bb(params, staged.images)
-            self._m["feature_fills"].inc(len(staged.fill_index))
+            self._m["feature_fills"].inc(len(fill_local))
+            pos = {i: j for j, i in enumerate(staged.fill_index)}
+            for i in fill_local:
+                fill_map[i] = fill_feats[pos[i]:pos[i] + 1]
         rows: List[Any] = []
-        fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
         for i in range(len(staged.requests)):
-            if i in fill_pos:
-                rows.append(fill_feats[fill_pos[i]:fill_pos[i] + 1])
-            else:
-                rows.append(staged.features[i])
+            row = fill_map.get(i)
+            rows.append(staged.features[i] if row is None else row)
         bound = staged.exemplars.shape[0]
         pad = bound - len(rows)
         if pad:
             rows.extend([jnp.zeros_like(rows[0])] * pad)
         feats = jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
         fn = self._pred._get_heads_fn(cap, size)
-        return fn(params, rparams, feats, staged.exemplars), fill_feats
+        return fn(params, rparams, feats, staged.exemplars), \
+            (fill_map or None)
 
     # ---------------------------------------------------------- completion
     def _finish(self, staged: StagedBatch, out: dict, fill_feats) -> None:
@@ -933,7 +1031,6 @@ class ServeEngine:
         # rider's unpad+resolve into the later riders' spans
         t_fetch1 = time.perf_counter()
         kind, size = staged.bucket[0], staged.bucket[1]
-        fill_pos = {i: j for j, i in enumerate(staged.fill_index)}
         traced = obs.tracing_enabled()
         now = time.perf_counter()
         for i, req in enumerate(staged.requests):
@@ -968,10 +1065,10 @@ class ServeEngine:
                     result["degrade_steps"] = list(req.degrade_steps)
                 if req.result_key is not None:
                     self.result_cache.put(req.result_key, result)
-                if kind == "heads" and i in fill_pos:
+                if kind == "heads" and fill_feats and i in fill_feats:
                     self.feature_cache.put(
-                        (req.image_digest, size),
-                        fill_feats[fill_pos[i]:fill_pos[i] + 1],
+                        self._feature_key(req.image_digest, size),
+                        fill_feats[i],
                     )
                 self._drop_inflight(req)
                 self._admission.release(req)

@@ -257,7 +257,8 @@ class GalleryBank:
         ``TMR_GALLERY_FEATURE_CACHE``, default 8; 0 disables) or an
         existing :class:`LRUCache` to SHARE (e.g. a ServeEngine's, so
         stream frames and interactive traffic amortize one encoder
-        pass; keys are the engine's (digest, size) tuples).
+        pass; keys are the engine's stamped
+        (digest, size, params digest, backbone) tuples).
     feature_cache_mb: byte bound on an owned feature cache (None ->
         ``TMR_GALLERY_FEATURE_CACHE_MB``; ignored for a shared cache).
     max_n_bucket: N-rung ladder cap (None -> ``TMR_GALLERY_NMAX`` ->
@@ -298,6 +299,12 @@ class GalleryBank:
         self._seen = LRUCache(
             max(4 * max(self.feature_cache.capacity, 1), 16)
         )
+        #: feature-key provenance (params digest, backbone formulation):
+        #: a checkpoint/knob swap can never serve stale frame features —
+        #: and a cache SHARED with a ServeEngine over the same predictor
+        #: still interoperates (both sides derive the same stamp)
+        fstamp = getattr(predictor, "feature_stamp", None)
+        self._feat_stamp = tuple(fstamp()) if callable(fstamp) else ()
         if max_n_bucket is not None:
             nmax = int(max_n_bucket)
         else:
@@ -389,6 +396,12 @@ class GalleryBank:
             self._groups = groups
             return groups
 
+    def _feature_key(self, digest: str, size: int) -> tuple:
+        """The frame-feature cache key: image digest + size + the
+        predictor's (params digest, backbone formulation) stamp, so
+        reuse can never cross a checkpoint or formulation swap."""
+        return (digest, size) + self._feat_stamp
+
     # -------------------------------------------------------------- search
     def _resolve_topk(self, override: Optional[int]) -> int:
         if override is not None:
@@ -445,7 +458,7 @@ class GalleryBank:
         topk = self._resolve_topk(prefilter_topk)
         prefilter_on = 0 < topk < total
         digest = array_digest(img)
-        feats = (self.feature_cache.get((digest, size))
+        feats = (self.feature_cache.get(self._feature_key(digest, size))
                  if self.feature_cache.capacity > 0 else None)
 
         if feats is None and not prefilter_on and len(groups) == 1 \
@@ -485,7 +498,8 @@ class GalleryBank:
             # second-sighting promotion, as-is from the serve engine:
             # one-off frames never churn the cache, repeats amortize
             if (digest, size) in self._seen:
-                self.feature_cache.put((digest, size), feats)
+                self.feature_cache.put(self._feature_key(digest, size),
+                                       feats)
             else:
                 self._seen.put((digest, size), True)
 
@@ -697,29 +711,43 @@ class FeatureSinkServer:
       the ``atomic_save_npy`` durability contract on the wire: the
       worker's journal marker commits only after a clean ack, and a
       dirty ack fails the shard attempt so the retry machinery
-      re-streams it. Each ack RESETS the connection's accounting
-      window, so a historic error fails exactly the attempt that
-      streamed it, never every attempt after;
+      re-streams it;
     - ``{"op": "evict", "shard": s}`` → ack; drops the shard's features
       (the coordinator's quarantine-cleanup authority);
     - ``{"op": "bye"}`` → ack, connection closes.
+
+    ANY successful round-trip — sync, evict, hello, an ``on_request``
+    op — RESETS the connection's accounting window, so a historic
+    error fails exactly the attempt that streamed it, never every
+    attempt after (the retry machinery re-streams the whole shard).
+    The pre-PR-16 server reset only on sync acks, which made an online
+    (request/response, never-syncing) link accumulate errors forever.
 
     ``index`` is any :class:`LRUCache`-shaped store keyed
     ``(shard_stem, image_stem)`` — byte-bound it for HBM/host residency
     (``max_bytes``); a :class:`GalleryBank`'s feature cache or a plain
     standalone index both work. ``on_feature(shard, name, array)`` is
     the optional push hook (e.g. device placement, digest-keyed serve
-    cache fill).
+    cache fill). ``on_request(doc, state)`` generalizes the sink into
+    an ONLINE request/response link: ops the built-in table does not
+    know route to it and its reply document (must carry ``"ok"``) is
+    sent back on the same connection — serve/feature_tier.py's data
+    plane composes this. Returning None falls through to the
+    unknown-op error; an exception becomes a counted error reply.
+    Backpressure is the CALLER's side of the contract: a client keeps
+    a bounded in-flight window and fails fast (→ its own local
+    fallback) instead of queueing unboundedly on the link.
     """
 
     def __init__(self, index: Optional[LRUCache] = None, *,
                  host: str = "127.0.0.1", port: int = 0,
                  max_entries: int = 4096,
                  max_bytes: Optional[int] = None,
-                 on_feature=None):
+                 on_feature=None, on_request=None):
         self.index = LRUCache(max_entries, max_bytes=max_bytes) \
             if index is None else index
         self._on_feature = on_feature
+        self._on_request = on_request
         self._lock = threading.Lock()
         self._host, self._port = host, int(port)
         self._server: Optional[_SinkServer] = None
@@ -771,6 +799,17 @@ class FeatureSinkServer:
             return dict(self._counters)
 
     # ------------------------------------------------------------ protocol
+    @staticmethod
+    def _ack(state: dict, reply: dict) -> dict:
+        """A SUCCESSFUL round-trip closes the connection's accounting
+        window (features/errors reset): the next attempt on the same
+        connection starts clean. An unsuccessful reply leaves the
+        window open — the error it reports is still this attempt's."""
+        if reply.get("ok") is True:
+            state["features"] = 0
+            state["errors"] = 0
+        return reply
+
     def _dispatch(self, doc: dict, state: dict) -> Optional[dict]:
         op = doc.get("op")
         if op == "feature":
@@ -800,11 +839,9 @@ class FeatureSinkServer:
                      "shard": doc.get("shard"),
                      "features": state["features"],
                      "errors": state["errors"]}
-            # the ack CLOSES this connection's accounting window: the
-            # next shard attempt on the same connection starts clean —
-            # a historic error must fail exactly the attempt that
-            # streamed it, never every attempt after (the retry
-            # machinery re-streams the whole shard)
+            # a sync ack closes the window even when it reports dirty:
+            # the errors it carries fail THIS shard attempt; the retry
+            # re-streams the whole shard on a clean slate
             state["features"] = 0
             state["errors"] = 0
             return reply
@@ -815,12 +852,27 @@ class FeatureSinkServer:
                 self._counters["evicted_shards"] += 1
             for name in names:
                 self.index.pop((shard, name))
-            return {"op": "evict", "ok": True, "shard": shard,
-                    "dropped": len(names)}
+            return self._ack(state, {"op": "evict", "ok": True,
+                                     "shard": shard,
+                                     "dropped": len(names)})
         if op == "hello":
             with self._lock:
                 self._counters["connections"] += 1
-            return {"op": "hello", "ok": True}
+            return self._ack(state, {"op": "hello", "ok": True})
         if op == "bye":
-            return {"op": "bye", "ok": True}
+            return self._ack(state, {"op": "bye", "ok": True})
+        if self._on_request is not None:
+            # online request/response generalization: unknown ops route
+            # to the composing server (feature-tier data plane); its
+            # successful replies close the window like any other ack
+            try:
+                reply = self._on_request(doc, state)
+            except Exception as e:
+                state["errors"] += 1
+                with self._lock:
+                    self._counters["errors"] += 1
+                return {"op": op, "ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            if reply is not None:
+                return self._ack(state, reply)
         return {"ok": False, "error": f"unknown op {op!r}"}

@@ -294,6 +294,74 @@ def read_gallery_report(path: str) -> dict:
     }
 
 
+# ---------------------------------------------------------- stream report
+
+
+def read_stream_report(path: str) -> dict:
+    """Reduce a ``stream_report/v1`` document (scripts/stream_bench.py
+    output) to the rc-gating fields: the backbone-amortization witness
+    (executions ≪ frames on the bursty stream), the frames/s speedup
+    over the frame-independent path, the bitwise-exactness pin on
+    every "changed" frame, and the cross-stream isolation count.
+
+    Returns ``{"summary": ..., "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable stream report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"stream report is an error record: "
+                         f"{doc['error']}"}
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        return {"error": f"no checks section in {path}"}
+    bb = doc.get("backbone") or {}
+    tput = doc.get("throughput") or {}
+    reuse = doc.get("reuse") or {}
+    ex = doc.get("exactness") or {}
+    return {
+        "summary": {
+            "streams": (doc.get("config") or {}).get("streams"),
+            "frames": (doc.get("config") or {}).get("frames"),
+            "backbone_executions": bb.get("executions"),
+            "backbone_frames": bb.get("frames"),
+            "reused_frames": reuse.get("reused_frames"),
+            "changed_frames": reuse.get("changed_frames"),
+            "stream_frames_per_sec": tput.get("stream_frames_per_sec"),
+            "independent_frames_per_sec": tput.get(
+                "independent_frames_per_sec"
+            ),
+            "speedup": tput.get("speedup"),
+            "changed_frames_checked": ex.get("changed_frames_checked"),
+        },
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "backbone_amortized": checks.get("backbone_amortized")
+            is True,
+            "speedup_ok": checks.get("speedup_ok") is True,
+            "changed_frames_exact": checks.get("changed_frames_exact")
+            is True,
+            "cross_stream_isolated": checks.get("cross_stream_isolated")
+            is True,
+            "reuse_labeled": checks.get("reuse_labeled") is True,
+        },
+    }
+
+
 # ----------------------------------------------------------- serve sweep
 
 
