@@ -29,6 +29,7 @@ from tmr_tpu.diagnostics import validate_bench_trend  # noqa: E402
 from tmr_tpu.utils.bench_trend import (  # noqa: E402
     DEFAULT_THRESHOLD,
     collect_bench_trend,
+    read_chaos_report,
     read_fleet_report,
     read_gallery_report,
     read_serve_sweep,
@@ -78,7 +79,36 @@ def main(argv=None) -> int:
                          "'changed' frame is bitwise-exact, reuse "
                          "never crossed stream ids, and every reused "
                          "frame carried the temporal_reuse label")
+    ap.add_argument("--chaos", default=None,
+                    help="read a serve_chaos_report/v1 file "
+                         "(serve_chaos_probe output) instead of the "
+                         "BENCH history: one JSON line with the "
+                         "pattern-loss/fault-ledger summary; rc 1 "
+                         "unless ZERO registered patterns were lost "
+                         "across the kill rounds, healthy-fleet "
+                         "fan-out stayed byte-identical to the single "
+                         "bank, every injected fault was observed AND "
+                         "accounted for, degraded searches were "
+                         "exactly labeled, and every probe check "
+                         "passed")
     args = ap.parse_args(argv)
+
+    if args.chaos:
+        doc = read_chaos_report(args.chaos)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["zero_patterns_lost"]
+                     and ck["fanout_byte_identical"]
+                     and ck["all_faults_observed"]
+                     and ck["all_faults_accounted"]
+                     and ck["degraded_exactly_labeled"]
+                     and ck["probe_checks_pass"]) else 1
 
     if args.stream:
         doc = read_stream_report(args.stream)

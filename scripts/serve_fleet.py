@@ -16,10 +16,21 @@ heartbeats them with its measured drain rate)::
     python scripts/serve_fleet.py worker --coordinator HOST:7078 \
         --engine model --checkpoint ckpt   # the real predictor
 
+Gallery workers (replicated pattern shards on the gallery-fleet
+coordinator, tmr_tpu/serve/gallery_fleet.py; ``--bank stub`` is the
+wire-exact numpy drill)::
+
+    python scripts/serve_fleet.py gallery-worker \
+        --coordinator HOST:7079 [--bank stub]
+
 Lease liveness rides the shared TMR_ELASTIC_* knobs; fleet behavior
 (saturation threshold, recruitment bounds, resubmission bound) rides
-TMR_FLEET_* (config.ENV_KNOBS). ``scripts/elastic_serve_probe.py`` is
-the canned chaos proof (kill -9 / SIGSTOP / recruitment), riding tier-1.
+TMR_FLEET_* (config.ENV_KNOBS). Every entrypoint here installs
+``TMR_FAULTS`` schedules (faults.install_from_env) so chaos probes
+reach lease-held serve processes the same way map workers install
+them. ``scripts/elastic_serve_probe.py`` is the canned chaos proof
+(kill -9 / SIGSTOP / recruitment) for the traffic fleet and
+``scripts/serve_chaos_probe.py`` for the gallery fleet, riding tier-1.
 """
 
 import argparse
@@ -172,6 +183,67 @@ def _cli_worker(args) -> int:
     return 1 if worker.drained or worker.coordinator_lost else 0
 
 
+def _cli_gallery_worker(args) -> int:
+    from tmr_tpu.serve.gallery_fleet import (
+        GalleryFleetWorker,
+        StubGalleryBank,
+    )
+    from tmr_tpu.utils import faults
+    from tmr_tpu.utils.profiling import log_info, log_warning
+
+    # chaos schedules reach lease-held gallery workers through the
+    # SAME env contract the map/elastic workers honor — a probe sets
+    # TMR_FAULTS in the subprocess env and the beats/pushes here fire
+    if faults.install_from_env():
+        log_warning(
+            "fault injection ACTIVE (TMR_FAULTS="
+            f"{os.environ.get('TMR_FAULTS', '')!r})"
+        )
+    if args.bank == "stub":
+        def bank_factory(shard):
+            return StubGalleryBank(image_size=args.image_size)
+    else:
+        from tmr_tpu.config import preset
+        from tmr_tpu.inference import Predictor
+        from tmr_tpu.serve.gallery import GalleryBank
+
+        cfg = preset("TMR_FSCD147", backbone="sam_vit_b",
+                     image_size=args.image_size)
+        pred = Predictor(cfg)
+        if args.checkpoint:
+            pred.load_params(args.checkpoint)
+        else:
+            log_warning("gallery worker: no --checkpoint, random weights")
+            pred.init_params(seed=0, image_size=args.image_size)
+
+        def bank_factory(shard):
+            return GalleryBank(pred, image_size=args.image_size)
+
+    worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
+    worker = GalleryFleetWorker(
+        _parse_address(args.coordinator), worker_id,
+        bank_factory=bank_factory,
+        data_host=args.data_host, data_port=args.data_port,
+    ).start()
+    log_info(
+        f"gallery worker {worker_id}: bank={args.bank}, data plane at "
+        f"{worker.data_address[:2]}"
+    )
+    try:
+        while not (worker.drained or worker.coordinator_lost):
+            time.sleep(0.25)
+        log_info(
+            f"gallery worker {worker_id}: "
+            + ("drained" if worker.drained else "coordinator lost")
+            + "; exiting"
+        )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        worker.stop()
+    return 1 if worker.drained or worker.coordinator_lost else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python scripts/serve_fleet.py", description=__doc__,
@@ -221,9 +293,25 @@ def main(argv=None) -> int:
     w.add_argument("--data_host", default="127.0.0.1")
     w.add_argument("--data_port", default=0, type=int)
 
+    g = sub.add_parser("gallery-worker",
+                       help="lease and serve replicated pattern shards")
+    g.add_argument("--coordinator", required=True,
+                   help="HOST:PORT of the gallery-fleet coordinator")
+    g.add_argument("--worker_id", default=None,
+                   help="stable worker identity (default host-pid)")
+    g.add_argument("--bank", default="stub", choices=("stub", "model"),
+                   help="'stub' = numpy drill bank (no XLA)")
+    g.add_argument("--image_size", default=32, type=int)
+    g.add_argument("--checkpoint", default=None)
+    g.add_argument("--data_host", default="127.0.0.1")
+    g.add_argument("--data_port", default=0, type=int)
+
     args = p.parse_args(argv)
-    return _cli_frontdoor(args) if args.cmd == "frontdoor" \
-        else _cli_worker(args)
+    if args.cmd == "frontdoor":
+        return _cli_frontdoor(args)
+    if args.cmd == "gallery-worker":
+        return _cli_gallery_worker(args)
+    return _cli_worker(args)
 
 
 if __name__ == "__main__":

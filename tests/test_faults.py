@@ -162,3 +162,41 @@ def test_retry_policy_delay_keys_on_shard():
                       backoff_jitter=0.9, seed=1)
     assert pol.delay(0, 1) == pol.delay(0, 1)
     assert pol.delay(0, 1) != pol.delay(1, 1)  # shards decorrelate
+
+
+def test_serve_tier_points_in_grammar_and_fire():
+    """The PR-17 serve-tier points (serve.link, gallery.replica,
+    gallery.beat) parse, scope, and fire like the map-tier points —
+    one closed vocabulary, one grammar."""
+    specs = faults.parse_schedule(
+        "serve.link:shard=2:attempts=1:raise=OSError;"
+        "gallery.replica:corrupt=1;"
+        "gallery.beat:latency=0.01"
+    )
+    assert [s.point for s in specs] == [
+        "serve.link", "gallery.replica", "gallery.beat"
+    ]
+
+    faults.configure("serve.link:shard=2:attempts=1:raise=OSError",
+                     seed=0)
+    with faults.shard_scope(1, 0):
+        faults.fire("serve.link")  # wrong shard: no fire
+    with faults.shard_scope(2, 1):
+        faults.fire("serve.link")  # attempt past the bound: healed
+    with faults.shard_scope(2, 0):
+        with pytest.raises(OSError):
+            faults.fire("serve.link")
+    assert [r["action"] for r in faults.fired()] == ["raise"]
+
+    faults.configure("gallery.replica:corrupt=1", seed=7)
+    raw = bytes(range(256))
+    with faults.shard_scope(0, 0):
+        mangled = faults.corrupt_bytes("gallery.replica", raw)
+    assert mangled != raw and len(mangled) == len(raw)
+    assert mangled[64:] == raw[64:]  # first-64-bytes contract
+
+    faults.configure("gallery.beat:latency=0.01", seed=0)
+    t0 = time.monotonic()
+    faults.fire("gallery.beat")  # no scope needed: unconditional spec
+    assert time.monotonic() - t0 >= 0.01
+    assert faults.fired()[-1]["action"] == "latency"

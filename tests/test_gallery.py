@@ -415,3 +415,111 @@ def test_make_feature_sinks_npy_path_unchanged(tmp_path):
     save, cleanup, sync = make_feature_sinks(str(tmp_path / "feat"))
     assert callable(save) and callable(cleanup) and callable(sync)
     assert make_feature_sinks(None) == (None, None, None)
+
+
+# --------------------------------------------------- link-death regressions
+def test_feature_sink_truncated_frame_counts_link_error():
+    """A peer dying MID-WRITE leaves a truncated (newline-less) frame on
+    the wire: the handler must count it as a LINK error and exit — never
+    hang in readline, never raise out of handle(), and never poison the
+    server for the next connection."""
+    import time
+
+    from tmr_tpu.parallel.leases import recv_line, send_line
+    from tmr_tpu.serve.gallery import FeatureSinkServer
+
+    sink = FeatureSinkServer(max_entries=8)
+    host, port = sink.start()
+    try:
+        dirty = socket.create_connection((host, port), timeout=5)
+        dirty.sendall(b'{"op": "hello", "worker": "t"')  # no newline
+        dirty.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and sink.counters()["link_errors"] < 1:
+            time.sleep(0.02)
+        assert sink.counters()["link_errors"] == 1
+        # the server survives: a clean connection still round-trips
+        with socket.create_connection((host, port), timeout=5) as s:
+            f = s.makefile("rb")
+            send_line(s, {"op": "hello", "worker": "t2"})
+            assert recv_line(f)["ok"] is True
+            send_line(s, {"op": "bye"})
+    finally:
+        sink.close()
+    # a clean EOF (close with no partial bytes) is NOT a link error
+    assert sink.counters()["link_errors"] == 1
+
+
+def test_extract_link_truncated_reply_degrades_not_raises():
+    """The client half of the same contract: a worker dying mid-reply
+    (partial line, then close) must turn the round-trip into a dead
+    link + None — the degrade machinery owns it — never a ValueError
+    out of call()."""
+    from tmr_tpu.serve.feature_tier import _ExtractLink
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def half_reply():
+        conn, _ = srv.accept()
+        f = conn.makefile("rb")
+        f.readline()  # the request frame
+        conn.sendall(b'{"ok": tru')  # dies mid-write
+        conn.close()
+
+    t = threading.Thread(target=half_reply, daemon=True)
+    t.start()
+    link = _ExtractLink(srv.getsockname(), timeout_s=5.0)
+    try:
+        assert link.call({"op": "extract"}) is None
+        assert link.dead is True
+        # a dead link stays inert, still never raises
+        assert link.call({"op": "extract"}) is None
+    finally:
+        link.close()
+        srv.close()
+        t.join(timeout=10)
+
+
+def test_evict_racing_search_serves_snapshot(pred, monkeypatch):
+    """Evicting a pattern while a search is in flight must serve the
+    search from its pre-evict snapshot — full results for EVERY entry
+    the search started with, bitwise-identical, never a KeyError or a
+    None hole — and the next search cleanly excludes the entry."""
+    from tmr_tpu.serve import GalleryBank
+
+    bank = GalleryBank(pred, feature_cache=0)
+    bank.register("keep", BOXES[0])
+    bank.register("gone", BOXES[1])
+    img = _img(3)
+    before = bank.search(img)
+
+    orig = bank._groups_locked
+    snapshot_taken = threading.Event()
+    evict_done = threading.Event()
+
+    def paused():
+        groups = orig()
+        snapshot_taken.set()  # search holds its snapshot...
+        assert evict_done.wait(30)  # ...while the evict lands
+        return groups
+
+    monkeypatch.setattr(bank, "_groups_locked", paused)
+    out = {}
+    worker = threading.Thread(
+        target=lambda: out.update(res=bank.search(img)), daemon=True
+    )
+    worker.start()
+    assert snapshot_taken.wait(30)
+    assert bank.evict("gone") is True
+    evict_done.set()
+    worker.join(30)
+    assert not worker.is_alive()
+    raced = out["res"]
+    assert set(raced) == {"keep", "gone"}  # the snapshot, no holes
+    _assert_bitwise(raced["gone"], before["gone"], "raced search")
+    _assert_bitwise(raced["keep"], before["keep"], "raced search")
+    after = bank.search(img)  # post-evict: cleanly excluded
+    assert set(after) == {"keep"}

@@ -1,0 +1,246 @@
+"""Replicated gallery partitions (tmr_tpu/serve/gallery_fleet.py):
+stable shard placement, the byte-exact results codec, the write-ahead
+pattern journal (fencing + digest + refusal semantics), and the
+in-process fleet loop — leased shards, replicated registration,
+fan-out parity with the single bank, fenced stale searches, and the
+counted partition_unavailable degrade when holders die.
+
+The subprocess version of this story (kill -9, env-delivered faults)
+is scripts/serve_chaos_probe.py, gated via test_serve_chaos_probe.py;
+these tests pin the module's contracts without process churn."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu.parallel.leases import LeasePolicy, oneshot
+from tmr_tpu.serve.gallery_fleet import (
+    GALLERY_JOURNAL_SCHEMA,
+    GalleryFleet,
+    GalleryFleetWorker,
+    PatternJournal,
+    StaleLeaseError,
+    StubGalleryBank,
+    pack_results,
+    shard_of,
+    unavailable_result,
+    unpack_results,
+)
+from tmr_tpu.utils import faults
+
+SIZE = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _policy():
+    return LeasePolicy(
+        lease_ttl_s=1.0, hb_interval_s=0.2, check_interval_s=0.05,
+        straggler_factor=0.0, max_reassigns=1_000_000_000,
+        resource_fail_workers=1_000_000_000,
+    )
+
+
+def _poll(predicate, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return None
+
+
+def _dets_equal(got, want):
+    if set(got) != set(want):
+        return False
+    for key, w in want.items():
+        g = got[key]
+        if isinstance(w, np.ndarray):
+            if not (isinstance(g, np.ndarray) and g.dtype == w.dtype
+                    and g.shape == w.shape
+                    and g.tobytes() == w.tobytes()):
+                return False
+        elif g != w:
+            return False
+    return True
+
+
+# ------------------------------------------------------------- placement
+def test_shard_of_stable_and_in_range():
+    """Placement is sha256-derived, NOT hash() (process-randomized) —
+    a restarted coordinator must re-derive the journal's placement."""
+    for n in (1, 2, 4, 7):
+        for name in ("a", "pattern-1", "ünïcode", ""):
+            s = shard_of(name, n)
+            assert 0 <= s < n
+            assert s == shard_of(name, n)  # stable within and (by
+            # construction: content hash) across processes
+    assert shard_of("anything", 1) == 0
+
+
+# ----------------------------------------------------------------- codec
+def test_results_codec_byte_exact_and_extra_fields():
+    bank = StubGalleryBank(image_size=SIZE)
+    bank.register("a", np.arange(8, dtype=np.float32).reshape(2, 4))
+    img = np.linspace(0, 1, SIZE * SIZE * 3, dtype=np.float32).reshape(
+        SIZE, SIZE, 3
+    )
+    results = bank.search(img)
+    results["down"] = unavailable_result()
+    doc = json.loads(json.dumps(pack_results(results)))  # wire trip
+    back = unpack_results(doc)
+    assert set(back) == {"a", "down"}
+    assert _dets_equal(back["a"], results["a"])
+    assert back["down"]["degrade_steps"] == ["partition_unavailable"]
+    assert back["down"]["boxes"].shape == (1, 0, 4)
+
+
+# --------------------------------------------------------------- journal
+def test_pattern_journal_wal_semantics(tmp_path):
+    """Markers are atomic + digest-sealed; a fence raise aborts
+    marker-less; the ``journal`` fault point refuses BEFORE disk; a
+    tampered marker is skipped on recovery (never acknowledged)."""
+    journal = PatternJournal(str(tmp_path))
+    payload = {"b64": "AAAA", "dtype": "float32", "shape": [1]}
+    journal.record("keep", 1, payload, 1)
+    assert set(journal.load_all()) == {"keep"}
+    rec = journal.load_all()["keep"]
+    assert rec["schema"] == GALLERY_JOURNAL_SCHEMA
+    assert rec["shard"] == 1 and rec["payload"]["b64"] == "AAAA"
+
+    # fencing: a stale lease aborts the commit with NO marker
+    def stale_fence():
+        raise StaleLeaseError("epoch moved on")
+
+    with pytest.raises(StaleLeaseError):
+        journal.record("fenced", 0, payload, 1, fence=stale_fence)
+    assert set(journal.load_all()) == {"keep"}
+
+    # the journal fault point fires before anything touches disk
+    faults.configure("journal:raise=OSError", seed=0)
+    with pytest.raises(OSError):
+        journal.record("refused", 0, payload, 1)
+    faults.clear()
+    assert set(journal.load_all()) == {"keep"}
+
+    # a hand-edited marker fails its digest and is skipped
+    journal.record("tampered", 0, payload, 1)
+    path = journal._path("tampered")
+    doc = json.load(open(path))
+    doc["k_real"] = 99
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert set(journal.load_all()) == {"keep"}
+
+    journal.invalidate("keep")
+    journal.invalidate("keep")  # idempotent
+    assert journal.load_all() == {}
+
+
+# ------------------------------------------------------ in-process fleet
+def test_fleet_replicates_fans_out_and_degrades(tmp_path):
+    """The whole loop without process churn: two in-process workers
+    lease two shards; registrations ack R=2 copies; the fan-out client
+    is byte-identical to one StubGalleryBank; a stale-epoch gsearch is
+    FENCED; a drained fleet degrades every pattern to the counted
+    partition_unavailable label; a cold coordinator restart recovers
+    the catalog from the journal."""
+    reference = StubGalleryBank(image_size=SIZE)
+    fleet = GalleryFleet(
+        2, policy=_policy(), replicas=2,
+        journal_dir=str(tmp_path / "journal"),
+    )
+    fleet.start()
+    workers = []
+    try:
+        workers = [
+            GalleryFleetWorker(
+                fleet.address, f"w{i}",
+                bank_factory=lambda shard: StubGalleryBank(SIZE),
+            ).start()
+            for i in range(2)
+        ]
+        assert _poll(lambda: all(
+            fleet.holder_for(s) is not None for s in range(2)
+        ))
+        rng = np.random.default_rng(0)
+        names = [f"pat{i}" for i in range(4)]
+        for name in names:
+            ex = rng.standard_normal((2, 4)).astype(np.float32)
+            ack = fleet.register(name, ex)
+            reference.register(name, ex)
+            assert ack["ok"] and ack["journaled"]
+            assert ack["copies"] == 2 and not ack["under_replicated"]
+
+        client = fleet.client()
+        img = rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+        got = client.search(img)
+        want = reference.search(img)
+        assert set(got) == set(names)
+        for name in names:
+            assert "degrade_steps" not in got[name]
+            assert _dets_equal(got[name], want[name])
+
+        # fenced: a revoked epoch NEVER serves stale detections
+        shard = 0
+        wid, epoch, addr = fleet.holder_for(shard)
+        from tmr_tpu.serve.fleet import pack_array
+
+        reply = oneshot(addr, {
+            "op": "gsearch", "shard": shard, "epoch": epoch + 1,
+            "image": pack_array(img),
+        }, timeout=10.0)
+        assert reply["ok"] is False and reply["status"] == "fenced"
+
+        # kill one worker (hard stop): its shards promote onto the
+        # survivor, which already mirrors every pattern — zero loss
+        victim = fleet.holder_for(0)[0]
+        survivor = next(w for w in workers if w.worker_id != victim)
+        next(w for w in workers if w.worker_id == victim).stop()
+
+        def healed():
+            holders = [fleet.holder_for(s) for s in range(2)]
+            if not all(h and h[0] == survivor.worker_id
+                       for h in holders):
+                return False
+            out = client.search(img)
+            return all("degrade_steps" not in out[n] for n in names)
+
+        assert _poll(healed)
+        again = client.search(img)
+        for name in names:
+            assert _dets_equal(again[name], want[name])
+
+        # full outage: every pattern degrades to the COUNTED label
+        survivor.stop()
+        assert _poll(lambda: all(
+            fleet.holder_for(s) is None for s in range(2)
+        ))
+        dark = client.search(img)
+        assert set(dark) == set(names)
+        for name in names:
+            assert dark[name]["degrade_steps"] == [
+                "partition_unavailable"
+            ]
+        assert client.counters()["degraded_patterns"] >= len(names)
+    finally:
+        for w in workers:
+            w.stop()
+        fleet.close()
+
+    # coordinator restart: the WAL is the catalog of record
+    reborn = GalleryFleet(
+        2, policy=_policy(), replicas=2,
+        journal_dir=str(tmp_path / "journal"),
+    )
+    assert set(reborn.patterns()) == set(names)
+    assert reborn.counters()["journal_recovered"] == len(names)

@@ -1,0 +1,82 @@
+"""The serve-tier chaos gauntlet (scripts/serve_chaos_probe.py) must
+pass on tier-1: replicated gallery shards survive repeated kill -9 of
+primary holders with ZERO pattern loss, fan-out stays byte-identical
+to the single bank when healthy, a severed serve link degrades exactly
+the dead partition's patterns (and heals), a corrupted replica push is
+digest-rejected and retried clean, the write-ahead journal refuses the
+ack before any partial state, and a TMR_FAULTS env schedule reaches a
+lease-held worker subprocess — one validated serve_chaos_report/v1,
+rc-gated again (fail-closed) through scripts/bench_trend.py --chaos."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from tmr_tpu.diagnostics import (
+    SERVE_CHAOS_CHECK_KEYS,
+    validate_serve_chaos_report,
+)
+from tmr_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_chaos_probe_passes(tmp_path, capsys):
+    out = tmp_path / "serve_chaos_report.json"
+    rc = _load("serve_chaos_probe").main(["--tiny", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert validate_serve_chaos_report(doc) == []
+    checks = doc["checks"]
+    for key in SERVE_CHAOS_CHECK_KEYS:
+        assert checks[key] is True, key
+    # the ledger closes: every acknowledged registration survived
+    assert doc["patterns"]["lost"] == []
+    assert doc["patterns"]["registered"] == doc["patterns"]["survived"]
+    assert doc["kills"]["rounds"] >= 1
+    # every serve-tier fault point was injected, fired, and accounted
+    points = {rec["point"] for rec in doc["faults"]["injected"]}
+    assert points == {"serve.link", "gallery.replica", "gallery.beat",
+                      "journal"}
+    assert all(rec["fired"] >= 1 and rec["accounted"] >= 1
+               for rec in doc["faults"]["injected"])
+    # the trend reader rc-gates the same document
+    capsys.readouterr()
+    assert _load("bench_trend").main(["--chaos", str(out)]) == 0
+    reader_doc = json.loads(capsys.readouterr().out.strip())
+    assert reader_doc["checks"]["zero_patterns_lost"] is True
+    assert reader_doc["checks"]["probe_checks_pass"] is True
+
+    # --chaos is FAIL-CLOSED: a lost pattern flips the gate to rc 1
+    tampered = json.loads(out.read_text())
+    tampered["patterns"]["lost"] = ["pat000"]
+    tampered["patterns"]["survived"] -= 1
+    bad = tmp_path / "tampered.json"
+    bad.write_text(json.dumps(tampered) + "\n")
+    capsys.readouterr()
+    assert _load("bench_trend").main(["--chaos", str(bad)]) == 1
+    # ... and an error record (wedged probe) also gates rc 1
+    err = tmp_path / "error.json"
+    err.write_text(json.dumps(
+        {"schema": "serve_chaos_report/v1", "error": "watchdog"}
+    ) + "\n")
+    capsys.readouterr()
+    assert _load("bench_trend").main(["--chaos", str(err)]) == 1

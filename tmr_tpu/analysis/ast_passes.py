@@ -630,7 +630,11 @@ def _registry_entries(ctx: AnalysisContext) -> Dict[str, Tuple[int, str]]:
 def knob_parity(ctx: AnalysisContext) -> Iterable[Finding]:
     registry = _registry_entries(ctx)
     consumed: Dict[str, Tuple[str, int]] = {}
-    for rel in ctx.lib_files():
+    # consumption scan covers the DRIVER surface too (bench.py,
+    # scripts/): a TMR_ knob introduced by a probe or bench driver is
+    # part of the same env surface and must be registered — before
+    # this, only tmr_tpu/ reads could trip the rule
+    for rel in ctx.lib_files() + ctx.driver_files():
         for knob, line in env_knob_reads(ctx.tree(rel)).items():
             consumed.setdefault(knob, (rel, line))
     if not consumed:

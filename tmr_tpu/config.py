@@ -237,6 +237,14 @@ ENV_KNOBS = {
         "(entries)",
     "TMR_GALLERY_FEATURE_CACHE_MB": "byte bound on the gallery "
         "frame-feature cache (MB)",
+    # replicated gallery fleet (serve/gallery_fleet.py; off unless a
+    # fleet is constructed — the single-bank path never reads these)
+    "TMR_GALLERY_REPLICAS": "gallery fleet: copies kept per pattern "
+        "(primary + mirrors) on live workers; fewer live workers than "
+        "R counts as under-replication, never an error (default 2)",
+    "TMR_GALLERY_FLEET_TIMEOUT_S": "gallery fleet: per-round-trip "
+        "timeout for pattern pushes and fan-out searches — past it the "
+        "shard degrades to partition_unavailable for that frame",
     "TMR_SERVE_MESH": "serving device mesh spec (dp<N>/tp<M>, e.g. "
         "dp4, tp4, dp2tp2); unset = unsharded round-robin serving",
     "TMR_SERVE_AOT": "ahead-of-time compile+warmup of the bucketed "

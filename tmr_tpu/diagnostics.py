@@ -847,6 +847,169 @@ def validate_elastic_serve_report(doc: dict) -> List[str]:
     return problems
 
 
+#: schema tag of the serve-tier chaos gauntlet emitted by
+#: scripts/serve_chaos_probe.py (the chaos_probe story extended past
+#: the map/elastic layer into the replicated gallery fleet,
+#: serve/gallery_fleet.py): phase-by-phase pattern accounting across
+#: repeated primary kill -9s (zero registered patterns lost — journal
+#: + replica promotion), healthy-fleet fan-out-vs-single-bank byte
+#: equality, and a fault ledger proving every injected serve-tier
+#: fault (severed links, corrupt replica payloads, beats delayed past
+#: the lease window) was observed, accounted for, and surfaced as a
+#: labeled degrade step. bench_guard wraps the probe, so an error
+#: record ({"schema": ..., "error": str}) is contractually valid;
+#: scripts/bench_trend.py --chaos rc-gates fail-closed on the
+#: zero-loss and all-faults-accounted invariants.
+SERVE_CHAOS_REPORT_SCHEMA = "serve_chaos_report/v1"
+
+#: the closed serve-tier fault-point vocabulary a serve_chaos_report
+#: may inject/observe — the serve slice of faults.POINTS
+SERVE_CHAOS_FAULT_POINTS = (
+    "serve.link", "gallery.replica", "gallery.beat", "journal",
+)
+
+#: the checks every serve_chaos_report/v1 must carry — the probe's
+#: acceptance invariants, each a bool (rc-gated by the probe itself
+#: and re-gated fail-closed by bench_trend --chaos)
+SERVE_CHAOS_CHECK_KEYS = (
+    "zero_patterns_lost", "fanout_byte_identical",
+    "all_faults_observed", "all_faults_accounted",
+    "degraded_exactly_labeled", "degrade_heals",
+    "replication_recovered", "env_schedule_delivered",
+)
+
+
+def validate_serve_chaos_report(doc: dict) -> List[str]:
+    """Structural + reconciliation check of a serve_chaos_report/v1
+    document; returns a list of problems (empty == valid). An error
+    record is contractually valid (the bench_guard wedge path).
+    Dependency-free like the other validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != SERVE_CHAOS_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {SERVE_CHAOS_REPORT_SCHEMA}: "
+            f"{doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    config = doc.get("config")
+    if not isinstance(config, dict):
+        problems.append("config: not a dict")
+    else:
+        for key in ("shards", "workers", "replicas", "patterns"):
+            v = config.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                problems.append(f"config.{key}: not a positive int")
+    phases = doc.get("phases")
+    if not isinstance(phases, list) or not phases:
+        problems.append("phases: not a non-empty list")
+        phases = []
+    for i, phase in enumerate(phases):
+        where = f"phases[{i}]"
+        if not isinstance(phase, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        if not isinstance(phase.get("name"), str) or not phase["name"]:
+            problems.append(f"{where}.name: not a non-empty string")
+        if not isinstance(phase.get("ok"), bool):
+            problems.append(f"{where}.ok: not a bool")
+    patterns = doc.get("patterns")
+    if not isinstance(patterns, dict):
+        problems.append("patterns: not a dict")
+    else:
+        for key in ("registered", "survived"):
+            v = patterns.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(
+                    f"patterns.{key}: not a non-negative int"
+                )
+        lost = patterns.get("lost")
+        if not isinstance(lost, list):
+            problems.append("patterns.lost: not a list")
+        elif not problems and isinstance(patterns.get("registered"), int):
+            # exact pattern reconciliation: every registered pattern is
+            # either survived or named in the lost list — no third bin
+            if patterns["registered"] != patterns["survived"] + len(lost):
+                problems.append(
+                    "patterns: registered != survived + len(lost)"
+                )
+    kills = doc.get("kills")
+    if not isinstance(kills, dict) or not all(
+        isinstance(kills.get(k), int) and not isinstance(kills.get(k),
+                                                         bool)
+        for k in ("rounds", "workers_killed")
+    ):
+        problems.append("kills: missing rounds/workers_killed ints")
+    elif kills["rounds"] < 1:
+        problems.append("kills.rounds: no kill rounds ran")
+    faults_sec = doc.get("faults")
+    if not isinstance(faults_sec, dict):
+        problems.append("faults: not a dict")
+    else:
+        injected = faults_sec.get("injected")
+        if not isinstance(injected, list) or not injected:
+            problems.append("faults.injected: not a non-empty list")
+            injected = []
+        inj_points = set()
+        for i, rec in enumerate(injected):
+            where = f"faults.injected[{i}]"
+            if not isinstance(rec, dict):
+                problems.append(f"{where}: not a dict")
+                continue
+            point = rec.get("point")
+            if point not in SERVE_CHAOS_FAULT_POINTS:
+                problems.append(f"{where}.point: bad point {point!r}")
+            else:
+                inj_points.add(point)
+            if not isinstance(rec.get("schedule"), str) \
+                    or not rec["schedule"]:
+                problems.append(
+                    f"{where}.schedule: not a non-empty string"
+                )
+            for key in ("fired", "accounted"):
+                v = rec.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    problems.append(
+                        f"{where}.{key}: not a non-negative int"
+                    )
+        observed = faults_sec.get("observed")
+        if not isinstance(observed, dict):
+            problems.append("faults.observed: not a dict")
+        else:
+            for point, n in observed.items():
+                if point not in SERVE_CHAOS_FAULT_POINTS:
+                    problems.append(
+                        f"faults.observed: bad point {point!r}"
+                    )
+                if not isinstance(n, int) or isinstance(n, bool) \
+                        or n < 0:
+                    problems.append(
+                        f"faults.observed[{point!r}]: not a "
+                        "non-negative int"
+                    )
+            # every injected point must have been OBSERVED firing at
+            # least once — a schedule that never fired proves nothing
+            for point in inj_points:
+                if not observed.get(point):
+                    problems.append(
+                        f"faults: injected point {point!r} never "
+                        "observed firing"
+                    )
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in SERVE_CHAOS_CHECK_KEYS:
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
 #: schema tag of the serving-layer benchmark document emitted by
 #: scripts/serve_bench.py (offered-load sweep over tmr_tpu/serve): per-
 #: workload throughput + latency percentiles + batch-occupancy histogram +

@@ -260,6 +260,14 @@ class LeaseService:
             rec.bye = False
             return rec
 
+    def live_workers(self) -> List[str]:
+        """Worker ids currently eligible for grants: registered, not
+        departed (dead/bye), not poison-drained. Replica placement
+        (serve/gallery_fleet.py) mirrors pattern payloads onto these."""
+        with self.lock:
+            return [w.wid for w in self.workers.values()
+                    if not (w.dead or w.bye or w.drained)]
+
     def restart_clock(self) -> None:
         """Re-anchor the run clock (clients call this at ``start()`` so
         reported wall time measures SERVING, not construction — resume

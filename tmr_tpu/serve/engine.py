@@ -200,6 +200,12 @@ class ServeEngine:
         #: disaggregated match-tier mode (serve/feature_tier.py) —
         #: None keeps every routing decision byte-identical to before
         self._feature_client = feature_client
+        #: optional pattern-search backend: a GalleryBank
+        #: (serve/gallery.py) or a replicated-fleet front door
+        #: (serve/gallery_fleet.py GalleryFleetClient). None — the
+        #: default — keeps the engine byte-identical to before;
+        #: ``attach_gallery`` arms ``search_gallery``.
+        self._gallery: Optional[Any] = None
         #: feature-cache key provenance: (params digest, backbone
         #: formulation) — a checkpoint/knob swap can never serve stale
         #: features (predictors without the stamp key on image alone,
@@ -324,6 +330,32 @@ class ServeEngine:
             t.start()
         if self._plan is not None:
             self._admission.attach_drain_source(self._drain_total)
+
+    # -------------------------------------------------------------- gallery
+    def attach_gallery(self, gallery: Any) -> None:
+        """Arm ``search_gallery`` with a pattern-search backend — any
+        object with the bank surface (``search(image) -> {name:
+        dets}``): a local :class:`~tmr_tpu.serve.gallery.GalleryBank`
+        or a replicated fleet's
+        :class:`~tmr_tpu.serve.gallery_fleet.GalleryFleetClient`.
+        Detached (the default) nothing in the engine changes."""
+        with self._lock:
+            self._gallery = gallery
+
+    def search_gallery(self, image, **kw) -> Dict[str, dict]:
+        """Match every registered pattern against one frame through
+        the attached backend. Degrade labeling is the backend's
+        contract (``degrade_steps: ["partition_unavailable"]`` on
+        fleet partitions that are dead mid-search); the counter is
+        created lazily so default-off metrics shapes are unchanged."""
+        with self._lock:
+            gallery = self._gallery
+        if gallery is None:
+            raise RuntimeError(
+                "no gallery attached (ServeEngine.attach_gallery)"
+            )
+        self.metrics.counter("serve.gallery.searches").inc()
+        return gallery.search(image, **kw)
 
     # -------------------------------------------------------------- sizing
     def _bound_device(self, bucket: tuple) -> int:

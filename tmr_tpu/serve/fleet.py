@@ -244,11 +244,21 @@ class _WorkerLink:
             if self.dead:
                 return False
             try:
+                # serve.link: one request is about to hit this worker's
+                # wire — an injected raise severs the link exactly like
+                # a peer-reset OSError would (the routing layer's
+                # dead-link handling owns what happens next)
+                faults.fire("serve.link")
                 send_line(self.sock, doc)
                 return True
             except OSError:
                 self.dead = True
                 return False
+            except Exception:
+                if faults.active():
+                    self.dead = True
+                    return False
+                raise
 
     def close(self) -> None:
         with self._wlock:

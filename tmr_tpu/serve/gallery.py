@@ -658,6 +658,11 @@ class _SinkHandler(socketserver.StreamRequestHandler):
             try:
                 doc = _recv_line(self.rfile)
             except (ValueError, OSError):
+                # a peer dying MID-WRITE leaves a truncated line
+                # (ValueError) or a reset socket (OSError): that is a
+                # LINK error — counted on the sink, never raised out
+                # of the handler and never an infinite readline spin
+                self.server.sink._count_link_error()
                 break
             if doc is None:
                 break
@@ -754,7 +759,8 @@ class FeatureSinkServer:
         self._thread: Optional[threading.Thread] = None
         self._shards: Dict[str, set] = {}
         self._counters = {"connections": 0, "features": 0, "bytes": 0,
-                          "syncs": 0, "evicted_shards": 0, "errors": 0}
+                          "syncs": 0, "evicted_shards": 0, "errors": 0,
+                          "link_errors": 0}
 
     def start(self) -> Tuple[str, int]:
         with self._lock:
@@ -797,6 +803,13 @@ class FeatureSinkServer:
     def counters(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counters)
+
+    def _count_link_error(self) -> None:
+        """A connection died mid-line (truncated frame / reset peer) —
+        the handler's loop exit path, counted here so wire-level peer
+        death is observable without parsing logs."""
+        with self._lock:
+            self._counters["link_errors"] += 1
 
     # ------------------------------------------------------------ protocol
     @staticmethod

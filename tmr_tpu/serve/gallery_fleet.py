@@ -1,0 +1,1283 @@
+"""Replicated gallery partitions on the lease service — pattern shards
+as leased fleet resources with crash-proof registration.
+
+PR 15's :class:`~tmr_tpu.serve.gallery.GalleryBank` is one process's
+device memory: a ``kill -9`` of the bank holder silently loses every
+registered pattern. This module makes gallery state a cluster resource
+(ROADMAP item 1's sharded-bank half):
+
+- **pattern shards** (``name -> stable hash % n_shards``) are leased
+  from the same :class:`~tmr_tpu.parallel.leases.LeaseService` state
+  machine the map/serve/feature fleets use — :class:`GalleryFleet` is
+  the coordinator (hello/lease/beat/bye over the fleet control
+  protocol, liveness via ``expire_pass``);
+- **registration is durable BEFORE it is acknowledged**: every
+  ``register`` first appends to a write-ahead :class:`PatternJournal`
+  (the ``parallel/journal.py`` discipline — atomic marker + payload
+  digest + an optional fence that aborts marker-less), then pushes the
+  payload to the shard's primary AND mirrors it to R−1 replicas
+  (``TMR_GALLERY_REPLICAS``), acking with the replica count. Worker
+  death between register and search loses nothing: the journal and the
+  surviving copies re-materialize the shard on promotion;
+- **promotion re-materializes**: when a lease rebalances onto a new
+  holder the coordinator sends ``adopt`` (install from the worker's
+  local replica store, digest-checked) and pushes any missing payloads
+  from its catalog, then re-mirrors so replication heals back to R;
+- the **front door** is :class:`GalleryFleetClient`: one frame fans
+  out to the workers holding its shards and the disjoint per-shard
+  results union (per-entry NMS already ran worker-side, exactly as in
+  the single bank — healthy-fleet fan-out is byte-identical to one
+  bank holding every pattern). A dead/slow/fenced shard degrades to
+  empty detections carrying ``degrade_steps:
+  ["partition_unavailable"]`` — a counted partial result, never an
+  error — and heals when the lease rebalances onto a replica.
+
+Fault points (``tmr_tpu/utils/faults.py`` closed vocabulary):
+``serve.link`` fires before each fan-out write (a raise severs the
+link), ``gallery.replica`` fires/corrupts each replica push (a
+digest-checked worker rejects the corrupt copy and the push retries),
+``gallery.beat`` fires before each worker heartbeat (``latency=S``
+past the TTL is the SIGSTOP stand-in — the shard goes stale and is
+promoted onto a replica). ``scripts/serve_chaos_probe.py`` drives all
+of it and emits a validated ``serve_chaos_report/v1``.
+
+Everything here is OFF by default: nothing imports this module unless
+a fleet is constructed, and the single-bank path is untouched.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import re
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tmr_tpu.parallel.journal import StaleLeaseError  # noqa: F401 — re-export
+from tmr_tpu.parallel.leases import (
+    LeasePolicy,
+    LeaseService,
+    Resource,
+    connect_timeout,
+    oneshot,
+    recv_line,
+    send_line,
+)
+from tmr_tpu.serve.feature_tier import _ExtractLink
+from tmr_tpu.serve.fleet import fleet_policy, pack_array, unpack_array
+from tmr_tpu.serve.gallery import FeatureSinkServer
+from tmr_tpu.utils import faults
+from tmr_tpu.utils.atomicio import atomic_write
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def shard_of(name: str, n_shards: int) -> int:
+    """Stable pattern->shard placement: a sha256 prefix, NOT ``hash()``
+    (randomized per process — coordinator restarts must re-derive the
+    same placement the journal recorded)."""
+    h = hashlib.sha256(str(name).encode()).hexdigest()[:8]
+    return int(h, 16) % max(int(n_shards), 1)
+
+
+# ------------------------------------------------------------- partitions
+class PatternShard(Resource):
+    """One gallery pattern shard. Leased for the lifetime of its
+    holder (never settles)."""
+
+    __slots__ = ()
+
+    def __init__(self, index: int):
+        super().__init__(index, f"gshard{index}")
+
+
+# ---------------------------------------------------------------- journal
+#: schema tag stamped on every pattern marker — bump on incompatible change
+GALLERY_JOURNAL_SCHEMA = "gallery_journal/v1"
+
+#: payload fields covered by the marker digest (order matters — it is
+#: the canonical serialization the digest is computed over)
+_MARKER_FIELDS = ("name", "shard", "k_real", "payload")
+
+
+def _marker_digest(entry: dict) -> str:
+    blob = json.dumps(
+        [entry.get(k) for k in _MARKER_FIELDS], sort_keys=True
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+class PatternJournal:
+    """Write-ahead journal of pattern registrations — one atomic JSON
+    marker per pattern (the ``parallel/journal.py`` discipline:
+    tmp + ``os.replace``, a digest over the payload fields, and an
+    optional ``fence`` callable invoked right before the write whose
+    raise — :class:`StaleLeaseError` — aborts the commit marker-less).
+    A registration is acknowledged only after its marker is durable,
+    so a crash anywhere downstream re-materializes from here."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        stem = re.sub(r"[^\w.-]", "_", str(name)) or "_unnamed"
+        suffix = hashlib.sha256(str(name).encode()).hexdigest()[:8]
+        return os.path.join(self.directory, f"{stem}-{suffix}.json")
+
+    def record(self, name: str, shard: int, payload: dict, k_real: int,
+               fence: Optional[Callable[[], None]] = None) -> dict:
+        """Atomically commit one pattern marker. The ``journal`` fault
+        point fires before anything touches disk; ``fence`` (when
+        given) runs after it and before the write — raising aborts the
+        commit with NO marker written."""
+        faults.fire("journal")
+        if fence is not None:
+            fence()
+        entry = {
+            "schema": GALLERY_JOURNAL_SCHEMA,
+            "name": str(name),
+            "shard": int(shard),
+            "k_real": int(k_real),
+            "payload": {
+                "b64": payload["b64"],
+                "dtype": payload["dtype"],
+                "shape": list(payload["shape"]),
+            },
+        }
+        entry["digest"] = _marker_digest(entry)
+        atomic_write(self._path(name), lambda f: json.dump(entry, f))
+        return entry
+
+    def invalidate(self, name: str) -> None:
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def load_all(self) -> Dict[str, dict]:
+        """Every valid marker keyed by pattern name; truncated or
+        hand-edited markers fail the digest check and are skipped (the
+        pattern was never acknowledged durable)."""
+        out: Dict[str, dict] = {}
+        for fn in sorted(os.listdir(self.directory)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.directory, fn)) as f:
+                    entry = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(entry, dict):
+                continue
+            if entry.get("schema") != GALLERY_JOURNAL_SCHEMA:
+                continue
+            if entry.get("digest") != _marker_digest(entry):
+                continue
+            out[entry["name"]] = entry
+        return out
+
+
+# ----------------------------------------------------------- wire helpers
+def _payload_digest(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()
+
+
+def pack_results(results: Dict[str, dict]) -> Dict[str, dict]:
+    """Pack one ``{name: dets}`` search result for the wire: arrays
+    b64-exact (fan-out stays bitwise vs the local bank), non-array
+    fields (``degrade_steps``, ``prefilter_score``) as plain JSON."""
+    out: Dict[str, dict] = {}
+    for name, dets in results.items():
+        arrays: Dict[str, dict] = {}
+        extra: Dict[str, Any] = {}
+        for key, val in dets.items():
+            if isinstance(val, np.ndarray):
+                arrays[key] = pack_array(val)
+            else:
+                extra[key] = val
+        out[name] = {"arrays": arrays, "extra": extra}
+    return out
+
+
+def unpack_results(doc: Dict[str, dict]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for name, rec in doc.items():
+        dets = {
+            key: unpack_array(val)
+            for key, val in (rec.get("arrays") or {}).items()
+        }
+        dets.update(rec.get("extra") or {})
+        out[name] = dets
+    return out
+
+
+def unavailable_result() -> dict:
+    """The degraded per-pattern result for a dead/slow/fenced shard:
+    the single bank's empty-detections shape with the partition label
+    — a counted partial result, never an error."""
+    return {
+        "boxes": np.zeros((1, 0, 4), np.float32),
+        "scores": np.zeros((1, 0), np.float32),
+        "refs": np.zeros((1, 0, 2), np.float32),
+        "valid": np.zeros((1, 0), bool),
+        "degrade_steps": ["partition_unavailable"],
+    }
+
+
+# ------------------------------------------------------------ coordinator
+class _GalleryHandler(socketserver.StreamRequestHandler):
+    """Control-plane handler (the fleet _FleetHandler shape): JSON
+    lines in/out; EOF with leases held is the kill -9 signature."""
+
+    def handle(self):  # noqa: D102 — protocol loop
+        fleet = self.server.fleet  # type: ignore[attr-defined]
+        control_worker = None
+        clean = False
+        try:
+            while True:
+                try:
+                    msg = recv_line(self.rfile)
+                except (OSError, ValueError):
+                    break
+                if msg is None:
+                    break
+                if msg.get("op") == "hello":
+                    control_worker = msg.get("worker")
+                if msg.get("op") == "bye":
+                    clean = True
+                reply = fleet.dispatch(msg)
+                try:
+                    send_line(self.connection, reply)
+                except OSError:
+                    break
+                if clean:
+                    break
+        finally:
+            if control_worker is not None:
+                fleet.control_closed(control_worker, clean=clean)
+
+
+class _GalleryServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class GalleryFleet:
+    """The gallery-fleet coordinator: workers lease pattern shards
+    here; the catalog (pattern name -> shard, payload, copies) lives
+    here, backed by the write-ahead :class:`PatternJournal`. One per
+    cluster, usually co-located with the front door."""
+
+    def __init__(self, n_shards: int, *,
+                 policy: Optional[LeasePolicy] = None,
+                 replicas: Optional[int] = None,
+                 journal_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 check_interval_s: Optional[float] = None,
+                 push_timeout_s: Optional[float] = None):
+        self.n_shards = int(n_shards)
+        if self.n_shards < 1:
+            raise ValueError("a gallery fleet needs at least one shard")
+        #: total copies per pattern (primary + mirrors) the fleet tries
+        #: to keep on LIVE workers; fewer live workers than R is
+        #: counted under-replication, never an error
+        self.replicas = max(
+            _env_int("TMR_GALLERY_REPLICAS", 2)
+            if replicas is None else int(replicas), 1,
+        )
+        shards = [PatternShard(i) for i in range(self.n_shards)]
+        self.policy = fleet_policy(policy)
+        self._svc = LeaseService(
+            shards, self.policy,
+            metrics_prefix="gallery_fleet", noun="partition",
+            key_field="partition",
+            history_bound=4096,
+        )
+        self._shards = shards
+        self._host, self._port = host, int(port)
+        self._lock = threading.RLock()
+        self._worker_addr: Dict[str, Tuple[str, int]] = {}
+        #: pattern name -> {name, shard, k_real, payload, digest,
+        #: copies: set(worker id)} — insertion-ordered (registration
+        #: order, like the single bank's entries)
+        self._patterns: Dict[str, dict] = {}
+        self._counters: Dict[str, int] = {
+            "registrations": 0, "evictions": 0, "journal_recovered": 0,
+            "replica_pushes": 0, "replica_corrupt": 0,
+            "push_failures": 0, "under_replicated": 0,
+            "promotions": 0, "adopt_installed": 0, "adopt_pushed": 0,
+            "materialize_errors": 0,
+        }
+        self._journal = (
+            PatternJournal(journal_dir) if journal_dir else None
+        )
+        if self._journal is not None:
+            # coordinator restart: the WAL is the catalog of record —
+            # every durable (acknowledged) registration survives here
+            for name, entry in self._journal.load_all().items():
+                self._patterns[name] = {
+                    "name": name,
+                    "shard": int(entry["shard"]),
+                    "k_real": int(entry["k_real"]),
+                    "payload": dict(entry["payload"]),
+                    "digest": _payload_digest(
+                        base64.b64decode(entry["payload"]["b64"])
+                    ),
+                    "copies": set(),
+                }
+                self._counters["journal_recovered"] += 1
+        self._push_timeout = (
+            _env_float("TMR_GALLERY_FLEET_TIMEOUT_S", 10.0)
+            if push_timeout_s is None else float(push_timeout_s)
+        )
+        self._closed = False
+        self._stop_event = threading.Event()
+        self._server: Optional[_GalleryServer] = None
+        self._threads: List[threading.Thread] = []
+        self._check_s = (
+            self.policy.check_interval_s
+            if check_interval_s is None else float(check_interval_s)
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> Tuple[str, int]:
+        server = _GalleryServer((self._host, self._port), _GalleryHandler)
+        server.fleet = self  # type: ignore[attr-defined]
+        threads = [
+            threading.Thread(target=server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             name="gallery-fleet-control", daemon=True),
+            threading.Thread(target=self._monitor_loop,
+                             name="gallery-fleet-monitor", daemon=True),
+        ]
+        with self._lock:
+            self._server = server
+            self._threads = threads
+        self._svc.restart_clock()
+        for t in threads:
+            t.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        with self._lock:
+            assert self._server is not None, "gallery fleet not started"
+            return self._server.server_address[:2]
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            server = self._server
+            threads = list(self._threads)
+        self._stop_event.set()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        deadline = time.monotonic() + max(timeout, 0.0)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+
+    def __enter__(self) -> "GalleryFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_event.wait(self._check_s):
+            try:
+                self._svc.expire_pass()
+            except Exception:
+                pass  # the liveness loop must survive anything
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # ----------------------------------------------------- control protocol
+    def dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        handler = {
+            "hello": self._op_hello,
+            "lease": self._op_lease,
+            "beat": self._op_beat,
+            "fail": self._op_fail,
+            "bye": self._op_bye,
+            "state": lambda m: self.state(),
+        }.get(op)
+        if handler is None:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        try:
+            return handler(msg)
+        except Exception as e:  # protocol must answer, never wedge
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _op_hello(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        self._svc.rejoin(wid)
+        data_addr = msg.get("data_addr")
+        if isinstance(data_addr, (list, tuple)) and len(data_addr) == 2:
+            with self._lock:
+                self._worker_addr[wid] = (str(data_addr[0]),
+                                          int(data_addr[1]))
+        return {
+            "ok": True,
+            "shards": self.n_shards,
+            "replicas": self.replicas,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+        }
+
+    def _op_lease(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        wait = {"partition": None,
+                "wait_s": max(self.policy.check_interval_s, 0.05)}
+        verdict, part, epoch = self._svc.select(wid)
+        if verdict == "drained":
+            return {"partition": None, "drained": True}
+        if verdict != "grant":
+            return wait  # fleets are never "done" while serving
+        if self._svc.install(part, epoch, wid) is None:
+            return wait
+        # promotion re-materialization happens BEFORE the grant
+        # returns: by the time the worker records the lease, its bank
+        # holds every durable pattern of the shard (replica store
+        # first, catalog push for the rest) — searches that raced the
+        # rebalance were fenced, searches after the grant are whole
+        self._materialize(part, epoch, wid)
+        return {
+            "partition": part.key,
+            "index": part.index,
+            "epoch": epoch,
+            "ttl_s": self.policy.lease_ttl_s,
+            "hb_interval_s": self.policy.hb_interval_s,
+        }
+
+    def _op_beat(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        stale: List[List[int]] = []
+        for pair in msg.get("held") or ():
+            index, epoch = int(pair[0]), int(pair[1])
+            if not self._svc.heartbeat(wid, index, epoch):
+                stale.append([index, epoch])
+        worker = self._svc.worker_rec(wid)
+        return {"ok": True, "stale": stale, "drained": worker.drained}
+
+    def _op_fail(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
+        res = self._svc.fail(wid, index, epoch, msg.get("causes") or [])
+        return {"ok": True, **res}
+
+    def _op_bye(self, msg: dict) -> dict:
+        wid = str(msg.get("worker"))
+        self._svc.bye(wid)
+        self._svc.revoke_worker(wid, "worker_exit")
+        return {"ok": True}
+
+    def control_closed(self, wid: str, clean: bool) -> None:
+        self._svc.control_closed(str(wid), clean)
+
+    # ------------------------------------------------------------ placement
+    def shard_of(self, name: str) -> int:
+        return shard_of(name, self.n_shards)
+
+    def holder_for(self, shard: int
+                   ) -> Optional[Tuple[str, int, Tuple[str, int]]]:
+        """The live holder of one shard as ``(worker id, epoch, data
+        address)`` — or None (unheld, or a holder that never registered
+        a data plane)."""
+        holder = self._svc.holder(int(shard))
+        if holder is None:
+            return None
+        wid, epoch = holder
+        with self._lock:
+            addr = self._worker_addr.get(wid)
+        if addr is None:
+            return None
+        return wid, epoch, addr
+
+    def _addr_of(self, wid: str) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            return self._worker_addr.get(wid)
+
+    def shard_map(self) -> Dict[int, List[str]]:
+        """Registered pattern names per shard, registration order —
+        the front door's fan-out plan."""
+        out: Dict[int, List[str]] = {}
+        with self._lock:
+            for name, entry in self._patterns.items():
+                out.setdefault(entry["shard"], []).append(name)
+        return out
+
+    def patterns(self) -> List[str]:
+        with self._lock:
+            return list(self._patterns)
+
+    # ----------------------------------------------------------- registrar
+    def register(self, name: str, exemplars, k_real: Optional[int] = None
+                 ) -> dict:
+        """Durably register one pattern. Ordering is the correctness
+        contract: journal FIRST (the write-ahead marker), catalog,
+        then primary push + replica mirrors — the ack carries how many
+        copies acknowledged, and a crash at ANY later point loses
+        nothing because the marker already vouches."""
+        name = str(name)
+        arr = np.ascontiguousarray(np.asarray(exemplars, np.float32))
+        kr = int(k_real) if k_real is not None else int(
+            arr.shape[0] if arr.ndim >= 1 else 1
+        )
+        shard = self.shard_of(name)
+        payload = pack_array(arr)
+        entry = {
+            "name": name,
+            "shard": shard,
+            "k_real": kr,
+            "payload": payload,
+            "digest": _payload_digest(arr.tobytes()),
+            "copies": set(),
+        }
+        if self._journal is not None:
+            self._journal.record(name, shard, payload, kr)
+        with self._lock:
+            self._patterns[name] = entry
+            self._counters["registrations"] += 1
+        copies = self._distribute(entry)
+        under = copies < min(self.replicas,
+                             max(len(self._svc.live_workers()), 1))
+        if under:
+            self._count("under_replicated")
+        return {
+            "ok": True,
+            "name": name,
+            "shard": shard,
+            "copies": copies,
+            "journaled": self._journal is not None,
+            "under_replicated": under,
+        }
+
+    def evict(self, name: str) -> bool:
+        name = str(name)
+        if self._journal is not None:
+            self._journal.invalidate(name)
+        with self._lock:
+            entry = self._patterns.pop(name, None)
+            if entry is None:
+                return False
+            self._counters["evictions"] += 1
+            copies = set(entry["copies"])
+        for wid in copies:
+            addr = self._addr_of(wid)
+            if addr is None:
+                continue
+            try:
+                oneshot(addr, {"op": "evict_pattern", "name": name,
+                               "shard": entry["shard"]},
+                        timeout=self._push_timeout)
+            except Exception:
+                pass  # a dead copy-holder has nothing left to evict
+        return True
+
+    # --------------------------------------------------------- replication
+    def _distribute(self, entry: dict) -> int:
+        """Push one pattern to its shard's primary and mirror it to
+        R−1 other live workers; returns how many copies acknowledged."""
+        shard = entry["shard"]
+        copies = 0
+        primary = None
+        resolved = self.holder_for(shard)
+        if resolved is not None:
+            primary = resolved[0]
+            if self._push_pattern(entry, primary, resolved[2],
+                                  replica=False):
+                copies += 1
+        copies += self._mirror(entry, exclude={primary} if primary else set())
+        return copies
+
+    def _mirror(self, entry: dict, exclude: set) -> int:
+        """Top replication back up to R copies on live workers."""
+        live = self._svc.live_workers()
+        with self._lock:
+            have = {w for w in entry["copies"] if w in live}
+        need = self.replicas - len(have) - len(exclude - have)
+        acked = 0
+        for wid in sorted(live):
+            if need <= acked:
+                break
+            if wid in have or wid in exclude:
+                continue
+            addr = self._addr_of(wid)
+            if addr is None:
+                continue
+            if self._push_pattern(entry, wid, addr, replica=True):
+                acked += 1
+        return acked
+
+    def _push_pattern(self, entry: dict, wid: str,
+                      addr: Tuple[str, int], *, replica: bool,
+                      tries: int = 3) -> bool:
+        """One copy onto one worker, digest-verified end to end. The
+        ``gallery.replica`` fault point fires (and may corrupt the
+        payload bytes) per REPLICA push attempt; a corrupt copy is
+        rejected by the worker's digest check and retried clean —
+        counted, never silently installed."""
+        raw = base64.b64decode(entry["payload"]["b64"])
+        for attempt in range(max(tries, 1)):
+            data = raw
+            try:
+                with faults.shard_scope(entry["shard"], attempt):
+                    if replica:
+                        faults.fire("gallery.replica")
+                        data = faults.corrupt_bytes("gallery.replica", raw)
+                doc = {
+                    "op": "pattern",
+                    "name": entry["name"],
+                    "shard": entry["shard"],
+                    "k_real": entry["k_real"],
+                    "replica": bool(replica),
+                    "digest": entry["digest"],
+                    "payload": {
+                        "b64": base64.b64encode(data).decode("ascii"),
+                        "dtype": entry["payload"]["dtype"],
+                        "shape": list(entry["payload"]["shape"]),
+                    },
+                }
+                if replica:
+                    self._count("replica_pushes")
+                reply = oneshot(addr, doc, timeout=self._push_timeout)
+            except Exception:
+                # injected raise or a dead worker: this attempt is
+                # gone; the retry (or the journal) owns durability
+                self._count("push_failures")
+                continue
+            if reply.get("ok") is True:
+                with self._lock:
+                    ent = self._patterns.get(entry["name"])
+                    if ent is not None:
+                        ent["copies"].add(wid)
+                return True
+            if reply.get("status") == "corrupt":
+                self._count("replica_corrupt")
+                continue
+            self._count("push_failures")
+        return False
+
+    def _materialize(self, part: PatternShard, epoch: int,
+                     wid: str) -> None:
+        """Re-materialize one shard onto its (possibly new) holder:
+        adopt from the worker's replica store first (digest-checked),
+        push the rest from the catalog, then heal replication."""
+        with self._lock:
+            pats = [
+                dict(e, copies=e["copies"]) for e in
+                self._patterns.values() if e["shard"] == part.index
+            ]
+        if not pats:
+            return
+        addr = self._addr_of(wid)
+        if addr is None:
+            self._count("materialize_errors")
+            return
+        installed: set = set()
+        try:
+            adopt = oneshot(addr, {
+                "op": "adopt", "shard": part.index, "epoch": int(epoch),
+                "patterns": [
+                    {"name": p["name"], "digest": p["digest"],
+                     "k_real": p["k_real"]} for p in pats
+                ],
+            }, timeout=self._push_timeout)
+            if adopt.get("ok") is True:
+                installed = set(adopt.get("installed") or ())
+        except Exception:
+            self._count("materialize_errors")
+        if installed:
+            self._count("adopt_installed", len(installed))
+            with self._lock:
+                for p in pats:
+                    if p["name"] in installed:
+                        ent = self._patterns.get(p["name"])
+                        if ent is not None:
+                            ent["copies"].add(wid)
+        for p in pats:
+            if p["name"] in installed:
+                continue
+            if self._push_pattern(p, wid, addr, replica=False):
+                self._count("adopt_pushed")
+            else:
+                self._count("materialize_errors")
+        if part.assignments > 1:
+            self._count("promotions")
+        with self._lock:
+            fresh = [dict(e, copies=e["copies"]) for e in
+                     self._patterns.values() if e["shard"] == part.index]
+        for p in fresh:
+            self._mirror(p, exclude=set())
+
+    # --------------------------------------------------------------- state
+    def client(self, **kw) -> "GalleryFleetClient":
+        return GalleryFleetClient(self, **kw)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def state(self) -> dict:
+        with self._svc.lock:
+            with self._lock:
+                return {
+                    "ok": True,
+                    "shards": {
+                        s.key: {
+                            "status": s.status,
+                            "holder": self._svc.holder(s.index),
+                            "assignments": s.assignments,
+                        }
+                        for s in self._shards
+                    },
+                    "patterns": len(self._patterns),
+                    "workers": {
+                        w.wid: {"drained": w.drained, "dead": w.dead}
+                        for w in self._svc.workers.values()
+                    },
+                    "reassignments": [
+                        dict(r) for r in self._svc.reassignments
+                    ],
+                    "counters": dict(self._counters),
+                }
+
+
+# ---------------------------------------------------------------- worker
+class GalleryFleetWorker:
+    """One gallery worker: joins a :class:`GalleryFleet`, leases
+    pattern shards, heartbeats them, and answers fenced ``gsearch``
+    round-trips on its data plane (a
+    :class:`~tmr_tpu.serve.gallery.FeatureSinkServer` composed through
+    ``on_request``, exactly like the feature tier's workers).
+
+    ``bank_factory(shard_index)`` builds the per-shard bank — a real
+    :class:`~tmr_tpu.serve.gallery.GalleryBank` in production,
+    :class:`StubGalleryBank` in the harnesses. Replica payloads live
+    in a host-side store until promotion installs them; only the held
+    shard's bank serves searches (``gsearch`` is epoch-fenced — a
+    revoked worker answers ``fenced``, never stale detections)."""
+
+    def __init__(self, coordinator: Tuple[str, int], worker_id: str, *,
+                 bank_factory: Callable[[int], Any],
+                 data_host: str = "127.0.0.1", data_port: int = 0,
+                 timeout: float = 30.0):
+        self.worker_id = worker_id
+        self._bank_factory = bank_factory
+        self.coordinator = (coordinator[0], int(coordinator[1]))
+        self._lock = threading.RLock()
+        self._held: Dict[int, int] = {}  # shard index -> epoch
+        self._banks: Dict[int, Any] = {}
+        self._installed: Dict[int, set] = {}
+        #: replica store: pattern name -> the full wire entry (payload
+        #: + digest) — promotion re-materializes banks from here
+        self._store: Dict[str, dict] = {}
+        self._stop_event = threading.Event()
+        self._drained = False
+        self._coordinator_lost = False
+        self._counters = {
+            "searches": 0, "fenced": 0, "errors": 0,
+            "patterns_stored": 0, "patterns_installed": 0,
+            "corrupt_rejected": 0, "evicted": 0,
+        }
+        self._sink = FeatureSinkServer(
+            host=data_host, port=data_port,
+            on_request=self._on_request,
+        )
+        data_addr = self._sink.start()
+        self._sock = socket.create_connection(
+            self.coordinator, timeout=connect_timeout(min(timeout, 5.0))
+        )
+        self._sock.settimeout(timeout)
+        self._file = self._sock.makefile("rb")
+        self._ctl_lock = threading.Lock()
+        self.config = self._call({
+            "op": "hello",
+            "data_addr": list(data_addr[:2]),
+        })
+        self._hb_interval = float(
+            self.config.get("hb_interval_s") or 2.5
+        )
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- control
+    def _call(self, doc: dict) -> dict:
+        doc = dict(doc)
+        doc.setdefault("worker", self.worker_id)
+        with self._ctl_lock:
+            send_line(self._sock, doc)
+            reply = recv_line(self._file)
+        if reply is None:
+            raise ConnectionError("gallery-fleet coordinator closed the "
+                                  "connection")
+        return reply
+
+    def start(self) -> "GalleryFleetWorker":
+        threads = [
+            threading.Thread(target=self._lease_loop,
+                             name=f"gal-lease-{self.worker_id}",
+                             daemon=True),
+            threading.Thread(target=self._beat_loop,
+                             name=f"gal-beat-{self.worker_id}",
+                             daemon=True),
+        ]
+        with self._lock:
+            self._threads = threads
+        for t in threads:
+            t.start()
+        return self
+
+    def _lease_loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                grant = self._call({"op": "lease"})
+            except (ConnectionError, OSError):
+                if not self._stop_event.is_set():
+                    with self._lock:
+                        self._coordinator_lost = True
+                return
+            if grant.get("drained"):
+                with self._lock:
+                    self._drained = True
+                return
+            index = grant.get("index")
+            if index is None:
+                if self._stop_event.wait(
+                    float(grant.get("wait_s", 0.2))
+                ):
+                    return
+                continue
+            with self._lock:
+                self._held[int(index)] = int(grant["epoch"])
+
+    def _beat_loop(self) -> None:
+        while not self._stop_event.wait(self._hb_interval):
+            try:
+                # the gallery.beat point is the SIGSTOP stand-in:
+                # latency=S delays the beat past the TTL (the shard
+                # goes stale and is promoted onto a replica); a raise
+                # just drops this beat — both ARE the liveness signal
+                faults.fire("gallery.beat")
+                self._beat_once()
+            except (ConnectionError, OSError):
+                pass
+            except Exception:
+                if not faults.active():
+                    raise
+
+    def _beat_once(self) -> dict:
+        with self._lock:
+            held = [[i, e] for i, e in self._held.items()]
+        reply = oneshot(self.coordinator, {
+            "op": "beat", "worker": self.worker_id, "held": held,
+        })
+        stale = reply.get("stale") or ()
+        with self._lock:
+            for index, epoch in stale:
+                if self._held.get(int(index)) == int(epoch):
+                    del self._held[int(index)]
+            if reply.get("drained"):
+                self._drained = True
+        return reply
+
+    # ---------------------------------------------------------- data plane
+    def holds(self, index: int, epoch: int) -> bool:
+        with self._lock:
+            return self._held.get(int(index)) == int(epoch)
+
+    def _bank_for(self, shard: int):
+        with self._lock:
+            bank = self._banks.get(shard)
+            if bank is None:
+                bank = self._banks[shard] = self._bank_factory(shard)
+                self._installed.setdefault(shard, set())
+            return bank
+
+    def _install(self, entry: dict) -> None:
+        shard = int(entry["shard"])
+        bank = self._bank_for(shard)
+        arr = unpack_array(entry["payload"])
+        bank.register(entry["name"], arr, k_real=int(entry["k_real"]))
+        with self._lock:
+            self._installed.setdefault(shard, set()).add(entry["name"])
+            self._counters["patterns_installed"] += 1
+
+    def _on_request(self, doc: dict, state: dict) -> Optional[dict]:
+        op = doc.get("op")
+        if op == "pattern":
+            return self._op_pattern(doc)
+        if op == "adopt":
+            return self._op_adopt(doc)
+        if op == "evict_pattern":
+            return self._op_evict(doc)
+        if op == "gsearch":
+            return self._op_gsearch(doc)
+        if op == "gstate":
+            return self._op_gstate(doc)
+        return None  # unknown ops fall through to the sink's error
+
+    def _op_pattern(self, doc: dict) -> dict:
+        raw = base64.b64decode(doc["payload"]["b64"])
+        if _payload_digest(raw) != doc.get("digest"):
+            # a corrupt copy must NEVER enter the store: the digest
+            # check is the replica-integrity contract the chaos probe
+            # injects against
+            with self._lock:
+                self._counters["corrupt_rejected"] += 1
+            return {"op": "pattern", "ok": False, "status": "corrupt",
+                    "name": doc.get("name")}
+        entry = {
+            "name": str(doc["name"]),
+            "shard": int(doc["shard"]),
+            "k_real": int(doc["k_real"]),
+            "payload": dict(doc["payload"]),
+            "digest": str(doc["digest"]),
+        }
+        with self._lock:
+            self._store[entry["name"]] = entry
+            self._counters["patterns_stored"] += 1
+        if not doc.get("replica"):
+            self._install(entry)
+        return {"op": "pattern", "ok": True, "status": "ok",
+                "name": entry["name"], "replica": bool(doc.get("replica"))}
+
+    def _op_adopt(self, doc: dict) -> dict:
+        shard = int(doc.get("shard", -1))
+        installed: List[str] = []
+        missing: List[str] = []
+        for want in doc.get("patterns") or ():
+            name = str(want.get("name"))
+            with self._lock:
+                ent = self._store.get(name)
+                already = name in self._installed.get(shard, set())
+            if already:
+                installed.append(name)
+                continue
+            if ent is None or ent["digest"] != want.get("digest") \
+                    or ent["shard"] != shard:
+                missing.append(name)
+                continue
+            self._install(ent)
+            installed.append(name)
+        return {"op": "adopt", "ok": True, "shard": shard,
+                "installed": installed, "missing": missing}
+
+    def _op_evict(self, doc: dict) -> dict:
+        name = str(doc.get("name"))
+        shard = int(doc.get("shard", -1))
+        with self._lock:
+            self._store.pop(name, None)
+            bank = self._banks.get(shard)
+            had = name in self._installed.get(shard, set())
+            self._installed.get(shard, set()).discard(name)
+            self._counters["evicted"] += 1
+        if bank is not None and had:
+            bank.evict(name)
+        return {"op": "evict_pattern", "ok": True, "name": name}
+
+    def _op_gsearch(self, doc: dict) -> dict:
+        shard = int(doc.get("shard", -1))
+        epoch = int(doc.get("epoch", -1))
+        if not self.holds(shard, epoch):
+            with self._lock:
+                self._counters["fenced"] += 1
+            return {"op": "gsearch", "ok": False, "status": "fenced"}
+        try:
+            image = unpack_array(doc["image"])
+            with self._lock:
+                bank = self._banks.get(shard)
+            results = bank.search(image) if bank is not None else {}
+        except Exception as e:
+            with self._lock:
+                self._counters["errors"] += 1
+            return {"op": "gsearch", "ok": False, "status": "error",
+                    "message": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            self._counters["searches"] += 1
+        return {"op": "gsearch", "ok": True, "status": "ok",
+                "shard": shard, "results": pack_results(results)}
+
+    def _op_gstate(self, doc: dict) -> dict:
+        with self._lock:
+            return {
+                "op": "gstate", "ok": True, "worker": self.worker_id,
+                "held": {str(i): e for i, e in self._held.items()},
+                "stored": sorted(self._store),
+                "installed": {
+                    str(s): sorted(names)
+                    for s, names in self._installed.items()
+                },
+                "counters": dict(self._counters),
+                "faults_active": faults.active(),
+                "faults_fired": len(faults.fired()),
+            }
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def held(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._held)
+
+    @property
+    def drained(self) -> bool:
+        with self._lock:
+            return self._drained
+
+    @property
+    def coordinator_lost(self) -> bool:
+        with self._lock:
+            return self._coordinator_lost
+
+    @property
+    def data_address(self) -> Tuple[str, int]:
+        return self._sink.address
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_event.set()
+        try:
+            self._call({"op": "bye"})
+        except (ConnectionError, OSError):
+            pass
+        try:  # shutdown-first: unblocks any reader before the close
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sink.close()
+        with self._lock:
+            threads = list(self._threads)
+        deadline = time.monotonic() + max(timeout, 0.0)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+
+
+# ---------------------------------------------------------------- client
+class GalleryFleetClient:
+    """The fan-out front door: one frame goes to every worker holding
+    one of its shards; the disjoint per-shard results union back into
+    the single bank's ``{name: dets}`` shape.
+
+    Degrade contract: a shard with no live holder, a severed link
+    (the ``serve.link`` fault point fires per fan-out write), or a
+    fenced/raced reply yields that shard's patterns as empty
+    detections labeled ``degrade_steps: ["partition_unavailable"]`` —
+    counted, never an error — and heals on the next search once the
+    lease rebalances. With every shard healthy the merged result is
+    byte-identical to one bank holding all patterns (per-entry results
+    are independent of bank co-residents — PR 15's per-entry bitwise
+    pin — and the wire codec is exact bytes)."""
+
+    def __init__(self, fleet: GalleryFleet, *,
+                 timeout_s: Optional[float] = None):
+        self._fleet = fleet
+        self._timeout_s = (
+            _env_float("TMR_GALLERY_FLEET_TIMEOUT_S", 10.0)
+            if timeout_s is None else float(timeout_s)
+        )
+        self._lock = threading.Lock()
+        self._links: Dict[str, _ExtractLink] = {}
+        #: per-shard fan-out attempt numbers — the ambient attempt the
+        #: serve.link fault point scopes by (attempts=1 severs the
+        #: first fan-out to a shard and lets the retry heal)
+        self._attempts: Dict[int, int] = {}
+        self._counters = {
+            "searches": 0, "fanouts": 0, "merged_patterns": 0,
+            "degraded_shards": 0, "degraded_patterns": 0,
+            "no_holder": 0, "link_failures": 0, "fenced": 0,
+            "errors": 0,
+        }
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def _link_for(self, wid: str,
+                  addr: Tuple[str, int]) -> Optional[_ExtractLink]:
+        with self._lock:
+            link = self._links.get(wid)
+        if link is not None and not link.dead \
+                and link.address == (addr[0], int(addr[1])):
+            return link
+        try:
+            fresh = _ExtractLink(addr, self._timeout_s)
+        except OSError:
+            return None
+        with self._lock:
+            old = self._links.get(wid)
+            self._links[wid] = fresh
+        if old is not None:
+            old.close()
+        return fresh
+
+    def _drop_link(self, wid: str) -> None:
+        with self._lock:
+            link = self._links.pop(wid, None)
+        if link is not None:
+            link.close()
+
+    def _fetch_shard(self, shard: int, image_doc: dict
+                     ) -> Optional[Dict[str, dict]]:
+        with self._lock:
+            attempt = self._attempts.get(shard, 0)
+            self._attempts[shard] = attempt + 1
+        resolved = self._fleet.holder_for(shard)
+        if resolved is None:
+            self._bump("no_holder")
+            return None
+        wid, epoch, addr = resolved
+        link = self._link_for(wid, addr)
+        if link is None:
+            self._bump("link_failures")
+            return None
+        try:
+            with faults.shard_scope(shard, attempt):
+                # an injected raise here IS a severed data link: drop
+                # the connection and degrade this shard for this frame
+                faults.fire("serve.link")
+        except Exception:
+            self._drop_link(wid)
+            self._bump("link_failures")
+            return None
+        reply = link.call({
+            "op": "gsearch", "shard": int(shard), "epoch": int(epoch),
+            "image": image_doc,
+        })
+        if reply is None:
+            self._bump("link_failures")
+            return None
+        if reply.get("ok") is not True:
+            self._bump("fenced" if reply.get("status") == "fenced"
+                       else "errors")
+            return None
+        return unpack_results(reply.get("results") or {})
+
+    def search(self, image) -> Dict[str, dict]:
+        """Fan out one frame to every pattern shard's holder and merge
+        — the single bank's ``search`` surface, cluster-sized."""
+        img = np.ascontiguousarray(np.asarray(image, np.float32))
+        image_doc = pack_array(img)
+        plan = self._fleet.shard_map()
+        self._bump("searches")
+        results: Dict[str, dict] = {}
+        for shard in sorted(plan):
+            names = plan[shard]
+            if not names:
+                continue
+            self._bump("fanouts")
+            got = self._fetch_shard(shard, image_doc)
+            if got is None:
+                self._bump("degraded_shards")
+                self._bump("degraded_patterns", len(names))
+                for name in names:
+                    results[name] = unavailable_result()
+                continue
+            for name in names:
+                dets = got.get(name)
+                if dets is None:
+                    # the holder has the lease but not (yet) this
+                    # pattern — degrade exactly that entry
+                    self._bump("degraded_patterns")
+                    results[name] = unavailable_result()
+                else:
+                    self._bump("merged_patterns")
+                    results[name] = dets
+        return results
+
+    def close(self) -> None:
+        with self._lock:
+            links = list(self._links.values())
+            self._links.clear()
+        for link in links:
+            link.close()
+
+
+# ------------------------------------------------------------------ stub
+class StubGalleryBank:
+    """A dependency-free bank with the :class:`GalleryBank` surface
+    (register/evict/search) whose detections depend ONLY on
+    (pattern exemplars, frame) — float32 arithmetic, deterministic
+    across processes — so fan-out-vs-single-bank equality through this
+    stub is a genuine end-to-end wire check: crossed shards, stale
+    payloads, or a lossy codec all show as byte mismatches."""
+
+    def __init__(self, image_size: int = 32):
+        self.image_size = int(image_size)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[np.ndarray, int]] = {}
+
+    def register(self, name: str, exemplars, k_real: Optional[int] = None
+                 ) -> dict:
+        arr = np.ascontiguousarray(np.asarray(exemplars, np.float32))
+        kr = int(k_real) if k_real is not None else int(
+            arr.shape[0] if arr.ndim >= 1 else 1
+        )
+        with self._lock:
+            self._entries[str(name)] = (arr, kr)
+        return {"name": str(name), "k_real": kr,
+                "capacity": int(arr.size), "k_bucket": kr}
+
+    def evict(self, name: str) -> bool:
+        with self._lock:
+            return self._entries.pop(str(name), None) is not None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def search(self, image, prefilter_topk: Optional[int] = None
+               ) -> Dict[str, dict]:
+        img = np.asarray(image, np.float32)
+        sig = np.float32(img.mean(dtype=np.float32))
+        with self._lock:
+            entries = list(self._entries.items())
+        out: Dict[str, dict] = {}
+        for name, (ex, kr) in entries:
+            exsum = np.float32(ex.sum(dtype=np.float32))
+            score = np.float32(sig + exsum)
+            out[name] = {
+                "boxes": np.asarray(
+                    [[[0.0, 0.0, float(exsum), float(sig)]]], np.float32
+                ),
+                "scores": np.asarray([[score]], np.float32),
+                "refs": np.zeros((1, 1, 2), np.float32),
+                "valid": np.ones((1, 1), bool),
+                "count": np.asarray([kr], np.int32),
+            }
+        return out

@@ -362,6 +362,91 @@ def read_stream_report(path: str) -> dict:
     }
 
 
+# ----------------------------------------------------------- chaos report
+
+
+def read_chaos_report(path: str) -> dict:
+    """Reduce a ``serve_chaos_report/v1`` document
+    (scripts/serve_chaos_probe.py output) to the rc-gating fields: the
+    zero-pattern-loss invariant across repeated primary kills, the
+    healthy-fleet fan-out byte-equality pin, and the fault ledger —
+    every injected serve-tier fault observed AND accounted for.
+
+    Returns ``{"summary": ..., "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable chaos report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"chaos report is an error record: "
+                         f"{doc['error']}"}
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        return {"error": f"no checks section in {path}"}
+    patterns = doc.get("patterns") or {}
+    kills = doc.get("kills") or {}
+    fsec = doc.get("faults") or {}
+    injected = [r for r in (fsec.get("injected") or ())
+                if isinstance(r, dict)]
+    lost = patterns.get("lost")
+    return {
+        "summary": {
+            "patterns_registered": patterns.get("registered"),
+            "patterns_survived": patterns.get("survived"),
+            "patterns_lost": len(lost) if isinstance(lost, list)
+            else None,
+            "kill_rounds": kills.get("rounds"),
+            "workers_killed": kills.get("workers_killed"),
+            "faults_injected": len(injected),
+            "faults_fired": sum(int(r.get("fired") or 0)
+                                for r in injected),
+            "phases": [p.get("name") for p in (doc.get("phases") or ())
+                       if isinstance(p, dict)],
+        },
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "zero_patterns_lost": bool(
+                checks.get("zero_patterns_lost") is True
+                and isinstance(lost, list) and not lost
+            ),
+            "fanout_byte_identical": checks.get("fanout_byte_identical")
+            is True,
+            "all_faults_observed": bool(
+                checks.get("all_faults_observed") is True
+                and injected
+                and all(int(r.get("fired") or 0) > 0 for r in injected)
+            ),
+            "all_faults_accounted": bool(
+                checks.get("all_faults_accounted") is True
+                and injected
+                and all(int(r.get("accounted") or 0) > 0
+                        for r in injected)
+            ),
+            "degraded_exactly_labeled": checks.get(
+                "degraded_exactly_labeled"
+            ) is True,
+            "probe_checks_pass": bool(
+                isinstance(checks, dict) and checks
+                and all(checks.values())
+            ),
+        },
+    }
+
+
 # ----------------------------------------------------------- serve sweep
 
 

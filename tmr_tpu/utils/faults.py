@@ -44,6 +44,21 @@ test pins this table against the actual
     fleet.recruit fleet: sustained queue saturation is
                 about to recruit a worker through the
                 spawner (scale-out election)
+    serve.link  serve data link: one request/frame is
+                about to be written to a worker's wire
+                connection (fleet worker links and the
+                gallery fleet's per-partition search
+                links; a raise severs the link — the
+                peer-death stand-in)
+    gallery.replica gallery fleet: one pattern payload     corrupt=1
+                is about to be pushed to a replica
+                holder (scope: shard index, attempt =
+                push retry number)
+    gallery.beat gallery fleet: a worker is about to
+                send its lease heartbeat (latency=S
+                past the TTL is the SIGSTOP stand-in:
+                the pattern shard goes stale and is
+                promoted onto a replica)
 
 A schedule is a `;`-separated list of specs, each
 ``point[:key=value]*``, installed from the ``TMR_FAULTS`` env var
@@ -95,6 +110,7 @@ POINTS = (
     "tar.open", "tar.member", "decode", "encode", "save", "journal",
     "lease", "heartbeat", "steal",
     "fleet.route", "fleet.commit", "fleet.recruit",
+    "serve.link", "gallery.replica", "gallery.beat",
 )
 
 
