@@ -135,9 +135,14 @@ def main(argv=None) -> int:
         if "error" in doc:
             return 1
         ck = doc["checks"]
+        # EVERY check the reducer surfaced must hold — the n_sweep
+        # gates (index_sublinear / index_recall_ok / index_off_exact /
+        # fleet_probe_ok) activate fail-closed exactly when the report
+        # carries the optional catalog-scale sweep section
         return 0 if (ck["bitwise_exact"] and ck["backbone_amortized"]
                      and ck["prefilter_recall_ok"]
-                     and ck["prefilter_cut_ok"]) else 1
+                     and ck["prefilter_cut_ok"]
+                     and all(v is True for v in ck.values())) else 1
 
     if args.fleet:
         doc = read_fleet_report(args.fleet)

@@ -22,6 +22,17 @@ tmr_tpu/diagnostics.py):
 - **N-ladder sweep** — full-bank search wall under ladder caps
   (chunked heads programs vs the one fused rung); the winner persists
   as the measured ``TMR_GALLERY_NMAX``.
+- **Index N-sweep** (``--sweep 1000,10000,100000``) — catalog-scale
+  banks of random-geometry entries, per point: the exact linear
+  prefilter pass timed and kept as the selection oracle, the
+  coarse-to-fine sketch index (serve/gallery_index.py) timed on the
+  same frame features, SELECTION recall (index top-k ∩ linear top-k)
+  against ``--index-recall-floor``, and the argpartition-vs-stable-
+  sort tie contract recomputed from the raw scores. The log-log
+  wall-vs-N exponents of both arms land in the report
+  (``n_sweep.fit``) with the sublinearity check; ``--fleet-patterns P``
+  additionally re-runs the PR 17 chaos gauntlet with ``P`` bulk
+  patterns per shard and gates on its rc.
 
 The synthetic workload is the WATCHLIST shape: of the N registered
 patterns only a fixed quarter are present in the stream frames
@@ -47,6 +58,8 @@ as a hollow recall pass.
 
 Usage:  python scripts/gallery_bench.py [--tiny] [--out FILE]
         [--patterns N] [--frames F] [--topk K] [--seed S]
+        [--sweep N1,N2,...] [--index-recall-floor R] [--nprobe P]
+        [--fleet-patterns P]
 
 ``--tiny`` (or TMR_BENCH_TINY=1) shrinks geometry so the whole sweep
 smoke-runs on CPU (tier-1 runs it under JAX_PLATFORMS=cpu); real
@@ -236,6 +249,206 @@ def _craft_detector(pred, frame, boxes, present, capacity: int,
             "scale": round(scale, 4)}
 
 
+def _sweep_boxes(n: int, seed: int) -> list:
+    """``n`` random-geometry (1, 4) normalized exemplar boxes for
+    catalog-scale banks. The patch-aligned watchlist layout tops out at
+    ~hundreds of non-overlapping slots; index sweep points need
+    10^3..10^5 entries whose SELECTION (not detection quality) is under
+    test, so arbitrary overlapping geometry is exactly right."""
+    rng = np.random.default_rng(seed)
+    wh = rng.uniform(0.04, 0.25, size=(n, 2)).astype(np.float32)
+    xy = rng.uniform(size=(n, 2)).astype(np.float32) * (1.0 - wh)
+    boxes = np.concatenate([xy, xy + wh], axis=1)
+    return [boxes[i:i + 1] for i in range(n)]
+
+
+def _linear_scan(bank, feats):
+    """The exact linear prefilter pass, run bench-side so the sweep
+    can time it AND keep every raw per-entry score for the stable-sort
+    tie reference (the bank's own scan tail-caps its scores dict at
+    catalog scale)."""
+    names, chunks = [], []
+    for g in bank._groups_locked():
+        fn = bank._pred._get_gallery_prefilter_fn(g.n_bucket, g.k_bucket)
+        s = np.asarray(fn(feats, g.ex_dev, g.k_dev, g.n_dev))
+        names.extend(g.names)
+        chunks.append(s[:g.n_real])
+    return names, np.concatenate(chunks)
+
+
+def _loglog_exponent(ns, walls):
+    """Least-squares slope of log(wall) vs log(N) — the measured
+    scaling exponent (1.0 = linear, 0.5 = sqrt)."""
+    if len(ns) < 2 or any(w <= 0 for w in walls):
+        return None
+    slope = np.polyfit(np.log(np.asarray(ns, np.float64)),
+                       np.log(np.asarray(walls, np.float64)), 1)[0]
+    return round(float(slope), 3)
+
+
+def _run_fleet_probe(patterns_per_shard: int) -> dict:
+    """Re-run the PR 17 serve chaos gauntlet with the streamed
+    bulk-ingest phase at ``patterns_per_shard`` — the index/bulk paths
+    proven under kills, corrupt replicas, and journal faults."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "serve_chaos_probe.py"),
+         "--tiny", "--patterns-per-shard", str(patterns_per_shard)],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    doc = {}
+    for ln in proc.stdout.splitlines():
+        try:
+            doc = json.loads(ln)
+            break
+        except ValueError:
+            continue
+    out = {
+        "patterns_per_shard": int(patterns_per_shard),
+        "rc": int(proc.returncode),
+        "checks": doc.get("checks"),
+    }
+    if "error" in doc:
+        out["error"] = doc["error"]
+    bulk = next((p for p in doc.get("phases", ())
+                 if isinstance(p, dict) and p.get("name") == "bulk_ingest"),
+                None)
+    if bulk is not None:
+        out["bulk_ingest"] = bulk
+    return out
+
+
+def _run_sweep(pred, size: int, args) -> dict:
+    """The index N-sweep (module docstring). Per point: one bank holds
+    both arms — the same frame features flow through the exact linear
+    scan (oracle + timing) and the sketch-index election (timing +
+    recall + counters)."""
+    import jax.numpy as jnp
+
+    from tmr_tpu.serve import GalleryBank
+    from tmr_tpu.serve.gallery import _topk_flat
+
+    ns = sorted({int(x) for x in args.sweep.split(",") if x.strip()})
+    floor = float(args.index_recall_floor)
+    rng = np.random.default_rng(args.seed + 77)
+    # structured query frame (low-frequency field + mild detail): the
+    # regime a GEOMETRIC index serves. Real stream frames have smooth
+    # feature maps, so nearby boxes score nearby; pure white noise
+    # decorrelates at the patch scale and defeats any coarse routing —
+    # an adversarial input the index answers with its counted linear
+    # fallback, not a recall claim
+    coarse = rng.standard_normal((8, 8, 3)).astype(np.float32)
+    frame = np.repeat(np.repeat(coarse, size // 8, 0), size // 8, 1)
+    frame = frame + rng.standard_normal(
+        (size, size, 3)
+    ).astype(np.float32) * 0.1
+    bb = pred._get_backbone_fn()
+    feats = bb(pred.exec_params(), jnp.asarray(frame[None]))
+    points = []
+    for n in ns:
+        topk = max(1, min(32, n // 4))
+        _progress(f"sweep N={n}: registering")
+        boxes = _sweep_boxes(n, args.seed + n)
+        t0 = time.perf_counter()
+        bank = GalleryBank(pred, feature_cache=0, max_n_bucket=32,
+                           index=True, index_min_n=1,
+                           index_nprobe=args.nprobe or None)
+        for i, b in enumerate(boxes):
+            bank.register(f"sku{i:06d}", b)
+        reg_s = time.perf_counter() - t0
+        groups = bank._groups_locked()
+        # warm pass: compiles both arms' programs; the first index
+        # election also pays the k-means build (recorded via
+        # index_stats, kept out of the timed query)
+        t0 = time.perf_counter()
+        _linear_scan(bank, feats)
+        bank._prefilter_select(feats, groups, topk, jnp)
+        warm_s = time.perf_counter() - t0
+        c0 = {k: bank.counters[k]
+              for k in ("index_queries", "index_probes",
+                        "index_candidates", "index_fallbacks")}
+        t0 = time.perf_counter()
+        names, flat = _linear_scan(bank, feats)
+        lin_idx = _topk_flat(flat, topk)
+        linear_ms = (time.perf_counter() - t0) * 1e3
+        linear_sel = {names[i] for i in lin_idx}
+        # the argpartition/tie contract, recomputed from raw scores:
+        # identical selection SET to the stable descending sort's
+        # first top-k (ties in flat group order)
+        ranked = sorted(range(len(names)), key=lambda i: -flat[i])
+        off_exact = {names[i] for i in ranked[:topk]} == linear_sel
+        t0 = time.perf_counter()
+        index_sel, _ = bank._prefilter_select(feats, groups, topk, jnp)
+        index_ms = (time.perf_counter() - t0) * 1e3
+        delta = {k: int(bank.counters[k] - c0[k]) for k in c0}
+        istats = bank.index_stats()
+        recall = len(index_sel & linear_sel) / float(topk)
+        points.append({
+            "n": int(n), "topk": int(topk),
+            "register_s": round(reg_s, 3),
+            "warm_s": round(warm_s, 3),
+            "linear_ms": round(linear_ms, 3),
+            "index_ms": round(index_ms, 3),
+            "recall": round(recall, 4),
+            "off_exact": bool(off_exact),
+            "indexed": bool(delta["index_queries"] >= 1
+                            and delta["index_fallbacks"] == 0),
+            "centroids": int(istats.get("centroids") or 0),
+            "probes": delta["index_probes"],
+            "candidates": delta["index_candidates"],
+            "groups": len(groups),
+            "rebuild_wall_s": istats.get("rebuild_wall_s"),
+        })
+        _progress(
+            f"N={n}: linear {linear_ms:.1f}ms index {index_ms:.1f}ms "
+            f"recall {recall:.3f} (probes {delta['index_probes']}, "
+            f"candidates {delta['index_candidates']})"
+        )
+    exp_linear = _loglog_exponent([p["n"] for p in points],
+                                  [p["linear_ms"] for p in points])
+    exp_index = _loglog_exponent([p["n"] for p in points],
+                                 [p["index_ms"] for p in points])
+    if exp_index is not None:
+        # sublinear in measured exponent, or decisively below the
+        # linear arm's own measured scaling (fixed per-call dispatch
+        # overhead can flatten BOTH curves at small N)
+        sublinear = bool(exp_index <= 0.8
+                         or (exp_linear is not None
+                             and exp_index <= 0.8 * exp_linear))
+    else:  # single-point sweep: no fit — gate on the direct wall win
+        sublinear = bool(points
+                         and points[-1]["index_ms"]
+                         <= points[-1]["linear_ms"])
+    checks = {
+        "index_sublinear": sublinear and all(p["indexed"]
+                                             for p in points),
+        "index_recall_ok": bool(points) and all(
+            p["recall"] >= floor for p in points
+        ),
+        "index_off_exact": bool(points) and all(
+            p["off_exact"] for p in points
+        ),
+    }
+    sweep = {
+        "points": points,
+        "recall_floor": floor,
+        "fit": {"linear_exponent": exp_linear,
+                "index_exponent": exp_index},
+        "checks": checks,
+    }
+    if args.fleet_patterns > 0:
+        _progress(f"fleet probe re-run: {args.fleet_patterns} "
+                  "patterns/shard through the bulk sink")
+        probe = _run_fleet_probe(args.fleet_patterns)
+        sweep["fleet_probe"] = probe
+        checks["fleet_probe_ok"] = bool(probe["rc"] == 0)
+        _progress(f"fleet probe rc={probe['rc']}")
+    return sweep
+
+
 def _det_count(result: dict) -> int:
     return int(np.asarray(result["valid"]).sum())
 
@@ -281,6 +494,19 @@ def _run(cancel_watchdog, argv=None) -> int:
     ap.add_argument("--topk", type=int, default=None,
                     help="pin one prefilter top-k instead of sweeping")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", default="",
+                    help="comma-separated catalog sizes for the index "
+                         "N-sweep (e.g. 1000,10000,100000; empty = "
+                         "skipped)")
+    ap.add_argument("--index-recall-floor", type=float, default=0.9,
+                    help="minimum index-vs-linear selection recall "
+                         "per sweep point")
+    ap.add_argument("--nprobe", type=int, default=0,
+                    help="buckets probed per indexed sweep query "
+                         "(0 = auto = ceil(sqrt(C)))")
+    ap.add_argument("--fleet-patterns", type=int, default=0,
+                    help="re-run the serve chaos gauntlet with this "
+                         "many bulk patterns per shard (0 = skipped)")
     args = ap.parse_args(argv)
 
     tiny = args.tiny or os.environ.get("TMR_BENCH_TINY", "") not in (
@@ -483,6 +709,9 @@ def _run(cancel_watchdog, argv=None) -> int:
         nmax_winner = best
     record_gallery_winners(size, nmax=nmax_winner, topk=elected)
 
+    # ---- index N-sweep: sketch index vs linear scan at catalog scale
+    n_sweep = _run_sweep(pred, size, args) if args.sweep else None
+
     # a recall pass must be NON-HOLLOW: detections exist and do not
     # saturate the slot capacity (a fire-everywhere detector makes any
     # union recall read 1.0)
@@ -540,6 +769,7 @@ def _run(cancel_watchdog, argv=None) -> int:
             ),
         },
         "ladder": {"rungs": ladder, "elected_nmax": nmax_winner},
+        **({"n_sweep": n_sweep} if n_sweep is not None else {}),
         "counters": counters_full,
         "checks": {
             "bitwise_exact": bool(exact),

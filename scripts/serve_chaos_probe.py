@@ -35,11 +35,19 @@ tmr_tpu/diagnostics.py):
   the shard promotes onto the clean replica (``stale_heartbeat``), and
   the worker's own ``gstate`` shows the schedule active and fired —
   chaos schedules reach lease-held serve processes.
+- **bulk_ingest** (``--patterns-per-shard N``, default 0 = skipped) —
+  ``N * shards`` patterns stream through the coordinator's bulk-ingest
+  sink (``fleet.bulk_sink()`` + ``bulk_register``: journal-first
+  feature ops, one ``gflush`` distribution) and must come back from a
+  fan-out search byte-identical to the single-bank oracle, fully
+  replicated, and survive the final journal-recovery check like any
+  register() pattern — the PR 17 gauntlet re-run at catalog scale.
 - **final_sweep** — every acknowledged registration (both fleets) must
   search clean + byte-identical, and a cold coordinator restart over
   the same journal directory recovers the exact catalog.
 
 Usage:  python scripts/serve_chaos_probe.py [--tiny] [--out FILE]
+        [--patterns-per-shard N]
 
 Fast (seconds, numpy stub banks, CPU): rides tier-1 via
 tests/test_serve_chaos_probe.py. One-JSON-line contract via
@@ -190,6 +198,9 @@ def _run(cancel_watchdog, argv=None) -> int:
                     help="fewer kill rounds / frames (tier-1 budget)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
+    ap.add_argument("--patterns-per-shard", type=int, default=0,
+                    help="bulk-ingest this many patterns per shard "
+                         "through the streamed sink (0 = skip phase)")
     args = ap.parse_args(argv)
 
     from tmr_tpu.diagnostics import (
@@ -197,7 +208,11 @@ def _run(cancel_watchdog, argv=None) -> int:
         validate_serve_chaos_report,
     )
     from tmr_tpu.parallel.leases import oneshot
-    from tmr_tpu.serve.gallery_fleet import GalleryFleet, StubGalleryBank
+    from tmr_tpu.serve.gallery_fleet import (
+        GalleryFleet,
+        StubGalleryBank,
+        bulk_register,
+    )
     from tmr_tpu.utils import faults
 
     kill_rounds = 1 if args.tiny else 2
@@ -492,6 +507,50 @@ def _run(cancel_watchdog, argv=None) -> int:
         _progress(f"env beat fault: delivered={env_delivered} "
                   f"stale={stale_count} healed={mini_healed}")
 
+        # ------ phase 6.5: streamed bulk ingest at catalog scale
+        # (opt-in: the coordinator's feature-sink bulk path — journal
+        # -first streaming, one gflush distribution — must land every
+        # pattern byte-identical and fully replicated, and those
+        # patterns then ride the final sweep + journal recovery like
+        # any register() pattern)
+        if args.patterns_per_shard > 0:
+            total = SHARDS * args.patterns_per_shard
+            _progress(f"bulk ingest: streaming {total} patterns")
+            t0 = time.perf_counter()
+            bulk_pats = [(f"blk{i:06d}", _exemplars(f"blk{i:06d}"))
+                         for i in range(total)]
+            res = bulk_register(fleet.bulk_sink(), bulk_pats,
+                                batch="chaos")
+            wall = time.perf_counter() - t0
+            bulk_names = []
+            for name, ex in bulk_pats:
+                reference.register(name, ex)
+                ledger.append(name)
+                bulk_names.append(name)
+            img = _frame(21)
+            got = client.search(img)
+            want = reference.search(img)
+            bulk_parity = all(
+                name in got and "degrade_steps" not in got[name]
+                and _dets_equal(got[name], want[name])
+                for name in bulk_names
+            )
+            flush = res.get("flush") or {}
+            bulk_ok = bool(
+                res.get("ok") and res.get("streamed") == total
+                and flush.get("under_replicated") == 0 and bulk_parity
+            )
+            phases.append({
+                "name": "bulk_ingest", "ok": bulk_ok,
+                "patterns": total,
+                "streamed": int(res.get("streamed") or 0),
+                "copies": int(flush.get("copies") or 0),
+                "parity": bool(bulk_parity),
+                "wall_s": round(wall, 3),
+            })
+            _progress(f"bulk ingest: ok={bulk_ok} "
+                      f"wall={wall:.2f}s copies={flush.get('copies')}")
+
         # -------------------- phase 7: final sweep + journal recovery
         img = _frame(11)
         final = client.search(img)
@@ -551,12 +610,15 @@ def _run(cancel_watchdog, argv=None) -> int:
         ),
         "env_schedule_delivered": bool(by_name["beat_env"]["ok"]),
     }
+    if "bulk_ingest" in by_name:  # opt-in bulk-scale phase ran
+        checks["bulk_ingest_ok"] = bool(by_name["bulk_ingest"]["ok"])
     doc = {
         "schema": SERVE_CHAOS_REPORT_SCHEMA,
         "config": {
             "shards": SHARDS, "workers": WORKERS,
             "replicas": REPLICAS, "patterns": registered,
             "tiny": bool(args.tiny),
+            "patterns_per_shard": int(args.patterns_per_shard),
         },
         "phases": phases,
         "patterns": {

@@ -23,6 +23,20 @@ wire-exact numpy drill)::
     python scripts/serve_fleet.py gallery-worker \
         --coordinator HOST:7079 [--bank stub]
 
+Gallery front door (the gallery-fleet coordinator + its streamed
+bulk-ingest sink; workers join with ``gallery-worker``)::
+
+    python scripts/serve_fleet.py gallery-frontdoor --shards 4 \
+        --journal_dir /tmp/gj [--port 7079]
+
+Bulk registration (stream a pattern catalog into a running gallery
+front door's bulk sink — one pipelined connection + one distributing
+flush, NOT N register round-trips; ``--npz`` loads named arrays, else
+``--count`` synthesizes a seeded catalog)::
+
+    python scripts/serve_fleet.py bulk-register --sink HOST:PORT \
+        [--npz patterns.npz | --count 100000] [--prefix sku]
+
 Lease liveness rides the shared TMR_ELASTIC_* knobs; fleet behavior
 (saturation threshold, recruitment bounds, resubmission bound) rides
 TMR_FLEET_* (config.ENV_KNOBS). Every entrypoint here installs
@@ -244,6 +258,88 @@ def _cli_gallery_worker(args) -> int:
     return 1 if worker.drained or worker.coordinator_lost else 0
 
 
+def _cli_gallery_frontdoor(args) -> int:
+    from tmr_tpu.serve.gallery_fleet import GalleryFleet
+    from tmr_tpu.utils import faults
+    from tmr_tpu.utils.profiling import log_info, log_warning
+
+    if faults.install_from_env():
+        log_warning(
+            "fault injection ACTIVE (TMR_FAULTS="
+            f"{os.environ.get('TMR_FAULTS', '')!r})"
+        )
+    fleet = GalleryFleet(
+        args.shards, replicas=args.replicas or None,
+        journal_dir=args.journal_dir, host=args.host, port=args.port,
+    )
+    host, port = fleet.start()
+    bhost, bport = fleet.bulk_sink()
+    log_info(
+        f"gallery front door: {fleet.n_shards} shard(s) x "
+        f"{fleet.replicas} replica(s) at {host}:{port}, bulk-ingest "
+        f"sink at {bhost}:{bport}"
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        counters = fleet.counters()
+        log_info(
+            "gallery front door: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())
+                        if v)
+        )
+        fleet.close()
+    return 0
+
+
+def _cli_bulk_register(args) -> int:
+    import numpy as np
+
+    from tmr_tpu.serve.gallery_fleet import bulk_register
+    from tmr_tpu.utils.profiling import log_info
+
+    if args.npz:
+        data = np.load(args.npz)
+        patterns = ((name, data[name]) for name in data.files)
+        total = len(data.files)
+    else:
+        rng = np.random.default_rng(args.seed)
+
+        def synthetic():
+            for i in range(args.count):
+                # k in 1..3 rows of normalized xyxy boxes — the synth
+                # catalog shape gallery_bench's N-sweep uses
+                k = int(rng.integers(1, 4))
+                x0 = rng.uniform(0.0, 0.8, size=(k, 1))
+                y0 = rng.uniform(0.0, 0.8, size=(k, 1))
+                w = rng.uniform(0.05, 0.2, size=(k, 1))
+                h = rng.uniform(0.05, 0.2, size=(k, 1))
+                box = np.concatenate(
+                    [x0, y0, np.minimum(x0 + w, 1.0),
+                     np.minimum(y0 + h, 1.0)], axis=1
+                ).astype(np.float32)
+                yield f"{args.prefix}{i:06d}", box
+
+        patterns = synthetic()
+        total = args.count
+    t0 = time.monotonic()
+    res = bulk_register(
+        _parse_address(args.sink), patterns, batch=args.batch,
+        flush=not args.no_flush,
+    )
+    dt = time.monotonic() - t0
+    rate = res["streamed"] / dt if dt > 0 else 0.0
+    log_info(
+        f"bulk-register: {res['streamed']}/{total} streamed "
+        f"({rate:.0f}/s), sync ok={res['ok']}, "
+        f"flush={res.get('flush')}"
+    )
+    return 0 if res["ok"] else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python scripts/serve_fleet.py", description=__doc__,
@@ -306,11 +402,49 @@ def main(argv=None) -> int:
     g.add_argument("--data_host", default="127.0.0.1")
     g.add_argument("--data_port", default=0, type=int)
 
+    gf = sub.add_parser(
+        "gallery-frontdoor",
+        help="gallery-fleet coordinator + streamed bulk-ingest sink",
+    )
+    gf.add_argument("--shards", default=4, type=int)
+    gf.add_argument("--replicas", default=0, type=int,
+                    help="copies per pattern (0 = the "
+                         "TMR_GALLERY_REPLICAS knob)")
+    gf.add_argument("--journal_dir", default=None,
+                    help="write-ahead pattern journal directory "
+                         "(unset = registrations are not durable)")
+    gf.add_argument("--host", default="127.0.0.1")
+    gf.add_argument("--port", default=0, type=int,
+                    help="control port (0 = ephemeral, printed at start)")
+
+    b = sub.add_parser(
+        "bulk-register",
+        help="stream a pattern catalog into a gallery bulk-ingest sink",
+    )
+    b.add_argument("--sink", required=True,
+                   help="HOST:PORT of the front door's bulk-ingest sink")
+    b.add_argument("--npz", default=None,
+                   help="load named exemplar arrays from this .npz")
+    b.add_argument("--count", default=1000, type=int,
+                   help="synthetic catalog size when --npz is unset")
+    b.add_argument("--prefix", default="sku",
+                   help="synthetic pattern name prefix")
+    b.add_argument("--seed", default=0, type=int)
+    b.add_argument("--batch", default="bulk",
+                   help="batch label the sink accounts this stream under")
+    b.add_argument("--no_flush", action="store_true",
+                   help="stream + sync only; distribute later with one "
+                        "flush over all batches")
+
     args = p.parse_args(argv)
     if args.cmd == "frontdoor":
         return _cli_frontdoor(args)
     if args.cmd == "gallery-worker":
         return _cli_gallery_worker(args)
+    if args.cmd == "gallery-frontdoor":
+        return _cli_gallery_frontdoor(args)
+    if args.cmd == "bulk-register":
+        return _cli_bulk_register(args)
     return _cli_worker(args)
 
 

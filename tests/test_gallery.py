@@ -523,3 +523,92 @@ def test_evict_racing_search_serves_snapshot(pred, monkeypatch):
     _assert_bitwise(raced["keep"], before["keep"], "raced search")
     after = bank.search(img)  # post-evict: cleanly excluded
     assert set(after) == {"keep"}
+
+
+# ----------------------------------------------------------- sketch index
+def _idx_box(i):
+    """Distinct well-separated crops on the 128px grid — no exact score
+    ties between the index and linear candidate orderings."""
+    x = 0.05 + 0.11 * (i % 8)
+    y = 0.08 + 0.28 * (i // 8)
+    w = 0.10 + 0.02 * (i % 5)
+    return np.asarray([[x, y, x + w, y + w]], np.float32)
+
+
+def _assert_search_parity(got, want, ctx):
+    assert set(got) == set(want), ctx
+    for nm in want:
+        _assert_bitwise(got[nm], want[nm], f"{ctx}: {nm}")
+        assert got[nm].get("degrade_steps") == \
+            want[nm].get("degrade_steps"), f"{ctx}: {nm} degrade label"
+
+
+def test_sketch_index_selection_matches_linear_through_churn(pred):
+    """The indexed election vs the exact linear scan, end to end
+    through search(): at small C the auto nprobe policy degrades to the
+    full probe, so the candidate set is the whole bank and the indexed
+    results must be byte-identical to the linear arm — selection,
+    detections, AND degrade labels. Evicted entries vanish from the
+    very next search (no rebuild needed); churn past the threshold
+    re-clusters in-line (counted, stamped) and parity still holds; a
+    bank fed the same registry in reverse order elects the same
+    clustering (digest) and the same results."""
+    from tmr_tpu.serve import GalleryBank
+
+    names = [f"n{i:02d}" for i in range(12)]
+    linear = GalleryBank(pred, feature_cache=0, max_n_bucket=32,
+                         index=False)
+    indexed = GalleryBank(pred, feature_cache=0, max_n_bucket=32,
+                          index=True, index_min_n=1)
+    for i, nm in enumerate(names):
+        linear.register(nm, _idx_box(i))
+        indexed.register(nm, _idx_box(i))
+    img = _img(23)
+
+    want = linear.search(img, prefilter_topk=3)
+    got = indexed.search(img, prefilter_topk=3)
+    _assert_search_parity(got, want, "initial")
+    assert indexed.counters["index_queries"] == 1
+    assert indexed.counters["index_fallbacks"] == 0
+    assert indexed.counters["index_rebuilds"] == 1  # the first build
+    assert indexed.counters["index_candidates"] == 12  # full probe
+    st = indexed.stats()["index"]
+    assert st["enabled"] and st["built"] and st["entries"] == 12
+    assert st["centroids"] == 3 and st["queries"] == 1
+    stamps = indexed.index_stamps()
+    assert len(stamps) == 1 and stamps[0]["entries"] == 12
+    assert linear.stats()["index"]["enabled"] is False
+
+    # eviction: gone from the NEXT search, no rebuild required
+    for bank in (linear, indexed):
+        assert bank.evict("n03") is True
+    got = indexed.search(img, prefilter_topk=3)
+    assert "n03" not in got
+    _assert_search_parity(got, indexed.search(img, prefilter_topk=3),
+                          "post-evict rerun")
+    _assert_search_parity(got, linear.search(img, prefilter_topk=3),
+                          "post-evict")
+    assert indexed.counters["index_rebuilds"] == 1  # churn 1 <= 3
+
+    # churn past rebuild_frac * built_n: the next query re-clusters
+    for i in range(12, 16):
+        linear.register(f"n{i:02d}", _idx_box(i))
+        indexed.register(f"n{i:02d}", _idx_box(i))
+    want = linear.search(img, prefilter_topk=3)
+    got = indexed.search(img, prefilter_topk=3)
+    _assert_search_parity(got, want, "post-churn")
+    assert indexed.counters["index_rebuilds"] == 2
+    assert len(indexed.index_stamps()) == 2
+    assert indexed.counters["index_fallbacks"] == 0
+
+    # registration-order independence: reversed-in => same clustering
+    live = [nm for nm in (names + ["n12", "n13", "n14", "n15"])
+            if nm != "n03"]
+    mirror = GalleryBank(pred, feature_cache=0, max_n_bucket=32,
+                         index=True, index_min_n=1)
+    for nm in reversed(live):
+        mirror.register(nm, _idx_box(int(nm[1:])))
+    _assert_search_parity(mirror.search(img, prefilter_topk=3), want,
+                          "reversed registration")
+    assert mirror.index_stamps()[-1]["digest"] == \
+        indexed.index_stamps()[-1]["digest"]

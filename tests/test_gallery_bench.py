@@ -65,6 +65,23 @@ def _valid_doc():
     }
 
 
+def _sweep_section():
+    return {
+        "points": [
+            {"n": 1000, "topk": 32, "linear_ms": 12.0, "index_ms": 9.0,
+             "recall": 1.0, "off_exact": True, "indexed": True,
+             "centroids": 32, "probes": 32, "candidates": 1000},
+            {"n": 10000, "topk": 32, "linear_ms": 110.0,
+             "index_ms": 31.0, "recall": 0.97, "off_exact": True,
+             "indexed": True, "centroids": 100, "probes": 32,
+             "candidates": 3300},
+        ],
+        "fit": {"linear_exponent": 0.96, "index_exponent": 0.54},
+        "checks": {"index_sublinear": True, "index_recall_ok": True,
+                   "index_off_exact": True},
+    }
+
+
 def test_validate_gallery_report_accepts_valid_and_error_docs():
     from tmr_tpu.diagnostics import (
         GALLERY_REPORT_SCHEMA,
@@ -75,6 +92,11 @@ def test_validate_gallery_report_accepts_valid_and_error_docs():
     assert validate_gallery_report(
         {"schema": GALLERY_REPORT_SCHEMA, "error": "watchdog: ..."}
     ) == []
+    # the n_sweep section is OPTIONAL (legacy docs above stay valid)
+    # but validated when present
+    with_sweep = _valid_doc()
+    with_sweep["n_sweep"] = _sweep_section()
+    assert validate_gallery_report(with_sweep) == []
 
 
 @pytest.mark.parametrize("mutate, fragment", [
@@ -89,6 +111,20 @@ def test_validate_gallery_report_accepts_valid_and_error_docs():
     (lambda d: d["prefilter"].update(elected_topk=0), "elected_topk"),
     (lambda d: d["checks"].pop("bitwise_exact"), "bitwise_exact"),
     (lambda d: d.update(error=""), "error"),
+    (lambda d: d.update(n_sweep="nope"), "n_sweep"),
+    (lambda d: d.update(n_sweep=dict(_sweep_section(), points=[])),
+     "points"),
+    (lambda d: d.update(n_sweep=_sweep_section())
+     or d["n_sweep"]["points"][0].update(n=0), "n"),
+    (lambda d: d.update(n_sweep=_sweep_section())
+     or d["n_sweep"]["points"][1].update(recall=1.5), "recall"),
+    (lambda d: d.update(n_sweep=_sweep_section())
+     or d["n_sweep"]["points"][0].update(index_ms=-1), "index_ms"),
+    (lambda d: d.update(n_sweep=dict(_sweep_section(), fit=None)),
+     "fit"),
+    (lambda d: d.update(n_sweep=_sweep_section())
+     or d["n_sweep"]["checks"].pop("index_sublinear"),
+     "index_sublinear"),
 ])
 def test_validate_gallery_report_rejects_broken_docs(mutate, fragment):
     from tmr_tpu.diagnostics import validate_gallery_report
@@ -124,6 +160,18 @@ def test_read_gallery_report_reduces_and_fails_closed(tmp_path):
                                 "error": "boom"}))
     assert "error" in read_gallery_report(str(path))
     assert "error" in read_gallery_report(str(tmp_path / "absent.json"))
+    # the optional n_sweep section reduces to sweep_points + the three
+    # sweep checks (fail closed: a missing check is not a pass)
+    doc = _valid_doc()
+    doc["n_sweep"] = _sweep_section()
+    del doc["n_sweep"]["checks"]["index_recall_ok"]
+    path.write_text(json.dumps(doc) + "\n")
+    out = read_gallery_report(str(path))
+    assert out["checks"]["index_sublinear"] is True
+    assert out["checks"]["index_recall_ok"] is False
+    assert "fleet_probe_ok" not in out["checks"]  # only when recorded
+    assert out["summary"]["index_exponent"] == 0.54
+    assert [p["n"] for p in out["sweep_points"]] == [1000, 10000]
 
 
 def test_bench_trend_gallery_rc_gates(tmp_path):
@@ -145,6 +193,24 @@ def test_bench_trend_gallery_rc_gates(tmp_path):
         capture_output=True, text=True, timeout=120,
     )
     assert fail.returncode == 1
+    # a failing n_sweep check gates rc even with the four legacy
+    # checks green — and a passing sweep keeps rc 0
+    sweep_doc = _valid_doc()
+    sweep_doc["n_sweep"] = _sweep_section()
+    swept = tmp_path / "swept.json"
+    swept.write_text(json.dumps(sweep_doc) + "\n")
+    ok2 = subprocess.run(
+        [sys.executable, script, "--gallery", str(swept)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert ok2.returncode == 0, ok2.stdout + ok2.stderr
+    sweep_doc["n_sweep"]["checks"]["index_sublinear"] = False
+    swept.write_text(json.dumps(sweep_doc) + "\n")
+    fail2 = subprocess.run(
+        [sys.executable, script, "--gallery", str(swept)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert fail2.returncode == 1
 
 
 def test_measured_gallery_winners_round_trip(tmp_path, monkeypatch):

@@ -1,0 +1,257 @@
+"""The host-side half of the sublinear gallery prefilter:
+tmr_tpu/serve/gallery_index.py's SketchIndex (deterministic seed-pinned
+clustering, exact-extrema probe election, churn-triggered rebuilds,
+bounded stamp journal, immediate eviction) and the coordinator's
+streamed bulk-ingest path (journal-first cataloging, deferred
+idempotent flush, cold-restart recovery) — all without a device or a
+worker process. The device-scoring half (GalleryBank's probe/candidate
+calls and the off-switch bitwise contract) lives in test_gallery.py;
+the end-to-end fleet story is scripts/serve_chaos_probe.py
+--patterns-per-shard."""
+
+import numpy as np
+
+from tmr_tpu.serve.gallery_fleet import GalleryFleet, bulk_register
+from tmr_tpu.serve.gallery_index import (
+    SKETCH_DIMS,
+    SketchIndex,
+    entry_sketch,
+)
+from tmr_tpu.parallel.leases import LeasePolicy
+
+
+def _vec(i, n=64):
+    """A deterministic sketch-like vector: three well-separated blobs
+    so the clustering has real structure to find."""
+    rng = np.random.default_rng(1000 + i)
+    center = np.asarray([0.2, 0.2, 0.5, 0.8][i % 4] * np.ones(4))
+    return np.concatenate(
+        [center + rng.normal(0, 0.03, 4), rng.normal(0, 0.01, 4)]
+    ).astype(np.float32)
+
+
+def _fill(idx, names):
+    for i, nm in enumerate(names):
+        idx.add(nm, _vec(i))
+
+
+def _probe_state(idx):
+    # member-list ORDER is insertion order and does not affect queries
+    # (candidates re-sort by registry position); the determinism
+    # contract is over the sets + the elected probes
+    snap = idx.snapshot()
+    return (snap["medoids"], snap["probes"],
+            [sorted(ms) for ms in snap["members"]])
+
+
+# ------------------------------------------------------------ entry_sketch
+def test_entry_sketch_uses_only_real_rows():
+    ex = np.asarray([[0.1, 0.1, 0.3, 0.4],
+                     [0.5, 0.5, 0.9, 0.9],
+                     [0.0, 0.0, 1.0, 1.0]], np.float32)
+    v2 = entry_sketch(ex, 2)
+    assert v2.shape == (SKETCH_DIMS,) and v2.dtype == np.float32
+    # pad rows past k_real must not move the vector — the bank hands
+    # the index its PADDED exemplar array
+    padded = np.concatenate([ex[:2], np.tile(ex[1:2], (5, 1))], axis=0)
+    assert entry_sketch(padded, 2).tobytes() == v2.tobytes()
+    assert entry_sketch(ex, 3).tobytes() != v2.tobytes()
+
+
+# ------------------------------------------------------------- determinism
+def test_rebuild_deterministic_across_insertion_order():
+    """Same entry set in => byte-identical clustering out, regardless
+    of registration order — the contract that lets a journal-rebuilt
+    replica elect the same candidates as the primary it replaced."""
+    names = [f"p{i:03d}" for i in range(48)]
+    a, b = SketchIndex(), SketchIndex()
+    _fill(a, names)
+    for i in reversed(range(len(names))):  # reverse order into b
+        b.add(names[i], _vec(i))
+    sa, sb = a.rebuild(), b.rebuild()
+    assert sa["digest"] == sb["digest"]
+    assert sa["entries"] == 48 and sa["centroids"] == sb["centroids"]
+    snap_a, snap_b = a.snapshot(), b.snapshot()
+    assert snap_a["medoids"] == snap_b["medoids"]
+    assert snap_a["probes"] == snap_b["probes"]
+    assert snap_a["members"] == snap_b["members"]
+
+
+def test_incremental_maintenance_is_order_independent():
+    """Probes are EXACT extrema over the member set, so incremental
+    add/remove after a build lands in the same state no matter the
+    order — and removing + re-adding an entry is a no-op."""
+    names = [f"p{i:03d}" for i in range(32)]
+    a, b = SketchIndex(), SketchIndex()
+    _fill(a, names)
+    _fill(b, names)
+    a.rebuild()
+    b.rebuild()
+    extra = [(f"x{i}", _vec(100 + i)) for i in range(6)]
+    for nm, v in extra:
+        a.add(nm, v)
+    for nm, v in reversed(extra):
+        b.add(nm, v)
+    assert _probe_state(a) == _probe_state(b)
+    # churn round trip: drop an elected probe and bring it back
+    victim = a.snapshot()["probes"][0][0]
+    vvec = _vec(names.index(victim))
+    assert a.remove(victim)
+    assert victim not in [p for pl in a.snapshot()["probes"] for p in pl]
+    a.add(victim, vvec)
+    assert _probe_state(a) == _probe_state(b)
+
+
+def test_removed_entries_leave_snapshot_immediately():
+    """No rebuild needed: eviction drops the name from the posting
+    lists (and re-elects its cluster's probes) under the same lock, so
+    a stale-but-built index can never hand an evicted name back."""
+    names = [f"p{i:03d}" for i in range(20)]
+    idx = SketchIndex()
+    _fill(idx, names)
+    idx.rebuild()
+    for nm in names[:10]:
+        assert idx.remove(nm)
+    snap = idx.snapshot()
+    gone = set(names[:10])
+    assert not gone & {m for ms in snap["members"] for m in ms}
+    assert not gone & {p for pl in snap["probes"] for p in pl}
+    assert not idx.remove("p000")  # second remove: no longer indexed
+    assert len(idx) == 10
+
+
+# ------------------------------------------------------------------ churn
+def test_needs_rebuild_tracks_churn_threshold():
+    idx = SketchIndex(rebuild_frac=0.25)
+    assert not idx.needs_rebuild()  # empty: nothing to build
+    idx.add("a", _vec(0))
+    assert idx.needs_rebuild()  # never built
+    names = [f"p{i:03d}" for i in range(40)]
+    _fill(idx, names)
+    idx.rebuild()
+    assert not idx.needs_rebuild()
+    # churn accrues on add AND remove; the threshold is a strict >
+    churn_allowance = int(0.25 * (len(names) + 1))
+    for i in range(churn_allowance):
+        idx.add(f"n{i}", _vec(200 + i))
+    assert not idx.needs_rebuild()
+    idx.remove("n0")
+    assert idx.needs_rebuild()
+    idx.rebuild(reason="test")
+    assert not idx.needs_rebuild()
+    assert idx.stamps()[-1]["reason"] == "test"
+
+
+def test_stamps_journal_bounded_and_digest_pins_entry_set():
+    idx = SketchIndex(max_stamps=4)
+    _fill(idx, [f"p{i}" for i in range(9)])
+    digests = set()
+    for r in range(7):
+        stamp = idx.rebuild(reason=f"r{r}")
+        assert stamp["entries"] == 9 and stamp["centroids"] == 3
+        assert stamp["wall_s"] >= 0.0
+        digests.add(stamp["digest"])
+    assert len(digests) == 1  # same entry set => same digest
+    log = idx.stamps()
+    assert len(log) == 4  # bounded, oldest dropped
+    assert [s["reason"] for s in log] == ["r3", "r4", "r5", "r6"]
+    assert log[-1]["rebuild"] == 7
+    stats = idx.stats()
+    assert stats["rebuilds"] == 7 and stats["built"] is True
+    assert stats["last_rebuild"]["digest"] == log[-1]["digest"]
+    # the digest moves when the entry set does
+    idx.remove("p0")
+    assert idx.rebuild()["digest"] not in digests
+
+
+def test_probes_are_medoid_plus_anti_medoid():
+    """One tight hand-built cluster: the medoid is the member nearest
+    the centroid, the anti-medoid the farthest, ties by name."""
+    idx = SketchIndex(min_centroids=1)
+    base = np.zeros(SKETCH_DIMS, np.float32)
+    idx.add("near", base + 0.01)
+    idx.add("mid", base + 0.05)
+    idx.add("far", base + 0.20)
+    idx.rebuild()
+    snap = idx.snapshot()
+    assert snap["centroids"] >= 1
+    flat = [p for pl in snap["probes"] for p in pl]
+    assert "near" in flat and "far" in flat
+    for medoid, probes in zip(snap["medoids"], snap["probes"]):
+        assert probes[0] == medoid
+        assert 1 <= len(probes) <= 2
+
+
+# ------------------------------------------------------------- bulk ingest
+def _patterns(n):
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(i)
+        out.append((f"blk{i:04d}",
+                    rng.random((1 + i % 3, 4)).astype(np.float32)))
+    return out
+
+
+def test_bulk_register_streams_journal_first_and_flush_is_deferred(
+        tmp_path):
+    """The streamed path lands every pattern in the journal + catalog
+    off ONE pipelined connection; with no live workers the deferred
+    flush counts every pattern under-replicated (never an error), and
+    a cold coordinator over the same journal recovers them all."""
+    fleet = GalleryFleet(
+        2, replicas=2, journal_dir=str(tmp_path / "journal"),
+        policy=LeasePolicy(lease_ttl_s=1.0, hb_interval_s=0.2,
+                           check_interval_s=0.05),
+    )
+    try:
+        pats = _patterns(10)
+        res = bulk_register(fleet.bulk_sink(), pats, batch="t",
+                            flush=False)
+        assert res["ok"] is True
+        assert res["streamed"] == res["synced"] == 10
+        assert res["errors"] == 0 and "flush" not in res
+        assert set(fleet.patterns()) == {nm for nm, _ in pats}
+        counters = fleet.counters()
+        assert counters["bulk_registered"] == 10
+        assert counters["journal_recovered"] == 0
+        # no workers: flush distributes nothing, counts everything
+        flush = fleet.flush_pending()
+        assert flush == {"patterns": 10, "copies": 0,
+                         "under_replicated": 10}
+        # idempotent: still copy-less, so the same set is retried
+        assert fleet.flush_pending()["patterns"] == 10
+        assert fleet.counters()["bulk_flushes"] == 2
+        # the flush op also rides the sink connection (one round trip)
+        res2 = bulk_register(
+            fleet.bulk_sink(),
+            [("solo", np.ones((2, 4), np.float32))],
+            batch="t2", flush=True,
+        )
+        assert res2["ok"] is True
+        assert res2["flush"]["ok"] is True
+        assert res2["flush"]["copies"] == 0
+    finally:
+        fleet.close()
+    # cold restart: the WAL is the catalog of record
+    reborn = GalleryFleet(2, replicas=2,
+                          journal_dir=str(tmp_path / "journal"))
+    try:
+        assert set(reborn.patterns()) >= {nm for nm, _ in pats}
+        assert reborn.counters()["journal_recovered"] == 11
+        # recovered payloads round-trip byte-exact
+        entry = reborn._patterns["blk0003"]
+        want = dict(pats)["blk0003"]
+        assert entry["k_real"] == want.shape[0]
+        assert entry["digest"] == fleet._patterns["blk0003"]["digest"]
+    finally:
+        reborn.close()
+
+
+def test_bulk_sink_reuses_one_server():
+    fleet = GalleryFleet(1, journal_dir=None)
+    try:
+        assert fleet.bulk_sink() == fleet.bulk_sink()
+        assert fleet._bulk is not None
+    finally:
+        fleet.close()
+    assert fleet._bulk is None  # close() tore the sink down

@@ -5,8 +5,10 @@ to the single bank when healthy, a severed serve link degrades exactly
 the dead partition's patterns (and heals), a corrupted replica push is
 digest-rejected and retried clean, the write-ahead journal refuses the
 ack before any partial state, and a TMR_FAULTS env schedule reaches a
-lease-held worker subprocess — one validated serve_chaos_report/v1,
-rc-gated again (fail-closed) through scripts/bench_trend.py --chaos."""
+lease-held worker subprocess, and the streamed bulk-ingest path lands
+its patterns in the same zero-loss ledger — one validated
+serve_chaos_report/v1, rc-gated again (fail-closed) through
+scripts/bench_trend.py --chaos."""
 
 import importlib.util
 import json
@@ -41,13 +43,21 @@ def _load(name):
 
 def test_serve_chaos_probe_passes(tmp_path, capsys):
     out = tmp_path / "serve_chaos_report.json"
-    rc = _load("serve_chaos_probe").main(["--tiny", "--out", str(out)])
+    rc = _load("serve_chaos_probe").main(
+        ["--tiny", "--out", str(out), "--patterns-per-shard", "2"]
+    )
     assert rc == 0
     doc = json.loads(out.read_text())
     assert validate_serve_chaos_report(doc) == []
     checks = doc["checks"]
     for key in SERVE_CHAOS_CHECK_KEYS:
         assert checks[key] is True, key
+    # the opt-in bulk-ingest phase streamed every pattern, replicated
+    # them, and they joined the zero-loss ledger for the final sweep
+    assert checks["bulk_ingest_ok"] is True
+    (bulk,) = [p for p in doc["phases"] if p["name"] == "bulk_ingest"]
+    assert bulk["streamed"] == bulk["patterns"] > 0
+    assert bulk["parity"] is True
     # the ledger closes: every acknowledged registration survived
     assert doc["patterns"]["lost"] == []
     assert doc["patterns"]["registered"] == doc["patterns"]["survived"]

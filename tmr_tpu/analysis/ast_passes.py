@@ -281,6 +281,7 @@ LOCK_FILES = (
     "tmr_tpu/serve/feature_tier.py",
     "tmr_tpu/serve/fleet.py",
     "tmr_tpu/serve/gallery.py",
+    "tmr_tpu/serve/gallery_index.py",
     "tmr_tpu/serve/streams.py",
     "tmr_tpu/parallel/elastic.py",
     "tmr_tpu/parallel/leases.py",

@@ -237,6 +237,21 @@ ENV_KNOBS = {
         "(entries)",
     "TMR_GALLERY_FEATURE_CACHE_MB": "byte bound on the gallery "
         "frame-feature cache (MB)",
+    # coarse-to-fine sketch index (serve/gallery_index.py; off =
+    # today's exact linear prefilter scan, bitwise)
+    "TMR_GALLERY_INDEX": "gallery sketch index: unset/0/off = linear "
+        "prefilter scan (exact), anything else = IVF coarse-to-fine "
+        "candidate election (sublinear in N; recall bench-pinned)",
+    "TMR_GALLERY_INDEX_NPROBE": "indexed prefilter: how many coarse "
+        "buckets' members earn the exact sketch rescore per frame "
+        "(0/unset = auto = max(2*ceil(sqrt(centroids)), "
+        "min(centroids, topk)))",
+    "TMR_GALLERY_INDEX_MIN_N": "banks below this entry count stay on "
+        "the linear scan even with the index on (default 256 — the "
+        "index only pays past catalog scale)",
+    "TMR_GALLERY_INDEX_REBUILD": "register/evict churn fraction of the "
+        "built entry count past which an indexed query reclusters "
+        "(default 0.25; every rebuild leaves a journaled stamp)",
     # replicated gallery fleet (serve/gallery_fleet.py; off unless a
     # fleet is constructed — the single-bank path never reads these)
     "TMR_GALLERY_REPLICAS": "gallery fleet: copies kept per pattern "

@@ -1205,6 +1205,14 @@ GALLERY_REPORT_CHECKS = (
     "prefilter_cut_ok",
 )
 
+#: the boolean checks the OPTIONAL ``n_sweep`` section (the
+#: catalog-scale sketch-index sweep, scripts/gallery_bench.py --sweep)
+#: must carry when present; legacy documents without the section stay
+#: valid
+GALLERY_SWEEP_CHECKS = (
+    "index_sublinear", "index_recall_ok", "index_off_exact",
+)
+
 
 def validate_gallery_report(doc: dict) -> List[str]:
     """Structural check of a gallery_report/v1 document; returns a list
@@ -1279,6 +1287,46 @@ def validate_gallery_report(doc: dict) -> List[str]:
             problems.append(
                 "prefilter.elected_topk: not a positive int or null"
             )
+    sweep = doc.get("n_sweep")
+    if sweep is not None:  # OPTIONAL: only --sweep runs carry it
+        if not isinstance(sweep, dict):
+            problems.append("n_sweep: not a dict")
+        else:
+            pts = sweep.get("points")
+            if not isinstance(pts, list) or not pts:
+                problems.append("n_sweep.points: not a non-empty list")
+                pts = []
+            for i, p in enumerate(pts):
+                where = f"n_sweep.points[{i}]"
+                if not isinstance(p, dict):
+                    problems.append(f"{where}: not a dict")
+                    continue
+                v = p.get("n")
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v <= 0:
+                    problems.append(f"{where}.n: not a positive int")
+                for key in ("linear_ms", "index_ms"):
+                    v = p.get(key)
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool) or v < 0:
+                        problems.append(
+                            f"{where}.{key}: not a non-negative number"
+                        )
+                v = p.get("recall")
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or not 0.0 <= v <= 1.0:
+                    problems.append(f"{where}.recall: not in [0, 1]")
+            if not isinstance(sweep.get("fit"), dict):
+                problems.append("n_sweep.fit: not a dict")
+            scheck = sweep.get("checks")
+            if not isinstance(scheck, dict):
+                problems.append("n_sweep.checks: not a dict")
+            else:
+                for key in GALLERY_SWEEP_CHECKS:
+                    if key not in scheck:
+                        problems.append(
+                            f"n_sweep.checks: missing {key!r}"
+                        )
     checks = doc.get("checks")
     if not isinstance(checks, dict):
         problems.append("checks: not a dict")

@@ -48,12 +48,29 @@ multiplier over the existing backbone/heads split programs:
   instead of bouncing through ``.npy`` trees — the deferred half of
   PR 10's elastic item.
 
+- **Sketch index** (``TMR_GALLERY_INDEX``; off = today's linear scan,
+  bitwise): at catalog scale the prefilter itself goes sublinear — a
+  two-level IVF-style index (serve/gallery_index.py) clusters entries
+  by box geometry into ~sqrt(N) buckets, one batched device call
+  scores each bucket's probes (medoid + anti-medoid), and the exact
+  sketch correlation runs
+  only over the ``TMR_GALLERY_INDEX_NPROBE`` best buckets' members
+  before feeding the SAME top-k selection (degrade labels, counters,
+  and the exactness contract carry over unchanged). Any index-path
+  failure falls back to the exact linear scan, counted.
+
 Env knobs (lazily read; registered in config.ENV_KNOBS):
 ``TMR_GALLERY_PREFILTER_TOPK`` (0/unset = off = exact; ``auto`` = the
 bench-elected winner; int = that top-k), ``TMR_GALLERY_NMAX`` (N-bucket
 ladder cap; default the measured winner, else 32),
 ``TMR_GALLERY_FEATURE_CACHE`` (frame-feature cache entries),
-``TMR_GALLERY_FEATURE_CACHE_MB`` (byte bound on the same cache).
+``TMR_GALLERY_FEATURE_CACHE_MB`` (byte bound on the same cache),
+``TMR_GALLERY_INDEX`` (sketch index on/off; off default = exact
+linear prefilter), ``TMR_GALLERY_INDEX_NPROBE`` (buckets probed per
+query; 0 = auto = max(2*ceil(sqrt(C)), min(C, topk))),
+``TMR_GALLERY_INDEX_MIN_N`` (banks
+below this stay linear even with the index on),
+``TMR_GALLERY_INDEX_REBUILD`` (churn fraction triggering a rebuild).
 """
 
 from __future__ import annotations
@@ -68,6 +85,7 @@ import numpy as np
 
 from tmr_tpu.obs.metrics import MetricsRegistry
 from tmr_tpu.serve.caches import LRUCache, array_digest
+from tmr_tpu.serve.gallery_index import SketchIndex, entry_sketch
 
 #: detection fields a gallery result carries (mirrors engine._det_fields:
 #: the fixed four plus the device decode tail's optional count vector)
@@ -78,8 +96,34 @@ _DET_FIELDS = ("boxes", "scores", "refs", "valid", "count")
 _COUNTER_NAMES = (
     "searches", "fused_frames", "heads_frames", "backbone_fills",
     "registered", "evicted", "full_match_entries", "prefilter_runs",
-    "prefilter_skipped", "nloop_fallback_frames",
+    "prefilter_skipped", "nloop_fallback_frames", "index_queries",
+    "index_probes", "index_hits", "index_candidates", "index_rebuilds",
+    "index_fallbacks",
 )
+
+#: above this many scored entries the per-name score dict keeps only
+#: the SELECTED entries (skipped large-N tails would otherwise pay an
+#: O(N) host dict per frame just to decorate empty results)
+_SCORE_TAIL_MAX = 4096
+
+#: flat batched prefilter calls chunk at this many entries per device
+#: call — coarse_prefilter_scores broadcasts the frame's feature map
+#: per (entry, row), so unbounded batches explode memory at 10^5 N
+_INDEX_CHUNK = 1024
+
+
+def _topk_flat(scores: np.ndarray, k: int) -> np.ndarray:
+    """Top-k indices of ``scores`` with ties resolved EXACTLY like the
+    historic stable ``ranked.sort(key=-score)`` selection: every entry
+    strictly above the k-th value, then kth-valued ties in ascending
+    flat order. O(N) argpartition instead of the old O(N log N) sort."""
+    n = int(scores.shape[0])
+    if k >= n:
+        return np.arange(n)
+    kth = np.partition(scores, n - k)[n - k]
+    above = np.flatnonzero(scores > kth)
+    ties = np.flatnonzero(scores == kth)
+    return np.concatenate([above, ties[: k - above.size]])
 
 
 def _env_int(name: str, default: int) -> int:
@@ -264,6 +308,14 @@ class GalleryBank:
     max_n_bucket: N-rung ladder cap (None -> ``TMR_GALLERY_NMAX`` ->
         the autotune-measured winner -> 32); banks larger than the cap
         chunk into multiple program calls.
+    index: force the coarse-to-fine sketch index on/off (None -> the
+        ``TMR_GALLERY_INDEX`` knob; off = exact linear prefilter).
+    index_nprobe: buckets probed per indexed query (None ->
+        ``TMR_GALLERY_INDEX_NPROBE``; 0 = auto = ceil(sqrt(C))).
+    index_min_n: banks below this entry count stay on the linear scan
+        even with the index on (None -> ``TMR_GALLERY_INDEX_MIN_N``).
+    index_rebuild_frac: churn fraction past which queries trigger a
+        recluster (None -> ``TMR_GALLERY_INDEX_REBUILD``).
     """
 
     def __init__(self, predictor, *, image_size: Optional[int] = None,
@@ -271,7 +323,11 @@ class GalleryBank:
                  feature_cache: Any = None,
                  feature_cache_mb: Optional[float] = None,
                  max_n_bucket: Optional[int] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 index: Optional[bool] = None,
+                 index_nprobe: Optional[int] = None,
+                 index_min_n: Optional[int] = None,
+                 index_rebuild_frac: Optional[float] = None):
         if predictor.params is None:
             raise RuntimeError("predictor has no params loaded")
         self._pred = predictor
@@ -280,11 +336,19 @@ class GalleryBank:
         self._entries: "OrderedDict[str, GalleryEntry]" = OrderedDict()
         self._groups: Optional[List[_Group]] = None
         self._topk_arg = prefilter_topk
+        self._index: Optional[SketchIndex] = None
+        self._index_arg = index
+        self._index_nprobe_arg = index_nprobe
+        self._index_min_n_arg = index_min_n
+        self._index_rebuild_arg = index_rebuild_frac
         self.metrics = MetricsRegistry() if registry is None else registry
         self._m = {
             name: self.metrics.counter(f"gallery.{name}")
             for name in _COUNTER_NAMES
         }
+        self._g_rebuild_wall = self.metrics.gauge(
+            "gallery.index_rebuild_wall_s"
+        )
         if isinstance(feature_cache, LRUCache):
             self.feature_cache = feature_cache
         else:
@@ -343,6 +407,10 @@ class GalleryBank:
             self._entries[str(name)] = GalleryEntry(
                 str(name), padded, k, k_bucket, cap
             )
+            if self._index is not None:
+                # incremental maintenance: the entry is probe-reachable
+                # immediately; churn accounting decides the recluster
+                self._index.add(str(name), entry_sketch(padded, k))
             self._groups = None  # rebuilt (and re-placed) lazily
         self._m["registered"].inc()
         return {"name": str(name), "capacity": cap, "k_bucket": k_bucket,
@@ -355,6 +423,8 @@ class GalleryBank:
         with self._lock:
             existed = self._entries.pop(str(name), None) is not None
             if existed:
+                if self._index is not None:
+                    self._index.remove(str(name))
                 self._groups = None
         if existed:
             self._m["evicted"].inc()
@@ -422,6 +492,198 @@ class GalleryBank:
                 f"TMR_GALLERY_PREFILTER_TOPK={raw!r}: expected "
                 "off|auto|<int>"
             )
+
+    # ------------------------------------------------------- sketch index
+    def _index_enabled(self) -> bool:
+        if self._index_arg is not None:
+            return bool(self._index_arg)
+        raw = os.environ.get("TMR_GALLERY_INDEX", "")
+        return bool(raw) and raw not in ("0", "off", "false")
+
+    def _index_min_n_resolved(self) -> int:
+        if self._index_min_n_arg is not None:
+            return max(int(self._index_min_n_arg), 0)
+        return max(_env_int("TMR_GALLERY_INDEX_MIN_N", 256), 0)
+
+    def _resolve_nprobe(self, n_centroids: int, topk: int) -> int:
+        if self._index_nprobe_arg is not None:
+            nprobe = int(self._index_nprobe_arg)
+        else:
+            nprobe = _env_int("TMR_GALLERY_INDEX_NPROBE", 0)
+        if nprobe <= 0:
+            # auto: 2*ceil(sqrt(C)) — measured (scripts/gallery_bench
+            # --sweep) as the smallest policy holding selection recall
+            # >= 0.9 at catalog scale with candidates ~N^(3/4) — but
+            # never fewer buckets than the election needs winners: at
+            # topk ~ C every bucket plausibly holds one, so small
+            # banks degrade toward the full probe (the min_n gate is
+            # what keeps genuinely small banks on the linear arm)
+            nprobe = max(2 * int(np.ceil(np.sqrt(max(n_centroids, 1)))),
+                         min(n_centroids, topk))
+        return max(1, min(nprobe, n_centroids))
+
+    def _ensure_index(self) -> SketchIndex:
+        """The bank's SketchIndex, created lazily on the first indexed
+        query (the off path never pays for it) and seeded with every
+        registered entry; register/evict keep it in sync after that."""
+        with self._lock:
+            if self._index is None:
+                frac = (float(self._index_rebuild_arg)
+                        if self._index_rebuild_arg is not None
+                        else _env_float("TMR_GALLERY_INDEX_REBUILD", 0.25))
+                idx = SketchIndex(rebuild_frac=frac)
+                for e in self._entries.values():
+                    idx.add(e.name, entry_sketch(e.exemplars, e.k_real))
+                self._index = idx
+            return self._index
+
+    def _prefilter_select(self, feats, groups: List[_Group], topk: int,
+                          jnp) -> Tuple[set, Dict[str, float]]:
+        """Elect the top-k entries for the full match. The index path
+        (sublinear: medoid probe + candidate rescore) is a candidate
+        OPTIMIZATION over the exact linear scan — any failure falls
+        back to the scan, counted, never silent and never a lost
+        frame."""
+        total = sum(g.n_real for g in groups)
+        if self._index_enabled() and total >= self._index_min_n_resolved():
+            try:
+                return self._index_select(feats, groups, topk, jnp)
+            except Exception:
+                self._m["index_fallbacks"].inc()
+        return self._linear_select(feats, groups, topk)
+
+    def _linear_select(self, feats, groups: List[_Group], topk: int
+                       ) -> Tuple[set, Dict[str, float]]:
+        """Today's exact scan: the per-group prefilter device calls are
+        UNCHANGED (the ``TMR_GALLERY_INDEX=0`` bitwise contract); only
+        the host-side ranking moved from a full O(N log N) sort to
+        O(N) argpartition with identical tie semantics."""
+        names: List[str] = []
+        chunks: List[np.ndarray] = []
+        for g in groups:
+            fn = self._pred._get_gallery_prefilter_fn(g.n_bucket,
+                                                      g.k_bucket)
+            s = np.asarray(fn(feats, g.ex_dev, g.k_dev, g.n_dev))
+            names.extend(g.names)
+            chunks.append(s[:g.n_real])
+        flat = np.concatenate(chunks)
+        sel_idx = _topk_flat(flat, topk)
+        selected = {names[i] for i in sel_idx}
+        if flat.shape[0] <= _SCORE_TAIL_MAX:
+            scores = {names[i]: float(flat[i])
+                      for i in range(flat.shape[0])}
+        else:
+            scores = {names[i]: float(flat[i]) for i in sel_idx}
+        return selected, scores
+
+    def _index_select(self, feats, groups: List[_Group], topk: int, jnp
+                      ) -> Tuple[set, Dict[str, float]]:
+        """The coarse-to-fine indexed election: ONE batched device call
+        scores every cluster's probe entries (medoid + anti-medoid),
+        the best ``nprobe`` buckets by probe-MAX are rescored with the
+        exact sketch correlation, and the same top-k/tie selection runs
+        over those candidates only — device prefilter work drops from
+        O(N) to O(sqrt(N) + nprobe * N/sqrt(N)) per frame."""
+        idx = self._ensure_index()
+        if idx.needs_rebuild():
+            # racing searches may both recluster — benign (the rebuild
+            # is deterministic and idempotent), and both are counted
+            stamp = idx.rebuild()
+            self._m["index_rebuilds"].inc()
+            self._g_rebuild_wall.set(stamp["wall_s"])
+        snap = idx.snapshot()
+        if not snap["built"] or not snap["probes"]:
+            raise RuntimeError("sketch index has no built clustering")
+        with self._lock:
+            entries = dict(self._entries)
+        # flat (group-order, member-order) positions — the tie-break
+        # order the linear scan's selection uses; also the membership
+        # filter that keeps a stale index from ever returning an entry
+        # not in the live registry view this search is serving
+        pos: Dict[str, int] = {}
+        for g in groups:
+            for nm in g.names:
+                pos[nm] = len(pos)
+        spans: List[Tuple[int, int]] = []  # (start, len) per cluster
+        pnames: List[str] = []
+        for plist in snap["probes"]:
+            spans.append((len(pnames), len(plist)))
+            pnames.extend(plist)
+        if any(nm not in entries for nm in pnames):
+            raise RuntimeError("index probes out of sync with registry")
+        self._m["index_queries"].inc()
+        kpad = max(g.k_bucket for g in groups)
+        pscores = self._score_flat(feats, [entries[nm] for nm in pnames],
+                                   kpad, jnp)
+        bucket_scores = np.asarray(
+            [pscores[s:s + ln].max() for s, ln in spans], np.float32
+        )
+        probe = _topk_flat(bucket_scores,
+                           self._resolve_nprobe(len(spans), topk))
+        self._m["index_probes"].inc(int(probe.size))
+        cand_set = set()
+        for ci in probe:
+            for nm in snap["members"][int(ci)]:
+                if nm in pos and nm in entries:
+                    cand_set.add(nm)
+        if not cand_set:
+            raise RuntimeError("index probe produced no candidates")
+        cand = sorted(cand_set, key=pos.__getitem__)
+        self._m["index_candidates"].inc(len(cand))
+        cscores = self._score_flat(feats, [entries[nm] for nm in cand],
+                                   kpad, jnp)
+        sel_local = _topk_flat(cscores, topk)
+        selected = {cand[i] for i in sel_local}
+        if len(cand) <= _SCORE_TAIL_MAX:
+            scores = {cand[i]: float(cscores[i])
+                      for i in range(len(cand))}
+        else:
+            scores = {cand[i]: float(cscores[i]) for i in sel_local}
+        hits = sum(
+            1 for ci in probe
+            if any(nm in selected for nm in snap["members"][int(ci)])
+        )
+        self._m["index_hits"].inc(hits)
+        return selected, scores
+
+    def _score_flat(self, feats, ents: List[GalleryEntry], kpad: int,
+                    jnp) -> np.ndarray:
+        """Exact coarse-sketch scores for an arbitrary entry list in
+        ONE batched query shape-family: entries pad on the k axis to
+        the bank-wide ``kpad`` (k_real masks the pad rows) and chunk at
+        ``_INDEX_CHUNK`` per device call on power-of-two rungs, so the
+        compile cache sees a handful of (rung, kpad) keys regardless
+        of N or which buckets a probe elects."""
+        out = np.empty((len(ents),), np.float32)
+        done = 0
+        while done < len(ents):
+            chunk = ents[done:done + _INDEX_CHUNK]
+            m = len(chunk)
+            rung = 1
+            while rung < m:
+                rung *= 2
+            ex = np.stack([
+                e.exemplars if e.exemplars.shape[0] == kpad else
+                np.concatenate(
+                    [e.exemplars,
+                     np.tile(e.exemplars[-1:],
+                             (kpad - e.exemplars.shape[0], 1))],
+                    axis=0,
+                )
+                for e in chunk
+            ], axis=0)
+            kr = np.asarray([e.k_real for e in chunk], np.int32)
+            if rung > m:
+                ex = np.concatenate(
+                    [ex, np.tile(ex[-1:], (rung - m, 1, 1))], axis=0
+                )
+                kr = np.concatenate([kr, np.ones((rung - m,), np.int32)])
+            fn = self._pred._get_gallery_prefilter_fn(rung, kpad)
+            s = np.asarray(fn(feats, jnp.asarray(ex), jnp.asarray(kr),
+                              jnp.asarray(m, jnp.int32)))
+            out[done:done + m] = s[:m]
+            done += m
+        return out
 
     def search(self, image, prefilter_topk: Optional[int] = None
                ) -> Dict[str, dict]:
@@ -506,18 +768,9 @@ class GalleryBank:
         selected: Optional[set] = None
         scores: Dict[str, float] = {}
         if prefilter_on:
-            selected = set()
             self._m["prefilter_runs"].inc()
-            ranked: List[Tuple[float, int, str]] = []
-            for gi, g in enumerate(groups):
-                fn = self._pred._get_gallery_prefilter_fn(g.n_bucket,
-                                                          g.k_bucket)
-                s = np.asarray(fn(feats, g.ex_dev, g.k_dev, g.n_dev))
-                for i in range(g.n_real):
-                    scores[g.names[i]] = float(s[i])
-                    ranked.append((float(s[i]), gi, g.names[i]))
-            ranked.sort(key=lambda r: -r[0])
-            selected = {name for _s, _gi, name in ranked[:topk]}
+            selected, scores = self._prefilter_select(feats, groups,
+                                                      topk, jnp)
 
         results: Dict[str, dict] = {}
         ran_heads = False
@@ -630,6 +883,33 @@ class GalleryBank:
     def counters(self) -> Dict[str, int]:
         return {name: c.value for name, c in self._m.items()}
 
+    def index_stats(self) -> dict:
+        """The sketch index's state + derived query metrics — light
+        enough for fleet heartbeats (no group rebuild, no device
+        placement, unlike ``stats``)."""
+        with self._lock:
+            idx = self._index
+        probes = self._m["index_probes"].value
+        hits = self._m["index_hits"].value
+        out = {
+            "enabled": self._index_enabled(),
+            "min_n": self._index_min_n_resolved(),
+            "queries": self._m["index_queries"].value,
+            "hit_rate": (round(hits / probes, 4) if probes else None),
+            "rebuild_wall_s": self._g_rebuild_wall.value,
+            "built": False,
+        }
+        if idx is not None:
+            out.update(idx.stats())
+        return out
+
+    def index_stamps(self) -> List[dict]:
+        """The journaled rebuild-stamp log (empty before the first
+        indexed query builds the index)."""
+        with self._lock:
+            idx = self._index
+        return [] if idx is None else idx.stamps()
+
     def stats(self) -> dict:
         groups = self._groups_locked()
         return {
@@ -643,6 +923,7 @@ class GalleryBank:
             "max_n_bucket": self.max_n_bucket,
             "prefilter_topk": self._resolve_topk(None),
             "feature_cache": self.feature_cache.stats(),
+            "index": self.index_stats(),
             **self.counters,
         }
 

@@ -326,8 +326,15 @@ class GalleryFleet:
             "replica_pushes": 0, "replica_corrupt": 0,
             "push_failures": 0, "under_replicated": 0,
             "promotions": 0, "adopt_installed": 0, "adopt_pushed": 0,
-            "materialize_errors": 0,
+            "materialize_errors": 0, "bulk_registered": 0,
+            "bulk_flushes": 0,
         }
+        #: per-worker bank stats from the last heartbeat (entry counts
+        #: + sketch-index state per held shard) — the fleet's window
+        #: into each shard's index health without a gstate round-trip
+        self._beat_banks: Dict[str, dict] = {}
+        #: the streamed bulk-ingest sink (started on demand)
+        self._bulk: Optional[FeatureSinkServer] = None
         self._journal = (
             PatternJournal(journal_dir) if journal_dir else None
         )
@@ -391,7 +398,10 @@ class GalleryFleet:
             self._closed = True
             server = self._server
             threads = list(self._threads)
+            bulk, self._bulk = self._bulk, None
         self._stop_event.set()
+        if bulk is not None:
+            bulk.close()
         if server is not None:
             server.shutdown()
             server.server_close()
@@ -482,6 +492,10 @@ class GalleryFleet:
             index, epoch = int(pair[0]), int(pair[1])
             if not self._svc.heartbeat(wid, index, epoch):
                 stale.append([index, epoch])
+        banks = msg.get("banks")
+        if isinstance(banks, dict):
+            with self._lock:
+                self._beat_banks[wid] = banks
         worker = self._svc.worker_rec(wid)
         return {"ok": True, "stale": stale, "drained": worker.drained}
 
@@ -600,8 +614,94 @@ class GalleryFleet:
                 pass  # a dead copy-holder has nothing left to evict
         return True
 
+    # --------------------------------------------------------- bulk ingest
+    def bulk_sink(self) -> Tuple[str, int]:
+        """Start (or return) the streamed bulk-ingest sink: a
+        :class:`FeatureSinkServer` whose pipelined ``feature`` op lands
+        each pattern straight in the journal + catalog (the streaming
+        client's ``sync`` ack vouches for durability, exactly the
+        map-fleet contract), with distribution to the shard holders
+        DEFERRED to one ``gflush`` round-trip over persistent links —
+        loading 10^5 patterns is a streamed pipeline, not 10^5
+        register() round-trips."""
+        with self._lock:
+            sink = self._bulk
+        if sink is not None:
+            return sink.address
+        fresh = FeatureSinkServer(
+            on_feature=self._bulk_feature, on_request=self._bulk_request,
+        )
+        addr = fresh.start()
+        with self._lock:
+            if self._bulk is None:
+                self._bulk = fresh
+                return addr
+            sink = self._bulk
+        fresh.close()  # lost the creation race
+        return sink.address
+
+    def _bulk_feature(self, shard: str, name: str, arr) -> None:
+        """One streamed pattern: journal FIRST, then catalog — the
+        register() durability ordering, minus the per-call push. A
+        raise here is counted on the sink connection and dirties the
+        client's next sync ack, which fails the batch attempt."""
+        name = str(name)
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        kr = int(arr.shape[0]) if arr.ndim >= 1 else 1
+        sh = self.shard_of(name)
+        payload = pack_array(arr)
+        if self._journal is not None:
+            self._journal.record(name, sh, payload, kr)
+        entry = {
+            "name": name,
+            "shard": sh,
+            "k_real": kr,
+            "payload": payload,
+            "digest": _payload_digest(arr.tobytes()),
+            "copies": set(),
+        }
+        with self._lock:
+            self._patterns[name] = entry
+            self._counters["registrations"] += 1
+            self._counters["bulk_registered"] += 1
+
+    def _bulk_request(self, doc: dict, state: dict) -> Optional[dict]:
+        if doc.get("op") != "gflush":
+            return None
+        return {"op": "gflush", "ok": True, **self.flush_pending()}
+
+    def flush_pending(self) -> dict:
+        """Distribute every catalog pattern with no acknowledged copy
+        yet (the bulk path journals + catalogs only) to the shard
+        holders + mirrors, over ONE persistent data-plane link per
+        worker. Idempotent — re-running touches only what is still
+        copy-less."""
+        with self._lock:
+            pending = [dict(e, copies=e["copies"])
+                       for e in self._patterns.values()
+                       if not e["copies"]]
+        links: Dict[str, _ExtractLink] = {}
+        pushed = under = 0
+        try:
+            for entry in pending:
+                copies = self._distribute(entry, links=links)
+                pushed += copies
+                if copies < min(self.replicas,
+                                max(len(self._svc.live_workers()), 1)):
+                    under += 1
+        finally:
+            for link in links.values():
+                link.close()
+        if under:
+            self._count("under_replicated", under)
+        self._count("bulk_flushes")
+        return {"patterns": len(pending), "copies": pushed,
+                "under_replicated": under}
+
     # --------------------------------------------------------- replication
-    def _distribute(self, entry: dict) -> int:
+    def _distribute(self, entry: dict, *,
+                    links: Optional[Dict[str, _ExtractLink]] = None
+                    ) -> int:
         """Push one pattern to its shard's primary and mirror it to
         R−1 other live workers; returns how many copies acknowledged."""
         shard = entry["shard"]
@@ -611,12 +711,15 @@ class GalleryFleet:
         if resolved is not None:
             primary = resolved[0]
             if self._push_pattern(entry, primary, resolved[2],
-                                  replica=False):
+                                  replica=False, links=links):
                 copies += 1
-        copies += self._mirror(entry, exclude={primary} if primary else set())
+        copies += self._mirror(entry,
+                               exclude={primary} if primary else set(),
+                               links=links)
         return copies
 
-    def _mirror(self, entry: dict, exclude: set) -> int:
+    def _mirror(self, entry: dict, exclude: set, *,
+                links: Optional[Dict[str, _ExtractLink]] = None) -> int:
         """Top replication back up to R copies on live workers."""
         live = self._svc.live_workers()
         with self._lock:
@@ -631,13 +734,34 @@ class GalleryFleet:
             addr = self._addr_of(wid)
             if addr is None:
                 continue
-            if self._push_pattern(entry, wid, addr, replica=True):
+            if self._push_pattern(entry, wid, addr, replica=True,
+                                  links=links):
                 acked += 1
         return acked
 
+    def _push_link(self, links: Optional[Dict[str, _ExtractLink]],
+                   wid: str, addr: Tuple[str, int]
+                   ) -> Optional[_ExtractLink]:
+        """The caller-owned persistent link for one worker during a
+        bulk flush (None = use per-push oneshot, the default path). A
+        dead link is replaced so a retry reconnects."""
+        if links is None:
+            return None
+        link = links.get(wid)
+        if link is not None and not link.dead \
+                and link.address == (addr[0], int(addr[1])):
+            return link
+        try:
+            links[wid] = _ExtractLink(addr, self._push_timeout)
+        except OSError:
+            return None
+        return links[wid]
+
     def _push_pattern(self, entry: dict, wid: str,
                       addr: Tuple[str, int], *, replica: bool,
-                      tries: int = 3) -> bool:
+                      tries: int = 3,
+                      links: Optional[Dict[str, _ExtractLink]] = None
+                      ) -> bool:
         """One copy onto one worker, digest-verified end to end. The
         ``gallery.replica`` fault point fires (and may corrupt the
         payload bytes) per REPLICA push attempt; a corrupt copy is
@@ -666,7 +790,13 @@ class GalleryFleet:
                 }
                 if replica:
                     self._count("replica_pushes")
-                reply = oneshot(addr, doc, timeout=self._push_timeout)
+                link = self._push_link(links, wid, addr)
+                if link is not None:
+                    reply = link.call(doc)
+                    if reply is None:
+                        raise ConnectionError("bulk push link died")
+                else:
+                    reply = oneshot(addr, doc, timeout=self._push_timeout)
             except Exception:
                 # injected raise or a dead worker: this attempt is
                 # gone; the retry (or the journal) owns durability
@@ -759,7 +889,8 @@ class GalleryFleet:
                     },
                     "patterns": len(self._patterns),
                     "workers": {
-                        w.wid: {"drained": w.drained, "dead": w.dead}
+                        w.wid: {"drained": w.drained, "dead": w.dead,
+                                "banks": self._beat_banks.get(w.wid)}
                         for w in self._svc.workers.values()
                     },
                     "reassignments": [
@@ -894,8 +1025,13 @@ class GalleryFleetWorker:
     def _beat_once(self) -> dict:
         with self._lock:
             held = [[i, e] for i, e in self._held.items()]
+            banks = {
+                str(i): self._bank_beat_stats(self._banks[i])
+                for i, _ in held if i in self._banks
+            }
         reply = oneshot(self.coordinator, {
             "op": "beat", "worker": self.worker_id, "held": held,
+            "banks": banks,
         })
         stale = reply.get("stale") or ()
         with self._lock:
@@ -905,6 +1041,21 @@ class GalleryFleetWorker:
             if reply.get("drained"):
                 self._drained = True
         return reply
+
+    @staticmethod
+    def _bank_beat_stats(bank) -> dict:
+        """The per-shard payload a heartbeat carries: entry count plus
+        the bank's sketch-index stats when it has them (a real
+        GalleryBank's ``index_stats`` is beat-light by design; stubs
+        just report size)."""
+        rec = {"entries": len(bank)}
+        stats_fn = getattr(bank, "index_stats", None)
+        if callable(stats_fn):
+            try:
+                rec["index"] = stats_fn()
+            except Exception:
+                pass  # a beat must never die on a stats probe
+        return rec
 
     # ---------------------------------------------------------- data plane
     def holds(self, index: int, epoch: int) -> bool:
@@ -1032,6 +1183,10 @@ class GalleryFleetWorker:
                 "installed": {
                     str(s): sorted(names)
                     for s, names in self._installed.items()
+                },
+                "banks": {
+                    str(s): self._bank_beat_stats(b)
+                    for s, b in self._banks.items()
                 },
                 "counters": dict(self._counters),
                 "faults_active": faults.active(),
@@ -1226,6 +1381,82 @@ class GalleryFleetClient:
             self._links.clear()
         for link in links:
             link.close()
+
+
+# ------------------------------------------------------------ bulk client
+def bulk_register(sink_addr: Tuple[str, int], patterns, *,
+                  batch: str = "bulk", timeout_s: Optional[float] = None,
+                  flush: bool = True,
+                  flush_timeout_s: float = 600.0) -> dict:
+    """Stream ``(name, exemplars)`` pairs into a :class:`GalleryFleet`
+    bulk-ingest sink (``fleet.bulk_sink()``'s address) over ONE
+    pipelined connection — the map fleet's feature-sink protocol
+    reused as the gallery's bulk-register path.
+
+    Every pattern rides a no-reply ``feature`` op (``k_real`` = the
+    exemplar row count); the trailing ``sync`` ack vouches that all of
+    them are journaled + cataloged (``ok`` goes False on any count
+    mismatch or sink-side error — re-stream the batch). With ``flush``
+    (default) one ``gflush`` round-trip then distributes everything
+    copy-less to the shard holders over persistent links; pass
+    ``flush=False`` when streaming several batches before one
+    ``fleet.flush_pending()``.
+    """
+    timeout = (
+        _env_float("TMR_GALLERY_FLEET_TIMEOUT_S", 30.0)
+        if timeout_s is None else float(timeout_s)
+    )
+    sock = socket.create_connection(
+        (sink_addr[0], int(sink_addr[1])),
+        timeout=connect_timeout(min(timeout, 5.0)),
+    )
+    sock.settimeout(timeout)
+    f = sock.makefile("rb")
+    streamed = 0
+    try:
+        send_line(sock, {"op": "hello", "worker": f"bulk-{batch}"})
+        if (recv_line(f) or {}).get("ok") is not True:
+            raise ConnectionError("bulk sink refused hello")
+        for name, exemplars in patterns:
+            arr = np.ascontiguousarray(np.asarray(exemplars, np.float32))
+            send_line(sock, {
+                "op": "feature", "shard": str(batch),
+                "name": str(name), "array": pack_array(arr),
+            })
+            streamed += 1
+        send_line(sock, {"op": "sync", "shard": str(batch)})
+        sync = recv_line(f) or {}
+        ok = (sync.get("ok") is True
+              and int(sync.get("features", -1)) == streamed)
+        out = {
+            "streamed": streamed,
+            "synced": int(sync.get("features", 0)),
+            "errors": int(sync.get("errors", 0)),
+            "ok": ok,
+        }
+        if flush and ok:
+            # distribution fans out to every holder before replying —
+            # a catalog-sized flush outlives the per-line timeout
+            sock.settimeout(max(float(flush_timeout_s), timeout))
+            send_line(sock, {"op": "gflush"})
+            reply = recv_line(f) or {}
+            out["flush"] = {
+                key: reply.get(key)
+                for key in ("ok", "patterns", "copies",
+                            "under_replicated")
+            }
+            out["ok"] = ok and reply.get("ok") is True
+        try:
+            send_line(sock, {"op": "bye"})
+            recv_line(f)
+        except (OSError, ValueError):
+            pass
+        return out
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 # ------------------------------------------------------------------ stub

@@ -232,7 +232,11 @@ def read_gallery_report(path: str) -> dict:
     output) to the rc-gating fields: the fused-arm exactness pin, the
     backbone-amortization evidence (backbone executions == frames, not
     frames×N), and the prefilter recall/cut checks at the elected
-    top-k — plus a per-rung prefilter table.
+    top-k — plus a per-rung prefilter table. When the document carries
+    the OPTIONAL catalog-scale ``n_sweep`` section (``--sweep`` runs),
+    its checks (sublinearity, selection recall, the argpartition tie
+    contract, and the fleet-probe rc when re-run) gate fail-closed
+    too; legacy documents without the section keep the original gate.
 
     Returns ``{"summary": ..., "rungs": [...], "checks": {...}}`` or
     ``{"error": ...}`` when the file holds no readable report."""
@@ -268,7 +272,7 @@ def read_gallery_report(path: str) -> dict:
          "full_matches": r.get("full_matches")}
         for r in (pre.get("rungs") or ()) if isinstance(r, dict)
     ]
-    return {
+    out = {
         "summary": {
             "patterns": (doc.get("config") or {}).get("patterns"),
             "frames": (doc.get("config") or {}).get("frames"),
@@ -292,6 +296,25 @@ def read_gallery_report(path: str) -> dict:
             "prefilter_cut_ok": checks.get("prefilter_cut_ok") is True,
         },
     }
+    sweep = doc.get("n_sweep")
+    if isinstance(sweep, dict):  # optional section => gates activate
+        scheck = sweep.get("checks")
+        scheck = scheck if isinstance(scheck, dict) else {}
+        fit = sweep.get("fit") or {}
+        out["summary"]["index_exponent"] = fit.get("index_exponent")
+        out["summary"]["linear_exponent"] = fit.get("linear_exponent")
+        out["sweep_points"] = [
+            {"n": p.get("n"), "linear_ms": p.get("linear_ms"),
+             "index_ms": p.get("index_ms"), "recall": p.get("recall")}
+            for p in (sweep.get("points") or ()) if isinstance(p, dict)
+        ]
+        for key in ("index_sublinear", "index_recall_ok",
+                    "index_off_exact"):
+            out["checks"][key] = scheck.get(key) is True
+        if "fleet_probe_ok" in scheck:  # only --fleet-patterns runs
+            out["checks"]["fleet_probe_ok"] = \
+                scheck.get("fleet_probe_ok") is True
+    return out
 
 
 # ---------------------------------------------------------- stream report
