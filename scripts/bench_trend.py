@@ -30,6 +30,7 @@ from tmr_tpu.utils.bench_trend import (  # noqa: E402
     DEFAULT_THRESHOLD,
     collect_bench_trend,
     read_chaos_report,
+    read_fleet_obs_report,
     read_fleet_report,
     read_gallery_report,
     read_serve_sweep,
@@ -91,7 +92,38 @@ def main(argv=None) -> int:
                          "accounted for, degraded searches were "
                          "exactly labeled, and every probe check "
                          "passed")
+    ap.add_argument("--fleet-obs", default=None, dest="fleet_obs",
+                    help="read a fleet_obs_report/v1 file "
+                         "(fleet_obs_probe output) instead of the "
+                         "BENCH history: one JSON line with the "
+                         "span-chain / reconciliation / timeline "
+                         "summary; rc 1 unless at least one "
+                         "cross-process span chain is complete, the "
+                         "sum-of-deltas metrics reconciliation is "
+                         "exact, the stitched timeline is monotone "
+                         "after clock-offset correction, the slow "
+                         "worker and killed worker each fired exactly "
+                         "their anomaly, the calm pass stayed quiet, "
+                         "and the disabled-mode overhead is under 1%")
     args = ap.parse_args(argv)
+
+    if args.fleet_obs:
+        doc = read_fleet_obs_report(args.fleet_obs)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        return 0 if (ck["span_chain_complete"]
+                     and ck["metrics_reconciled"]
+                     and ck["stitched_monotone"]
+                     and ck["slow_worker_exact"]
+                     and ck["beat_gap_exact"]
+                     and ck["calm_quiet"]
+                     and ck["overhead_ok"]) else 1
 
     if args.chaos:
         doc = read_chaos_report(args.chaos)

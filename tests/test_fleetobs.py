@@ -1,0 +1,534 @@
+"""The TMR_FLEET_OBS fleet observability plane
+(tmr_tpu/obs/fleetobs.py) and its wiring: disabled-mode byte-identity
+pins (no ``ctx``/``obs`` wire keys, beat replies and state() exactly
+the PR 18 shape), the enabled cross-process round trip (front-door
+trace ids, worker serve spans coming home on beats, exact
+sum-of-deltas reconciliation after a clean bye — ServeFleet AND the
+elastic coordinator), wire back-compat in both directions, the
+clock-offset stitcher, the fleet HealthWatch anomaly vocabulary, the
+beat-attachment error counter, the ``bench_trend --fleet-obs`` rc
+gate, and the full scripts/fleet_obs_probe.py proof."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tmr_tpu.diagnostics import (
+    FLEET_ANOMALY_KINDS,
+    validate_anomaly,
+    validate_fleet_obs_report,
+    validate_metrics_report,
+)
+from tmr_tpu.obs import fleetobs, tracing
+from tmr_tpu.obs import metrics as obsmetrics
+from tmr_tpu.parallel.leases import LeasePolicy
+from tmr_tpu.serve.fleet import FleetWorker, ServeFleet, stub_engine
+from tmr_tpu.utils import faults
+from tmr_tpu.utils.bench_trend import read_fleet_obs_report
+
+SIZE = 32
+EX = np.asarray([[0.4, 0.4, 0.6, 0.6]], np.float32)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts with the plane OFF and leaves it that way —
+    the disabled byte-identity contract of every other test file
+    depends on it."""
+    faults.clear()
+    fleetobs.configure(enabled=False)
+    yield
+    faults.clear()
+    fleetobs.configure(enabled=False, beat_bytes=262144, max_spans=256)
+    tracing.configure(enabled=False)
+    tracing.clear()
+
+
+def _img(seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+
+
+def _policy():
+    return LeasePolicy(
+        lease_ttl_s=2.0, hb_interval_s=0.1, check_interval_s=0.05,
+        straggler_factor=0.0, max_reassigns=1_000_000_000,
+        resource_fail_workers=1_000_000_000,
+    )
+
+
+def _fleet(**kw):
+    kw.setdefault("policy", _policy())
+    kw.setdefault("check_interval_s", 0.05)
+    fleet = ServeFleet([SIZE], classes=1, **kw)
+    fleet.start()
+    return fleet
+
+
+def _poll(predicate, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _await_holders(fleet, want=1):
+    return _poll(lambda: sum(
+        1 for rec in fleet.state()["partitions"].values()
+        if rec["holder"] is not None
+    ) >= want)
+
+
+# --------------------------------------------- disabled: byte identity
+def test_disabled_plane_is_invisible():
+    """TMR_FLEET_OBS=0 pins: no plane objects, no wire keys, beat
+    replies and state() exactly the pre-plane shape."""
+    assert fleetobs.make_ctx() is None
+    assert fleetobs.root_span("x") is None
+    assert fleetobs.op_span({"ctx": {"trace_id": "t",
+                                     "parent_span_id": 1}}, "x") \
+        is fleetobs._NOOP_REMOTE
+    fleet = _fleet()
+    try:
+        assert fleet.fleet_obs is None
+        assert fleet.fleet_obs_pass() == []
+        worker = FleetWorker(fleet.address, "w1", stub_engine()).start()
+        try:
+            assert worker._obs is None
+            assert _await_holders(fleet)
+            fleet.submit(_img(3), EX).result(timeout=30)
+            # the wire-level beat reply: EXACTLY the PR 18 keys
+            reply = fleet._op_beat({"op": "beat", "worker": "w1",
+                                    "held": []})
+            assert set(reply) == {"ok", "stale", "drained"}
+            state = fleet.state()
+            assert "fleet_metrics" not in state
+        finally:
+            worker.stop()
+    finally:
+        fleet.close()
+
+
+def test_wire_backcompat_both_directions():
+    """Old peer vs new peer, both ways: an enabled coordinator accepts
+    ctx-less/obs-less ops bitwise (and counts nothing), a disabled
+    coordinator ignores obs-carrying beats without a protocol error."""
+    # new worker -> OLD coordinator: the obs attachment is ignored
+    fleet_old = _fleet()
+    try:
+        reply = fleet_old._op_beat({
+            "op": "beat", "worker": "w-new", "held": [],
+            "obs": {"v": 1, "pid": 1, "metrics": {"counters": {"x": 1},
+                                                  "gauges": {},
+                                                  "histograms": {}}},
+        })
+        assert set(reply) == {"ok", "stale", "drained"}
+        assert reply["ok"] is True
+    finally:
+        fleet_old.close()
+    # old worker -> NEW coordinator: no ctx/obs keys, tolerated bitwise
+    fleetobs.configure(enabled=True)
+    fleet_new = _fleet()
+    try:
+        reply = fleet_new._op_beat({"op": "beat", "worker": "w-old",
+                                    "held": []})
+        assert reply["ok"] is True
+        assert "obs_ts" in reply  # the new reply stamps its clock
+        assert fleet_new.fleet_obs.metrics.errors == 0
+        # beat liveness was still recorded for the old worker
+        assert fleet_new.fleet_obs.worker_state()["w-old"]["beats"] == 1
+        serve_reply = {}  # ctx-less op opens no span
+        assert fleetobs.ctx_of(serve_reply) is None
+    finally:
+        fleet_new.close()
+
+
+# ------------------------------------------------- enabled: round trip
+def test_enabled_round_trip_chains_and_reconciliation():
+    """One in-process fleet with the plane ON: the front door mints
+    trace ids, worker serve spans come home on beats, the clean stop
+    flushes finals, and the sum-of-deltas reconciliation is EXACT."""
+    fleetobs.configure(enabled=True)
+    fleet = _fleet()
+    try:
+        fo = fleet.fleet_obs
+        assert fo is not None
+        worker = FleetWorker(fleet.address, "w1", stub_engine()).start()
+        try:
+            assert _await_holders(fleet)
+            for i in range(4):
+                fleet.submit(_img(20 + i), EX).result(timeout=30)
+            assert _poll(lambda: any(
+                (acc.get("histograms") or {}).get(
+                    "serve.request_latency_s", {}
+                ).get("count", 0) >= 4
+                for acc in fo.metrics.per_worker().values()
+            )), "latency deltas never folded"
+            state = fleet.state()
+            assert "fleet_metrics" in state
+            assert validate_metrics_report(
+                state["fleet_metrics"]["merged"]
+            ) == []
+        finally:
+            worker.stop()  # clean bye -> final snapshot flush
+        recon = _poll(lambda: (
+            lambda r: r if r["exact"] else None
+        )(fo.metrics.reconcile()))
+        assert recon and recon["exact"] is True
+        assert recon["workers_with_finals"] == ["w1"]
+        assert recon["mismatches"] == []
+        # at least one complete frontdoor -> worker chain per trace id
+        chains = fo.span_chains()
+        complete = 0
+        for recs in chains.values():
+            roots = {r["span"] for r in recs if r["parent"] == 0
+                     and r["proc"] == "coordinator"}
+            if roots and any(r["parent"] in roots and r["proc"] == "w1"
+                             for r in recs):
+                complete += 1
+        assert complete >= 1
+        rep = fo.report()
+        assert rep["trace"]["monotone"] is True
+        assert rep["beat_errors"] == 0
+    finally:
+        fleet.close()
+
+
+def test_elastic_bye_flushes_final_snapshot(tmp_path):
+    """The elastic coordinator gets the same end-of-life contract: a
+    clean WorkerClient.close() flushes the final totals and the lease
+    grant's ctx chains the worker's shard span under the grant root."""
+    from tmr_tpu.parallel import elastic
+
+    fleetobs.configure(enabled=True)
+    client = None
+    coord = elastic.ElasticCoordinator(
+        [], str(tmp_path / "_journal"), image_size=SIZE, batch_size=2,
+        policy=elastic.ElasticPolicy(
+            lease_ttl_s=2.0, hb_interval_s=0.1, check_interval_s=0.05,
+            straggler_factor=0.0,
+        ),
+    )
+    coord.start()
+    try:
+        assert coord.fleet_obs is not None
+        client = elastic.WorkerClient(coord.address, "ew1")
+        client.heartbeat(-1, -1)
+        assert _poll(
+            lambda: coord.fleet_obs.worker_state().get("ew1", {}).get(
+                "beats", 0) >= 1
+        )
+        assert "fleet_metrics" in coord.state()
+        client.close()
+        client = None
+        recon = _poll(lambda: (
+            lambda r: r if r["workers_with_finals"] else None
+        )(coord.fleet_obs.metrics.reconcile()))
+        assert recon and recon["workers_with_finals"] == ["ew1"]
+        assert recon["exact"] is True
+    finally:
+        if client is not None:
+            client.close()
+        coord.stop()
+
+
+# --------------------------------------------- clock offsets, stitching
+def test_estimate_offset_midpoint_and_min_rtt():
+    # remote clock runs 5s AHEAD of local; rtt 10ms symmetric
+    samples = [(100.0, 105.005, 100.010),  # midpoint exact: off=+5.0
+               (200.0, 205.100, 200.200)]  # worse rtt: must not win
+    off, err = fleetobs.estimate_offset(samples)
+    assert abs(off - 5.0) <= err
+    assert err == pytest.approx(0.005)
+    assert fleetobs.estimate_offset([]) is None
+    assert fleetobs.estimate_offset([(1.0, None, 1.1)]) is None
+    sync = fleetobs.ClockSync()
+    sync.add(100.0, 105.005, 100.010)
+    sync.add(100.0, "bogus", 100.010)  # non-numeric stamp ignored
+    est = sync.estimate()
+    assert est["samples"] == 1
+    assert abs(est["offset_s"] - 5.0) <= est["err_s"]
+
+
+def test_stitched_timeline_offset_correction_and_pid_remap():
+    """Two tracks on skewed clocks: after per-track offset correction
+    the merged trace is monotone, the offset is stamped into the track
+    name, and colliding pids get distinct synthetic rows."""
+    span = lambda ts, name: {"name": name, "ts": ts, "dur": 0.001,
+                             "tid": 1, "trace": "t1", "span": 1,
+                             "parent": 0, "attrs": {}}
+    tracks = [
+        {"pid": 42, "label": "coordinator", "offset_s": 0.0,
+         "err_s": 0.0, "spans": [span(10.0, "a"), span(10.5, "b")]},
+        # worker clock 5s AHEAD: raw stamps 15.1/15.6 are really
+        # 10.1/10.6 on the reference clock -> offset −5
+        {"pid": 42, "label": "w1", "offset_s": -5.0, "err_s": 0.002,
+         "spans": [span(15.1, "c"), span(15.6, "d")]},
+    ]
+    doc = fleetobs.stitch_chrome_traces(tracks)
+    assert fleetobs.tracks_monotone(doc)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len({e["pid"] for e in meta}) == 2  # collision remapped
+    names = [e["args"]["name"] for e in meta]
+    assert any("coordinator" in n and "+0.000" in n for n in names)
+    assert any("w1" in n and "-5000.000" in n and "2.000" in n
+               for n in names)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    w1_ts = [e["ts"] for e in xs if e["args"]["proc"] == "w1"]
+    # corrected worker stamps land ~0.1s after the coordinator's
+    assert w1_ts[0] == pytest.approx((15.1 - 5.0) * 1e6)
+    # an out-of-order track is detected
+    bad = fleetobs.stitch_chrome_traces([
+        {"pid": 1, "label": "x", "offset_s": 0.0, "err_s": 0.0,
+         "spans": [span(2.0, "a"), span(1.0, "b")]},
+    ])
+    assert not fleetobs.tracks_monotone(bad)
+
+
+# ------------------------------------------------------ fleet HealthWatch
+def _hist(counts, buckets=(0.01, 0.1, 1.0)):
+    return {"buckets_le": list(buckets), "counts": list(counts),
+            "count": sum(counts), "sum": 0.0, "min": 0.0, "max": 1.0}
+
+
+def _lat(per_worker_counts):
+    return {
+        wid: {"histograms": {"serve.request_latency_s": _hist(counts)}}
+        for wid, counts in per_worker_counts.items()
+    }
+
+
+def test_healthwatch_kinds_fire_once_and_validate():
+    watch = fleetobs.FleetHealthWatch(min_window_requests=8,
+                                      min_window_total=24)
+    # calm: two balanced fast workers
+    calm = watch.observe(_lat({"a": [12, 0, 0], "b": [12, 0, 0]}))
+    assert calm == []
+    # one worker's window lands in the 1.0s bucket: outlier, named
+    fired = watch.observe(_lat({"a": [24, 0, 0], "b": [24, 0, 0],
+                                "slow": [0, 0, 12]}))
+    kinds = [a["anomaly"] for a in fired]
+    assert kinds == ["worker_outlier_latency"]
+    assert fired[0]["evidence"]["worker"] == "slow"
+    for rec in fired:
+        assert validate_anomaly(rec) == []
+        assert rec["anomaly"] in FLEET_ANOMALY_KINDS
+    # skew: one of three workers draws 80% of the window (fair share
+    # 33%, bound min(2 x fair, 0.95) = 67%)
+    watch2 = fleetobs.FleetHealthWatch(min_window_requests=8,
+                                       min_window_total=24)
+    watch2.observe(_lat({"a": [8, 0, 0], "b": [8, 0, 0],
+                         "c": [8, 0, 0]}))
+    fired = watch2.observe(_lat({"a": [88, 0, 0], "b": [18, 0, 0],
+                                 "c": [18, 0, 0]}))
+    assert [a["anomaly"] for a in fired] == ["partition_skew"]
+    assert fired[0]["evidence"]["worker"] == "a"
+
+
+def test_healthwatch_beat_gap_latches_until_fresh_beat():
+    watch = fleetobs.FleetHealthWatch()
+    beats = {"w1": 100.0, "w2": 100.0}
+    fired = watch.observe({}, beats=beats, hb_interval_s=0.2,
+                          now=101.0, live=["w1", "w2"],
+                          held={"w1": ["s32c0"]})
+    assert [a["anomaly"] for a in fired] == ["beat_gap", "beat_gap"]
+    # latched: the same silence is ONE anomaly, not one per pass
+    again = watch.observe({}, beats=beats, hb_interval_s=0.2,
+                          now=102.0, live=["w1", "w2"])
+    assert again == []
+    # a fresh beat unlatches; renewed silence fires again
+    beats["w1"] = 102.0
+    assert watch.observe({}, beats=beats, hb_interval_s=0.2,
+                         now=102.1, live=["w1"]) == []
+    fired = watch.observe({}, beats=beats, hb_interval_s=0.2,
+                          now=104.0, live=["w1"])
+    assert [a["anomaly"] for a in fired] == ["beat_gap"]
+    assert fired[0]["evidence"]["worker"] == "w1"
+    # a cleanly-left worker (not in live) never fires
+    assert watch.observe({}, beats={"w9": 0.0}, hb_interval_s=0.2,
+                         now=10.0, live=[]) == []
+
+
+def test_healthwatch_fleet_mfu_drop_rolling_baseline():
+    watch = fleetobs.FleetHealthWatch(mfu_drop=0.5)
+    mk = lambda f, d: {"w": {"flops": f, "device_s": d}}
+    watch.observe({}, mfu_by_worker=mk(0.0, 0.0))
+    for i in range(1, 4):  # three healthy windows: 1 TFLOP/s baseline
+        assert watch.observe({}, mfu_by_worker=mk(i * 1e12, i * 1.0)) \
+            == []
+    # the next window achieves 0.1 TFLOP/s: an 10x drop fires
+    fired = watch.observe({}, mfu_by_worker=mk(3e12 + 1e11, 4.0))
+    assert [a["anomaly"] for a in fired] == ["fleet_mfu_drop"]
+    assert validate_anomaly(fired[0]) == []
+
+
+# ------------------------------------------- delta codec + beat errors
+def test_delta_codec_roundtrip_exact():
+    reg = obsmetrics.MetricsRegistry()
+    reg.counter("req").inc(3)
+    hist = reg.histogram("lat")
+    hist.observe(0.02)
+    snap1 = reg.snapshot()
+    reg.counter("req").inc(2)
+    reg.counter("new").inc(1)
+    hist.observe(0.5)
+    hist.observe(0.7)
+    snap2 = reg.snapshot()
+    acc = fleetobs._empty_acc()
+    fleetobs._fold_delta(acc, fleetobs.snapshot_delta(None, snap1))
+    fleetobs._fold_delta(acc, fleetobs.snapshot_delta(snap1, snap2))
+    report = fleetobs._acc_to_report(acc)
+    assert validate_metrics_report(report) == []
+    assert report["counters"] == snap2["counters"]
+    folded = report["histograms"]["lat"]
+    assert folded["count"] == snap2["histograms"]["lat"]["count"]
+    assert folded["counts"] == snap2["histograms"]["lat"]["counts"]
+    assert fleetobs.snapshot_delta(snap2, snap2) is None  # quiescent
+
+
+def test_truncated_and_garbage_attachments_count_not_drop():
+    fleetobs.configure(enabled=True)
+    fo = fleetobs.FleetObs()
+    before = obsmetrics.counter("fleet.obs_beat_errors").value
+    fo.note_beat("w1")
+    assert fo.fold("w1", "garbage") is False
+    assert fo.fold("w1", {"v": 1, "truncated": True}) is False
+    assert fo.metrics.errors == 2
+    assert obsmetrics.counter("fleet.obs_beat_errors").value \
+        == before + 2
+    # the beat's liveness half survived the bad attachments
+    assert fo.worker_state()["w1"]["beats"] == 1
+    assert fo.state()["beat_errors"] == 2
+
+
+def test_worker_attachment_truncation_rolls_back_delta():
+    """An over-budget attachment ships ``truncated`` WITHOUT advancing
+    the delta watermark: the window re-ships whole on a later beat, so
+    reconciliation stays exact."""
+    fleetobs.configure(enabled=True, beat_bytes=4096)
+    reg = obsmetrics.MetricsRegistry()
+    for i in range(400):
+        reg.counter(f"stress.metric_{i:04d}.total").inc(i + 1)
+    wobs = fleetobs.WorkerObs(reg)
+    att = wobs.attachment()
+    assert att.get("truncated") is True
+    assert "metrics" not in att
+    fleetobs.configure(beat_bytes=262144)
+    att2 = wobs.attachment(final=True)
+    assert "truncated" not in att2
+    # the rolled-back window shipped whole: delta == final totals
+    fo = fleetobs.FleetObs()
+    fo.fold("w1", att)  # counted, folds nothing
+    fo.fold("w1", att2, final=True)
+    recon = fo.metrics.reconcile()
+    assert recon["exact"] is True, recon["mismatches"]
+
+
+# -------------------------------------------------- reader + probe gate
+def _good_report():
+    return {
+        "schema": "fleet_obs_report/v1",
+        "config": {},
+        "workers": {"w1": {"beats": 3, "spans": 5,
+                           "clock": {"offset_s": 0.1, "err_s": 0.01}}},
+        "merged": {"schema": "metrics_report/v1", "counters": {},
+                   "gauges": {}, "histograms": {}},
+        "reconciliation": {"exact": True, "counters_checked": 4},
+        "trace": {"events": 10, "tracks": 2, "monotone": True},
+        "chains": {"total": 4, "complete": 4},
+        "anomalies": {"calm": []},
+        "beat_errors": 0,
+        "overhead": {"disabled_ns_per_check": 100.0,
+                     "overhead_disabled_pct": 0.01},
+        "checks": {
+            "span_chain_complete": True, "metrics_reconciled": True,
+            "stitched_monotone": True, "slow_worker_exact": True,
+            "beat_gap_exact": True, "calm_quiet": True,
+            "overhead_ok": True,
+        },
+    }
+
+
+def test_read_fleet_obs_report_fails_closed(tmp_path):
+    doc = _good_report()
+    assert validate_fleet_obs_report(doc) == []
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(doc))
+    out = read_fleet_obs_report(str(good))
+    assert all(v is True for v in out["checks"].values())
+    assert out["summary"]["complete_chains"] == 4
+    # every degradation fails CLOSED
+    doc["checks"]["metrics_reconciled"] = True
+    doc["reconciliation"]["exact"] = False  # check lies, field honest
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    assert read_fleet_obs_report(str(bad))["checks"][
+        "metrics_reconciled"] is False
+    del doc["checks"]["overhead_ok"]
+    doc["reconciliation"]["exact"] = True
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps(doc))
+    assert read_fleet_obs_report(str(partial))["checks"][
+        "overhead_ok"] is False
+    err = tmp_path / "err.json"
+    err.write_text(json.dumps({"schema": "fleet_obs_report/v1",
+                               "error": "wedged"}))
+    assert "error" in read_fleet_obs_report(str(err))
+    assert "error" in read_fleet_obs_report(str(tmp_path / "nope"))
+    garbled = tmp_path / "garbled.jsonl"
+    garbled.write_text("not json\n" + json.dumps(_good_report()) + "\n")
+    assert "error" not in read_fleet_obs_report(str(garbled))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trend_fleet_obs_rc(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_good_report()))
+    assert _load("bench_trend").main(["--fleet-obs", str(good)]) == 0
+    capsys.readouterr()
+    doc = _good_report()
+    doc["checks"]["calm_quiet"] = False
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    assert _load("bench_trend").main(["--fleet-obs", str(bad)]) == 1
+    capsys.readouterr()
+    assert _load("bench_trend").main(
+        ["--fleet-obs", str(tmp_path / "missing.json")]
+    ) == 1
+    capsys.readouterr()
+
+
+def test_fleet_obs_probe_passes(tmp_path, capsys):
+    """The full measured proof: 3-worker mixed fleet + kill -9, one
+    validated fleet_obs_report/v1, rc-gated again through
+    scripts/bench_trend.py --fleet-obs."""
+    out = tmp_path / "fleet_obs_report.json"
+    rc = _load("fleet_obs_probe").main(["--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert validate_fleet_obs_report(doc) == []
+    assert all(v is True for v in doc["checks"].values())
+    assert doc["chains"]["complete"] >= 1
+    assert doc["reconciliation"]["exact"] is True
+    assert doc["overhead"]["overhead_disabled_pct"] < 1.0
+    capsys.readouterr()
+    assert _load("bench_trend").main(["--fleet-obs", str(out)]) == 0
+    reader_doc = json.loads(capsys.readouterr().out.strip())
+    assert all(v is True for v in reader_doc["checks"].values())

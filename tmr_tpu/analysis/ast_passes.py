@@ -287,6 +287,7 @@ LOCK_FILES = (
     "tmr_tpu/parallel/leases.py",
     "tmr_tpu/utils/faults.py",
     "tmr_tpu/obs/metrics.py",
+    "tmr_tpu/obs/fleetobs.py",
 )
 
 

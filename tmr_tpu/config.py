@@ -298,6 +298,13 @@ ENV_KNOBS = {
     "TMR_FLIGHT_RING": "flight-recorder ring capacity (records)",
     "TMR_HEALTH_INTERVAL_S": "health-heartbeat JSONL write interval "
         "seconds",
+    "TMR_FLEET_OBS": "fleet observability plane on/off (default off): "
+        "cross-process trace propagation, beat-borne metrics rollup, "
+        "stitched cluster timeline, fleet HealthWatch",
+    "TMR_FLEET_OBS_BEAT_BYTES": "per-beat observability attachment "
+        "byte cap (spans drop first, an oversized metrics delta rolls "
+        "back and the beat counts as truncated)",
+    "TMR_FLEET_OBS_SPANS": "max completed spans shipped per beat",
     # elastic map phase (parallel/elastic.py coordinator/worker leases)
     "TMR_ELASTIC_TTL_S": "lease heartbeat budget seconds: a lease not "
         "beaten for this long is revoked and its shard reassigned",

@@ -194,14 +194,28 @@ def validate_mfu_report(doc: dict) -> List[str]:
 #: window p99 beyond factor x rolling baseline; queue_saturation =
 #: batcher depth over threshold; cache_hit_collapse = window hit rate
 #: collapsed vs rolling baseline; mfu_drop = window achieved FLOP/s
-#: below factor x rolling baseline.
+#: below factor x rolling baseline. The fleet kinds (FLEET_ANOMALY_KINDS,
+#: tmr_tpu/obs/fleetobs.py FleetHealthWatch over the beat-merged
+#: registry) extend the same vocabulary: worker_outlier_latency = one
+#: worker's window p95 beyond factor x the median of its peers;
+#: partition_skew = one worker drawing a window request share beyond
+#: factor x the fair share; fleet_mfu_drop = cluster-summed window
+#: FLOP/s below factor x rolling baseline; beat_gap = a live worker's
+#: last heartbeat older than factor x the beat interval.
+FLEET_ANOMALY_KINDS = (
+    "worker_outlier_latency",
+    "partition_skew",
+    "fleet_mfu_drop",
+    "beat_gap",
+)
+
 ANOMALY_KINDS = (
     "recompile_storm",
     "latency_regression",
     "queue_saturation",
     "cache_hit_collapse",
     "mfu_drop",
-)
+) + FLEET_ANOMALY_KINDS
 
 
 def validate_anomaly(rec: dict) -> List[str]:
@@ -379,6 +393,110 @@ def validate_flight_report(doc: dict) -> List[str]:
         for key in ("mfu_finite", "flops_envelope_ok", "health_valid",
                     "heartbeat_roundtrip", "storm_exact", "queue_exact",
                     "overhead_ok"):
+            if key not in checks:
+                problems.append(f"checks: missing {key!r}")
+    return problems
+
+
+#: schema tag of the fleet-observability probe document emitted by
+#: scripts/fleet_obs_probe.py (plane in tmr_tpu/obs/fleetobs.py): the
+#: per-worker + merged beat-folded registries with the exact
+#: sum-of-deltas reconciliation, the cross-process span-chain evidence,
+#: the stitched-timeline summary (per-track clock offsets + post-
+#: correction monotonicity), the fleet HealthWatch firings per phase,
+#: and the disabled-mode overhead of the whole plane. bench_guard wraps
+#: the probe, so an error record ({"schema": ..., "error": str}) is
+#: contractually valid.
+FLEET_OBS_REPORT_SCHEMA = "fleet_obs_report/v1"
+
+
+def validate_fleet_obs_report(doc: dict) -> List[str]:
+    """Structural check of a fleet_obs_report/v1 document; returns a
+    list of problems (empty == valid). An error record is contractually
+    valid (the bench_guard wedge path)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != FLEET_OBS_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {FLEET_OBS_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    if not isinstance(doc.get("config"), dict):
+        problems.append("config: not a dict")
+    workers = doc.get("workers")
+    if not isinstance(workers, dict):
+        problems.append("workers: not a dict")
+    else:
+        for wid, rec in workers.items():
+            if not isinstance(rec, dict):
+                problems.append(f"workers[{wid!r}]: not a dict")
+                continue
+            for key in ("beats", "spans"):
+                if not isinstance(rec.get(key), int) or isinstance(
+                    rec.get(key), bool
+                ):
+                    problems.append(f"workers[{wid!r}].{key}: not an int")
+            clock = rec.get("clock")
+            if clock is not None and (
+                not isinstance(clock, dict)
+                or not all(isinstance(clock.get(k), (int, float))
+                           for k in ("offset_s", "err_s"))
+            ):
+                problems.append(
+                    f"workers[{wid!r}].clock: missing offset_s/err_s"
+                )
+    problems += [f"merged: {p}" for p in validate_metrics_report(
+        doc.get("merged") or {}
+    )]
+    recon = doc.get("reconciliation")
+    if not isinstance(recon, dict) or not isinstance(
+        recon.get("exact"), bool
+    ):
+        problems.append("reconciliation: missing exact bool")
+    trace = doc.get("trace")
+    if not isinstance(trace, dict):
+        problems.append("trace: not a dict")
+    else:
+        for key in ("events", "tracks"):
+            if not isinstance(trace.get(key), int) or isinstance(
+                trace.get(key), bool
+            ):
+                problems.append(f"trace.{key}: not an int")
+        if not isinstance(trace.get("monotone"), bool):
+            problems.append("trace.monotone: not a bool")
+    anomalies = doc.get("anomalies")
+    if not isinstance(anomalies, dict):
+        problems.append("anomalies: not a dict")
+    else:
+        for section, recs in anomalies.items():
+            if not isinstance(recs, list):
+                problems.append(f"anomalies.{section}: not a list")
+                continue
+            for i, rec in enumerate(recs):
+                problems += [f"anomalies.{section}[{i}]: {p}"
+                             for p in validate_anomaly(rec)]
+    if not isinstance(doc.get("beat_errors"), int) or isinstance(
+        doc.get("beat_errors"), bool
+    ):
+        problems.append("beat_errors: not an int")
+    overhead = doc.get("overhead")
+    if not isinstance(overhead, dict):
+        problems.append("overhead: not a dict")
+    else:
+        for key in ("disabled_ns_per_check", "overhead_disabled_pct"):
+            if not isinstance(overhead.get(key), (int, float)):
+                problems.append(f"overhead: missing {key!r}")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        problems.append("checks: not a dict")
+    else:
+        for key in ("span_chain_complete", "metrics_reconciled",
+                    "stitched_monotone", "slow_worker_exact",
+                    "beat_gap_exact", "calm_quiet", "overhead_ok"):
             if key not in checks:
                 problems.append(f"checks: missing {key!r}")
     return problems

@@ -8,9 +8,13 @@ compile.py (per-trace/compile events with cold vs key-change causes),
 devtime.py (per-program device-time attribution + MFU/roofline
 accounting, ``mfu_report/v1``), and flight.py (the ``TMR_FLIGHT``
 recorder ring, the anomaly-detecting HealthWatch, and the health
-heartbeat). ``scripts/obs_probe.py`` and ``scripts/obs_watch.py`` are
-the measured proofs; QUICKSTART_RUN.md "Observability" and
-"Performance accounting & health watch" document the knobs.
+heartbeat), and fleetobs.py (the ``TMR_FLEET_OBS`` fleet-wide plane:
+cross-process trace propagation, heartbeat metrics rollup, the
+stitched cluster timeline, and the fleet HealthWatch).
+``scripts/obs_probe.py``, ``scripts/obs_watch.py``, and
+``scripts/fleet_obs_probe.py`` are the measured proofs;
+QUICKSTART_RUN.md "Observability", "Performance accounting & health
+watch", and "Fleet observability" document the knobs.
 Import-light on purpose: nothing here imports jax at module load, so
 any layer (ops, data, utils) can instrument itself.
 """
@@ -30,6 +34,14 @@ from tmr_tpu.obs.devtime import (
     platform_peak,
     track_devtime,
 )
+from tmr_tpu.obs.fleetobs import (
+    FleetHealthWatch,
+    FleetObs,
+    WorkerObs,
+    fleet_obs_enabled,
+    stitch_chrome_traces,
+)
+from tmr_tpu.obs.fleetobs import configure as fleet_obs_configure
 from tmr_tpu.obs.flight import (
     FlightRecorder,
     Heartbeat,
@@ -64,12 +76,15 @@ from tmr_tpu.obs.tracing import (
 
 __all__ = [
     "Counter",
+    "FleetHealthWatch",
+    "FleetObs",
     "FlightRecorder",
     "Gauge",
     "HealthWatch",
     "Heartbeat",
     "Histogram",
     "MetricsRegistry",
+    "WorkerObs",
     "add_span",
     "attribute_call",
     "chrome_trace",
@@ -81,6 +96,8 @@ __all__ = [
     "counter",
     "drain_compile_events",
     "dropped_spans",
+    "fleet_obs_configure",
+    "fleet_obs_enabled",
     "flight_configure",
     "flight_enabled",
     "flight_record",
@@ -96,6 +113,7 @@ __all__ = [
     "save_chrome_trace",
     "span",
     "spans",
+    "stitch_chrome_traces",
     "tracing_enabled",
     "track_compile",
     "track_devtime",

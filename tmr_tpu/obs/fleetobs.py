@@ -1,0 +1,1072 @@
+"""Fleet-wide observability plane: cross-process trace propagation,
+heartbeat metrics rollup, and the stitched cluster timeline.
+
+PR 4/8's observability (spans, registries, flight recorder, HealthWatch)
+is strictly per-process; PRs 14-18 made the system distributed. This
+module is the cross-process half, everything OFF by default behind
+``TMR_FLEET_OBS`` (=0: wire bytes, beat payloads, and registries stay
+byte-identical to the per-process world — one module-global bool check
+per instrumented site, the tracing.py cost contract):
+
+- **context propagation** — :func:`make_ctx` mints ``{trace_id,
+  parent_span_id}`` at a front door (ServeFleet.submit /
+  GalleryFleetClient.search / FeatureTierClient.fetch / the elastic
+  lease grant); the dict rides every protocol op as an optional ``ctx``
+  field, and receivers open spans under the propagated ids
+  (:func:`op_span` / :func:`add_remote_span`) so one request's hops
+  share a trace. Peers lacking ``ctx`` are tolerated bitwise (absent =
+  the PR 18 behavior). Span ids are process-local — cross-process
+  consumers key by (process, span), which the stitcher does.
+- **metrics rollup** — :class:`WorkerObs` attaches a bounded delta of a
+  worker's ``MetricsRegistry`` snapshot (plus devtime MFU totals, newly
+  completed spans, and its clock-offset estimate) to each ``beat`` op;
+  :class:`FleetMetrics` folds deltas coordinator-side into per-worker +
+  fleet-wide merged totals — exact by construction (histogram counts
+  add), so sum-of-deltas reconciles bitwise against each worker's final
+  snapshot. Truncated/unparseable attachments count
+  (``fleet.obs_beat_errors``) instead of dropping the beat.
+- **stitched timeline** — :func:`stitch_chrome_traces` merges per-
+  process span tracks into ONE Perfetto-loadable Chrome trace, each
+  track shifted by the peer's clock offset (midpoint method over
+  existing beat round-trips, :class:`ClockSync`; offset + uncertainty
+  stamped into the process name).
+- **fleet HealthWatch** — :class:`FleetHealthWatch` runs the PR 8
+  detector discipline over the merged registry with the cluster kinds
+  (``diagnostics.FLEET_ANOMALY_KINDS``: worker_outlier_latency,
+  partition_skew, fleet_mfu_drop, beat_gap), at most one firing per
+  worker per kind per pass, evidence naming the worker/partitions.
+
+``scripts/fleet_obs_probe.py`` is the measured proof
+(``fleet_obs_report/v1``); QUICKSTART_RUN.md "Fleet observability"
+documents the knobs. Import-light: nothing here imports jax at module
+load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from tmr_tpu.diagnostics import METRICS_REPORT_SCHEMA
+from tmr_tpu.obs import devtime
+from tmr_tpu.obs import metrics as _metrics
+from tmr_tpu.obs import tracing
+from tmr_tpu.obs.flight import (
+    _anomaly,
+    _delta_hist_quantile,
+    _median,
+    flight_enabled,
+    get_recorder,
+)
+from tmr_tpu.obs.flight import record as _flight_record
+from tmr_tpu.obs.tracing import _env_flag, _env_int
+
+_LOCK = threading.Lock()
+
+#: module-global fast path: the ONLY thing a disabled fleet-obs site
+#: touches. None = not yet resolved — the TMR_FLEET_OBS* knobs are read
+#: LAZILY on first use (analysis rule knob-import-time), exactly the
+#: tracing.py pattern.
+_ENABLED: Optional[bool] = None
+_BEAT_BYTES: Optional[int] = None
+_MAX_SPANS: Optional[int] = None
+
+
+def _resolve_env() -> bool:
+    """Fill any still-unset knob from the environment under ``_LOCK``
+    (the tracing.py first-use-vs-configure race). Returns True when
+    this call flipped the plane on from the environment — the caller
+    then turns span tracing on too (outside the lock)."""
+    global _ENABLED, _BEAT_BYTES, _MAX_SPANS
+    enabled_now = False
+    with _LOCK:
+        if _ENABLED is None:
+            _ENABLED = _env_flag("TMR_FLEET_OBS")
+            enabled_now = _ENABLED
+        if _BEAT_BYTES is None:
+            _BEAT_BYTES = max(
+                _env_int("TMR_FLEET_OBS_BEAT_BYTES", 262144), 4096
+            )
+        if _MAX_SPANS is None:
+            _MAX_SPANS = max(_env_int("TMR_FLEET_OBS_SPANS", 256), 1)
+    return enabled_now
+
+
+def _auto_enable_tracing() -> None:
+    """An enabled plane implies span tracing — a timeline with no spans
+    is useless — UNLESS the operator explicitly set TMR_TRACE (either
+    way): an explicit 0 keeps the metrics/anomaly half without spans."""
+    if os.environ.get("TMR_TRACE") is None:
+        tracing.configure(enabled=True)
+
+
+def fleet_obs_enabled() -> bool:
+    """One bool check after first resolution — the whole disabled-mode
+    cost of the fleet observability plane at every instrumented site."""
+    if _ENABLED is None:
+        if _resolve_env():
+            _auto_enable_tracing()
+    return _ENABLED
+
+
+def configure(enabled: Optional[bool] = None,
+              beat_bytes: Optional[int] = None,
+              max_spans: Optional[int] = None) -> None:
+    """Programmatic override of TMR_FLEET_OBS / TMR_FLEET_OBS_BEAT_BYTES
+    / TMR_FLEET_OBS_SPANS (probes and tests flip the plane without
+    re-execing). Enabling also enables span tracing unless TMR_TRACE is
+    explicitly set in the environment."""
+    global _ENABLED, _BEAT_BYTES, _MAX_SPANS
+    with _LOCK:
+        if enabled is not None:
+            _ENABLED = bool(enabled)
+        if beat_bytes is not None:
+            _BEAT_BYTES = max(int(beat_bytes), 4096)
+        if max_spans is not None:
+            _MAX_SPANS = max(int(max_spans), 1)
+    _resolve_env()
+    if enabled:
+        _auto_enable_tracing()
+
+
+def _beat_bytes() -> int:
+    if _BEAT_BYTES is None:
+        _resolve_env()
+    return _BEAT_BYTES
+
+
+def _max_spans() -> int:
+    if _MAX_SPANS is None:
+        _resolve_env()
+    return _MAX_SPANS
+
+
+# ------------------------------------------------------- ctx propagation
+def make_ctx(parent_span_id: int = 0,
+             trace_id: Optional[str] = None) -> Optional[dict]:
+    """The wire-level trace context a front door stamps on an outgoing
+    op (``doc["ctx"] = ctx``): a fresh trace id unless one is supplied,
+    plus the span id receiver spans should parent under. None when the
+    plane is disabled — the caller then omits the field entirely, so
+    disabled wire bytes are identical to PR 18."""
+    if not fleet_obs_enabled():
+        return None
+    return {
+        "trace_id": str(trace_id) if trace_id else tracing.new_trace_id(),
+        "parent_span_id": int(parent_span_id),
+    }
+
+
+def ctx_of(msg: Any) -> Optional[dict]:
+    """The validated ``ctx`` of a received wire op, or None (plane
+    disabled, old peer, or malformed) — None means exactly today's
+    receiver behavior, which is how old-peer bitwise tolerance holds."""
+    if not fleet_obs_enabled():
+        return None
+    ctx = msg.get("ctx") if isinstance(msg, dict) else None
+    if not isinstance(ctx, dict):
+        return None
+    tid = ctx.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        return None
+    try:
+        parent = int(ctx.get("parent_span_id") or 0)
+    except (TypeError, ValueError):
+        parent = 0
+    return {"trace_id": tid, "parent_span_id": parent}
+
+
+def add_remote_span(name: str, t0: float, t1: float,
+                    ctx: Optional[dict], **attrs) -> None:
+    """Record one receiver-side span under a propagated ctx (explicit
+    perf_counter boundaries, the add_span discipline). No-op on None."""
+    if ctx is None:
+        return
+    tracing.add_span(
+        name, t0, t1, trace_id=ctx["trace_id"],
+        parent=int(ctx.get("parent_span_id") or 0), **attrs,
+    )
+
+
+class RootSpan:
+    """A front door's pre-minted root span: its id is advertised to the
+    remote hop (``ctx()``) while the span is still open; ``close()``
+    records it. Immutable after construction except attrs — no lock."""
+
+    __slots__ = ("name", "trace_id", "span_id", "t0", "attrs", "_done")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 **attrs) -> None:
+        self.name = name
+        self.trace_id = (str(trace_id) if trace_id
+                         else tracing.new_trace_id())
+        self.span_id = tracing.next_span_id()
+        self.t0 = time.perf_counter()
+        self.attrs = attrs
+        self._done = False
+
+    def ctx(self) -> dict:
+        return {"trace_id": self.trace_id,
+                "parent_span_id": self.span_id}
+
+    def close(self, **attrs) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.attrs.update(attrs)
+        tracing.add_span(
+            self.name, self.t0, time.perf_counter(),
+            trace_id=self.trace_id, parent=0, span_id=self.span_id,
+            **self.attrs,
+        )
+
+
+def root_span(name: str, **attrs) -> Optional[RootSpan]:
+    """Mint a front-door root span, or None when the plane is off."""
+    if not fleet_obs_enabled():
+        return None
+    return RootSpan(name, **attrs)
+
+
+class _NoopRemote:
+    """Shared no-op stand-in for :func:`op_span` without a ctx."""
+
+    __slots__ = ()
+    span_id = 0
+    trace_id = ""
+
+    def __enter__(self) -> "_NoopRemote":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def ctx(self) -> Optional[dict]:
+        return None
+
+    def set_attr(self, **attrs) -> None:
+        pass
+
+
+_NOOP_REMOTE = _NoopRemote()
+
+
+class _RemoteSpan:
+    """A receiver-side span parented under a propagated ctx; its own
+    pre-minted id is available (``ctx()``) for the next hop while the
+    span is open. The clock starts at construction (``op_span``
+    constructs inside the ``with`` header, so the boundary is the
+    same)."""
+
+    __slots__ = ("name", "trace_id", "parent", "span_id", "attrs",
+                 "t0", "_lock")
+
+    def __init__(self, name: str, ctx: dict, attrs: dict) -> None:
+        self.name = name
+        self.trace_id = ctx["trace_id"]
+        self.parent = int(ctx.get("parent_span_id") or 0)
+        self.span_id = tracing.next_span_id()
+        self.attrs = attrs
+        self._lock = threading.Lock()
+        self.t0 = time.perf_counter()
+
+    def __enter__(self) -> "_RemoteSpan":
+        return self
+
+    def ctx(self) -> dict:
+        return {"trace_id": self.trace_id,
+                "parent_span_id": self.span_id}
+
+    def set_attr(self, **attrs) -> None:
+        with self._lock:
+            self.attrs.update(attrs)
+
+    def __exit__(self, *exc) -> bool:
+        with self._lock:
+            attrs = dict(self.attrs)
+        tracing.add_span(
+            self.name, self.t0, time.perf_counter(),
+            trace_id=self.trace_id, parent=self.parent,
+            span_id=self.span_id, **attrs,
+        )
+        return False
+
+
+def op_span(msg: Any, name: str, **attrs):
+    """Context manager for a received wire op: a span under the op's
+    propagated ctx when the plane is on and the message carries one;
+    the shared no-op otherwise (one bool check + one dict probe)."""
+    ctx = ctx_of(msg)
+    if ctx is None:
+        return _NOOP_REMOTE
+    return _RemoteSpan(name, ctx, attrs)
+
+
+# ------------------------------------------------------ metrics delta codec
+def snapshot_delta(prev: Optional[dict], cur: dict) -> Optional[dict]:
+    """The bounded wire delta between two ``metrics_report/v1``
+    snapshots: counter diffs, changed gauges, and histogram bucket-count
+    diffs (exact — folding deltas reproduces the totals bitwise, which
+    is the reconciliation contract). None when nothing changed."""
+    counters: Dict[str, Any] = {}
+    pc = (prev or {}).get("counters") or {}
+    for name, v in (cur.get("counters") or {}).items():
+        d = v - pc.get(name, 0)
+        if d:
+            counters[name] = d
+    gauges: Dict[str, float] = {}
+    pg = (prev or {}).get("gauges") or {}
+    for name, v in (cur.get("gauges") or {}).items():
+        if name not in pg or pg[name] != v:
+            gauges[name] = v
+    histograms: Dict[str, dict] = {}
+    ph = (prev or {}).get("histograms") or {}
+    for name, h in (cur.get("histograms") or {}).items():
+        prev_h = ph.get(name)
+        dcount = int(h.get("count", 0)) - int((prev_h or {}).get(
+            "count", 0))
+        if dcount == 0:
+            continue
+        prev_counts = (prev_h or {}).get("counts") or []
+        counts = list(h.get("counts") or [])
+        if len(prev_counts) == len(counts):
+            counts = [c - p for c, p in zip(counts, prev_counts)]
+        histograms[name] = {
+            "buckets_le": list(h.get("buckets_le") or []),
+            "counts": counts,
+            "count": dcount,
+            "sum": float(h.get("sum", 0.0)) - float((prev_h or {}).get(
+                "sum", 0.0)),
+            "min": h.get("min"),
+            "max": h.get("max"),
+        }
+    if not (counters or gauges or histograms):
+        return None
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+def _fold_delta(acc: dict, delta: dict) -> None:
+    """Fold one wire delta into an accumulator (the snapshot_delta
+    inverse). Caller owns the accumulator's locking."""
+    for name, d in (delta.get("counters") or {}).items():
+        acc["counters"][name] = acc["counters"].get(name, 0) + d
+    for name, v in (delta.get("gauges") or {}).items():
+        acc["gauges"][name] = v
+    for name, h in (delta.get("histograms") or {}).items():
+        cur = acc["histograms"].get(name)
+        if cur is None:
+            acc["histograms"][name] = {
+                "buckets_le": list(h.get("buckets_le") or []),
+                "counts": list(h.get("counts") or []),
+                "count": int(h.get("count", 0)),
+                "sum": float(h.get("sum", 0.0)),
+                "min": h.get("min"),
+                "max": h.get("max"),
+            }
+            continue
+        counts = h.get("counts") or []
+        if len(counts) == len(cur["counts"]):
+            cur["counts"] = [a + b for a, b in zip(cur["counts"], counts)]
+        cur["count"] += int(h.get("count", 0))
+        cur["sum"] += float(h.get("sum", 0.0))
+        hmin, hmax = h.get("min"), h.get("max")
+        if hmin is not None and (cur["min"] is None or hmin < cur["min"]):
+            cur["min"] = hmin
+        if hmax is not None and (cur["max"] is None or hmax > cur["max"]):
+            cur["max"] = hmax
+
+
+def _empty_acc() -> dict:
+    return {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def _acc_to_report(acc: dict) -> dict:
+    """An accumulator rendered as a ``metrics_report/v1`` document
+    (histograms regain coarse p50/p95/p99 via bucket interpolation)."""
+    histograms: Dict[str, dict] = {}
+    for name, h in sorted(acc["histograms"].items()):
+        snap = {k: (list(v) if isinstance(v, list) else v)
+                for k, v in h.items()}
+        for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            val, _n = _delta_hist_quantile(None, snap, q)
+            snap[label] = 0.0 if val is None else val
+        histograms[name] = snap
+    return {
+        "schema": METRICS_REPORT_SCHEMA,
+        "counters": dict(sorted(acc["counters"].items())),
+        "gauges": dict(sorted(acc["gauges"].items())),
+        "histograms": histograms,
+    }
+
+
+# ------------------------------------------------------------- clock sync
+def estimate_offset(samples) -> Optional[Tuple[float, float]]:
+    """Midpoint clock-offset estimate from request/response round
+    trips. ``samples`` is an iterable of ``(t_send, t_server, t_recv)``
+    — send/receive stamped on the LOCAL clock, the server stamp on the
+    REMOTE clock. Each sample bounds the offset (remote - local) within
+    ±rtt/2 of its midpoint estimate; the minimum-rtt sample wins.
+    Returns ``(offset_s, err_s)`` or None without a usable sample."""
+    best: Optional[Tuple[float, float]] = None
+    for t_send, t_server, t_recv in samples:
+        if t_server is None:
+            continue
+        rtt = float(t_recv) - float(t_send)
+        if rtt < 0:
+            continue
+        off = float(t_server) - 0.5 * (float(t_send) + float(t_recv))
+        err = 0.5 * rtt
+        if best is None or err < best[1]:
+            best = (off, err)
+    return best
+
+
+class ClockSync:
+    """Bounded accumulator of beat round-trip samples with the min-RTT
+    midpoint estimate (offset = remote clock − local clock)."""
+
+    def __init__(self, cap: int = 64) -> None:
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=max(int(cap), 4))
+
+    def add(self, t_send: float, t_server: Any, t_recv: float) -> None:
+        if not isinstance(t_server, (int, float)):
+            return
+        with self._lock:
+            self._samples.append(
+                (float(t_send), float(t_server), float(t_recv))
+            )
+
+    def estimate(self) -> Optional[dict]:
+        with self._lock:
+            samples = list(self._samples)
+        best = estimate_offset(samples)
+        if best is None:
+            return None
+        return {"offset_s": best[0], "err_s": best[1],
+                "samples": len(samples)}
+
+
+# --------------------------------------------------------- worker side
+class WorkerObs:
+    """Everything one worker process attaches to its beats: the bounded
+    metrics delta, newly completed spans (watermarked by span id, so
+    nothing ships twice), devtime MFU totals, and its current clock-
+    offset estimate. The final (``bye``) attachment additionally
+    carries the worker's full counter totals — the coordinator's exact
+    reconciliation target — plus the tail of its flight ring, so a
+    short-lived worker is never observability-invisible."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None
+                 ) -> None:
+        self._reg = registry if registry is not None \
+            else _metrics.get_registry()
+        self._lock = threading.Lock()
+        self._prev: Optional[dict] = None
+        self._last_span = 0
+        self._clock = ClockSync()
+
+    def clock_sample(self, t_send: float, t_server: Any,
+                     t_recv: float) -> None:
+        with self._lock:
+            self._clock.add(t_send, t_server, t_recv)
+
+    def _new_spans(self, budget: int) -> List[dict]:
+        fresh = [r for r in tracing.spans()
+                 if r["span"] > self._last_span]
+        fresh.sort(key=lambda r: r["span"])
+        take = fresh[:max(min(budget, _max_spans()), 0)]
+        if take:
+            self._last_span = take[-1]["span"]
+        return [
+            {"name": r["name"], "ts": r["ts"], "dur": r["dur"],
+             "tid": r["tid"], "trace": r["trace"], "span": r["span"],
+             "parent": r["parent"], "attrs": dict(r["attrs"])}
+            for r in take
+        ]
+
+    def attachment(self, final: bool = False) -> dict:
+        """One beat attachment, size-capped at TMR_FLEET_OBS_BEAT_BYTES:
+        spans are dropped first (they stay queued for the next beat —
+        the watermark only advances past shipped spans); a metrics delta
+        that cannot fit is rolled back (the next beat re-diffs it) and
+        the attachment ships ``truncated`` so the coordinator counts it
+        instead of silently losing the window."""
+        cap = _beat_bytes()
+        with self._lock:
+            snap = self._reg.snapshot()
+            delta = snapshot_delta(self._prev, snap)
+            doc: Dict[str, Any] = {
+                "v": 1,
+                "pid": os.getpid(),
+                "metrics": delta,
+                "mfu": devtime.totals(),
+                "clock": self._clock.estimate(),
+            }
+            if final:
+                doc["final"] = True
+                doc["totals"] = dict(snap.get("counters") or {})
+                if flight_enabled():
+                    doc["flight"] = get_recorder().snapshot()[-32:]
+            base = len(json.dumps(doc))
+            if base > cap:
+                # even span-less the attachment is over budget: roll the
+                # delta back so its window ships whole on a later beat
+                doc.pop("metrics", None)
+                doc["truncated"] = True
+                return doc
+            self._prev = snap
+            spans = self._new_spans(_max_spans())
+            shipped: List[dict] = []
+            budget = cap - base - 16  # the "spans" key + brackets
+            for rec in spans:
+                need = len(json.dumps(rec)) + 2
+                if need > budget:
+                    # unshipped spans wait for the next beat
+                    self._last_span = min(self._last_span,
+                                          rec["span"] - 1)
+                    break
+                shipped.append(rec)
+                budget -= need
+            if shipped or final:
+                doc["spans"] = shipped
+            return doc
+
+
+# ---------------------------------------------------- coordinator side
+class FleetMetrics:
+    """Coordinator-side rollup: per-worker accumulators folded from
+    beat deltas, the fleet-wide merge summed across them on demand, and
+    the beat-attachment error count (truncated/unparseable attachments
+    count here — and in the process registry as
+    ``fleet.obs_beat_errors`` — instead of dropping the beat)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._workers: Dict[str, dict] = {}
+        self._finals: Dict[str, dict] = {}
+        self._errors = 0
+
+    def fold(self, wid: str, delta: Any) -> bool:
+        if not isinstance(delta, dict):
+            self.count_error()
+            return False
+        with self._lock:
+            acc = self._workers.setdefault(str(wid), _empty_acc())
+            try:
+                _fold_delta(acc, delta)
+            except Exception:
+                bad = True
+            else:
+                bad = False
+        if bad:
+            self.count_error()
+            return False
+        return True
+
+    def set_final(self, wid: str, totals: Any) -> None:
+        if isinstance(totals, dict):
+            with self._lock:
+                self._finals[str(wid)] = dict(totals)
+
+    def count_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+        _metrics.counter("fleet.obs_beat_errors").inc()
+
+    @property
+    def errors(self) -> int:
+        with self._lock:
+            return self._errors
+
+    def per_worker(self) -> Dict[str, dict]:
+        with self._lock:
+            return {wid: _acc_to_report(acc)
+                    for wid, acc in self._workers.items()}
+
+    def finals(self) -> Dict[str, dict]:
+        with self._lock:
+            return {wid: dict(t) for wid, t in self._finals.items()}
+
+    def merged(self) -> dict:
+        with self._lock:
+            total = _empty_acc()
+            for acc in self._workers.values():
+                _fold_delta(total, {
+                    "counters": acc["counters"],
+                    "gauges": {},  # last-write gauges do not sum
+                    "histograms": acc["histograms"],
+                })
+        return _acc_to_report(total)
+
+    def reconcile(self) -> dict:
+        """sum-of-deltas vs the final full snapshots: every counter of
+        every worker that flushed a final total must match its folded
+        accumulator EXACTLY (missing finals — a killed worker — are
+        reported, not silently skipped)."""
+        with self._lock:
+            workers = {wid: dict(acc["counters"])
+                       for wid, acc in self._workers.items()}
+            finals = {wid: dict(t) for wid, t in self._finals.items()}
+        mismatches: List[dict] = []
+        checked = 0
+        for wid, totals in finals.items():
+            folded = workers.get(wid, {})
+            for name in sorted(set(totals) | set(folded)):
+                checked += 1
+                if totals.get(name, 0) != folded.get(name, 0):
+                    mismatches.append({
+                        "worker": wid, "counter": name,
+                        "final": totals.get(name, 0),
+                        "folded": folded.get(name, 0),
+                    })
+        return {
+            "exact": not mismatches and bool(finals),
+            "counters_checked": checked,
+            "workers_with_finals": sorted(finals),
+            "workers_without_finals": sorted(
+                set(workers) - set(finals)
+            ),
+            "mismatches": mismatches[:16],
+        }
+
+
+class FleetHealthWatch:
+    """The PR 8 detector discipline over the beat-merged registry.
+    ``observe`` is one pass: every rate/quantile is computed on the
+    window since the previous pass, baselines are rolling medians that
+    never ingest their own firing window (no self-poisoning), and each
+    (kind, worker) fires at most once per pass — ``beat_gap``
+    additionally latches per worker until the worker beats again, so a
+    dead worker is one anomaly, not one per pass."""
+
+    def __init__(self, *,
+                 outlier_factor: float = 4.0,
+                 min_window_requests: int = 8,
+                 skew_factor: float = 2.0,
+                 min_window_total: int = 24,
+                 mfu_drop: float = 0.5,
+                 beat_gap_factor: float = 4.0,
+                 history: int = 8,
+                 latency_histogram: str = "serve.request_latency_s"):
+        self.outlier_factor = float(outlier_factor)
+        self.min_window_requests = int(min_window_requests)
+        self.skew_factor = float(skew_factor)
+        self.min_window_total = int(min_window_total)
+        self.mfu_drop = float(mfu_drop)
+        self.beat_gap_factor = float(beat_gap_factor)
+        self.latency_histogram = latency_histogram
+        self._lock = threading.Lock()
+        self._prev_hists: Dict[str, dict] = {}
+        self._prev_mfu: Optional[dict] = None
+        self._flops_hist: deque = deque(maxlen=max(int(history), 2))
+        self._gap_latched: set = set()
+        self._recent: deque = deque(maxlen=64)
+
+    def observe(self, per_worker: Dict[str, dict], *,
+                beats: Optional[Dict[str, float]] = None,
+                hb_interval_s: float = 2.5,
+                now: Optional[float] = None,
+                held: Optional[Dict[str, list]] = None,
+                mfu_by_worker: Optional[Dict[str, dict]] = None,
+                live: Optional[list] = None) -> List[dict]:
+        """One detector pass. ``per_worker`` maps worker id to its
+        folded metrics_report accumulator; ``beats`` to the monotonic
+        time of its last beat; ``held`` to the partitions it holds
+        (anomaly evidence); ``mfu_by_worker`` to its devtime totals;
+        ``live`` lists workers that have NOT cleanly left (beat_gap
+        candidates). Returns the anomalies fired this pass."""
+        held = held or {}
+        fired: List[dict] = []
+        with self._lock:
+            # per-worker latency windows (delta p95 + window count)
+            windows: Dict[str, Tuple[float, int]] = {}
+            for wid, doc in per_worker.items():
+                hist = (doc.get("histograms") or {}).get(
+                    self.latency_histogram)
+                if hist is None:
+                    continue
+                p95, n = _delta_hist_quantile(
+                    self._prev_hists.get(wid), hist, 0.95
+                )
+                if p95 is not None and n >= self.min_window_requests:
+                    windows[wid] = (p95, n)
+                self._prev_hists[wid] = {
+                    "buckets_le": list(hist.get("buckets_le") or []),
+                    "counts": list(hist.get("counts") or []),
+                }
+
+            # worker_outlier_latency: the worst worker's window p95 vs
+            # the median of its peers (cross-sectional — no warmup
+            # passes needed, one slow worker in a healthy fleet fires
+            # immediately)
+            if len(windows) >= 2:
+                worst = max(windows, key=lambda w: windows[w][0])
+                peers = [windows[w][0] for w in windows if w != worst]
+                base = _median(peers)
+                p95, n = windows[worst]
+                if base > 0 and p95 > self.outlier_factor * base:
+                    fired.append(_anomaly(
+                        "worker_outlier_latency",
+                        f"worker {worst!r} window p95 "
+                        f"{p95 * 1000:.1f} ms vs peer median "
+                        f"{base * 1000:.1f} ms (factor "
+                        f"{self.outlier_factor}) over {n} requests",
+                        worker=worst, p95_s=p95, peer_median_s=base,
+                        factor=self.outlier_factor, requests=n,
+                        partitions=list(held.get(worst, [])),
+                    ))
+
+            # partition_skew: one worker drawing far more than its fair
+            # share of the window's traffic
+            total = sum(n for _, n in windows.values())
+            if len(windows) >= 2 and total >= self.min_window_total:
+                hot = max(windows, key=lambda w: windows[w][1])
+                share = windows[hot][1] / total
+                fair = 1.0 / len(windows)
+                # cap below 1 so the bound stays reachable in small
+                # fleets (skew_factor x fair exceeds 1 at <= factor
+                # workers)
+                if share > min(self.skew_factor * fair, 0.95):
+                    fired.append(_anomaly(
+                        "partition_skew",
+                        f"worker {hot!r} served {share:.0%} of the "
+                        f"window ({windows[hot][1]}/{total} requests) "
+                        f"vs fair share {fair:.0%} (factor "
+                        f"{self.skew_factor})",
+                        worker=hot, share=share, fair_share=fair,
+                        factor=self.skew_factor,
+                        requests=windows[hot][1], total=total,
+                        partitions=list(held.get(hot, [])),
+                    ))
+
+            # fleet_mfu_drop: cluster-summed achieved FLOP/s window vs
+            # a rolling baseline (the flight.py mfu_drop discipline,
+            # fleet-wide)
+            if mfu_by_worker:
+                totals = {
+                    "flops": sum(float((t or {}).get("flops", 0.0))
+                                 for t in mfu_by_worker.values()),
+                    "device_s": sum(
+                        float((t or {}).get("device_s", 0.0))
+                        for t in mfu_by_worker.values()
+                    ),
+                }
+                if self._prev_mfu is not None:
+                    dflops = totals["flops"] - self._prev_mfu["flops"]
+                    ddev = totals["device_s"] - \
+                        self._prev_mfu["device_s"]
+                    if ddev > 0 and dflops > 0:
+                        achieved = dflops / ddev
+                        dropped = False
+                        if self._flops_hist:
+                            base = _median(list(self._flops_hist))
+                            if base > 0 and \
+                                    achieved < self.mfu_drop * base:
+                                dropped = True
+                                fired.append(_anomaly(
+                                    "fleet_mfu_drop",
+                                    f"fleet window achieved "
+                                    f"{achieved / 1e12:.4f} TFLOP/s vs "
+                                    f"rolling baseline "
+                                    f"{base / 1e12:.4f} (drop factor "
+                                    f"{self.mfu_drop}) across "
+                                    f"{len(mfu_by_worker)} workers",
+                                    achieved_flops_per_s=achieved,
+                                    baseline_flops_per_s=base,
+                                    drop_factor=self.mfu_drop,
+                                    workers=len(mfu_by_worker),
+                                ))
+                        if not dropped:  # no self-poisoning
+                            self._flops_hist.append(achieved)
+                self._prev_mfu = totals
+
+            # beat_gap: a live worker whose last beat is older than the
+            # gap bound — latched per worker so a dead worker is ONE
+            # anomaly until it beats again
+            if beats is not None:
+                t_now = time.monotonic() if now is None else float(now)
+                bound = self.beat_gap_factor * max(
+                    float(hb_interval_s), 1e-3
+                )
+                candidates = (live if live is not None
+                              else list(beats))
+                for wid in candidates:
+                    last = beats.get(wid)
+                    if last is None:
+                        continue
+                    gap = t_now - float(last)
+                    if gap <= bound:
+                        self._gap_latched.discard(wid)
+                        continue
+                    if wid in self._gap_latched:
+                        continue
+                    self._gap_latched.add(wid)
+                    fired.append(_anomaly(
+                        "beat_gap",
+                        f"worker {wid!r} last beat {gap:.2f}s ago "
+                        f"(bound {bound:.2f}s = {self.beat_gap_factor}"
+                        f" x {hb_interval_s}s beat interval)",
+                        worker=wid, gap_s=round(gap, 3),
+                        bound_s=round(bound, 3),
+                        partitions=list(held.get(wid, [])),
+                    ))
+            self._recent.extend(fired)
+        for rec in fired:
+            _flight_record("anomaly", **{k: v for k, v in rec.items()
+                                         if k != "schema"})
+        return fired
+
+    def recent(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._recent]
+
+
+class FleetObs:
+    """The coordinator-side plane: folds beat attachments (metrics
+    deltas, spans, clocks, MFU totals, final flushes), tracks beat
+    liveness, runs the :class:`FleetHealthWatch`, and stitches the
+    cluster timeline. One instance per coordinator, created only when
+    the plane is enabled — a disabled coordinator holds None and pays
+    one ``is None`` check per site."""
+
+    def __init__(self, *, hb_interval_s: float = 2.5,
+                 watch: Optional[FleetHealthWatch] = None,
+                 span_cap: int = 8192) -> None:
+        self.metrics = FleetMetrics()
+        self.watch = watch or FleetHealthWatch()
+        self.hb_interval_s = float(hb_interval_s)
+        self._lock = threading.Lock()
+        self._spans: Dict[str, deque] = {}
+        self._span_cap = max(int(span_cap), 64)
+        self._clock: Dict[str, dict] = {}
+        self._pids: Dict[str, int] = {}
+        self._mfu: Dict[str, dict] = {}
+        self._beats: Dict[str, float] = {}
+        self._beat_count: Dict[str, int] = {}
+        self._final: Dict[str, bool] = {}
+        self._flight: Dict[str, list] = {}
+
+    def note_beat(self, wid: str) -> None:
+        with self._lock:
+            self._beats[str(wid)] = time.monotonic()
+            self._beat_count[str(wid)] = \
+                self._beat_count.get(str(wid), 0) + 1
+
+    def fold(self, wid: str, attachment: Any,
+             final: bool = False) -> bool:
+        """Fold one beat/bye attachment. Malformed or truncated
+        attachments count as beat errors and fold nothing — the beat's
+        liveness half was already processed by the caller."""
+        wid = str(wid)
+        if not isinstance(attachment, dict) or \
+                attachment.get("truncated"):
+            self.metrics.count_error()
+            return False
+        try:
+            delta = attachment.get("metrics")
+            if delta is not None and \
+                    not self.metrics.fold(wid, delta):
+                return False
+            spans = attachment.get("spans")
+            pid = attachment.get("pid")
+            clock = attachment.get("clock")
+            mfu = attachment.get("mfu")
+            flight_tail = attachment.get("flight")
+            with self._lock:
+                if isinstance(spans, list):
+                    dq = self._spans.setdefault(
+                        wid, deque(maxlen=self._span_cap)
+                    )
+                    dq.extend(r for r in spans if isinstance(r, dict))
+                if isinstance(pid, int):
+                    self._pids[wid] = pid
+                if isinstance(clock, dict):
+                    self._clock[wid] = dict(clock)
+                if isinstance(mfu, dict):
+                    self._mfu[wid] = dict(mfu)
+                if isinstance(flight_tail, list):
+                    self._flight[wid] = [
+                        r for r in flight_tail if isinstance(r, dict)
+                    ][-32:]
+                if final or attachment.get("final"):
+                    self._final[wid] = True
+            if final or attachment.get("final"):
+                self.metrics.set_final(wid, attachment.get("totals"))
+        except Exception:
+            self.metrics.count_error()
+            return False
+        return True
+
+    def run_pass(self, *, live: Optional[list] = None,
+                 held: Optional[Dict[str, list]] = None) -> List[dict]:
+        with self._lock:
+            beats = dict(self._beats)
+            mfu = {w: dict(t) for w, t in self._mfu.items()}
+        return self.watch.observe(
+            self.metrics.per_worker(), beats=beats,
+            hb_interval_s=self.hb_interval_s, held=held,
+            mfu_by_worker=mfu or None, live=live,
+        )
+
+    def worker_state(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                wid: {
+                    "pid": self._pids.get(wid),
+                    "beats": self._beat_count.get(wid, 0),
+                    "spans": len(self._spans.get(wid, ())),
+                    "clock": (dict(self._clock[wid])
+                              if wid in self._clock else None),
+                    "mfu": (dict(self._mfu[wid])
+                            if wid in self._mfu else None),
+                    "final": bool(self._final.get(wid)),
+                }
+                for wid in set(self._beat_count) | set(self._final)
+            }
+
+    def state(self) -> dict:
+        """The ``state()["fleet_metrics"]`` attachment a coordinator
+        exposes when the plane is on."""
+        return {
+            "merged": self.metrics.merged(),
+            "workers": self.worker_state(),
+            "anomalies": self.watch.recent(),
+            "beat_errors": self.metrics.errors,
+        }
+
+    def tracks(self, local_label: str = "coordinator") -> List[dict]:
+        """Every process's span track, clock-corrected metadata
+        attached: the local process at offset 0 (it is the reference
+        clock — beat replies stamp ITS perf_counter) plus one track per
+        worker that shipped spans."""
+        out = [{
+            "pid": os.getpid(), "label": local_label,
+            "offset_s": 0.0, "err_s": 0.0,
+            "spans": tracing.spans(),
+        }]
+        with self._lock:
+            wids = sorted(self._spans)
+            for wid in wids:
+                clock = self._clock.get(wid) or {}
+                # worker offsets estimate coordinator − worker, so
+                # shifting worker stamps BY the offset lands them on
+                # the coordinator clock
+                out.append({
+                    "pid": self._pids.get(wid, 0),
+                    "label": wid,
+                    "offset_s": float(clock.get("offset_s") or 0.0),
+                    "err_s": float(clock.get("err_s") or 0.0),
+                    "spans": sorted(self._spans[wid],
+                                    key=lambda r: r.get("ts", 0.0)),
+                })
+        return out
+
+    def stitched(self, local_label: str = "coordinator") -> dict:
+        return stitch_chrome_traces(self.tracks(local_label))
+
+    def span_chains(self) -> Dict[str, List[dict]]:
+        """All known spans grouped by trace id (coordinator-local spans
+        plus everything workers shipped), each span annotated with its
+        process — the cross-process chain evidence."""
+        chains: Dict[str, List[dict]] = {}
+        for track in self.tracks():
+            for rec in track["spans"]:
+                tid = rec.get("trace") or ""
+                if not tid:
+                    continue
+                chains.setdefault(tid, []).append(
+                    {**rec, "proc": track["label"]}
+                )
+        return chains
+
+    def report(self) -> dict:
+        """The plane's half of a ``fleet_obs_report/v1`` (the probe
+        adds config/overhead/checks)."""
+        stitched = self.stitched()
+        return {
+            "workers": self.worker_state(),
+            "merged": self.metrics.merged(),
+            "per_worker": self.metrics.per_worker(),
+            "reconciliation": self.metrics.reconcile(),
+            "trace": {
+                "events": sum(
+                    1 for e in stitched["traceEvents"]
+                    if e.get("ph") == "X"
+                ),
+                "tracks": sum(
+                    1 for e in stitched["traceEvents"]
+                    if e.get("ph") == "M"
+                    and e.get("name") == "process_name"
+                ),
+                "monotone": tracks_monotone(stitched),
+            },
+            "beat_errors": self.metrics.errors,
+        }
+
+
+# ------------------------------------------------------------- stitching
+def stitch_chrome_traces(tracks: List[dict]) -> dict:
+    """Merge per-process span tracks into ONE Perfetto-loadable Chrome
+    trace. Each track is ``{"pid", "label", "offset_s", "err_s",
+    "spans"}`` with spans in the tracing.py record shape; every event's
+    timestamp is shifted by the track's clock offset onto the reference
+    clock, and the offset ± uncertainty is stamped into the process
+    name so the correction is legible in the UI. Input span order is
+    preserved per track (a constant per-process offset keeps a
+    monotone capture monotone — :func:`tracks_monotone` verifies)."""
+    events: List[dict] = []
+    used_pids: set = set()
+    for i, track in enumerate(tracks):
+        pid = int(track.get("pid") or (10_000 + i))
+        # two tracks may claim one pid (an in-process fleet: worker and
+        # coordinator share the interpreter) — each track must still be
+        # its own Perfetto process row, so collisions get synthetic pids
+        while pid in used_pids:
+            pid += 100_000
+        used_pids.add(pid)
+        off = float(track.get("offset_s") or 0.0)
+        err = float(track.get("err_s") or 0.0)
+        label = str(track.get("label") or f"proc{i}")
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"{label} (clock offset "
+                             f"{off * 1e3:+.3f}±{err * 1e3:.3f} ms)"},
+        })
+        for rec in track.get("spans") or ():
+            args = {"trace": rec.get("trace", ""),
+                    "span": rec.get("span", 0),
+                    "parent": rec.get("parent", 0),
+                    "proc": label}
+            args.update(rec.get("attrs") or {})
+            events.append({
+                "ph": "X",
+                "name": rec.get("name", "?"),
+                "pid": pid,
+                "tid": rec.get("tid", 0),
+                "ts": (float(rec.get("ts", 0.0)) + off) * 1e6,
+                "dur": float(rec.get("dur", 0.0)) * 1e6,
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def tracks_monotone(doc: dict) -> bool:
+    """True when every (pid, tid) track's ``X`` events appear in
+    non-decreasing corrected-timestamp order — the stitched-timeline
+    sanity contract after per-process offset correction."""
+    last: Dict[Tuple[int, int], float] = {}
+    for e in doc.get("traceEvents") or ():
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid", 0), e.get("tid", 0))
+        ts = float(e.get("ts", 0.0))
+        if key in last and ts < last[key] - 1e-6:
+            return False
+        last[key] = ts
+    return True

@@ -185,6 +185,15 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def next_span_id() -> int:
+    """Mint a span id WITHOUT recording anything — for spans whose id
+    must be advertised before they close (a fleet front door sends
+    ``parent_span_id`` to a worker while its own root span is still
+    open; obs/fleetobs.py). Ids are process-local: cross-process
+    consumers must key by (process, span)."""
+    return next(_SPAN_IDS)
+
+
 def configure(enabled: Optional[bool] = None,
               annotate: Optional[bool] = None,
               ring: Optional[int] = None) -> None:
@@ -281,10 +290,13 @@ def span(name: str, trace_id: Optional[str] = None, **attrs):
 
 def add_span(name: str, t0: float, t1: float,
              trace_id: Optional[str] = None, parent: int = 0,
-             **attrs) -> None:
+             span_id: Optional[int] = None, **attrs) -> None:
     """Record a complete event whose boundaries were stamped elsewhere
     (``time.perf_counter`` values) — queue-wait windows, batch-level
-    stages attributed per request. Does not touch the nesting stack."""
+    stages attributed per request. Does not touch the nesting stack.
+    ``span_id`` records under a pre-minted id (:func:`next_span_id`);
+    ``parent`` may be a remote process's span id (cross-process context
+    propagation parents receiver spans under the sender's id)."""
     if _ENABLED is None:
         _resolve_env()
     if not _ENABLED:
@@ -296,7 +308,7 @@ def add_span(name: str, t0: float, t1: float,
         "dur": max(t1 - t0, 0.0),
         "tid": b.tid,
         "trace": trace_id or "",
-        "span": next(_SPAN_IDS),
+        "span": next(_SPAN_IDS) if span_id is None else int(span_id),
         "parent": parent,
         "attrs": attrs,
     })

@@ -87,6 +87,7 @@ from tmr_tpu.diagnostics import (
     validate_elastic_report,
 )
 from tmr_tpu import obs
+from tmr_tpu.obs import fleetobs as _fleetobs
 from tmr_tpu.parallel.journal import (
     ShardJournal,
     StaleLeaseError,
@@ -307,6 +308,12 @@ class ElasticCoordinator:
         self._server_thread: Optional[threading.Thread] = None
         self._monitor_thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
+        # fleet observability plane (TMR_FLEET_OBS): None when off —
+        # instrumented ops below pay one `is None` check
+        self._fleetobs: Optional[_fleetobs.FleetObs] = (
+            _fleetobs.FleetObs(hb_interval_s=self.policy.hb_interval_s)
+            if _fleetobs.fleet_obs_enabled() else None
+        )
         if resume:
             for shard in self._shards:
                 entry = self.journal.done(os.path.basename(shard.path))
@@ -442,44 +449,75 @@ class ElasticCoordinator:
             return wait
         if self._svc.install(shard, epoch, wid) is None:
             return wait  # committed while we were firing faults
-        return {
+        grant = {
             "shard": shard.path,
             "index": shard.index,
             "epoch": epoch,
             "ttl_s": self.policy.lease_ttl_s,
             "hb_interval_s": self.policy.hb_interval_s,
         }
+        if self._fleetobs is not None:
+            # the lease grant is this protocol's front door: ONE trace
+            # id minted here follows the shard through every
+            # heartbeat/precommit/commit hop (instant root anchor span)
+            root = _fleetobs.root_span(
+                "elastic.grant", shard=os.path.basename(shard.path),
+                index=shard.index, epoch=epoch, worker=wid,
+            )
+            grant["ctx"] = root.ctx()
+            root.close()
+        return grant
 
     def _op_heartbeat(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
-        if not self._svc.heartbeat(wid, index, epoch):
-            return {"ok": False, "cause": "stale_epoch"}
-        return {"ok": True}
+        fo = self._fleetobs
+        if fo is None:
+            if not self._svc.heartbeat(wid, index, epoch):
+                return {"ok": False, "cause": "stale_epoch"}
+            return {"ok": True}
+        # liveness + rollup fold under the propagated lease trace; the
+        # reply stamps OUR clock for the worker's midpoint offset sample
+        with _fleetobs.op_span(msg, "elastic.heartbeat", worker=wid,
+                               index=index):
+            fo.note_beat(wid)
+            att = msg.get("obs")
+            if att is not None:
+                fo.fold(wid, att)
+            fresh = self._svc.heartbeat(wid, index, epoch)
+        if not fresh:
+            return {"ok": False, "cause": "stale_epoch",
+                    "obs_ts": time.perf_counter()}
+        return {"ok": True, "obs_ts": time.perf_counter()}
 
     def _op_precommit(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
-        with self._svc.lock:
-            if self._svc.current_lease(index, epoch, wid) is None:
-                self._svc.record_fence(index, wid, epoch, "precommit")
-                return {"ok": False, "cause": "stale_epoch"}
-            return {"ok": True}
+        with _fleetobs.op_span(msg, "elastic.precommit", worker=wid,
+                               index=index):
+            with self._svc.lock:
+                if self._svc.current_lease(index, epoch, wid) is None:
+                    self._svc.record_fence(index, wid, epoch,
+                                           "precommit")
+                    return {"ok": False, "cause": "stale_epoch"}
+                return {"ok": True}
 
     def _op_commit(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
         index, epoch = int(msg.get("index", -1)), int(msg.get("epoch", -1))
         entry = msg.get("entry")
-        with self._svc.lock:
-            if self._svc.current_lease(index, epoch, wid) is None \
-                    or not isinstance(entry, dict):
-                self._svc.record_fence(index, wid, epoch, "commit")
-                self._invalidate_stale_marker(index, epoch)
-                return {"ok": False, "cause": "stale_epoch"}
-            shard, _lease = self._svc.commit(wid, index, epoch)
-            shard.entry = entry
-            shard.images = int(entry.get("images", 0))
-            return {"ok": True}
+        with _fleetobs.op_span(msg, "elastic.commit", worker=wid,
+                               index=index):
+            with self._svc.lock:
+                if self._svc.current_lease(index, epoch, wid) is None \
+                        or not isinstance(entry, dict):
+                    self._svc.record_fence(index, wid, epoch, "commit")
+                    self._invalidate_stale_marker(index, epoch)
+                    return {"ok": False, "cause": "stale_epoch"}
+                shard, _lease = self._svc.commit(wid, index, epoch)
+                shard.entry = entry
+                shard.images = int(entry.get("images", 0))
+                return {"ok": True}
 
     def _invalidate_stale_marker(self, index: int, epoch: int) -> None:
         """A stale writer that slipped a marker to disk in the
@@ -522,6 +560,11 @@ class ElasticCoordinator:
         return {"ok": True, "drained": res["drained"]}
 
     def _op_bye(self, msg: dict) -> dict:
+        fo = self._fleetobs
+        if fo is not None and msg.get("obs") is not None:
+            # end-of-life flush: the leaver's final registry totals (+
+            # trace/flight tail) land before its state disappears
+            fo.fold(str(msg.get("worker")), msg.get("obs"), final=True)
         self._svc.bye(str(msg.get("worker")))
         return {"ok": True}
 
@@ -617,7 +660,7 @@ class ElasticCoordinator:
         """Mid-run introspection for probes/tests (NOT the report): held
         leases, live tallies, settled counts."""
         with self._svc.lock:
-            return {
+            out = {
                 "ok": True,
                 "settled": self._svc.settled_count,
                 "shards": len(self._shards),
@@ -643,6 +686,17 @@ class ElasticCoordinator:
                     for w in self._svc.workers.values()
                 },
             }
+        # outside the service lock; disabled state() stays
+        # byte-identical — no key at all
+        if self._fleetobs is not None:
+            out["fleet_metrics"] = self._fleetobs.state()
+        return out
+
+    @property
+    def fleet_obs(self) -> Optional[_fleetobs.FleetObs]:
+        """The coordinator-side observability plane (None when
+        TMR_FLEET_OBS is off)."""
+        return self._fleetobs
 
     def report(self) -> dict:
         """The final ``elastic_report/v1`` document (call after
@@ -744,7 +798,26 @@ class WorkerClient:
         )
         self._sock.settimeout(timeout)
         self._file = self._sock.makefile("rb")
+        # fleet observability plane (TMR_FLEET_OBS): metrics deltas +
+        # spans ride heartbeats, lease-grant ctx rides every fenced op
+        self._obs: Optional[_fleetobs.WorkerObs] = (
+            _fleetobs.WorkerObs()
+            if _fleetobs.fleet_obs_enabled() else None
+        )
+        self._lease_ctx: dict = {}  # (index, epoch) -> wire ctx
         self.config = self._call({"op": "hello"})
+
+    def _ctx_for(self, index: int, epoch: int) -> Optional[dict]:
+        if self._obs is None:
+            return None
+        with self._lock:
+            return self._lease_ctx.get((int(index), int(epoch)))
+
+    def _stamp_ctx(self, doc: dict, index: int, epoch: int) -> dict:
+        ctx = self._ctx_for(index, epoch)
+        if ctx is not None:
+            doc["ctx"] = ctx
+        return doc
 
     def _call(self, doc: dict) -> dict:
         doc = dict(doc)
@@ -757,34 +830,67 @@ class WorkerClient:
         return reply
 
     def lease(self) -> dict:
-        return self._call({"op": "lease"})
+        grant = self._call({"op": "lease"})
+        if self._obs is not None and grant.get("index") is not None:
+            ctx = _fleetobs.ctx_of(grant)
+            if ctx is not None:
+                with self._lock:
+                    self._lease_ctx[(int(grant["index"]),
+                                     int(grant["epoch"]))] = ctx
+        return grant
 
     def heartbeat(self, index: int, epoch: int) -> dict:
         """One beat on a fresh connection (never blocks the control
         channel; a killed worker's missing beats are the liveness
         signal)."""
-        return oneshot(self.address, {
+        doc = {
             "op": "heartbeat", "worker": self.worker_id,
             "index": index, "epoch": epoch,
-        })
+        }
+        w_obs = self._obs
+        t_send = 0.0
+        if w_obs is not None:
+            # bounded metrics/span delta + lease ctx ride the beat;
+            # the stamped reply clock feeds offset estimation
+            self._stamp_ctx(doc, index, epoch)
+            doc["obs"] = w_obs.attachment()
+            t_send = time.perf_counter()
+        reply = oneshot(self.address, doc)
+        if w_obs is not None:
+            w_obs.clock_sample(t_send, reply.get("obs_ts"),
+                               time.perf_counter())
+        return reply
 
     def precommit(self, index: int, epoch: int) -> dict:
-        return self._call({"op": "precommit", "index": index,
-                           "epoch": epoch})
+        return self._call(self._stamp_ctx(
+            {"op": "precommit", "index": index, "epoch": epoch},
+            index, epoch,
+        ))
 
     def commit(self, index: int, epoch: int, entry: dict) -> dict:
-        return self._call({"op": "commit", "index": index,
-                           "epoch": epoch, "entry": entry})
+        reply = self._call(self._stamp_ctx(
+            {"op": "commit", "index": index, "epoch": epoch,
+             "entry": entry},
+            index, epoch,
+        ))
+        if self._obs is not None:
+            with self._lock:
+                self._lease_ctx.pop((int(index), int(epoch)), None)
+        return reply
 
     def fail(self, index: int, epoch: int, causes: List[dict]) -> dict:
         return self._call({"op": "fail", "index": index, "epoch": epoch,
                            "causes": causes})
 
     def close(self) -> None:
+        bye = {"op": "bye", "worker": self.worker_id}
+        if self._obs is not None:
+            # end-of-life flush: final totals + remaining spans ride
+            # the bye so a short-lived worker still reconciles
+            bye["obs"] = self._obs.attachment(final=True)
         with self._lock:
             try:
-                _send_line(self._sock, {"op": "bye",
-                                        "worker": self.worker_id})
+                _send_line(self._sock, bye)
                 self._file.readline()
             except OSError:
                 pass
@@ -1107,6 +1213,9 @@ def run_worker(
             path = grant["shard"]
             index, epoch = int(grant["index"]), int(grant["epoch"])
             shard_base = os.path.basename(path)
+            grant_ctx = _fleetobs.ctx_of(grant)
+            t_run0 = time.perf_counter() if grant_ctx is not None \
+                else 0.0
             journal.set_lease(index, epoch)
             if hasattr(encode_stats_fn, "context"):
                 encode_stats_fn.context = shard_base
@@ -1135,6 +1244,14 @@ def run_worker(
                 )
             finally:
                 hb.stop(timeout=hb_interval + 5.0)
+                if grant_ctx is not None:
+                    # the worker's hop of the lease trace: the whole
+                    # shard run, parented under the grant anchor
+                    _fleetobs.add_remote_span(
+                        "elastic.worker.shard", t_run0,
+                        time.perf_counter(), grant_ctx,
+                        worker=worker_id, shard=shard_base, epoch=epoch,
+                    )
             rec = report.document()["shards"][0]
             if rec["status"] == "ok":
                 entry = journal.done(shard_base)

@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tmr_tpu.obs import fleetobs as _fleetobs
 from tmr_tpu.parallel.leases import (
     LeasePolicy,
     LeaseService,
@@ -493,26 +494,33 @@ class FeatureWorker:
             return None
         index = int(doc.get("partition", -1))
         epoch = int(doc.get("epoch", -1))
-        if not self.holds(index, epoch):
+        with _fleetobs.op_span(doc, "feature.extract",
+                               partition=index) as span:
+            if not self.holds(index, epoch):
+                with self._lock:
+                    self._counters["fenced"] += 1
+                span.set_attr(status="fenced")
+                return {"op": "extract", "ok": False,
+                        "status": "fenced"}
+            try:
+                image = unpack_array(doc["image"])
+                feats = self._extract(image)
+            except Exception as e:
+                with self._lock:
+                    self._counters["errors"] += 1
+                span.set_attr(status="error")
+                return {"op": "extract", "ok": False,
+                        "status": "error",
+                        "message": f"{type(e).__name__}: {e}"}
             with self._lock:
-                self._counters["fenced"] += 1
-            return {"op": "extract", "ok": False, "status": "fenced"}
-        try:
-            image = unpack_array(doc["image"])
-            feats = self._extract(image)
-        except Exception as e:
-            with self._lock:
-                self._counters["errors"] += 1
-            return {"op": "extract", "ok": False, "status": "error",
-                    "message": f"{type(e).__name__}: {e}"}
-        with self._lock:
-            self._counters["extracted"] += 1
-        reply = {"op": "extract", "ok": True, "status": "ok",
-                 "features": pack_array(feats)}
-        stamp = getattr(self._pred, "feature_stamp", None)
-        if callable(stamp):
-            reply["stamp"] = list(stamp())
-        return reply
+                self._counters["extracted"] += 1
+            span.set_attr(status="ok")
+            reply = {"op": "extract", "ok": True, "status": "ok",
+                     "features": pack_array(feats)}
+            stamp = getattr(self._pred, "feature_stamp", None)
+            if callable(stamp):
+                reply["stamp"] = list(stamp())
+            return reply
 
     def _extract(self, image: np.ndarray) -> np.ndarray:
         """One backbone pass (the tier's ONLY program): the same
@@ -708,10 +716,21 @@ class FeatureTierClient:
             if link is None:
                 self._bump("link_failures")
                 return None
-            reply = link.call({
+            doc = {
                 "op": "extract", "partition": index, "epoch": epoch,
                 "digest": str(digest), "image": pack_array(image),
-            })
+            }
+            root = _fleetobs.root_span("feature.fetch", size=int(size),
+                                       worker=wid)
+            if root is not None:
+                # the extract front door mints its own trace (the
+                # calling engine has no wire ctx to thread through)
+                doc["ctx"] = root.ctx()
+            try:
+                reply = link.call(doc)
+            finally:
+                if root is not None:
+                    root.close()
             if reply is None:
                 self._bump("link_failures")
                 return None

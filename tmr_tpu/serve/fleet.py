@@ -77,6 +77,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tmr_tpu import obs
+from tmr_tpu.obs import fleetobs as _fleetobs
 from tmr_tpu.parallel.leases import (
     LeasePolicy,
     LeaseService,
@@ -208,7 +209,7 @@ class _Inflight:
     """One routed request's front-door state."""
 
     __slots__ = ("rid", "fut", "partition", "epoch", "payload",
-                 "priority", "attempts", "t_submit", "deadline")
+                 "priority", "attempts", "t_submit", "deadline", "obs")
 
     def __init__(self, rid: str, fut: Future, partition: int,
                  payload: dict, priority: int,
@@ -222,6 +223,7 @@ class _Inflight:
         self.attempts = 0
         self.t_submit = time.monotonic()
         self.deadline = deadline
+        self.obs = None  # front-door root span when TMR_FLEET_OBS=1
 
 
 class _WorkerLink:
@@ -390,6 +392,12 @@ class ServeFleet:
                        self.policy.check_interval_s)
             if check_interval_s is None else float(check_interval_s)
         )
+        # fleet observability plane (TMR_FLEET_OBS): None when off —
+        # every instrumented site below pays one `is None` check
+        self._fleetobs: Optional[_fleetobs.FleetObs] = (
+            _fleetobs.FleetObs(hb_interval_s=self.policy.hb_interval_s)
+            if _fleetobs.fleet_obs_enabled() else None
+        )
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> Tuple[str, int]:
@@ -535,6 +543,14 @@ class ServeFleet:
                     None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1000.0,
                 )
+                if self._fleetobs is not None:
+                    # front door mints THE trace id: the root span is
+                    # pre-minted so its id rides the wire while it is
+                    # still open (closed in _terminal)
+                    rec.obs = _fleetobs.root_span(
+                        "fleet.submit", rid=rid, partition=index,
+                    )
+                    payload["ctx"] = rec.obs.ctx()
                 self._counters["offered"] += 1
                 self._inflight[rid] = rec
         if closed:
@@ -839,6 +855,8 @@ class ServeFleet:
                 rec.fut.set_result(outcome)
         elif not rec.fut.done():
             rec.fut.set_exception(outcome)
+        if rec.obs is not None:
+            rec.obs.close(outcome=bucket, attempts=rec.attempts)
         if obs.flight_enabled():
             obs.flight_record(
                 "fleet.request", rid=rec.rid, outcome=bucket,
@@ -933,6 +951,17 @@ class ServeFleet:
                 int(pending) if isinstance(pending, int) else 0,
             )
         worker = self._svc.worker_rec(wid)
+        fo = self._fleetobs
+        if fo is not None:
+            fo.note_beat(wid)
+            att = msg.get("obs")
+            if att is not None:
+                fo.fold(wid, att)
+            # the reply stamps OUR perf_counter so the worker can run
+            # midpoint clock-offset estimation over this round-trip
+            return {"ok": True, "stale": stale,
+                    "drained": worker.drained,
+                    "obs_ts": time.perf_counter()}
         return {"ok": True, "stale": stale, "drained": worker.drained}
 
     def _op_fail(self, msg: dict) -> dict:
@@ -943,6 +972,12 @@ class ServeFleet:
 
     def _op_bye(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
+        fo = self._fleetobs
+        if fo is not None and msg.get("obs") is not None:
+            # end-of-life flush: a clean leaver's final registry totals
+            # (+ flight/trace tail) land before its state disappears —
+            # short-lived workers are not observability-invisible
+            fo.fold(wid, msg.get("obs"), final=True)
         self._svc.bye(wid)
         # a clean leaver still releases its partitions for rebalance —
         # serve leases are held for the worker's lifetime, so a
@@ -1170,7 +1205,7 @@ class ServeFleet:
         """Mid-run introspection (NOT the report)."""
         with self._svc.lock:
             with self._lock:
-                return {
+                out = {
                     "ok": True,
                     "partitions": {
                         p.key: {
@@ -1191,6 +1226,44 @@ class ServeFleet:
                         dict(r) for r in self._svc.reassignments
                     ],
                 }
+        # outside every fleet lock (fleetobs locks are leaves, but the
+        # merged rollup is not worth holding the routing locks for);
+        # disabled state() stays byte-identical — no key at all
+        if self._fleetobs is not None:
+            out["fleet_metrics"] = self._fleetobs.state()
+        return out
+
+    @property
+    def fleet_obs(self) -> Optional[_fleetobs.FleetObs]:
+        """The coordinator-side observability plane (None when
+        TMR_FLEET_OBS is off) — probes reach the stitched timeline and
+        rollup through here."""
+        return self._fleetobs
+
+    def fleet_obs_pass(self) -> List[dict]:
+        """One caller-driven fleet HealthWatch pass over the beat-
+        merged registry (caller-driven — the monitor loop does NOT run
+        passes on its own, so probes/operators control the window
+        boundaries and at-most-once-per-pass firing is deterministic;
+        run it on whatever cadence state() is polled on). Returns the
+        anomalies fired this pass; [] when the plane is off."""
+        fo = self._fleetobs
+        if fo is None:
+            return []
+        with self._svc.lock:
+            with self._lock:
+                # beat_gap candidates are workers that have NOT cleanly
+                # left — a kill -9 sets dead (dirty close) but its
+                # silence is exactly what beat_gap must name, so only
+                # bye/drained leavers are excluded
+                live = [w.wid for w in self._svc.workers.values()
+                        if not w.bye and not w.drained]
+                held: Dict[str, list] = {}
+                for p in self._partitions:
+                    holder = self._svc.holder(p.index)
+                    if holder:
+                        held.setdefault(holder, []).append(p.key)
+        return fo.run_pass(live=live, held=held)
 
     def report(self) -> dict:
         """The fleet section of an ``elastic_serve_report/v1`` (the
@@ -1329,6 +1402,13 @@ class FleetWorker:
         self._hb_interval = float(
             self.config.get("hb_interval_s") or 2.5
         )
+        reg = getattr(engine, "metrics", None)
+        self._obs: Optional[_fleetobs.WorkerObs] = (
+            _fleetobs.WorkerObs(
+                reg if hasattr(reg, "snapshot") else None
+            )
+            if _fleetobs.fleet_obs_enabled() else None
+        )
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------- control
@@ -1400,10 +1480,23 @@ class FleetWorker:
     def _beat_once(self) -> dict:
         with self._lock:
             held = [[i, e] for i, e in self._held.items()]
-        reply = oneshot(self.coordinator, {
+        doc = {
             "op": "beat", "worker": self.worker_id, "held": held,
             "drain": self._drain_rate(), "pending": self._pending(),
-        })
+        }
+        w_obs = self._obs
+        t_send = 0.0
+        if w_obs is not None:
+            # metrics delta + fresh spans + clock estimate ride the
+            # liveness beat (bounded; old coordinators ignore the key)
+            doc["obs"] = w_obs.attachment()
+            t_send = time.perf_counter()
+        reply = oneshot(self.coordinator, doc)
+        if w_obs is not None:
+            # reply stamped with the coordinator clock -> one midpoint
+            # clock-offset sample per beat
+            w_obs.clock_sample(t_send, reply.get("obs_ts"),
+                               time.perf_counter())
         stale = reply.get("stale") or ()
         with self._lock:
             for index, epoch in stale:
@@ -1450,8 +1543,18 @@ class FleetWorker:
         epoch = int(msg.get("epoch", -1))
         base = {"op": "result", "rid": rid, "partition": index,
                 "epoch": epoch, "worker": self.worker_id}
+        ctx = _fleetobs.ctx_of(msg)
+        t_recv = time.perf_counter() if ctx is not None else 0.0
 
         def reply(**fields):
+            if ctx is not None:
+                # the worker's hop of the propagated trace: receipt to
+                # result-line, parented under the front door's root
+                _fleetobs.add_remote_span(
+                    "fleet.worker.serve", t_recv, time.perf_counter(),
+                    ctx, rid=rid, worker=self.worker_id,
+                    status=str(fields.get("status")),
+                )
             doc = dict(base)
             doc.update(fields)
             try:
@@ -1514,8 +1617,14 @@ class FleetWorker:
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop_event.set()
+        bye: Dict[str, Any] = {"op": "bye"}
+        if self._obs is not None:
+            # end-of-life flush: final metrics totals + remaining spans
+            # (+ flight tail) ride the bye so a short-lived worker's
+            # window still reconciles at the coordinator
+            bye["obs"] = self._obs.attachment(final=True)
         try:
-            self._call({"op": "bye"})
+            self._call(bye)
         except (ConnectionError, OSError):
             pass
         try:  # shutdown-first: unblocks any reader before the close

@@ -60,6 +60,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tmr_tpu.obs import fleetobs as _fleetobs
 from tmr_tpu.parallel.journal import StaleLeaseError  # noqa: F401 — re-export
 from tmr_tpu.parallel.leases import (
     LeasePolicy,
@@ -1155,24 +1156,30 @@ class GalleryFleetWorker:
     def _op_gsearch(self, doc: dict) -> dict:
         shard = int(doc.get("shard", -1))
         epoch = int(doc.get("epoch", -1))
-        if not self.holds(shard, epoch):
+        with _fleetobs.op_span(doc, "gallery.worker.gsearch",
+                               shard=shard) as span:
+            if not self.holds(shard, epoch):
+                with self._lock:
+                    self._counters["fenced"] += 1
+                span.set_attr(status="fenced")
+                return {"op": "gsearch", "ok": False,
+                        "status": "fenced"}
+            try:
+                image = unpack_array(doc["image"])
+                with self._lock:
+                    bank = self._banks.get(shard)
+                results = bank.search(image) if bank is not None else {}
+            except Exception as e:
+                with self._lock:
+                    self._counters["errors"] += 1
+                span.set_attr(status="error")
+                return {"op": "gsearch", "ok": False, "status": "error",
+                        "message": f"{type(e).__name__}: {e}"}
             with self._lock:
-                self._counters["fenced"] += 1
-            return {"op": "gsearch", "ok": False, "status": "fenced"}
-        try:
-            image = unpack_array(doc["image"])
-            with self._lock:
-                bank = self._banks.get(shard)
-            results = bank.search(image) if bank is not None else {}
-        except Exception as e:
-            with self._lock:
-                self._counters["errors"] += 1
-            return {"op": "gsearch", "ok": False, "status": "error",
-                    "message": f"{type(e).__name__}: {e}"}
-        with self._lock:
-            self._counters["searches"] += 1
-        return {"op": "gsearch", "ok": True, "status": "ok",
-                "shard": shard, "results": pack_results(results)}
+                self._counters["searches"] += 1
+            span.set_attr(status="ok")
+            return {"op": "gsearch", "ok": True, "status": "ok",
+                    "shard": shard, "results": pack_results(results)}
 
     def _op_gstate(self, doc: dict) -> dict:
         with self._lock:
@@ -1307,7 +1314,8 @@ class GalleryFleetClient:
         if link is not None:
             link.close()
 
-    def _fetch_shard(self, shard: int, image_doc: dict
+    def _fetch_shard(self, shard: int, image_doc: dict,
+                     ctx: Optional[dict] = None
                      ) -> Optional[Dict[str, dict]]:
         with self._lock:
             attempt = self._attempts.get(shard, 0)
@@ -1330,10 +1338,13 @@ class GalleryFleetClient:
             self._drop_link(wid)
             self._bump("link_failures")
             return None
-        reply = link.call({
+        doc = {
             "op": "gsearch", "shard": int(shard), "epoch": int(epoch),
             "image": image_doc,
-        })
+        }
+        if ctx is not None:
+            doc["ctx"] = ctx  # the search root's trace follows the hop
+        reply = link.call(doc)
         if reply is None:
             self._bump("link_failures")
             return None
@@ -1350,13 +1361,17 @@ class GalleryFleetClient:
         image_doc = pack_array(img)
         plan = self._fleet.shard_map()
         self._bump("searches")
+        # the gallery search front door mints ONE trace id for the
+        # whole fan-out; every shard hop parents under it
+        root = _fleetobs.root_span("gallery.search", shards=len(plan))
+        ctx = root.ctx() if root is not None else None
         results: Dict[str, dict] = {}
         for shard in sorted(plan):
             names = plan[shard]
             if not names:
                 continue
             self._bump("fanouts")
-            got = self._fetch_shard(shard, image_doc)
+            got = self._fetch_shard(shard, image_doc, ctx)
             if got is None:
                 self._bump("degraded_shards")
                 self._bump("degraded_patterns", len(names))
@@ -1373,6 +1388,8 @@ class GalleryFleetClient:
                 else:
                     self._bump("merged_patterns")
                     results[name] = dets
+        if root is not None:
+            root.close()
         return results
 
     def close(self) -> None:

@@ -470,6 +470,98 @@ def read_chaos_report(path: str) -> dict:
     }
 
 
+# ------------------------------------------------------------ fleet obs
+
+
+def read_fleet_obs_report(path: str) -> dict:
+    """Reduce a ``fleet_obs_report/v1`` document
+    (scripts/fleet_obs_probe.py output) to the rc-gating fields: the
+    cross-process span-chain completeness pin, the exact sum-of-deltas
+    metrics reconciliation, the stitched-timeline monotonicity after
+    clock-offset correction, the anomaly-exactness pins (slow worker,
+    beat gap, calm pass), and the <1% disabled-overhead bound.
+
+    Returns ``{"summary": ..., "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable fleet obs report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"fleet obs report is an error record: "
+                         f"{doc['error']}"}
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        return {"error": f"no checks section in {path}"}
+    workers = doc.get("workers") or {}
+    trace = doc.get("trace") or {}
+    recon = doc.get("reconciliation") or {}
+    overhead = doc.get("overhead") or {}
+    anomalies = doc.get("anomalies") or {}
+    chains = doc.get("chains") or {}
+    return {
+        "summary": {
+            "workers": len(workers) if isinstance(workers, dict)
+            else None,
+            "beats": sum(int(r.get("beats") or 0)
+                         for r in workers.values()
+                         if isinstance(r, dict))
+            if isinstance(workers, dict) else None,
+            "trace_events": trace.get("events"),
+            "trace_tracks": trace.get("tracks"),
+            "complete_chains": chains.get("complete"),
+            "counters_checked": recon.get("counters_checked"),
+            "beat_errors": doc.get("beat_errors"),
+            "anomaly_kinds": sorted({
+                rec.get("anomaly")
+                for recs in anomalies.values()
+                if isinstance(recs, list)
+                for rec in recs
+                if isinstance(rec, dict)
+            }),
+            "overhead_disabled_pct": overhead.get(
+                "overhead_disabled_pct"
+            ),
+        },
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "span_chain_complete": checks.get("span_chain_complete")
+            is True,
+            "metrics_reconciled": bool(
+                checks.get("metrics_reconciled") is True
+                and recon.get("exact") is True
+            ),
+            "stitched_monotone": bool(
+                checks.get("stitched_monotone") is True
+                and trace.get("monotone") is True
+            ),
+            "slow_worker_exact": checks.get("slow_worker_exact")
+            is True,
+            "beat_gap_exact": checks.get("beat_gap_exact") is True,
+            "calm_quiet": checks.get("calm_quiet") is True,
+            "overhead_ok": bool(
+                checks.get("overhead_ok") is True
+                and isinstance(overhead.get("overhead_disabled_pct"),
+                               (int, float))
+                and overhead["overhead_disabled_pct"] < 1.0
+            ),
+        },
+    }
+
+
 # ----------------------------------------------------------- serve sweep
 
 
