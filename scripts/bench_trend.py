@@ -33,6 +33,7 @@ from tmr_tpu.utils.bench_trend import (  # noqa: E402
     read_fleet_obs_report,
     read_fleet_report,
     read_gallery_report,
+    read_live_tune_report,
     read_serve_sweep,
     read_stream_report,
 )
@@ -105,7 +106,45 @@ def main(argv=None) -> int:
                          "worker and killed worker each fired exactly "
                          "their anomaly, the calm pass stayed quiet, "
                          "and the disabled-mode overhead is under 1%")
+    ap.add_argument("--live-tune", default=None, dest="live_tune",
+                    help="read a live_tune_report/v1 file "
+                         "(live_tune_probe output) instead of the "
+                         "BENCH history: one JSON line with the "
+                         "election summary; rc 1 unless disabled mode "
+                         "is bitwise-identical, the shadow fraction is "
+                         "under 1%% of steady-state device seconds, "
+                         "the device-seconds budget held, the faster "
+                         "candidate was promoted decisively and "
+                         "serves faster with zero hot-path cold "
+                         "compiles, the injected anomaly demoted with "
+                         "a recorded cause, the winner banks stayed "
+                         "generation-isolated, and the decision log "
+                         "replays to the same elections")
+    ap.add_argument("--max-carried-age-h", type=float, default=None,
+                    dest="max_carried_age_h",
+                    help="BENCH-history mode: flag carried rounds whose "
+                         "stale_hours exceed this bound (warn to stderr "
+                         "by default; rc 1 with --strict-carried)")
+    ap.add_argument("--strict-carried", action="store_true",
+                    dest="strict_carried",
+                    help="with --max-carried-age-h: a stale carried "
+                         "headline fails the run (rc 1) instead of "
+                         "warning")
     args = ap.parse_args(argv)
+
+    if args.live_tune:
+        doc = read_live_tune_report(args.live_tune)
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        if "error" in doc:
+            return 1
+        ck = doc["checks"]
+        # EVERY reduced check gates fail-closed — a probe that never
+        # exercised a phase reads as a failure, not a silent pass
+        return 0 if all(v is True for v in ck.values()) else 1
 
     if args.fleet_obs:
         doc = read_fleet_obs_report(args.fleet_obs)
@@ -203,7 +242,8 @@ def main(argv=None) -> int:
         return 0 if (ck["all_exact"] and ck["all_scaling_ok"]
                      and ck["all_warm"]) else 1
 
-    doc = collect_bench_trend(args.repo, threshold=args.threshold)
+    doc = collect_bench_trend(args.repo, threshold=args.threshold,
+                              max_carried_age_h=args.max_carried_age_h)
     problems = validate_bench_trend(doc)
     if problems:  # self-check: the emitted document must validate
         doc["validator_problems"] = problems
@@ -214,6 +254,16 @@ def main(argv=None) -> int:
     print(line)
     if "error" in doc:
         return 1
+    stale = doc.get("stale_carried") or ()
+    if stale:
+        # stdout stays the one JSON line; the staleness verdict is a
+        # human-facing warning unless --strict-carried arms the gate
+        for rec in stale:
+            print(f"[bench_trend] carried round {rec['label']!r} is "
+                  f"stale: {rec['stale_hours']}h > "
+                  f"{args.max_carried_age_h}h bound", file=sys.stderr)
+        if args.strict_carried:
+            return 1
     return 1 if doc["checks"]["regressed"] else 0
 
 

@@ -283,6 +283,7 @@ LOCK_FILES = (
     "tmr_tpu/serve/gallery.py",
     "tmr_tpu/serve/gallery_index.py",
     "tmr_tpu/serve/streams.py",
+    "tmr_tpu/autotune_live.py",
     "tmr_tpu/parallel/elastic.py",
     "tmr_tpu/parallel/leases.py",
     "tmr_tpu/utils/faults.py",

@@ -361,6 +361,21 @@ ENV_KNOBS = {
         "counted local fallback instead of queueing",
     "TMR_FEATURE_TIER_TIMEOUT_S": "feature-tier client: per-extract "
         "round-trip timeout before the counted local fallback",
+    # continuous in-production autotune (autotune_live.py)
+    "TMR_LIVE_TUNE": "continuous autotune master switch (0 = off, the "
+        "default: no sampling, no bank writes, serving stays "
+        "bitwise-identical — attach_live_tuner refuses)",
+    "TMR_LIVE_TUNE_SAMPLE": "continuous autotune: sampled fraction of "
+        "served batches shadow-measured (default 0.002; each sample "
+        "runs incumbent + candidate, keeping shadow work well under "
+        "1% of steady-state device seconds)",
+    "TMR_LIVE_TUNE_BUDGET": "continuous autotune: device-seconds token "
+        "budget for shadow execution — once spent, sampling stops "
+        "(counted) until the next election resets the ledger",
+    "TMR_LIVE_TUNE_WINS": "continuous autotune: consecutive decisive "
+        "(>10%) wins a candidate needs before promotion",
+    "TMR_LIVE_TUNE_BANK": "continuous autotune: winner-bank file path "
+        "override (default ~/.cache/tmr_tpu/winner_bank.json)",
     # bench.py driver knobs (consumed outside tmr_tpu/ but part of the
     # same surface; the parity test scans bench.py + scripts/ for these)
     "TMR_AUTOTUNE": "bench.py: run the autotune sweep (0 skips)",

@@ -1871,6 +1871,162 @@ def validate_analysis_report(doc: dict) -> List[str]:
     return problems
 
 
+#: schema tag of the per-device-generation winner bank file
+#: (tmr_tpu/autotune_live.py): one validated document holding live- and
+#: offline-elected formulation winners keyed
+#: ``device_kind|knob|geometry``, every entry stamped with the sweep
+#: revision it was measured under (autotune's ``_SWEEP_REV`` staleness
+#: discipline — a stale entry falls back to the offline cache instead of
+#: electing). Written only via atomicio.atomic_write.
+WINNER_BANK_SCHEMA = "winner_bank/v1"
+
+#: entry provenance vocabulary: "offline" = seeded from the autotune
+#: cache's sweep winners; "live" = elected (or restored by a demotion)
+#: from shadow-measured production traffic.
+WINNER_BANK_SOURCES = ("offline", "live")
+
+
+def validate_winner_bank(doc: dict) -> List[str]:
+    """Structural check of a winner_bank/v1 document; returns a list of
+    problems (empty == valid). Dependency-free like the other
+    validators — semantic checks that need autotune's variant sets
+    (winner membership, key/entry agreement) live in
+    ``autotune_live.load_bank``, which also degrades best-effort."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != WINNER_BANK_SCHEMA:
+        problems.append(
+            f"schema != {WINNER_BANK_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if not isinstance(doc.get("sweep_rev"), str) or not doc.get("sweep_rev"):
+        problems.append("sweep_rev: not a non-empty string")
+    if not isinstance(doc.get("ts"), (int, float)) \
+            or isinstance(doc.get("ts"), bool):
+        problems.append("ts: not a number")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        problems.append("entries: not a dict")
+        entries = {}
+    for key, entry in entries.items():
+        where = f"entries[{key!r}]"
+        if not isinstance(entry, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        for field in ("device_kind", "knob", "geometry", "winner",
+                      "sweep_rev"):
+            if not isinstance(entry.get(field), str):
+                problems.append(f"{where}.{field}: not a string")
+        if entry.get("source") not in WINNER_BANK_SOURCES:
+            problems.append(
+                f"{where}.source: bad source {entry.get('source')!r}"
+            )
+        if not isinstance(entry.get("wins"), int) \
+                or isinstance(entry.get("wins"), bool):
+            problems.append(f"{where}.wins: not an int")
+        if not isinstance(entry.get("ts"), (int, float)) \
+                or isinstance(entry.get("ts"), bool):
+            problems.append(f"{where}.ts: not a number")
+        per_item = entry.get("device_s_per_item")
+        if per_item is not None and (
+            not isinstance(per_item, dict) or not all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in per_item.values()
+            )
+        ):
+            problems.append(
+                f"{where}.device_s_per_item: not a dict of numbers"
+            )
+    return problems
+
+
+#: schema tag of the continuous-autotune probe/report document
+#: (scripts/live_tune_probe.py over tmr_tpu/autotune_live.py): the
+#: tuner's replayable decision log (every shadow measurement, oracle
+#: refusal, promotion, and demotion with cause), its shadow-budget
+#: accounting, and the probe's fail-closed checks — disabled-mode
+#: bitwise identity, <1% shadow fraction, promotion speedup with zero
+#: hot-path cold compiles, anomaly demotion, replay consistency.
+#: ``bench_trend.py --live-tune`` rc-gates on ``checks``.
+LIVE_TUNE_REPORT_SCHEMA = "live_tune_report/v1"
+
+#: closed decision-event vocabulary of the replayable log: "shadow" =
+#: one symmetric incumbent-vs-candidate measurement; "refusal" = the
+#: oracle rejected the candidate's result (arm disqualified);
+#: "promote" / "demote" = an election changed the serving formulation.
+LIVE_TUNE_EVENTS = ("shadow", "refusal", "promote", "demote")
+
+
+def validate_live_tune_report(doc: dict) -> List[str]:
+    """Structural check of a live_tune_report/v1 document; returns a
+    list of problems (empty == valid). An error record
+    ({"schema": ..., "error": str}) is contractually valid (the probe's
+    wedge path). Dependency-free like the other validators."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a dict: {type(doc).__name__}"]
+    if doc.get("schema") != LIVE_TUNE_REPORT_SCHEMA:
+        problems.append(
+            f"schema != {LIVE_TUNE_REPORT_SCHEMA}: {doc.get('schema')!r}"
+        )
+    if "error" in doc:
+        if not isinstance(doc["error"], str) or not doc["error"]:
+            problems.append("error: not a non-empty string")
+        return problems
+    if not isinstance(doc.get("device_kind"), str) \
+            or not doc.get("device_kind"):
+        problems.append("device_kind: not a non-empty string")
+    tuner = doc.get("tuner")
+    if not isinstance(tuner, dict):
+        problems.append("tuner: not a dict")
+    else:
+        for field in ("knob", "incumbent"):
+            if not isinstance(tuner.get(field), str) \
+                    or not tuner.get(field):
+                problems.append(f"tuner.{field}: not a non-empty string")
+        counters = tuner.get("counters")
+        if not isinstance(counters, dict) or not all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in counters.values()
+        ):
+            problems.append("tuner.counters: not a dict of numbers")
+        decisions = tuner.get("decisions")
+        if not isinstance(decisions, list):
+            problems.append("tuner.decisions: not a list")
+        else:
+            for i, rec in enumerate(decisions):
+                where = f"tuner.decisions[{i}]"
+                if not isinstance(rec, dict):
+                    problems.append(f"{where}: not a dict")
+                    continue
+                if rec.get("event") not in LIVE_TUNE_EVENTS:
+                    problems.append(
+                        f"{where}.event: bad event {rec.get('event')!r}"
+                    )
+                for field in ("knob", "arm"):
+                    if not isinstance(rec.get(field), str):
+                        problems.append(f"{where}.{field}: not a string")
+                if not isinstance(rec.get("ts"), (int, float)) \
+                        or isinstance(rec.get("ts"), bool):
+                    problems.append(f"{where}.ts: not a number")
+                if rec.get("event") == "demote" and (
+                    not isinstance(rec.get("cause"), str)
+                    or not rec.get("cause")
+                ):
+                    problems.append(
+                        f"{where}.cause: demote without a cause"
+                    )
+    summary = doc.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("summary: not a dict")
+    checks = doc.get("checks")
+    if not isinstance(checks, dict) or not checks or not all(
+        isinstance(v, bool) for v in checks.values()
+    ):
+        problems.append("checks: not a non-empty dict of booleans")
+    return problems
+
+
 #: registry bound: the attention gates are lru_cached (one record per
 #: config) but pallas_xcorr_ok's pre-cache refusals (kill-switch /
 #: backend / shape) record on EVERY call — a long-lived process that

@@ -96,6 +96,35 @@ class Predictor:
         #: storage state for the CURRENT param tree (TMR_QUANT_STORAGE)
         self._storage_cache: Optional[tuple] = None
 
+    def invalidate_compiled(self, kinds=None) -> int:
+        """Drop compiled programs so the next call re-traces under the
+        current env knobs — the live-autotune hot-swap hook
+        (autotune_live.apply_winner): a promoted formulation takes
+        effect without a restart, paying exactly the re-traces its knob
+        scope requires.
+
+        ``kinds`` is None for everything (formulation knobs every
+        program embeds — attention impls, quant numerics; the int8
+        storage cache drops too so TMR_QUANT_STORAGE re-resolves), or an
+        iterable of program kinds ("single", "multi", "multi_batched",
+        "backbone", "heads", "gallery", "gallery_heads") matching the
+        ``_compiled`` key convention: keys lead with their kind string
+        except the single-image program, whose key leads with the int
+        capacity. Returns the number of programs dropped."""
+        if kinds is None:
+            n = len(self._compiled)
+            self._compiled.clear()
+            self._storage_cache = None
+            return n
+        wanted = set(kinds)
+        drop = [
+            key for key in self._compiled
+            if (key[0] if isinstance(key[0], str) else "single") in wanted
+        ]
+        for key in drop:
+            del self._compiled[key]
+        return len(drop)
+
     # ------------------------------------------------------- int8 storage
     def _storage_state(self):
         """The offline-quantized param tree for TMR_QUANT_STORAGE=int8,

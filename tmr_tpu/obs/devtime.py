@@ -585,3 +585,21 @@ def attribute_call(fn, *args, iters: int = 3, rtt: float = 0.0) -> dict:
         "wall_s": dispatch[mid] + device[mid],
         "iters": len(dispatch),
     }
+
+
+def measure_once(fn, *args):
+    """One SYNCHRONOUS execution of ``fn(*args)``: returns
+    ``(out, wall_s)`` with ``block_until_ready`` inside the window.
+
+    The live-autotune shadow-measurement primitive (autotune_live):
+    unlike :func:`attribute_call` it pays no warmup iteration — a
+    shadow sample is a single production-shaped execution whose whole
+    cost counts against the tuner's device-seconds budget, compile
+    included (a candidate's first sample IS its warmup, and the tuner
+    compares like for like because the incumbent runs through the same
+    path)."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    return out, time.perf_counter() - t0

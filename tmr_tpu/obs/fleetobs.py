@@ -667,6 +667,15 @@ class FleetHealthWatch:
         self._flops_hist: deque = deque(maxlen=max(int(history), 2))
         self._gap_latched: set = set()
         self._recent: deque = deque(maxlen=64)
+        self._listeners: List[Any] = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(fired_records)`` to run after each observe pass
+        that fired anomalies (outside the watch lock, exceptions
+        swallowed) — mirrors HealthWatch.add_listener; the live-tune
+        fleet demotion hook attaches here."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def observe(self, per_worker: Dict[str, dict], *,
                 beats: Optional[Dict[str, float]] = None,
@@ -817,9 +826,15 @@ class FleetHealthWatch:
                         partitions=list(held.get(wid, [])),
                     ))
             self._recent.extend(fired)
+            listeners = list(self._listeners) if fired else ()
         for rec in fired:
             _flight_record("anomaly", **{k: v for k, v in rec.items()
                                          if k != "schema"})
+        for fn in listeners:
+            try:
+                fn(fired)
+            except Exception:
+                pass  # a reactor failure must never break the watch
         return fired
 
     def recent(self) -> List[dict]:

@@ -246,6 +246,15 @@ class HealthWatch:
         self._hit_hist: deque = deque(maxlen=history)
         self._flops_hist: deque = deque(maxlen=history)
         self._recent: deque = deque(maxlen=64)
+        self._listeners: List[Any] = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(fired_records)`` to run after each observe pass
+        that fired anomalies (outside the watch lock, exceptions
+        swallowed) — the live-tune demotion hook
+        (LiveTuner.observe_anomalies) and any future reactor."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def observe(self, snapshot: dict, *,
                 compile_events: Any = (),
@@ -408,9 +417,15 @@ class HealthWatch:
                 self._prev_mfu = dict(mfu_totals)
             self._prev = snapshot
             self._recent.extend(fired)
+            listeners = list(self._listeners) if fired else ()
         for rec in fired:
             record("anomaly", **{k: v for k, v in rec.items()
                                  if k != "schema"})
+        for fn in listeners:
+            try:
+                fn(fired)
+            except Exception:
+                pass  # a reactor failure must never break the watch
         return fired
 
     def recent(self) -> List[dict]:

@@ -222,6 +222,11 @@ class ServeEngine:
         #: default thresholds — probes inject their own HealthWatch
         #: (``watch=``) when they need deterministic ones
         self._watch = obs.HealthWatch() if watch is None else watch
+        #: continuous-autotune shadow tuner (tmr_tpu/autotune_live.py),
+        #: attached only under TMR_LIVE_TUNE=1 — None (the default)
+        #: keeps serving bitwise-identical: the hot path pays one
+        #: ``is None`` check per completed batch
+        self._tuner: Optional[Any] = None
         #: bounded admission (TMR_ADMIT* knobs; default disabled = the
         #: PR 3 unbounded behavior) and the adaptive degrade ladder
         #: (TMR_DEGRADE; default off). Probes pass their own controllers.
@@ -341,6 +346,25 @@ class ServeEngine:
         Detached (the default) nothing in the engine changes."""
         with self._lock:
             self._gallery = gallery
+
+    # ------------------------------------------------------ live autotune
+    def attach_live_tuner(self, tuner: Any) -> bool:
+        """Arm continuous autotune: completed batches are OFFERED to the
+        tuner (a sampling decision + bounded non-blocking enqueue; the
+        shadow execution runs on the tuner's own thread), and the
+        engine's health watch feeds it anomalies for demotion
+        (``HealthWatch.add_listener``). Refuses (returns False) unless
+        ``TMR_LIVE_TUNE=1`` — the default-off pin: a detached engine is
+        bitwise-identical to one that never heard of live tuning."""
+        from tmr_tpu import autotune_live
+
+        if not autotune_live.live_tune_enabled():
+            return False
+        with self._lock:
+            self._tuner = tuner
+        self._watch.add_listener(tuner.observe_anomalies)
+        tuner.start()
+        return True
 
     def search_gallery(self, image, **kw) -> Dict[str, dict]:
         """Match every registered pattern against one frame through
@@ -1135,6 +1159,20 @@ class ServeEngine:
                 self._admission.release(req)
                 req.fail(e)
                 self._m["errors"].inc(len(req.futures))
+        tuner = self._tuner
+        if tuner is not None:  # live autotune: offer AFTER every future
+            # resolved — a sampling decision + non-blocking enqueue, the
+            # shadow execution runs on the tuner's thread. Host-side
+            # request arrays, never the donated device buffers.
+            try:
+                tuner.offer(
+                    (staged.bucket,
+                     [(r.image, r.exemplars, r.k_real)
+                      for r in staged.requests]),
+                    None, items=len(staged.requests),
+                )
+            except Exception:
+                pass  # tuning must never fail a served batch
 
     # ------------------------------------------------------ error fallback
     def _isolate(self, requests: List[Request], exc: BaseException,
@@ -1321,8 +1359,11 @@ class ServeEngine:
                 return
             self._closed = True
             hb, self._heartbeat = self._heartbeat, None
+            tuner, self._tuner = self._tuner, None
         if hb is not None:
             hb.stop()
+        if tuner is not None:
+            tuner.stop()
         self._batcher.close()
         deadline = time.perf_counter() + max(timeout, 0.0)
         for t in self._threads:

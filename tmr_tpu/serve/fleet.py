@@ -398,6 +398,11 @@ class ServeFleet:
             _fleetobs.FleetObs(hb_interval_s=self.policy.hb_interval_s)
             if _fleetobs.fleet_obs_enabled() else None
         )
+        #: the coordinator's current live-autotune election (see
+        #: ``live_tune_pass``): None until one fires; pushed to workers
+        #: over the lease protocol's beat replies (epoch-guarded so a
+        #: re-delivered beat never re-applies an old election)
+        self._live_election: Optional[dict] = None
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> Tuple[str, int]:
@@ -951,6 +956,11 @@ class ServeFleet:
                 int(pending) if isinstance(pending, int) else 0,
             )
         worker = self._svc.worker_rec(wid)
+        # the live-autotune election rides the beat reply (extra keys
+        # are tolerated by every peer version); absent when none fired
+        with self._lock:
+            le = self._live_election
+        extra = {"live_tune": dict(le)} if le is not None else {}
         fo = self._fleetobs
         if fo is not None:
             fo.note_beat(wid)
@@ -961,8 +971,9 @@ class ServeFleet:
             # midpoint clock-offset estimation over this round-trip
             return {"ok": True, "stale": stale,
                     "drained": worker.drained,
-                    "obs_ts": time.perf_counter()}
-        return {"ok": True, "stale": stale, "drained": worker.drained}
+                    "obs_ts": time.perf_counter(), **extra}
+        return {"ok": True, "stale": stale, "drained": worker.drained,
+                **extra}
 
     def _op_fail(self, msg: dict) -> dict:
         wid = str(msg.get("worker"))
@@ -1265,6 +1276,84 @@ class ServeFleet:
                         held.setdefault(holder, []).append(p.key)
         return fo.run_pass(live=live, held=held)
 
+    # -------------------------------------------------------- live autotune
+    def live_tune_pass(self, knob: str, *,
+                       wins_needed: Optional[int] = None,
+                       geometry: str = "") -> Optional[dict]:
+        """One caller-driven fleet-wide election pass for ``knob``:
+        aggregate per-worker shadow-win/refusal counters from the
+        beat-merged registry (each worker's LiveTuner counts its
+        decisive wins into ``live_tune.win.<knob>=<arm>``, refusals into
+        ``live_tune.refusal.<knob>=<arm>`` — they ride the beat
+        attachments into ``state()["fleet_metrics"]`` with no new
+        plumbing), consult the fleet watch for demote anomalies, and
+        push the verdict to every worker over the next beat replies.
+
+        Demotion outranks promotion: a recent :data:`DEMOTE_ANOMALIES
+        <tmr_tpu.autotune_live.DEMOTE_ANOMALIES>`-kind fleet anomaly
+        while an election stands revokes it (``winner: None``,
+        ``demoted: True``, cause recorded) and disqualifies the demoted
+        arm from later passes. Otherwise the non-refused, non-demoted
+        arm whose summed wins reach ``wins_needed``
+        (``TMR_LIVE_TUNE_WINS``) becomes the election. Every verdict
+        bumps ``epoch`` so workers apply each at most once. Returns the
+        current election doc (None when nothing has fired); requires
+        the observability plane (TMR_FLEET_OBS) and TMR_LIVE_TUNE."""
+        from tmr_tpu import autotune_live
+
+        fo = self._fleetobs
+        if fo is None or not autotune_live.live_tune_enabled():
+            return None
+        need = autotune_live.default_wins() if wins_needed is None \
+            else max(int(wins_needed), 1)
+        counters = fo.metrics.merged().get("counters") or {}
+        win_prefix = f"live_tune.win.{knob}="
+        ref_prefix = f"live_tune.refusal.{knob}="
+        wins: Dict[str, int] = {}
+        refused: set = set()
+        for name, value in counters.items():
+            if name.startswith(win_prefix):
+                wins[name[len(win_prefix):]] = int(value)
+            elif name.startswith(ref_prefix) and value:
+                refused.add(name[len(ref_prefix):])
+        demote_cause = None
+        for rec in fo.watch.recent():
+            if rec.get("anomaly") in autotune_live.DEMOTE_ANOMALIES:
+                demote_cause = rec
+                break
+        with self._lock:
+            le = self._live_election
+            epoch = int(le["epoch"]) if le else 0
+            demoted_arms = set((le or {}).get("demoted_arms") or ())
+            standing = (le or {}).get("winner")
+            if demote_cause is not None and standing:
+                self._live_election = {
+                    "knob": str(knob), "winner": None,
+                    "demoted": True, "demoted_arm": standing,
+                    "cause": demote_cause.get("anomaly"),
+                    "evidence": dict(demote_cause.get("evidence") or {}),
+                    "geometry": str(geometry),
+                    "demoted_arms": sorted(demoted_arms | {standing}),
+                    "epoch": epoch + 1,
+                }
+                return dict(self._live_election)
+            best = None
+            for arm, n in sorted(wins.items()):
+                if arm in refused or arm in demoted_arms or n < need:
+                    continue
+                if best is None or n > wins[best]:
+                    best = arm
+            if best is not None and best != standing:
+                self._live_election = {
+                    "knob": str(knob), "winner": best,
+                    "demoted": False, "wins": wins[best],
+                    "geometry": str(geometry),
+                    "demoted_arms": sorted(demoted_arms),
+                    "epoch": epoch + 1,
+                }
+            return dict(self._live_election) if self._live_election \
+                else None
+
     def report(self) -> dict:
         """The fleet section of an ``elastic_serve_report/v1`` (the
         probe embeds one per phase; diagnostics._validate_fleet_section
@@ -1386,6 +1475,13 @@ class FleetWorker:
         self._drained = False
         self._coordinator_lost = False
         self._last_drain = (time.monotonic(), 0)
+        #: live-autotune election tracking: the highest election epoch
+        #: applied (beat replies re-deliver the current election every
+        #: interval — each must apply at most once) and the callback
+        #: that applies it locally (autotune_live.apply_winner over the
+        #: engine's predictor, typically)
+        self._live_epoch = 0
+        self._on_live_tune: Optional[Any] = None
         self._data_server = _DataServer((data_host, int(data_port)),
                                         _DataHandler)
         self._data_server.fleet_worker = self  # type: ignore[attr-defined]
@@ -1498,13 +1594,35 @@ class FleetWorker:
             w_obs.clock_sample(t_send, reply.get("obs_ts"),
                                time.perf_counter())
         stale = reply.get("stale") or ()
+        le = reply.get("live_tune")
+        apply_cb = None
         with self._lock:
             for index, epoch in stale:
                 if self._held.get(int(index)) == int(epoch):
                     del self._held[int(index)]
             if reply.get("drained"):
                 self._drained = True
+            # live-autotune election riding the beat reply: epoch-
+            # guarded (the coordinator re-sends the current election on
+            # every beat; each epoch applies at most once per worker)
+            if isinstance(le, dict) and \
+                    int(le.get("epoch") or 0) > self._live_epoch:
+                self._live_epoch = int(le["epoch"])
+                apply_cb = self._on_live_tune
+        if apply_cb is not None:
+            try:
+                apply_cb(dict(le))
+            except Exception:
+                pass  # applying an election must never kill the beat
         return reply
+
+    def on_live_tune(self, fn) -> None:
+        """Register ``fn(election_doc)`` to apply coordinator elections
+        delivered over beat replies (each epoch at most once) — wire it
+        to ``autotune_live.apply_winner`` over this worker's
+        predictor."""
+        with self._lock:
+            self._on_live_tune = fn
 
     def _drain_rate(self) -> float:
         """Requests/s from the engine's completed-counter delta between

@@ -968,18 +968,23 @@ def seed_store(seed: Dict[str, dict], path: Optional[str] = None) -> None:
     old seed or the new one, never a truncated file."""
     import json
 
+    from tmr_tpu.utils.atomicio import atomic_write
+
     path = path or os.environ.get("TMR_AUTOTUNE_SEED", SEED_PATH)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
+
+    def _write(f):
         json.dump(seed, f, indent=1, sort_keys=True)
         f.write("\n")
-    os.replace(tmp, path)
+
+    atomic_write(path, _write)
 
 
 def _cache_store(
     key: str, report: Dict[str, object], extra: Optional[Dict[str, str]] = None
 ) -> None:
     import json
+
+    from tmr_tpu.utils.atomicio import atomic_write
 
     path = os.environ.get("TMR_AUTOTUNE_CACHE", CACHE_PATH)
     try:
@@ -994,10 +999,11 @@ def _cache_store(
             **{k: v["picked"] for k, v in report.items()},
             **(extra or {}),
         }
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(cache, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)  # atomic: concurrent readers see old or new
+        # atomic + fsynced (atomicio): a LiveTuner promotion writing the
+        # winner bank while an offline sweep commits here must never
+        # leave either file torn — readers see old or new, never partial
+        atomic_write(path, lambda f: json.dump(
+            cache, f, indent=1, sort_keys=True))
     except OSError:
         pass  # caching is best-effort; the measured winners still export
 

@@ -38,19 +38,34 @@ def _round_entry(label: str, doc: Optional[dict]) -> dict:
     an outage record (bench.py ``carried: true`` / the pre-PR-1
     ``last_committed_live`` shape); "error" = no usable number."""
     rec = {"label": label, "value": None, "mfu": None, "source": "error",
-           "error": None}
+           "error": None, "stale_hours": None}
     if not isinstance(doc, dict):
         return rec
     rec["error"] = doc.get("error")
     carried_rec = doc.get("last_committed_live") or doc.get(
         "last_live_uncommitted"
     )
+
+    def _stale(*candidates):
+        # a carried headline's AGE travels with it: top-level
+        # stale_hours (bench.py's carried-promotion stamp) wins, the
+        # carried record's own stamp is the pre-promotion fallback
+        for v in candidates:
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+        return None
+
     value = doc.get("value")
     if value:
         rec["value"] = float(value)
         rec["mfu"] = doc.get("mfu")
         if doc.get("carried") or "error" in doc:
             rec["source"] = "carried"
+            rec["stale_hours"] = _stale(
+                doc.get("stale_hours"),
+                carried_rec.get("stale_hours")
+                if isinstance(carried_rec, dict) else None,
+            )
         else:
             rec["source"] = "measured"
         if rec["mfu"] is None and isinstance(carried_rec, dict):
@@ -61,6 +76,8 @@ def _round_entry(label: str, doc: Optional[dict]) -> dict:
         rec["value"] = float(carried_rec["value"])
         rec["mfu"] = carried_rec.get("mfu")
         rec["source"] = "carried"
+        rec["stale_hours"] = _stale(carried_rec.get("stale_hours"),
+                                    doc.get("stale_hours"))
     return rec
 
 
@@ -73,9 +90,16 @@ def _read_json(path: str) -> Optional[dict]:
 
 
 def collect_bench_trend(repo_dir: str,
-                        threshold: float = DEFAULT_THRESHOLD) -> dict:
+                        threshold: float = DEFAULT_THRESHOLD,
+                        max_carried_age_h: Optional[float] = None) -> dict:
     """Read ``BENCH_r*.json`` + the live bench files under ``repo_dir``
-    and return the ``bench_trend/v1`` document."""
+    and return the ``bench_trend/v1`` document.
+
+    ``max_carried_age_h`` arms the staleness audit: carried rounds whose
+    ``stale_hours`` exceed it (or carry no age stamp at all — fail
+    closed) are listed under ``stale_carried`` and flip the
+    ``carried_age_ok`` check. None (the default) adds neither key, so
+    existing consumers see the exact pre-audit shape."""
     rounds: List[dict] = []
     numbered = []
     for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
@@ -134,7 +158,7 @@ def collect_bench_trend(repo_dir: str,
             prev = (entry["label"], cur)
 
     measured = sum(1 for r in rounds if r["source"] == "measured")
-    return {
+    out = {
         "schema": BENCH_TREND_SCHEMA,
         "threshold": threshold,
         "rounds": rounds,
@@ -145,6 +169,18 @@ def collect_bench_trend(repo_dir: str,
             "regressed": bool(regressions),
         },
     }
+    if max_carried_age_h is not None:
+        stale = [
+            {"label": r["label"], "stale_hours": r["stale_hours"]}
+            for r in rounds if r["source"] == "carried" and (
+                r["stale_hours"] is None  # unstamped age: fail closed
+                or r["stale_hours"] > float(max_carried_age_h)
+            )
+        ]
+        out["max_carried_age_h"] = float(max_carried_age_h)
+        out["stale_carried"] = stale
+        out["checks"]["carried_age_ok"] = not stale
+    return out
 
 
 # ----------------------------------------------------------- fleet report
@@ -620,5 +656,89 @@ def read_serve_sweep(path: str) -> dict:
             "all_warm": all(
                 r["cold_compiles_after_warmup"] == 0 for r in rows
             ),
+        },
+    }
+
+
+# ------------------------------------------------------------ live tune
+
+
+def read_live_tune_report(path: str) -> dict:
+    """Reduce a ``live_tune_report/v1`` document
+    (scripts/live_tune_probe.py output) to the rc-gating fields: the
+    disabled-mode bitwise-identity pin, the shadow-fraction (<1% of
+    steady-state device seconds) and budget bounds, the
+    promotion-speedup + zero-hot-path-cold-compiles evidence, the
+    anomaly-demotion pin with its recorded cause, and the
+    decision-log replay-consistency check.
+
+    Returns ``{"summary": ..., "checks": {...}}`` or ``{"error": ...}``
+    when the file holds no readable report."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError as e:
+        return {"error": f"unreadable live tune report {path}: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for ln in text.splitlines():  # JSONL fallback: first valid line
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        return {"error": f"no JSON document in {path}"}
+    if "error" in doc:
+        return {"error": f"live tune report is an error record: "
+                         f"{doc['error']}"}
+    checks = doc.get("checks")
+    if not isinstance(checks, dict):
+        return {"error": f"no checks section in {path}"}
+    tuner = doc.get("tuner") or {}
+    counters = tuner.get("counters") or {}
+    summary = doc.get("summary") or {}
+    decisions = tuner.get("decisions") or ()
+    fraction = summary.get("shadow_fraction")
+    return {
+        "summary": {
+            "device_kind": doc.get("device_kind"),
+            "knob": tuner.get("knob"),
+            "incumbent": tuner.get("incumbent"),
+            "shadow_runs": counters.get("shadow_runs"),
+            "shadow_device_s": counters.get("shadow_device_s"),
+            "shadow_fraction": fraction,
+            "promotions": counters.get("promotions"),
+            "demotions": counters.get("demotions"),
+            "refusals": counters.get("refusals"),
+            "decisions": len(decisions)
+            if isinstance(decisions, list) else None,
+            "demote_cause": summary.get("demote_cause"),
+            "promotion_speedup": summary.get("promotion_speedup"),
+        },
+        "checks": {
+            # fail CLOSED: a missing/garbled field is NOT a pass
+            "disabled_identical": checks.get("disabled_identical")
+            is True,
+            "shadow_fraction_ok": bool(
+                checks.get("shadow_fraction_ok") is True
+                and isinstance(fraction, (int, float))
+                and fraction < 0.01
+            ),
+            "budget_respected": checks.get("budget_respected") is True,
+            "promoted_decisively": checks.get("promoted_decisively")
+            is True,
+            "promotion_faster": checks.get("promotion_faster") is True,
+            "no_hot_path_compiles": checks.get("no_hot_path_compiles")
+            is True,
+            "anomaly_demotes": bool(
+                checks.get("anomaly_demotes") is True
+                and summary.get("demote_cause")
+            ),
+            "replay_consistent": checks.get("replay_consistent")
+            is True,
+            "bank_isolated": checks.get("bank_isolated") is True,
         },
     }
